@@ -1,0 +1,27 @@
+"""mpi_operator_tpu: a TPU-native distributed-training job framework.
+
+A brand-new framework with the capability surface of the Kubeflow MPI Operator
+(reference: /root/reference, kubeflow/mpi-operator), redesigned TPU-first:
+
+- Declarative ``TPUJob`` resource (≙ MPIJob, v2/pkg/apis/kubeflow/v2beta1/types.go)
+  with defaulting, validation, and a Created/Running/Restarting/Succeeded/Failed
+  condition state machine.
+- A level-triggered controller/reconciler (≙ v2/pkg/controller/mpi_job_controller.go)
+  that materializes headless services, job config, gang-scheduled worker pods and
+  mirrors pod phases into job status.
+- A multi-host runtime layer replacing mpirun/SSH/hostfiles with coordinator
+  rendezvous (``jax.distributed``-style) and XLA collectives over ICI/DCN
+  (≙ the OpenMPI/Intel/MPICH + Horovod/NCCL stack the reference delegates to).
+- A workload library (data-parallel trainer, ResNet/MNIST/Llama models, ring
+  attention sequence parallelism) replacing the reference's Horovod examples.
+- Native C++ components (TCP collective runtime + pi smoke test,
+  ≙ examples/pi/pi.cc) under native/.
+"""
+
+__version__ = "0.1.0"
+
+# Single source of truth for the API group/kind lives in api.types; re-exported
+# here for convenience.
+from mpi_operator_tpu.api.types import API_VERSION, KIND_TPUJOB  # noqa: E402
+
+GROUP = API_VERSION.split("/", 1)[0]

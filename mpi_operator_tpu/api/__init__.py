@@ -1,0 +1,31 @@
+"""Job API layer: types, defaulting, validation, condition state machine.
+
+Capability parity with the reference API packages:
+- types:      /root/reference/v2/pkg/apis/kubeflow/v2beta1/types.go
+- defaults:   /root/reference/v2/pkg/apis/kubeflow/v2beta1/default.go
+- validation: /root/reference/v2/pkg/apis/kubeflow/validation/validation.go
+- conditions: /root/reference/v2/pkg/controller/mpi_job_controller_status.go
+"""
+
+from mpi_operator_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    Condition,
+    ConditionType,
+    Container,
+    ElasticPolicy,
+    JobStatus,
+    ObjectMeta,
+    PodTemplate,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SliceSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from mpi_operator_tpu.api.defaults import set_defaults  # noqa: F401
+from mpi_operator_tpu.api.validation import ValidationError, validate_tpujob  # noqa: F401
+from mpi_operator_tpu.api import conditions  # noqa: F401

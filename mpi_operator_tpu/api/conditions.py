@@ -1,0 +1,146 @@
+"""Job condition state machine.
+
+≙ /root/reference/v2/pkg/controller/mpi_job_controller_status.go:
+  updateMPIJobConditions (:49), newCondition (:62), getCondition (:73),
+  isFinished/isSucceeded/isFailed/isEvicted (:85-106), setCondition (:111),
+  filterOutCondition (:131-153).
+
+Semantics preserved exactly:
+- Setting a condition with the same (type, status, reason) as the current one
+  is a no-op (no timestamp churn).
+- Same (type, status) but new reason/message keeps last_transition_time.
+- Setting Running removes any Restarting condition and vice versa.
+- Setting Succeeded/Failed flips an existing Running condition to status=False
+  (the job keeps a record that it *was* running).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from mpi_operator_tpu.api.types import Condition, ConditionType, JobStatus
+
+# Reason strings, ≙ the constants used across the reference controller
+# (mpi_job_controller.go: mpiJobCreatedReason etc. and status.go usage).
+REASON_CREATED = "TPUJobCreated"
+REASON_RUNNING = "TPUJobRunning"
+REASON_RESTARTING = "TPUJobRestarting"
+REASON_SUSPENDED = "TPUJobSuspended"
+REASON_RESUMED = "TPUJobResumed"
+REASON_SUCCEEDED = "TPUJobSucceeded"
+REASON_FAILED = "TPUJobFailed"
+REASON_EVICTED = "TPUJobEvicted"
+REASON_BACKOFF = "TPUJobBackoffLimitExceeded"
+REASON_DEADLINE = "TPUJobDeadlineExceeded"
+
+
+def get_condition(status: JobStatus, ctype: str) -> Optional[Condition]:
+    for c in status.conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def _filter_out(conditions: List[Condition], ctype: str) -> List[Condition]:
+    """≙ filterOutCondition (status.go:131-153)."""
+    out: List[Condition] = []
+    for c in conditions:
+        if c.type == ctype:
+            continue
+        if ctype == ConditionType.RESTARTING and c.type == ConditionType.RUNNING:
+            continue
+        if ctype == ConditionType.RUNNING and c.type == ConditionType.RESTARTING:
+            continue
+        if ctype in (ConditionType.RESTARTING, ConditionType.RUNNING) and c.type in (
+            ConditionType.FAILED,
+            ConditionType.SUCCEEDED,
+        ):
+            # a job that is (re)starting is no longer terminal: keep the
+            # Failed/Succeeded record but flip it inactive so is_finished()
+            # turns false again while the retry runs
+            c.status = False
+        if ctype in (ConditionType.SUCCEEDED, ConditionType.FAILED) and c.type in (
+            ConditionType.RUNNING,
+            ConditionType.SUCCEEDED,
+            ConditionType.FAILED,
+        ):
+            # terminal condition supersedes Running and any *prior* opposite
+            # terminal state (a restarted-then-succeeded job must not keep
+            # reporting Failed=True), ≙ status.go:146
+            c.status = False
+        out.append(c)
+    return out
+
+
+def set_condition(status: JobStatus, cond: Condition) -> bool:
+    """≙ setCondition (status.go:111-128). Returns True if status changed."""
+    current = get_condition(status, cond.type)
+    if (
+        current is not None
+        and current.status == cond.status
+        and current.reason == cond.reason
+    ):
+        return False
+    if current is not None and current.status == cond.status:
+        cond.last_transition_time = current.last_transition_time
+    status.conditions = _filter_out(status.conditions, cond.type) + [cond]
+    return True
+
+
+def update_job_conditions(
+    status: JobStatus, ctype: str, reason: str, message: str, active: bool = True
+) -> bool:
+    """≙ updateMPIJobConditions (status.go:49-59)."""
+    return set_condition(status, Condition.new(ctype, active, reason, message))
+
+
+def has_condition(status: JobStatus, ctype: str) -> bool:
+    c = get_condition(status, ctype)
+    return c is not None and c.status
+
+
+def is_created(status: JobStatus) -> bool:
+    return has_condition(status, ConditionType.CREATED)
+
+
+def is_running(status: JobStatus) -> bool:
+    return has_condition(status, ConditionType.RUNNING)
+
+
+def is_suspended(status: JobStatus) -> bool:
+    return has_condition(status, ConditionType.SUSPENDED)
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, ConditionType.SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, ConditionType.FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    """≙ isFinished (status.go:85-87)."""
+    return is_succeeded(status) or is_failed(status)
+
+
+def is_evicted(status: JobStatus) -> bool:
+    """≙ isEvicted (status.go:99-106): failed with the eviction reason."""
+    c = get_condition(status, ConditionType.FAILED)
+    return c is not None and c.status and c.reason == REASON_EVICTED
+
+
+def ensure_timestamps(status: JobStatus) -> None:
+    """Set start/completion timestamps from condition flips (the reference sets
+    StartTime at Created, syncHandler :532-543, and CompletionTime on
+    terminal conditions, updateMPIJobStatus :921-996). A restart un-finishes
+    the job, so a stale completion_time is dropped until it finishes again."""
+    now = time.time()
+    if status.start_time is None and is_created(status):
+        status.start_time = now
+    if is_finished(status):
+        if status.completion_time is None:
+            status.completion_time = now
+    else:
+        status.completion_time = None

@@ -1,0 +1,59 @@
+"""Defaulting for TPUJob.
+
+≙ the registered scheme defaulters the reference controller applies at the top
+of every reconcile (scheme.Scheme.Default(mpiJob), v2/pkg/controller/
+mpi_job_controller.go:475; defaults defined in
+/root/reference/v2/pkg/apis/kubeflow/v2beta1/default.go:52-71):
+
+reference defaults                       → TPU-native defaults
+-----------------------------------------------------------------------------
+CleanPodPolicy = None                    → same
+SlotsPerWorker = 1                       → same (chips per host)
+SSHAuthMountPath = /root/.ssh            → (no SSH on TPU; dropped)
+MPIImplementation = OpenMPI              → slice.accelerator = "cpu" test backend
+launcher replicas = 1, worker = 0        → worker replicas = 1 (launcher-less;
+                                           a 0-worker SPMD job is meaningless)
+RestartPolicy (common default Never)     → same
+"""
+
+from __future__ import annotations
+
+from mpi_operator_tpu.api.types import (
+    CleanPodPolicy,
+    RestartPolicy,
+    TPUJob,
+)
+
+DEFAULT_SLOTS_PER_WORKER = 1
+DEFAULT_WORKER_REPLICAS = 1
+DEFAULT_RESTART_POLICY = RestartPolicy.NEVER
+DEFAULT_ACCELERATOR = "cpu"
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Mutates ``job`` in place, filling unset fields; returns it for chaining.
+
+    Idempotent, like the reference's defaulters (default_test.go asserts
+    set-fields are preserved; see tests/test_api_defaults.py).
+    """
+    spec = job.spec
+    if spec.slots_per_worker is None:
+        spec.slots_per_worker = DEFAULT_SLOTS_PER_WORKER
+    if spec.run_policy.clean_pod_policy is None:
+        spec.run_policy.clean_pod_policy = CleanPodPolicy.NONE
+    if spec.worker.replicas is None:
+        spec.worker.replicas = DEFAULT_WORKER_REPLICAS
+    if spec.worker.restart_policy is None:
+        spec.worker.restart_policy = DEFAULT_RESTART_POLICY
+    if not spec.slice.accelerator:
+        spec.slice.accelerator = DEFAULT_ACCELERATOR
+    # slots_per_worker is the user knob; chips_per_host follows it only when
+    # genuinely unset (None), so an explicit chips_per_host=1 is preserved.
+    if spec.slice.chips_per_host is None:
+        spec.slice.chips_per_host = spec.slots_per_worker
+    if spec.elastic is not None:
+        if spec.elastic.min_replicas is None:
+            spec.elastic.min_replicas = 1
+        if spec.elastic.max_replicas is None:
+            spec.elastic.max_replicas = spec.worker.replicas
+    return job

@@ -1,0 +1,502 @@
+"""TPUJob API types.
+
+Capability parity with the reference MPIJob v2beta1 API
+(/root/reference/v2/pkg/apis/kubeflow/v2beta1/types.go:25-80), redesigned for TPU:
+
+* **Launcher-less SPMD.** The reference models jobs as 1 Launcher (runs
+  ``mpirun``) + N Workers (run ``sshd``) because MPI spawns ranks from a single
+  point (types.go:59-67 ``MPIReplicaSpecs{Launcher,Worker}``). On TPU every
+  host boots the *same* program and rendezvouses with a coordinator
+  (``jax.distributed.initialize``), so ``TPUJobSpec`` has only a Worker replica
+  spec; worker 0 doubles as the coordinator. Status semantics the reference
+  derives from the launcher pod (Succeeded/Failed mirroring) are derived from
+  worker 0 here — the mapping is documented on ``ReplicaType``.
+* **slotsPerWorker → chips per host.** The reference's ``SlotsPerWorker``
+  (types.go:44-47) counts MPI slots per pod; here it is the number of TPU
+  chips attached to each host, which together with ``SliceSpec`` determines
+  the global device mesh.
+* **MPIImplementation (OpenMPI/Intel, types.go:74-79) has no TPU analogue** —
+  the collective fabric is XLA over ICI/DCN; instead ``SliceSpec`` captures
+  the slice topology the mesh is built from.
+
+Everything is a plain dataclass with ``to_dict``/``from_dict`` so job specs can
+round-trip through YAML/JSON manifests (≙ the CRD structural schema,
+/root/reference/manifests/base/crd.yaml:15-197) and the Python SDK
+(≙ /root/reference/sdk/python/mpijob/models/).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+API_VERSION = "tpujob.dev/v1"
+KIND_TPUJOB = "TPUJob"
+
+
+# ---------------------------------------------------------------------------
+# Enums (plain str constants: keeps YAML round-trip trivial)
+# ---------------------------------------------------------------------------
+
+class CleanPodPolicy:
+    """What to do with worker pods when the job finishes.
+
+    ≙ common.CleanPodPolicy used by MPIJobSpec.CleanPodPolicy
+    (reference v2beta1/types.go:49-53; enforcement in
+    v2/pkg/controller/mpi_job_controller.go:492-530).
+    """
+
+    NONE = "None"
+    RUNNING = "Running"
+    ALL = "All"
+
+    ALL_VALUES = (NONE, RUNNING, ALL)
+
+
+class RestartPolicy:
+    """Per-replica restart policy.
+
+    ≙ common.RestartPolicy; the reference maps EXIT_CODE to pod policy Never so
+    the controller owns restart semantics
+    (v2/pkg/controller/mpi_job_controller.go:1394-1400).
+    """
+
+    NEVER = "Never"
+    ON_FAILURE = "OnFailure"
+    ALWAYS = "Always"
+    EXIT_CODE = "ExitCode"
+
+    ALL_VALUES = (NEVER, ON_FAILURE, ALWAYS, EXIT_CODE)
+
+
+class ReplicaType:
+    """Replica roles.
+
+    The reference has Launcher + Worker (v2beta1/types.go:82-90). TPU jobs are
+    SPMD: every host runs the same program, so there is a single Worker type and
+    **worker 0 is the coordinator** (rendezvous server + the pod whose exit
+    status is mirrored into job success/failure, the role the launcher pod's
+    exit status plays in updateMPIJobStatus,
+    v2/pkg/controller/mpi_job_controller.go:921-996).
+    """
+
+    WORKER = "Worker"
+
+    ALL_VALUES = (WORKER,)
+
+
+class ConditionType:
+    """Job condition types — same state machine as the reference
+    (v2/pkg/controller/mpi_job_controller_status.go:49-153 + common.JobStatus):
+    Created → Running → (Restarting ↔ Running) → Succeeded | Failed,
+    plus Suspended (run policy)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUSPENDED = "Suspended"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    ALL_VALUES = (CREATED, RUNNING, RESTARTING, SUSPENDED, SUCCEEDED, FAILED)
+
+
+# ---------------------------------------------------------------------------
+# Serialization helpers
+# ---------------------------------------------------------------------------
+
+def _prune(d: Any) -> Any:
+    """Drop None values / empty containers recursively for compact manifests.
+
+    Children are pruned *first* so a nested object whose members all prune away
+    collapses to nothing rather than surviving as ``{}`` (which would break the
+    to_dict/from_dict round-trip)."""
+    if isinstance(d, dict):
+        out = {}
+        for k, v in d.items():
+            pv = _prune(v)
+            if pv is None or pv == {} or pv == []:
+                continue
+            out[k] = pv
+        return out
+    if isinstance(d, list):
+        return [_prune(v) for v in d]
+    return d
+
+
+class _Dictable:
+    def to_dict(self) -> Dict[str, Any]:
+        return _prune(dataclasses.asdict(self))
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Metadata (≙ k8s ObjectMeta, the subset the reference controller touches)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OwnerReference(_Dictable):
+    api_version: str = API_VERSION
+    kind: str = KIND_TPUJOB
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "OwnerReference":
+        return OwnerReference(
+            api_version=d.get("api_version", API_VERSION),
+            kind=d.get("kind", KIND_TPUJOB),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=d.get("controller", True),
+        )
+
+
+@dataclass
+class ObjectMeta(_Dictable):
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ObjectMeta":
+        return ObjectMeta(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=d.get("resource_version", 0),
+            generation=d.get("generation", 0),
+            labels=dict(d.get("labels", {})),
+            annotations=dict(d.get("annotations", {})),
+            owner_references=[OwnerReference.from_dict(o) for o in d.get("owner_references", [])],
+            creation_timestamp=d.get("creation_timestamp"),
+            deletion_timestamp=d.get("deletion_timestamp"),
+        )
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Pod template (the subset of corev1.PodTemplateSpec the framework schedules)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Container(_Dictable):
+    """Main container of a worker pod.
+
+    ≙ the ReplicaSpec.Template containers the reference passes through to pods
+    (v2/pkg/controller/mpi_job_controller.go:1246-1296 newWorker). ``resources``
+    uses the TPU-native resource name ``tpu`` (≙ google.com/tpu) where the
+    reference examples request nvidia.com/gpu."""
+
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: Dict[str, float] = field(default_factory=dict)
+    working_dir: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Container":
+        return Container(
+            image=d.get("image", ""),
+            command=list(d.get("command", [])),
+            args=list(d.get("args", [])),
+            env=dict(d.get("env", {})),
+            resources=dict(d.get("resources", {})),
+            working_dir=d.get("working_dir", ""),
+        )
+
+
+@dataclass
+class PodTemplate(_Dictable):
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    container: Container = field(default_factory=Container)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = ""
+    priority_class: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodTemplate":
+        return PodTemplate(
+            labels=dict(d.get("labels", {})),
+            annotations=dict(d.get("annotations", {})),
+            container=Container.from_dict(d.get("container", {})),
+            node_selector=dict(d.get("node_selector", {})),
+            scheduler_name=d.get("scheduler_name", ""),
+            priority_class=d.get("priority_class", ""),
+        )
+
+
+@dataclass
+class ReplicaSpec(_Dictable):
+    """≙ common.ReplicaSpec (replicas + template + restartPolicy) used by
+    MPIReplicaSpecs (reference v2beta1/types.go:59-67)."""
+
+    replicas: Optional[int] = None
+    restart_policy: Optional[str] = None
+    template: PodTemplate = field(default_factory=PodTemplate)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ReplicaSpec":
+        return ReplicaSpec(
+            replicas=d.get("replicas"),
+            restart_policy=d.get("restart_policy"),
+            template=PodTemplate.from_dict(d.get("template", {})),
+        )
+
+
+# ---------------------------------------------------------------------------
+# TPU-specific spec pieces
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SliceSpec(_Dictable):
+    """TPU slice request — the TPU-native replacement for the reference's
+    implicit "cluster shape" (hostfile slots, v2/pkg/controller/
+    mpi_job_controller.go:1088-1113).
+
+    ``accelerator`` names the slice family (e.g. ``v5p``, ``v5e``, or ``cpu``
+    for the multiprocess CPU test backend, §4 of SURVEY.md). ``topology`` is
+    the ICI mesh shape (e.g. ``4x4x4``); empty means derive from worker count.
+    ``chips_per_host`` is fixed per family (4 for v5p hosts); ``None`` means
+    "derive from slots_per_worker" at defaulting time.
+    """
+
+    accelerator: str = "cpu"
+    topology: str = ""
+    chips_per_host: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "SliceSpec":
+        return SliceSpec(
+            accelerator=d.get("accelerator", "cpu"),
+            topology=d.get("topology", ""),
+            chips_per_host=d.get("chips_per_host"),
+        )
+
+
+@dataclass
+class ElasticPolicy(_Dictable):
+    """Elastic worker membership bounds.
+
+    ≙ horovodrun ``-np/--min-np/--max-np`` driven by the controller-published
+    discover_hosts.sh (reference examples/horovod/tensorflow-mnist-elastic.yaml:20-27,
+    v2/pkg/controller/mpi_job_controller.go:1116-1138)."""
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ElasticPolicy":
+        return ElasticPolicy(
+            min_replicas=d.get("min_replicas"), max_replicas=d.get("max_replicas")
+        )
+
+
+@dataclass
+class SchedulingPolicy(_Dictable):
+    """Gang-scheduling knobs. ≙ common.SchedulingPolicy consumed by newPodGroup
+    (reference v2/pkg/controller/mpi_job_controller.go:1215-1237)."""
+
+    min_available: Optional[int] = None
+    queue: str = ""
+    priority_class: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "SchedulingPolicy":
+        return SchedulingPolicy(
+            min_available=d.get("min_available"),
+            queue=d.get("queue", ""),
+            priority_class=d.get("priority_class", ""),
+        )
+
+
+@dataclass
+class RunPolicy(_Dictable):
+    """≙ common.RunPolicy (declared in reference v1 types.go:55-62 and
+    implemented in v1alpha2 via batch Jobs). The reference v2 controller never
+    implements backoffLimit/activeDeadlineSeconds (SURVEY.md §5.3); this
+    framework does, in the controller."""
+
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+    suspend: bool = False
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "RunPolicy":
+        sp = d.get("scheduling_policy")
+        return RunPolicy(
+            clean_pod_policy=d.get("clean_pod_policy"),
+            ttl_seconds_after_finished=d.get("ttl_seconds_after_finished"),
+            active_deadline_seconds=d.get("active_deadline_seconds"),
+            backoff_limit=d.get("backoff_limit"),
+            scheduling_policy=SchedulingPolicy.from_dict(sp) if sp else None,
+            suspend=d.get("suspend", False),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Spec / Status / TPUJob
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TPUJobSpec(_Dictable):
+    """≙ MPIJobSpec (reference v2beta1/types.go:40-80) minus launcher/SSH/MPI
+    implementation fields, plus slice topology + elastic policy."""
+
+    slots_per_worker: Optional[int] = None
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    worker: ReplicaSpec = field(default_factory=ReplicaSpec)
+    slice: SliceSpec = field(default_factory=SliceSpec)
+    elastic: Optional[ElasticPolicy] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TPUJobSpec":
+        el = d.get("elastic")
+        return TPUJobSpec(
+            slots_per_worker=d.get("slots_per_worker"),
+            run_policy=RunPolicy.from_dict(d.get("run_policy", {})),
+            worker=ReplicaSpec.from_dict(d.get("worker", {})),
+            slice=SliceSpec.from_dict(d.get("slice", {})),
+            elastic=ElasticPolicy.from_dict(el) if el else None,
+        )
+
+
+@dataclass
+class Condition(_Dictable):
+    """≙ common.JobCondition (type/status/reason/message/timestamps)."""
+
+    type: str = ""
+    status: bool = False
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = 0.0
+    last_transition_time: float = 0.0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Condition":
+        return Condition(
+            type=d.get("type", ""),
+            status=bool(d.get("status", False)),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=d.get("last_update_time", 0.0),
+            last_transition_time=d.get("last_transition_time", 0.0),
+        )
+
+    @staticmethod
+    def new(ctype: str, status: bool, reason: str, message: str) -> "Condition":
+        now = time.time()
+        return Condition(ctype, status, reason, message, now, now)
+
+
+@dataclass
+class ReplicaStatus(_Dictable):
+    """≙ common.ReplicaStatus: per-replica-type pod phase counts
+    (reference updateMPIJobStatus, v2/pkg/controller/mpi_job_controller.go:921-996)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    evicted: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ReplicaStatus":
+        return ReplicaStatus(
+            active=d.get("active", 0),
+            succeeded=d.get("succeeded", 0),
+            failed=d.get("failed", 0),
+            evicted=d.get("evicted", 0),
+        )
+
+
+@dataclass
+class JobStatus(_Dictable):
+    """≙ common.JobStatus (conditions + replica statuses + timestamps)."""
+
+    conditions: List[Condition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+    restart_count: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "JobStatus":
+        return JobStatus(
+            conditions=[Condition.from_dict(c) for c in d.get("conditions", [])],
+            replica_statuses={
+                k: ReplicaStatus.from_dict(v) for k, v in d.get("replica_statuses", {}).items()
+            },
+            start_time=d.get("start_time"),
+            completion_time=d.get("completion_time"),
+            last_reconcile_time=d.get("last_reconcile_time"),
+            restart_count=d.get("restart_count", 0),
+        )
+
+
+@dataclass
+class TPUJob(_Dictable):
+    api_version: str = API_VERSION
+    kind: str = KIND_TPUJOB
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TPUJob":
+        return TPUJob(
+            api_version=d.get("api_version", d.get("apiVersion", API_VERSION)),
+            kind=d.get("kind", KIND_TPUJOB),
+            metadata=ObjectMeta.from_dict(d.get("metadata", {})),
+            spec=TPUJobSpec.from_dict(d.get("spec", {})),
+            status=JobStatus.from_dict(d.get("status", {})),
+        )
+
+    # -- naming helpers (≙ the name builders scattered through the reference
+    #    controller, e.g. workerName mpi_job_controller.go:1246, svc :1141) --
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def worker_name(self, index: int) -> str:
+        return f"{self.metadata.name}-worker-{index}"
+
+    def service_name(self) -> str:
+        return f"{self.metadata.name}-worker"
+
+    def config_name(self) -> str:
+        return f"{self.metadata.name}-config"
+
+    def podgroup_name(self) -> str:
+        return self.metadata.name
+
+    def worker_hostname(self, index: int) -> str:
+        """Stable DNS name behind the headless service, ≙ the hostfile entries
+        `<job>-worker-i.<job>-worker` (reference newConfigMap,
+        v2/pkg/controller/mpi_job_controller.go:1088-1113)."""
+        return f"{self.worker_name(index)}.{self.service_name()}"

@@ -1,0 +1,182 @@
+"""Validation for TPUJob.
+
+≙ /root/reference/v2/pkg/apis/kubeflow/validation/validation.go:41-128, which
+checks (a) the *worst-case generated pod hostname* is a valid DNS-1035 label
+(:47-60), (b) enum membership for cleanPodPolicy and mpiImplementation
+(:69-79), (c) launcher replicas == 1 (:101-103) and workers >= 1 (:113).
+
+TPU translation: there is no launcher (rule (c) first half vanishes); workers
+>= 1 stays; the enum checks cover CleanPodPolicy / RestartPolicy / accelerator;
+and we add slice-topology coherence (topology product must equal
+workers x chips_per_host) which has no reference analogue because the MPI
+cluster shape was never declared, only discovered from the hostfile.
+
+Errors are accumulated field-path style like Go's field.ErrorList
+(validation_test.go is table-driven over field paths; tests mirror that).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from mpi_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    RestartPolicy,
+    TPUJob,
+)
+
+# DNS-1035 label: lowercase alphanumeric + '-', must start with a letter,
+# max 63 chars (same rule the reference borrows from apimachinery, :47-60).
+_DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+_MAX_LABEL = 63
+
+# Accelerator families the runtime can build a mesh for ("cpu" = the
+# multiprocess CPU test backend of SURVEY.md §4/§7.1).
+KNOWN_ACCELERATORS = {"cpu", "v4", "v5e", "v5p", "v6e"}
+
+
+class ValidationError(ValueError):
+    """Carries the accumulated field errors."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("; ".join(errors))
+
+
+def _validate_topology(topology: str) -> Optional[List[int]]:
+    if not re.fullmatch(r"\d+(x\d+)*", topology):
+        return None
+    return [int(p) for p in topology.split("x")]
+
+
+def validate_tpujob(job: TPUJob) -> List[str]:
+    """Returns a list of field-path error strings; empty means valid."""
+    errs: List[str] = []
+    spec = job.spec
+
+    # --- metadata / generated-hostname rule (≙ validation.go:47-60) ---
+    name = job.metadata.name
+    if not name:
+        errs.append("metadata.name: required")
+    else:
+        replicas = spec.worker.replicas or 1
+        worst = job.worker_name(max(replicas - 1, 0))
+        if not _DNS1035.match(worst) or len(worst) > _MAX_LABEL:
+            errs.append(
+                f"metadata.name: generated pod hostname {worst!r} is not a valid "
+                f"DNS-1035 label (lowercase alphanumeric/'-', start with letter, "
+                f"<= {_MAX_LABEL} chars)"
+            )
+
+    # --- slots (≙ validation.go: SlotsPerWorker required/positive) ---
+    if spec.slots_per_worker is None:
+        errs.append("spec.slots_per_worker: required")
+    elif spec.slots_per_worker < 1:
+        errs.append("spec.slots_per_worker: must be >= 1")
+
+    # --- enums (≙ validation.go:69-79) ---
+    cpp = spec.run_policy.clean_pod_policy
+    if cpp is None:
+        errs.append("spec.run_policy.clean_pod_policy: required")
+    elif cpp not in CleanPodPolicy.ALL_VALUES:
+        errs.append(
+            f"spec.run_policy.clean_pod_policy: unsupported value {cpp!r}, "
+            f"expected one of {list(CleanPodPolicy.ALL_VALUES)}"
+        )
+    rp = spec.worker.restart_policy
+    if rp is not None and rp not in RestartPolicy.ALL_VALUES:
+        errs.append(
+            f"spec.worker.restart_policy: unsupported value {rp!r}, "
+            f"expected one of {list(RestartPolicy.ALL_VALUES)}"
+        )
+    acc = spec.slice.accelerator
+    if acc and acc not in KNOWN_ACCELERATORS:
+        # ≙ the MPIImplementation enum check (validation.go:69-79): reject
+        # unknown fabric families at admission, not at mesh-construction time.
+        errs.append(
+            f"spec.slice.accelerator: unsupported value {acc!r}, "
+            f"expected one of {sorted(KNOWN_ACCELERATORS)}"
+        )
+
+    # --- replicas (≙ validation.go:113 workers >= 1; launcher rule N/A) ---
+    if spec.worker.replicas is None:
+        errs.append("spec.worker.replicas: required")
+    elif spec.worker.replicas < 1:
+        errs.append("spec.worker.replicas: must be >= 1")
+
+    # --- run policy numerics ---
+    if (
+        spec.run_policy.backoff_limit is not None
+        and spec.run_policy.backoff_limit < 0
+    ):
+        errs.append("spec.run_policy.backoff_limit: must be >= 0")
+    if (
+        spec.run_policy.active_deadline_seconds is not None
+        and spec.run_policy.active_deadline_seconds < 0
+    ):
+        errs.append("spec.run_policy.active_deadline_seconds: must be >= 0")
+    if (
+        spec.run_policy.ttl_seconds_after_finished is not None
+        and spec.run_policy.ttl_seconds_after_finished < 0
+    ):
+        errs.append("spec.run_policy.ttl_seconds_after_finished: must be >= 0")
+
+    # --- slice coherence (TPU-specific; no reference analogue) ---
+    # slots_per_worker (the reference-parity user knob, types.go:44-47) and
+    # slice.chips_per_host (what mesh construction reads) name the same
+    # physical quantity; when both are set they must agree — divergence has no
+    # physical meaning and would split consumers across two truths.
+    cph = spec.slice.chips_per_host
+    if cph is not None and cph < 1:
+        errs.append("spec.slice.chips_per_host: must be >= 1")
+    elif cph is not None and spec.slots_per_worker and cph != spec.slots_per_worker:
+        errs.append(
+            f"spec.slice.chips_per_host: {cph} disagrees with "
+            f"spec.slots_per_worker = {spec.slots_per_worker}; they name the "
+            f"same quantity (chips per host) — set one or make them equal"
+        )
+    if spec.slice.topology:
+        dims = _validate_topology(spec.slice.topology)
+        per_host = cph if cph is not None else spec.slots_per_worker
+        if dims is None:
+            errs.append(
+                f"spec.slice.topology: malformed {spec.slice.topology!r}, "
+                f"expected e.g. '4x4x4'"
+            )
+        elif spec.worker.replicas and per_host:
+            chips = 1
+            for d in dims:
+                chips *= d
+            want = spec.worker.replicas * per_host
+            if chips != want:
+                errs.append(
+                    f"spec.slice.topology: topology {spec.slice.topology!r} has "
+                    f"{chips} chips but workers x chips_per_host = {want}"
+                )
+
+    # --- elastic bounds (≙ horovod -np/min-np/max-np sanity) ---
+    el: Optional[ElasticPolicy] = spec.elastic
+    if el is not None:
+        if el.min_replicas is not None and el.min_replicas < 1:
+            errs.append("spec.elastic.min_replicas: must be >= 1")
+        if (
+            el.min_replicas is not None
+            and el.max_replicas is not None
+            and el.min_replicas > el.max_replicas
+        ):
+            errs.append("spec.elastic: min_replicas must be <= max_replicas")
+        if spec.worker.replicas:
+            if el.max_replicas is not None and spec.worker.replicas > el.max_replicas:
+                errs.append("spec.worker.replicas: must be <= spec.elastic.max_replicas")
+            if el.min_replicas is not None and spec.worker.replicas < el.min_replicas:
+                errs.append("spec.worker.replicas: must be >= spec.elastic.min_replicas")
+
+    return errs
+
+
+def validate_or_raise(job: TPUJob) -> None:
+    errs = validate_tpujob(job)
+    if errs:
+        raise ValidationError(errs)

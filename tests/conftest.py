@@ -1,0 +1,19 @@
+"""Test configuration.
+
+All tests run on a virtual 8-device CPU mesh (the envtest-equivalent trick from
+SURVEY.md §4: real semantics, no TPU hardware) — JAX must see the flags before
+first import, so they are set at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,72 @@
+"""Defaulting tests, ≙ /root/reference/v2/pkg/apis/kubeflow/v2beta1/default_test.go
+(table-driven: unset fields get defaults, set fields are preserved)."""
+
+from mpi_operator_tpu.api import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    ObjectMeta,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+    set_defaults,
+)
+
+
+def test_empty_spec_gets_all_defaults():
+    job = set_defaults(TPUJob(metadata=ObjectMeta(name="j")))
+    assert job.spec.slots_per_worker == 1
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.NONE
+    assert job.spec.worker.replicas == 1
+    assert job.spec.worker.restart_policy == RestartPolicy.NEVER
+    assert job.spec.slice.accelerator == "cpu"
+    assert job.spec.slice.chips_per_host == 1
+
+
+def test_set_fields_preserved():
+    job = TPUJob(
+        metadata=ObjectMeta(name="j"),
+        spec=TPUJobSpec(
+            slots_per_worker=4,
+            run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.ALL),
+            worker=ReplicaSpec(replicas=8, restart_policy=RestartPolicy.ON_FAILURE),
+        ),
+    )
+    set_defaults(job)
+    assert job.spec.slots_per_worker == 4
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
+    assert job.spec.worker.replicas == 8
+    assert job.spec.worker.restart_policy == RestartPolicy.ON_FAILURE
+    # chips_per_host follows slots_per_worker when left at its default
+    assert job.spec.slice.chips_per_host == 4
+
+
+def test_idempotent():
+    job = set_defaults(TPUJob(metadata=ObjectMeta(name="j")))
+    snap = job.to_dict()
+    set_defaults(job)
+    assert job.to_dict() == snap
+
+
+def test_elastic_defaults():
+    job = TPUJob(
+        metadata=ObjectMeta(name="j"),
+        spec=TPUJobSpec(worker=ReplicaSpec(replicas=4), elastic=ElasticPolicy()),
+    )
+    set_defaults(job)
+    assert job.spec.elastic.min_replicas == 1
+    assert job.spec.elastic.max_replicas == 4
+
+
+def test_explicit_chips_per_host_preserved():
+    from mpi_operator_tpu.api import SliceSpec
+
+    job = TPUJob(
+        metadata=ObjectMeta(name="j"),
+        spec=TPUJobSpec(
+            slots_per_worker=4, slice=SliceSpec(accelerator="v5p", chips_per_host=1)
+        ),
+    )
+    set_defaults(job)
+    assert job.spec.slice.chips_per_host == 1  # explicit value survives

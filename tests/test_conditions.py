@@ -1,0 +1,107 @@
+"""Condition state machine tests.
+
+≙ the condition assertions embedded throughout the reference controller tests
+(TestLauncherSucceeded/Failed, v2/pkg/controller/mpi_job_controller_test.go:526,562)
+and the setCondition/filterOutCondition semantics of
+mpi_job_controller_status.go:111-153."""
+
+from mpi_operator_tpu.api import ConditionType, JobStatus
+from mpi_operator_tpu.api import conditions as cond
+
+
+def test_created_then_running():
+    st = JobStatus()
+    assert cond.update_job_conditions(
+        st, ConditionType.CREATED, cond.REASON_CREATED, "created"
+    )
+    cond.ensure_timestamps(st)
+    assert st.start_time is not None
+    assert cond.is_created(st)
+    assert not cond.is_finished(st)
+
+    cond.update_job_conditions(st, ConditionType.RUNNING, cond.REASON_RUNNING, "go")
+    assert cond.is_running(st)
+    # Created stays in the list (history preserved)
+    assert cond.is_created(st)
+
+
+def test_set_same_condition_is_noop():
+    st = JobStatus()
+    assert cond.update_job_conditions(st, ConditionType.RUNNING, "r", "m")
+    first = cond.get_condition(st, ConditionType.RUNNING)
+    t0 = first.last_transition_time
+    assert not cond.update_job_conditions(st, ConditionType.RUNNING, "r", "m2")
+    assert cond.get_condition(st, ConditionType.RUNNING).last_transition_time == t0
+
+
+def test_new_reason_keeps_transition_time():
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.RUNNING, "r1", "m")
+    t0 = cond.get_condition(st, ConditionType.RUNNING).last_transition_time
+    assert cond.update_job_conditions(st, ConditionType.RUNNING, "r2", "m")
+    assert cond.get_condition(st, ConditionType.RUNNING).last_transition_time == t0
+
+
+def test_restarting_removes_running():
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.RUNNING, "r", "m")
+    cond.update_job_conditions(st, ConditionType.RESTARTING, "rr", "m")
+    assert cond.get_condition(st, ConditionType.RUNNING) is None
+    assert cond.has_condition(st, ConditionType.RESTARTING)
+    # and back
+    cond.update_job_conditions(st, ConditionType.RUNNING, "r", "m")
+    assert cond.get_condition(st, ConditionType.RESTARTING) is None
+
+
+def test_terminal_flips_running_false():
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.RUNNING, "r", "m")
+    cond.update_job_conditions(st, ConditionType.SUCCEEDED, cond.REASON_SUCCEEDED, "m")
+    running = cond.get_condition(st, ConditionType.RUNNING)
+    assert running is not None and running.status is False
+    assert cond.is_succeeded(st)
+    assert cond.is_finished(st)
+    assert not cond.is_running(st)
+    cond.ensure_timestamps(st)
+    assert st.completion_time is not None
+
+
+def test_evicted_detection():
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.FAILED, cond.REASON_EVICTED, "evicted")
+    assert cond.is_failed(st)
+    assert cond.is_evicted(st)
+    st2 = JobStatus()
+    cond.update_job_conditions(st2, ConditionType.FAILED, cond.REASON_FAILED, "oom")
+    assert not cond.is_evicted(st2)
+
+
+def test_succeeded_supersedes_prior_failed():
+    # restart-then-succeed must not keep reporting Failed=True (status.go:146)
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.FAILED, cond.REASON_FAILED, "crash")
+    cond.update_job_conditions(st, ConditionType.RESTARTING, cond.REASON_RESTARTING, "retry")
+    cond.update_job_conditions(st, ConditionType.RUNNING, cond.REASON_RUNNING, "go")
+    cond.update_job_conditions(st, ConditionType.SUCCEEDED, cond.REASON_SUCCEEDED, "done")
+    assert cond.is_succeeded(st)
+    assert not cond.is_failed(st)
+    assert cond.is_finished(st)
+
+
+def test_restarting_unfinishes_failed():
+    # a restarting job must not report finished; stale completion_time drops
+    st = JobStatus()
+    cond.update_job_conditions(st, ConditionType.CREATED, cond.REASON_CREATED, "c")
+    cond.update_job_conditions(st, ConditionType.FAILED, cond.REASON_FAILED, "crash")
+    cond.ensure_timestamps(st)
+    assert st.completion_time is not None
+    cond.update_job_conditions(st, ConditionType.RESTARTING, cond.REASON_RESTARTING, "r")
+    cond.ensure_timestamps(st)
+    assert not cond.is_failed(st)
+    assert not cond.is_finished(st)
+    assert st.completion_time is None
+    cond.update_job_conditions(st, ConditionType.RUNNING, cond.REASON_RUNNING, "go")
+    assert not cond.is_finished(st)
+    cond.update_job_conditions(st, ConditionType.SUCCEEDED, cond.REASON_SUCCEEDED, "d")
+    cond.ensure_timestamps(st)
+    assert cond.is_finished(st) and st.completion_time is not None

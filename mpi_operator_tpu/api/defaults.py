@@ -22,6 +22,7 @@ from mpi_operator_tpu.api.types import (
     CleanPodPolicy,
     RestartPolicy,
     TPUJob,
+    family_chips_per_host,
 )
 
 DEFAULT_SLOTS_PER_WORKER = 1
@@ -37,16 +38,21 @@ def set_defaults(job: TPUJob) -> TPUJob:
     set-fields are preserved; see tests/test_api_defaults.py).
     """
     spec = job.spec
+    if not spec.slice.accelerator:
+        spec.slice.accelerator = DEFAULT_ACCELERATOR
     if spec.slots_per_worker is None:
-        spec.slots_per_worker = DEFAULT_SLOTS_PER_WORKER
+        # TPU families have a hardware-fixed chips-per-host (4 for v4..v6e);
+        # defaulting slots to it keeps the derived topology coherent. The cpu
+        # test family keeps the reference default of 1 (default.go:52-71).
+        spec.slots_per_worker = (
+            family_chips_per_host(spec.slice.accelerator) or DEFAULT_SLOTS_PER_WORKER
+        )
     if spec.run_policy.clean_pod_policy is None:
         spec.run_policy.clean_pod_policy = CleanPodPolicy.NONE
     if spec.worker.replicas is None:
         spec.worker.replicas = DEFAULT_WORKER_REPLICAS
     if spec.worker.restart_policy is None:
         spec.worker.restart_policy = DEFAULT_RESTART_POLICY
-    if not spec.slice.accelerator:
-        spec.slice.accelerator = DEFAULT_ACCELERATOR
     # slots_per_worker is the user knob; chips_per_host follows it only when
     # genuinely unset (None), so an explicit chips_per_host=1 is preserved.
     if spec.slice.chips_per_host is None:

@@ -36,6 +36,73 @@ from typing import Any, Dict, List, Optional
 API_VERSION = "tpujob.dev/v1"
 KIND_TPUJOB = "TPUJob"
 
+# Per-family host geometry: the block of the chip mesh owned by one host.
+# This is physical knowledge the whole stack shares (defaulting, validation,
+# placement, mesh construction): v4/v5p hosts own a 2x2x1 block of the 3-D
+# torus (4 chips); v5e/v6e hosts own 2x2 of the 2-D mesh (4 chips); the "cpu"
+# test family is 1-D with a free chips-per-host (emulated device count).
+HOST_BLOCK: Dict[str, tuple] = {
+    "v4": (2, 2, 1),
+    "v5p": (2, 2, 1),
+    "v5e": (2, 2),
+    "v6e": (2, 2),
+    "cpu": (1,),
+}
+
+
+def family_chips_per_host(accelerator: str) -> Optional[int]:
+    """Chips per host fixed by the hardware family; None for unknown families
+    and for "cpu" (emulated hosts hold any number of devices)."""
+    if accelerator == "cpu":
+        return None
+    block = HOST_BLOCK.get(accelerator)
+    if block is None:
+        return None
+    n = 1
+    for b in block:
+        n *= b
+    return n
+
+
+def host_block_for(accelerator: str, chips_per_host: Optional[int]) -> Optional[tuple]:
+    """The chip-mesh block one host owns, for a given chips-per-host request.
+    Returns None when the combination is physically illegal. This is the ONE
+    place sub-host geometry is defined — validation (admission), placement
+    (scheduling) and mesh construction (runtime) all consult it, so they can
+    never disagree.
+
+    Sub-host slices (chips_per_host < family chips) exist only as single-host
+    configurations (e.g. v5e-1 = 1x1, v5e-2 = 2x1); legal values are 1, 2, or
+    the full block."""
+    if accelerator == "cpu":
+        return (max(1, chips_per_host or 1),)
+    fam = HOST_BLOCK.get(accelerator)
+    if fam is None:
+        return None
+    full = family_chips_per_host(accelerator)
+    cph = chips_per_host or full
+    if cph == full:
+        return fam
+    if cph == 1:
+        return tuple(1 for _ in fam)
+    if cph == 2:
+        return (2,) + tuple(1 for _ in fam[1:])
+    return None
+
+
+def compute_host_mesh(topology: tuple, block: tuple) -> Optional[tuple]:
+    """Host mesh = chip topology / per-host block, dimension-wise. None when
+    the dimensionality differs or any axis is not divisible — the shared
+    shape check behind both admission validation and gang placement."""
+    if len(topology) != len(block):
+        return None
+    mesh = []
+    for t, b in zip(topology, block):
+        if b <= 0 or t % b != 0:
+            return None
+        mesh.append(t // b)
+    return tuple(mesh)
+
 
 # ---------------------------------------------------------------------------
 # Enums (plain str constants: keeps YAML round-trip trivial)

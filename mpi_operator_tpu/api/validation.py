@@ -21,10 +21,14 @@ import re
 from typing import List, Optional
 
 from mpi_operator_tpu.api.types import (
+    HOST_BLOCK,
     CleanPodPolicy,
     ElasticPolicy,
     RestartPolicy,
     TPUJob,
+    compute_host_mesh,
+    family_chips_per_host,
+    host_block_for,
 )
 
 # DNS-1035 label: lowercase alphanumeric + '-', must start with a letter,
@@ -33,8 +37,9 @@ _DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
 _MAX_LABEL = 63
 
 # Accelerator families the runtime can build a mesh for ("cpu" = the
-# multiprocess CPU test backend of SURVEY.md §4/§7.1).
-KNOWN_ACCELERATORS = {"cpu", "v4", "v5e", "v5p", "v6e"}
+# multiprocess CPU test backend of SURVEY.md §4/§7.1). Derived from the
+# family geometry table so the two can't drift.
+KNOWN_ACCELERATORS = frozenset(HOST_BLOCK)
 
 
 class ValidationError(ValueError):
@@ -137,24 +142,58 @@ def validate_tpujob(job: TPUJob) -> List[str]:
             f"spec.slots_per_worker = {spec.slots_per_worker}; they name the "
             f"same quantity (chips per host) — set one or make them equal"
         )
+    # TPU hosts own a hardware-fixed chip block (HOST_BLOCK in api.types).
+    # host_block_for is the single source of truth for legal per-host
+    # geometry — the same helper gang placement and mesh construction use, so
+    # a spec that passes admission can always be placed.
+    per_host = cph if cph is not None else spec.slots_per_worker
+    fam_cph = family_chips_per_host(acc)
+    block = host_block_for(acc, per_host) if acc in KNOWN_ACCELERATORS else None
+    if acc in KNOWN_ACCELERATORS and per_host and block is None:
+        errs.append(
+            f"spec.slots_per_worker: {per_host} chips per host is not a legal "
+            f"{acc} host configuration (full block "
+            f"{'x'.join(map(str, HOST_BLOCK[acc]))}, sub-host values 1 or 2)"
+        )
+    if (
+        fam_cph is not None
+        and per_host
+        and per_host != fam_cph
+        and (spec.worker.replicas or 0) > 1
+    ):
+        errs.append(
+            f"spec.slots_per_worker: multi-host {acc} jobs have {fam_cph} "
+            f"chips per host (hosts own a {'x'.join(map(str, HOST_BLOCK[acc]))} "
+            f"block), got {per_host} — sub-host slices are single-worker"
+        )
     if spec.slice.topology:
         dims = _validate_topology(spec.slice.topology)
-        per_host = cph if cph is not None else spec.slots_per_worker
         if dims is None:
             errs.append(
                 f"spec.slice.topology: malformed {spec.slice.topology!r}, "
                 f"expected e.g. '4x4x4'"
             )
-        elif spec.worker.replicas and per_host:
-            chips = 1
-            for d in dims:
-                chips *= d
-            want = spec.worker.replicas * per_host
-            if chips != want:
+        elif spec.worker.replicas and block is not None:
+            # identical math to controller.placement.place_workers: the host
+            # mesh must exist (per-axis divisibility) and hold exactly
+            # `replicas` hosts
+            mesh = compute_host_mesh(tuple(dims), block)
+            if mesh is None:
                 errs.append(
-                    f"spec.slice.topology: topology {spec.slice.topology!r} has "
-                    f"{chips} chips but workers x chips_per_host = {want}"
+                    f"spec.slice.topology: {spec.slice.topology!r} is not "
+                    f"divisible into {acc} host blocks of "
+                    f"{'x'.join(map(str, block))}"
                 )
+            else:
+                hosts = 1
+                for m in mesh:
+                    hosts *= m
+                if hosts != spec.worker.replicas:
+                    errs.append(
+                        f"spec.slice.topology: topology {spec.slice.topology!r} "
+                        f"holds {hosts} hosts but the job has "
+                        f"{spec.worker.replicas} workers"
+                    )
 
     # --- elastic bounds (≙ horovod -np/min-np/max-np sanity) ---
     el: Optional[ElasticPolicy] = spec.elastic

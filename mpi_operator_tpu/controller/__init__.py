@@ -1,0 +1,10 @@
+"""The TPUJob controller/reconciler.
+
+≙ /root/reference/v2/pkg/controller/ — the core of the reference operator.
+"""
+
+from mpi_operator_tpu.controller.controller import (  # noqa: F401
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.controller.placement import SlicePlacement, place_workers  # noqa: F401

@@ -1,0 +1,683 @@
+"""TPUJob controller: level-triggered reconciliation.
+
+≙ /root/reference/v2/pkg/controller/mpi_job_controller.go (1531 LoC, the core
+of the reference operator). The reconcile contract is preserved:
+
+  syncHandler (:443-608): lister get → deepcopy → default → validate →
+  finished-cleanup → dependents (service, config, gang, workers) → status
+  mirror — all idempotent getOrCreate with ownership adoption checks
+  (:625-631, :730-734), driven by a rate-limited workqueue fed by watches on
+  the job and every owned kind (handleObject :300-339).
+
+TPU-first redesign (SURVEY.md §7.3-4):
+- **Launcher-less**: no launcher pod, no SSH secret, no kubectl-delivery.
+  Worker 0 is the coordinator; its exit status plays the role the launcher's
+  does in updateMPIJobStatus (:921-996).
+- **Bootstrap = env injection**: instead of hostfiles + OMPI_MCA_* env
+  (:176-200) the controller injects TPUJOB_* rendezvous env (coordinator
+  address, host id/count, slice geometry) consumed by
+  runtime/bootstrap.py — the jax.distributed.initialize contract.
+- **Gang = slice placement**: a PodGroup with min_member == workers (no +1 —
+  there is no launcher) plus ICI-topology host coordinates stamped on every
+  pod (controller/placement.py).
+- **RunPolicy is actually implemented** (suspend, backoffLimit,
+  activeDeadlineSeconds, ttlSecondsAfterFinished) — the reference declares it
+  but its v1/v2 controllers never read it (SURVEY.md §2.2, §5.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.defaults import set_defaults
+from mpi_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ConditionType,
+    Container,
+    ObjectMeta,
+    OwnerReference,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from mpi_operator_tpu.api.validation import validate_tpujob
+from mpi_operator_tpu.controller.placement import (
+    PlacementError,
+    SlicePlacement,
+    place_workers,
+)
+from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ConfigMap,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    PodSpec,
+    Service,
+    ServiceSpec,
+)
+from mpi_operator_tpu.machinery.store import Conflict, ObjectStore, WatchEvent
+from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.controller")
+
+# Pod labels (≙ the group/job/replica labels of newWorker :1246-1260)
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_ROLE = "tpujob.dev/job-role"
+LABEL_REPLICA_INDEX = "tpujob.dev/replica-index"
+ROLE_WORKER = "worker"
+
+# Rendezvous env contract (≙ the OMPI/Intel env of :176-200; consumed by
+# runtime/bootstrap.py the way mpirun consumes the hostfile env).
+ENV_JOB_NAME = "TPUJOB_NAME"
+ENV_NAMESPACE = "TPUJOB_NAMESPACE"
+ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_NUM_HOSTS = "TPUJOB_NUM_HOSTS"
+ENV_HOST_ID = "TPUJOB_HOST_ID"
+ENV_CHIPS_PER_HOST = "TPUJOB_CHIPS_PER_HOST"
+ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"
+ENV_TOPOLOGY = "TPUJOB_TOPOLOGY"
+ENV_HOST_MESH = "TPUJOB_HOST_MESH"
+ENV_HOST_COORD = "TPUJOB_HOST_COORD"
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+# ConfigMap keys (≙ hostfile / discover_hosts.sh, :1088-1138)
+CONFIG_HOSTFILE = "hostfile"
+CONFIG_DISCOVER_HOSTS = "discover_hosts.sh"
+CONFIG_COORDINATOR = "coordinator"
+
+EVENT_VALIDATION_ERROR = "ValidationError"
+EVENT_PLACEMENT_ERROR = "PlacementError"
+
+
+@dataclass
+class ControllerOptions:
+    """≙ the operator flags (v2/cmd/mpi-operator/app/options/options.go:46-74)."""
+
+    namespace: Optional[str] = None  # None = cluster-scoped
+    threadiness: int = 2
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+    gang_scheduling: bool = True
+
+
+class TPUJobController:
+    """Level-triggered reconciler over an ObjectStore.
+
+    ≙ MPIJobController (mpi_job_controller.go:208-245). ``_write_status`` is
+    the injectable status-update hook the reference exposes for tests
+    (updateStatusHandler field :243-244).
+    """
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        options: Optional[ControllerOptions] = None,
+    ):
+        self.store = store
+        self.options = options or ControllerOptions()
+        self.recorder = recorder or EventRecorder(store)
+        self.queue = RateLimitingQueue()
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._watch_q = None
+        # injectable, ≙ updateStatusHandler (:243-244)
+        self._write_status = self._default_write_status
+
+    # ------------------------------------------------------------------
+    # run loop (≙ Run + runWorker + processNextWorkItem :347-438)
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        """Start the watch pump + worker threads. Non-blocking; stop()."""
+        self._watch_q = self.store.watch(None)
+        pump = threading.Thread(target=self._pump, name="tpujob-watch-pump", daemon=True)
+        pump.start()
+        self._threads.append(pump)
+        for i in range(self.options.threadiness):
+            t = threading.Thread(
+                target=self._run_worker, name=f"tpujob-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        # prime: enqueue all existing jobs (informer initial list)
+        for job in self.store.list("TPUJob", self.options.namespace):
+            self.enqueue(job.metadata.key())
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def _pump(self) -> None:
+        """Watch events → job keys (≙ the event handlers of :300-339: job
+        events enqueue directly; owned-object events enqueue the controller
+        owner via handleObject)."""
+        while not self._stop.is_set():
+            try:
+                ev: WatchEvent = self._watch_q.get(timeout=0.2)
+            except Exception:
+                continue
+            obj = ev.obj
+            if ev.kind == "Event":
+                continue
+            ns = obj.metadata.namespace
+            if self.options.namespace is not None and ns != self.options.namespace:
+                continue
+            if ev.kind == "TPUJob":
+                self.enqueue(obj.metadata.key())
+                continue
+            owner = self._controller_owner(obj)
+            if owner is not None:
+                self.enqueue(f"{ns}/{owner.name}")
+
+    @staticmethod
+    def _controller_owner(obj) -> Optional[OwnerReference]:
+        for ref in obj.metadata.owner_references:
+            if ref.controller and ref.kind == "TPUJob":
+                return ref
+        return None
+
+    def _run_worker(self) -> None:
+        while True:
+            key = self.queue.get()
+            if key is None:
+                return
+            try:
+                ok = self.sync_handler(key)
+            except Conflict:
+                ok = False  # stale read; retry
+            except Exception:
+                log.exception("sync %s failed", key)
+                ok = False
+            if ok:
+                self.queue.forget(key)
+            else:
+                self.queue.add_rate_limited(key)
+            self.queue.done(key)
+
+    # ------------------------------------------------------------------
+    # reconcile (≙ syncHandler :443-608)
+    # ------------------------------------------------------------------
+
+    def sync_handler(self, key: str) -> bool:
+        """One reconcile. Returns True on success (forget), False to requeue
+        (≙ syncHandler returning err → AddRateLimited in processNextWorkItem
+        :381-438; Conflicts and ownership errors both requeue)."""
+        t0 = time.time()
+        try:
+            return self._sync(key)
+        except Conflict:
+            return False
+        except RuntimeError as e:
+            log.warning("sync %s: %s", key, e)
+            return False
+        finally:
+            log.debug("sync %s took %.1fms", key, (time.time() - t0) * 1e3)
+
+    def _sync(self, key: str) -> bool:
+        namespace, name = key.split("/", 1)
+        job = self.store.try_get("TPUJob", namespace, name)
+        if job is None:
+            return True  # deleted; nothing to do (≙ :460-467)
+        set_defaults(job)  # store returned a deep copy (≙ DeepCopy + Default :470-475)
+
+        errs = validate_tpujob(job)
+        if errs:
+            # invalid specs are dropped, not requeued (≙ :482-487)
+            self.recorder.event(job, WARNING, EVENT_VALIDATION_ERROR, "; ".join(errs))
+            return True
+
+        workers = self._list_workers(job)
+
+        if cond.is_finished(job.status):
+            self._cleanup_finished(job, workers)
+            return True
+
+        # --- suspend (RunPolicy.Suspend; implemented, unlike the reference) ---
+        if job.spec.run_policy.suspend:
+            return self._sync_suspended(job, workers)
+        if cond.is_suspended(job.status):
+            cond.update_job_conditions(
+                job.status, ConditionType.SUSPENDED, cond.REASON_RESUMED, "resumed", False
+            )
+            self.recorder.event(job, NORMAL, cond.REASON_RESUMED, "job resumed")
+
+        # --- Created condition + start time (≙ :532-543) ---
+        if cond.update_job_conditions(
+            job.status,
+            ConditionType.CREATED,
+            cond.REASON_CREATED,
+            f"TPUJob {key} is created",
+        ):
+            metrics.jobs_created.inc()
+            self.recorder.event(job, NORMAL, cond.REASON_CREATED, "job created")
+        cond.ensure_timestamps(job.status)
+
+        # --- activeDeadlineSeconds (RunPolicy; SURVEY.md §5.3 gap, closed) ---
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if (
+            deadline is not None
+            and job.status.start_time is not None
+            and time.time() - job.status.start_time > deadline
+        ):
+            self._fail_job(
+                job,
+                workers,
+                cond.REASON_DEADLINE,
+                f"job exceeded activeDeadlineSeconds={deadline}",
+            )
+            return self._write_status(job)
+
+        # --- gang placement (≙ getOrCreatePodGroups :572-576 + ICI layout) ---
+        try:
+            placement = place_workers(job.spec.slice, job.spec.worker.replicas)
+        except PlacementError as e:
+            self.recorder.event(job, WARNING, EVENT_PLACEMENT_ERROR, str(e))
+            return True  # spec problem: drop like a validation error
+
+        # --- dependents, all idempotent getOrCreate ---
+        self._get_or_create_service(job)
+        self._get_or_create_configmap(job, workers)
+        if self.options.gang_scheduling:
+            self._get_or_create_podgroup(job)
+        workers = self._reconcile_workers(job, placement)
+
+        # --- status mirror (≙ updateMPIJobStatus call :602) ---
+        self._update_status(job, workers)
+        return self._write_status(job)
+
+    # ------------------------------------------------------------------
+    # dependents
+    # ------------------------------------------------------------------
+
+    def _owner_ref(self, job: TPUJob) -> OwnerReference:
+        return OwnerReference(name=job.name, uid=job.metadata.uid, controller=True)
+
+    def _check_owned(self, job: TPUJob, obj) -> bool:
+        """Adoption check (≙ :625-631): an existing dependent not controlled
+        by this job is a fatal ownership conflict → warning event + requeue."""
+        owner = self._controller_owner(obj)
+        if owner is None or owner.uid != job.metadata.uid:
+            msg = (
+                f"{obj.kind} {obj.metadata.key()} already exists and is not "
+                f"controlled by TPUJob {job.name}"
+            )
+            self.recorder.event(job, WARNING, "IneligibleOwnership", msg)
+            raise RuntimeError(msg)
+        return True
+
+    def _selector(self, job: TPUJob) -> Dict[str, str]:
+        return {LABEL_JOB_NAME: job.name}
+
+    def _list_workers(self, job: TPUJob) -> List[Pod]:
+        pods = self.store.list("Pod", job.namespace, selector=self._selector(job))
+        pods.sort(key=lambda p: int(p.metadata.labels.get(LABEL_REPLICA_INDEX, "0")))
+        return pods
+
+    def _get_or_create_service(self, job: TPUJob) -> Service:
+        """Headless service giving workers stable DNS (≙ newWorkersService
+        :1141-1171)."""
+        existing = self.store.try_get("Service", job.namespace, job.service_name())
+        if existing is not None:
+            self._check_owned(job, existing)
+            return existing
+        svc = Service(
+            metadata=ObjectMeta(
+                name=job.service_name(),
+                namespace=job.namespace,
+                labels=self._selector(job),
+                owner_references=[self._owner_ref(job)],
+            ),
+            spec=ServiceSpec(cluster_ip="None", selector=self._selector(job)),
+        )
+        return self.store.create(svc)
+
+    def coordinator_address(self, job: TPUJob) -> str:
+        return f"{job.worker_hostname(0)}:{self.options.coordinator_port}"
+
+    def _config_data(self, job: TPUJob, workers: List[Pod]) -> Dict[str, str]:
+        """hostfile + discover_hosts.sh parity (≙ newConfigMap :1088-1113 and
+        updateDiscoverHostsInConfigMap :1116-1138: static hostfile of stable
+        DNS names; dynamic script listing only *Running* pods, sorted)."""
+        slots = job.spec.slots_per_worker
+        hostfile = "".join(
+            f"{job.worker_hostname(i)} slots={slots}\n"
+            for i in range(job.spec.worker.replicas)
+        )
+        running = sorted(
+            int(p.metadata.labels[LABEL_REPLICA_INDEX])
+            for p in workers
+            if p.status.phase == PodPhase.RUNNING
+        )
+        discover = "#!/bin/sh\n" + "".join(
+            f"echo {job.worker_hostname(i)}:{slots}\n" for i in running
+        )
+        return {
+            CONFIG_HOSTFILE: hostfile,
+            CONFIG_DISCOVER_HOSTS: discover,
+            CONFIG_COORDINATOR: self.coordinator_address(job),
+        }
+
+    def _get_or_create_configmap(self, job: TPUJob, workers: List[Pod]) -> ConfigMap:
+        data = self._config_data(job, workers)
+        existing = self.store.try_get("ConfigMap", job.namespace, job.config_name())
+        if existing is not None:
+            self._check_owned(job, existing)
+            if existing.data != data:
+                existing.data = data
+                return self.store.update(existing)
+            return existing
+        cm = ConfigMap(
+            metadata=ObjectMeta(
+                name=job.config_name(),
+                namespace=job.namespace,
+                labels=self._selector(job),
+                owner_references=[self._owner_ref(job)],
+            ),
+            data=data,
+        )
+        return self.store.create(cm)
+
+    @staticmethod
+    def _desired_min_member(job: TPUJob) -> int:
+        sp = job.spec.run_policy.scheduling_policy
+        if sp and sp.min_available is not None:
+            return sp.min_available
+        return job.spec.worker.replicas
+
+    def _get_or_create_podgroup(self, job: TPUJob) -> PodGroup:
+        """Gang unit: min_member == workers — all-or-nothing slice allocation
+        (≙ newPodGroup :1215-1237 with minMember = workers+1 :573; no +1 here
+        because there is no launcher pod). A schedulingPolicy.minAvailable
+        overrides, on both the create and the reconcile-update path."""
+        desired = self._desired_min_member(job)
+        existing = self.store.try_get("PodGroup", job.namespace, job.podgroup_name())
+        if existing is not None:
+            self._check_owned(job, existing)
+            if existing.spec.min_member != desired:
+                existing.spec.min_member = desired
+                return self.store.update(existing)
+            return existing
+        sp = job.spec.run_policy.scheduling_policy
+        pg = PodGroup(
+            metadata=ObjectMeta(
+                name=job.podgroup_name(),
+                namespace=job.namespace,
+                labels=self._selector(job),
+                owner_references=[self._owner_ref(job)],
+            ),
+            spec=PodGroupSpec(
+                min_member=desired,
+                queue=sp.queue if sp else "",
+                priority_class=sp.priority_class if sp else "",
+            ),
+        )
+        return self.store.create(pg)
+
+    def _new_worker(self, job: TPUJob, index: int, placement: SlicePlacement) -> Pod:
+        """≙ newWorker (:1246-1296): stable hostname/subdomain behind the
+        headless service, labels for selection, controller env injected after
+        user env (controller values win for the rendezvous contract)."""
+        tmpl = job.spec.worker.template
+        container = Container.from_dict(tmpl.container.to_dict())
+        env = dict(container.env)
+        env.update(
+            {
+                ENV_JOB_NAME: job.name,
+                ENV_NAMESPACE: job.namespace,
+                ENV_COORDINATOR: self.coordinator_address(job),
+                ENV_NUM_HOSTS: str(job.spec.worker.replicas),
+                ENV_HOST_ID: str(index),
+                ENV_CHIPS_PER_HOST: str(job.spec.slice.chips_per_host),
+                ENV_ACCELERATOR: job.spec.slice.accelerator,
+                ENV_TOPOLOGY: "x".join(map(str, placement.topology)),
+                ENV_HOST_MESH: "x".join(map(str, placement.host_mesh)),
+                ENV_HOST_COORD: "x".join(map(str, placement.host_coords[index])),
+            }
+        )
+        container.env = env
+        labels = dict(tmpl.labels)
+        labels.update(self._selector(job))
+        labels[LABEL_ROLE] = ROLE_WORKER
+        labels[LABEL_REPLICA_INDEX] = str(index)
+        annotations = dict(tmpl.annotations)
+        annotations.update(placement.annotations_for(index))
+        # ExitCode policy is controller-owned: the pod itself never restarts
+        # (≙ setRestartPolicy :1394-1400)
+        pod_restart = (
+            RestartPolicy.NEVER
+            if job.spec.worker.restart_policy == RestartPolicy.EXIT_CODE
+            else job.spec.worker.restart_policy
+        )
+        return Pod(
+            metadata=ObjectMeta(
+                name=job.worker_name(index),
+                namespace=job.namespace,
+                labels=labels,
+                annotations=annotations,
+                owner_references=[self._owner_ref(job)],
+            ),
+            spec=PodSpec(
+                container=container,
+                hostname=job.worker_name(index),
+                subdomain=job.service_name(),
+                restart_policy=pod_restart,
+                node_selector=dict(tmpl.node_selector),
+                scheduler_name=tmpl.scheduler_name,
+                priority_class=tmpl.priority_class
+                or (
+                    job.spec.run_policy.scheduling_policy.priority_class
+                    if job.spec.run_policy.scheduling_policy
+                    else ""
+                ),
+            ),
+        )
+
+    def _reconcile_workers(self, job: TPUJob, placement: SlicePlacement) -> List[Pod]:
+        """Per-index get-or-create + elastic scale-down of indices >= replicas
+        (≙ getOrCreateWorker :817-877, scale-down :833-849)."""
+        replicas = job.spec.worker.replicas
+        existing = {p.metadata.name: p for p in self._list_workers(job)}
+        out: List[Pod] = []
+        for i in range(replicas):
+            name = job.worker_name(i)
+            pod = existing.pop(name, None)
+            if pod is None:
+                pod = self.store.create(self._new_worker(job, i, placement))
+            else:
+                self._check_owned(job, pod)
+            out.append(pod)
+        # anything left in `existing` has index >= replicas → scale down
+        for name, pod in existing.items():
+            self._check_owned(job, pod)
+            self.store.try_delete("Pod", job.namespace, name)
+        return out
+
+    # ------------------------------------------------------------------
+    # status (≙ updateMPIJobStatus :921-996, launcher→worker-0)
+    # ------------------------------------------------------------------
+
+    def _update_status(self, job: TPUJob, workers: List[Pod]) -> None:
+        rs = ReplicaStatus()
+        for p in workers:
+            if p.status.phase == PodPhase.RUNNING:
+                rs.active += 1
+            elif p.status.phase == PodPhase.SUCCEEDED:
+                rs.succeeded += 1
+            elif p.status.phase == PodPhase.FAILED:
+                rs.failed += 1
+                if p.is_evicted():
+                    rs.evicted += 1
+        job.status.replica_statuses = {ReplicaType.WORKER: rs}
+
+        replicas = job.spec.worker.replicas
+        coordinator = next(
+            (p for p in workers if p.metadata.labels.get(LABEL_REPLICA_INDEX) == "0"),
+            None,
+        )
+        if coordinator is not None:
+            metrics.job_info.set(
+                1, coordinator=coordinator.metadata.name, namespace=job.namespace
+            )
+
+        # --- success: coordinator (worker 0) exited 0 (≙ launcher Succeeded) ---
+        if coordinator is not None and coordinator.status.phase == PodPhase.SUCCEEDED:
+            if cond.update_job_conditions(
+                job.status,
+                ConditionType.SUCCEEDED,
+                cond.REASON_SUCCEEDED,
+                f"TPUJob {job.metadata.key()} successfully completed",
+            ):
+                metrics.jobs_successful.inc()
+                self.recorder.event(job, NORMAL, cond.REASON_SUCCEEDED, "job succeeded")
+            cond.ensure_timestamps(job.status)
+            return
+
+        # --- failures (≙ :935-983 + restart semantics of SURVEY.md §5.3) ---
+        failed = [p for p in workers if p.status.phase == PodPhase.FAILED]
+        if failed:
+            if all(self._pod_retryable(job, p) for p in failed):
+                backoff = job.spec.run_policy.backoff_limit
+                if backoff is not None and job.status.restart_count >= backoff:
+                    self._fail_job(
+                        job,
+                        workers,
+                        cond.REASON_BACKOFF,
+                        f"restart count {job.status.restart_count} reached "
+                        f"backoffLimit={backoff}",
+                    )
+                    return
+                job.status.restart_count += 1
+                metrics.jobs_restarted.inc()
+                if cond.update_job_conditions(
+                    job.status,
+                    ConditionType.RESTARTING,
+                    cond.REASON_RESTARTING,
+                    f"{len(failed)} worker pod(s) failed retryably; restarting",
+                ):
+                    self.recorder.event(
+                        job, WARNING, cond.REASON_RESTARTING, "job restarting"
+                    )
+                cond.ensure_timestamps(job.status)
+                # delete failed pods; next reconcile recreates them (≙ the
+                # evicted-launcher delete+requeue of syncHandler :506-529)
+                for p in failed:
+                    self.store.try_delete("Pod", p.metadata.namespace, p.metadata.name)
+                return
+            first = failed[0]
+            reason = cond.REASON_EVICTED if first.is_evicted() else cond.REASON_FAILED
+            msg = (
+                f"worker pod {first.metadata.name} failed with reason "
+                f"{first.status.reason or 'Error'}: {first.status.message or ''}"
+            )
+            self._fail_job(job, workers, reason, msg)
+            return
+
+        # --- running: every worker Running (≙ worker-readiness→Running,
+        # mpi_job_controller_test.go:771-935) ---
+        if replicas and rs.active == replicas:
+            if cond.update_job_conditions(
+                job.status,
+                ConditionType.RUNNING,
+                cond.REASON_RUNNING,
+                f"all {replicas} workers are running",
+            ):
+                self.recorder.event(job, NORMAL, cond.REASON_RUNNING, "job running")
+
+    def _pod_retryable(self, job: TPUJob, pod: Pod) -> bool:
+        """Eviction/preemption is always retryable (TPU preemption is routine;
+        ≙ the evicted-requeue of syncHandler :506-529). Otherwise the replica
+        restart policy decides; ExitCode retries only system exit codes >= 128
+        (SIGKILL'd / infrastructure), matching kubeflow-common convention."""
+        if pod.is_evicted():
+            return True
+        rp = job.spec.worker.restart_policy
+        if rp in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE):
+            return True
+        if rp == RestartPolicy.EXIT_CODE:
+            return pod.status.exit_code is not None and pod.status.exit_code >= 128
+        return False
+
+    def _fail_job(
+        self, job: TPUJob, workers: List[Pod], reason: str, message: str
+    ) -> None:
+        if cond.update_job_conditions(
+            job.status, ConditionType.FAILED, reason, message
+        ):
+            metrics.jobs_failed.inc()
+            self.recorder.event(job, WARNING, reason, message)
+        cond.ensure_timestamps(job.status)
+
+    # ------------------------------------------------------------------
+    # finished / suspend handling
+    # ------------------------------------------------------------------
+
+    def _sync_suspended(self, job: TPUJob, workers: List[Pod]) -> bool:
+        for p in workers:
+            self.store.try_delete("Pod", p.metadata.namespace, p.metadata.name)
+        self.store.try_delete("PodGroup", job.namespace, job.podgroup_name())
+        if cond.update_job_conditions(
+            job.status,
+            ConditionType.SUSPENDED,
+            cond.REASON_SUSPENDED,
+            "job is suspended",
+        ):
+            self.recorder.event(job, NORMAL, cond.REASON_SUSPENDED, "job suspended")
+        rs = job.status.replica_statuses.setdefault(ReplicaType.WORKER, ReplicaStatus())
+        rs.active = 0
+        return self._write_status(job)
+
+    def _cleanup_finished(self, job: TPUJob, workers: List[Pod]) -> None:
+        """≙ the finished branch of syncHandler (:492-530): apply
+        cleanPodPolicy, drop the gang, honor ttlSecondsAfterFinished."""
+        policy = job.spec.run_policy.clean_pod_policy
+        for p in workers:
+            delete = policy == CleanPodPolicy.ALL or (
+                policy == CleanPodPolicy.RUNNING and p.status.phase == PodPhase.RUNNING
+            )
+            if delete:
+                self.store.try_delete("Pod", p.metadata.namespace, p.metadata.name)
+        self.store.try_delete("PodGroup", job.namespace, job.podgroup_name())
+
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time is not None:
+            age = time.time() - job.status.completion_time
+            if age >= ttl:
+                self.store.try_delete("TPUJob", job.namespace, job.name)
+            else:
+                self.queue.add_after(job.metadata.key(), ttl - age + 0.01)
+
+    # ------------------------------------------------------------------
+    # status write (injectable; ≙ updateStatusHandler :243-244)
+    # ------------------------------------------------------------------
+
+    def _default_write_status(self, job: TPUJob) -> bool:
+        """Persist status only when it changed (≙ UpdateStatus-on-change,
+        :602 + :921-996 tail). Conflict → requeue (False)."""
+        stored = self.store.try_get("TPUJob", job.namespace, job.name)
+        if stored is None:
+            return True
+        if stored.status.to_dict() == job.status.to_dict():
+            return True
+        stored.status = job.status
+        try:
+            self.store.update(stored)
+        except Conflict:
+            return False
+        return True

@@ -1,0 +1,128 @@
+"""ICI-topology-aware gang placement.
+
+The reference delegates gang scheduling to Volcano PodGroups
+(minMember = workers+1, v2/pkg/controller/mpi_job_controller.go:573,1215-1237)
+and knows nothing about interconnect topology — MPI ranks are
+placement-agnostic. On TPU, placement IS the performance model: the hosts of a
+job must form a contiguous slice so collectives ride ICI, and each host's
+position in the slice determines its coordinates in the device mesh
+(SURVEY.md §2.5, §7 "hard parts": topology-aware gang scheduling).
+
+This module computes the slice-host layout for a job:
+
+- A slice topology like ``4x4x4`` (chips) is split into per-host blocks using
+  the family's chips-per-host geometry (v4/v5p hosts own a ``2x2x1`` block of
+  the chip mesh; v5e/v6e hosts own ``2x2`` of a 2-D mesh; the ``cpu`` test
+  family is 1 chip per host, 1-D).
+- Every worker index is assigned (a) a host coordinate in the host mesh and
+  (b) the base coordinate of its chip block — stamped into pod annotations so
+  the runtime can build a ``jax.sharding.Mesh`` whose axes line up with
+  physical ICI neighbours (runtime/topology.py consumes these).
+
+Placement is atomic: either every worker fits the declared topology or the
+job cannot be placed (gang semantics; a TPU slice is inherently all-or-nothing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from mpi_operator_tpu.api.types import (
+    HOST_BLOCK,
+    SliceSpec,
+    compute_host_mesh,
+    host_block_for,
+)
+
+ANNOTATION_HOST_COORD = "tpujob.dev/host-coord"
+ANNOTATION_CHIP_BASE = "tpujob.dev/chip-base"
+ANNOTATION_HOST_MESH = "tpujob.dev/host-mesh"
+ANNOTATION_TOPOLOGY = "tpujob.dev/topology"
+
+
+class PlacementError(ValueError):
+    pass
+
+
+@dataclass
+class SlicePlacement:
+    """The computed layout for one job's gang."""
+
+    topology: Tuple[int, ...]  # chip mesh shape
+    host_block: Tuple[int, ...]  # chips-per-host block shape
+    host_mesh: Tuple[int, ...]  # host mesh shape (topology / host_block)
+    host_coords: List[Tuple[int, ...]] = field(default_factory=list)  # per worker index
+    chip_bases: List[Tuple[int, ...]] = field(default_factory=list)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.host_coords)
+
+    def annotations_for(self, index: int) -> Dict[str, str]:
+        return {
+            ANNOTATION_HOST_COORD: "x".join(map(str, self.host_coords[index])),
+            ANNOTATION_CHIP_BASE: "x".join(map(str, self.chip_bases[index])),
+            ANNOTATION_HOST_MESH: "x".join(map(str, self.host_mesh)),
+            ANNOTATION_TOPOLOGY: "x".join(map(str, self.topology)),
+        }
+
+
+def _default_topology(block: Tuple[int, ...], num_workers: int) -> Tuple[int, ...]:
+    """Derive a chip topology when the job didn't declare one: a 1-D layout of
+    num_workers host blocks along the first axis."""
+    dims = list(block)
+    dims[0] *= num_workers
+    return tuple(dims)
+
+
+def place_workers(slice_spec: SliceSpec, num_workers: int) -> SlicePlacement:
+    """Compute the gang layout. Raises PlacementError when the topology cannot
+    host exactly ``num_workers`` hosts (atomic/gang: no partial placement).
+    Uses the same host_block_for/compute_host_mesh helpers as admission
+    validation, so a validated spec is always placeable."""
+    family = slice_spec.accelerator
+    if family not in HOST_BLOCK:
+        raise PlacementError(f"unknown accelerator family {family!r}")
+    block = host_block_for(family, slice_spec.chips_per_host)
+    if block is None:
+        raise PlacementError(
+            f"{slice_spec.chips_per_host} chips per host is not a legal "
+            f"{family} host configuration"
+        )
+
+    if slice_spec.topology:
+        topo = tuple(int(p) for p in slice_spec.topology.split("x"))
+    else:
+        topo = _default_topology(block, num_workers)
+    host_mesh_t = compute_host_mesh(topo, block)
+    if host_mesh_t is None:
+        raise PlacementError(
+            f"topology {topo} is not divisible into {family} host blocks of {block}"
+        )
+    host_mesh = list(host_mesh_t)
+    total_hosts = 1
+    for h in host_mesh:
+        total_hosts *= h
+    if total_hosts != num_workers:
+        raise PlacementError(
+            f"topology {'x'.join(map(str, topo))} holds {total_hosts} "
+            f"{family} hosts but the job has {num_workers} workers — gang "
+            f"placement is all-or-nothing"
+        )
+
+    # Row-major host enumeration: worker index i ↔ host coordinate. Row-major
+    # matches jax mesh_utils' device ordering so mesh axes line up with ICI.
+    placement = SlicePlacement(
+        topology=topo, host_block=block, host_mesh=tuple(host_mesh)
+    )
+    for i in range(num_workers):
+        coord = []
+        rem = i
+        for dim in reversed(host_mesh):
+            coord.append(rem % dim)
+            rem //= dim
+        coord = tuple(reversed(coord))
+        placement.host_coords.append(coord)
+        placement.chip_bases.append(tuple(c * b for c, b in zip(coord, block)))
+    return placement

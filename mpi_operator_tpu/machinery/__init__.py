@@ -1,0 +1,32 @@
+"""Cluster-state machinery: the k8s-apimachinery-equivalent substrate.
+
+The reference operator leans on kube-apiserver + client-go: typed objects with
+ObjectMeta/ownerReferences, informer caches with event handlers, rate-limited
+workqueues, and an event recorder (wired in NewMPIJobController,
+/root/reference/v2/pkg/controller/mpi_job_controller.go:248-341). This package
+provides the same substrate as an in-process, thread-safe object store so the
+TPU controller can be developed and tested exactly like the reference's
+envtest tier (SURVEY.md §4.2) without a cluster — and so a future remote
+backend (real k8s, GKE TPU provisioner) can slot in behind the same interface.
+"""
+
+from mpi_operator_tpu.machinery.objects import (  # noqa: F401
+    ConfigMap,
+    Event,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    Service,
+    ServiceSpec,
+)
+from mpi_operator_tpu.machinery.store import (  # noqa: F401
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    WatchEvent,
+)
+from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue  # noqa: F401
+from mpi_operator_tpu.machinery.events import EventRecorder  # noqa: F401

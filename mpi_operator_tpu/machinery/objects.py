@@ -1,0 +1,134 @@
+"""Dependent-object types the controller materializes.
+
+≙ the corev1/volcano objects the reference reconciler creates for each MPIJob
+(v2/pkg/controller/mpi_job_controller.go): worker/launcher Pods (:1246-1392),
+headless Service (:1141-1171), ConfigMap (:1088-1138), PodGroup (:1215-1237),
+and the Events recorded throughout. Secrets (SSH keys, :1175-1210) have no TPU
+analogue — rendezvous replaces rank-spawn — so there is no Secret type.
+
+Only the fields the framework actually schedules/observes are modeled; each
+type reuses the api ObjectMeta so ownership/adoption logic is uniform.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from mpi_operator_tpu.api.types import Container, ObjectMeta, _Dictable
+
+
+class PodPhase:
+    """≙ corev1.PodPhase, the signal updateMPIJobStatus consumes
+    (mpi_job_controller.go:921-996)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    ALL_VALUES = (PENDING, RUNNING, SUCCEEDED, FAILED)
+
+
+@dataclass
+class PodSpec(_Dictable):
+    container: Container = field(default_factory=Container)
+    hostname: str = ""
+    subdomain: str = ""
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    restart_policy: str = "Never"
+    scheduler_name: str = ""
+    priority_class: str = ""
+
+
+@dataclass
+class PodStatus(_Dictable):
+    phase: str = PodPhase.PENDING
+    ready: bool = False
+    reason: str = ""
+    message: str = ""
+    exit_code: Optional[int] = None
+    pod_ip: str = ""
+    host_ip: str = ""
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod(_Dictable):
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def is_finished(self) -> bool:
+        return self.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+    def is_evicted(self) -> bool:
+        """≙ isEvicted check on launcher pods (status.go:99-106 + controller
+        :935-950): Failed with reason Evicted."""
+        return self.status.phase == PodPhase.FAILED and self.status.reason == "Evicted"
+
+
+@dataclass
+class ServiceSpec(_Dictable):
+    cluster_ip: str = "None"  # headless, ≙ newWorkersService :1141-1147
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Service(_Dictable):
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class ConfigMap(_Dictable):
+    kind: str = "ConfigMap"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodGroupSpec(_Dictable):
+    min_member: int = 0
+    queue: str = ""
+    priority_class: str = ""
+
+
+@dataclass
+class PodGroup(_Dictable):
+    """Gang-scheduling unit, ≙ volcano PodGroup (newPodGroup :1215-1237).
+    On TPU this doubles as the slice-allocation request: min_member hosts that
+    must be placed atomically on one slice."""
+
+    kind: str = "PodGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+
+
+@dataclass
+class ObjectRef(_Dictable):
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event(_Dictable):
+    """≙ corev1.Event as used by the reference's recorder (user-facing audit
+    log, asserted by the integration eventChecker, v2/test/integration/
+    main_test.go:116-178)."""
+
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved: ObjectRef = field(default_factory=ObjectRef)
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    timestamp: float = 0.0
+
+
+KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event")

@@ -1,0 +1,187 @@
+"""Thread-safe versioned object store with watches.
+
+≙ the kube-apiserver + informer-cache layer the reference depends on. The
+semantics preserved from the reference's usage:
+
+- **resourceVersion optimistic concurrency**: updates with a stale
+  resource_version raise Conflict (the reference relies on apiserver conflicts
+  + requeue; our controller does the same).
+- **Watches**: every create/update/delete fans out a WatchEvent to subscriber
+  queues, which is what informers consume (≙ the event handlers registered in
+  NewMPIJobController, v2/pkg/controller/mpi_job_controller.go:300-339).
+- **Objects are deep-copied on the way in and out** so callers can never
+  mutate the store's copy — the same rule as informer caches ("read-only +
+  DeepCopy before mutation", SURVEY.md §5.2).
+- **Label selection** for list operations (≙ the group/job-name selector the
+  controller lists pods with, :689-707).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: Any
+
+
+def _meta(obj: Any):
+    return obj.metadata
+
+
+class ObjectStore:
+    """In-process apiserver equivalent. Keyed by (kind, namespace, name)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], Any] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        self._now = __import__("time").time
+
+    # -- internal ----------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, etype: str, kind: str, obj: Any) -> None:
+        for want_kind, q in list(self._watchers):
+            if want_kind is None or want_kind == kind:
+                q.put(WatchEvent(etype, kind, obj.deepcopy()))
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
+        return (kind, namespace, name)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            m = _meta(obj)
+            k = self._key(obj.kind, m.namespace, m.name)
+            if k in self._objects:
+                raise AlreadyExists(f"{obj.kind} {m.namespace}/{m.name} already exists")
+            obj = obj.deepcopy()
+            m = _meta(obj)
+            if not m.uid:
+                m.uid = str(uuid.uuid4())
+            m.resource_version = self._next_rv()
+            if m.creation_timestamp is None:
+                m.creation_timestamp = self._now()
+            self._objects[k] = obj
+            self._notify(ADDED, obj.kind, obj)
+            return obj.deepcopy()
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return self._objects[k].deepcopy()
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def update(self, obj: Any, force: bool = False) -> Any:
+        """Optimistic update; ``force=True`` skips the resource_version check
+        (used by test fixtures playing kubelet, ≙ envtest's updatePodsToPhase,
+        v2/test/integration/mpi_job_controller_test.go)."""
+        with self._lock:
+            m = _meta(obj)
+            k = self._key(obj.kind, m.namespace, m.name)
+            if k not in self._objects:
+                raise NotFound(f"{obj.kind} {m.namespace}/{m.name} not found")
+            current = self._objects[k]
+            if not force and m.resource_version != _meta(current).resource_version:
+                raise Conflict(
+                    f"{obj.kind} {m.namespace}/{m.name}: resource_version "
+                    f"{m.resource_version} != {_meta(current).resource_version}"
+                )
+            obj = obj.deepcopy()
+            _meta(obj).resource_version = self._next_rv()
+            self._objects[k] = obj
+            self._notify(MODIFIED, obj.kind, obj)
+            return obj.deepcopy()
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = self._objects.pop(k)
+            self._notify(DELETED, kind, obj)
+            return obj.deepcopy()
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFound:
+            return None
+
+    # -- list / select ------------------------------------------------------
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        """List objects, optionally namespace-scoped and label-selected
+        (selector semantics: all key=value pairs must match, ≙ labels.Set
+        selectors used at mpi_job_controller.go:689-707)."""
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector:
+                    lbls = _meta(obj).labels
+                    if any(lbls.get(sk) != sv for sk, sv in selector.items()):
+                        continue
+                out.append(obj.deepcopy())
+            out.sort(key=lambda o: (_meta(o).namespace, _meta(o).name))
+            return out
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        """Returns a queue receiving WatchEvents for ``kind`` (None = all).
+        The caller owns draining it; stop with stop_watch()."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            self._watchers.append((kind, q))
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]

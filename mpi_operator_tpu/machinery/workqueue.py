@@ -1,0 +1,117 @@
+"""Rate-limited deduplicating work queue.
+
+≙ client-go's workqueue.RateLimitingInterface as used by the reference
+controller (queue wiring at v2/pkg/controller/mpi_job_controller.go:229-234,
+drain loop processNextWorkItem :381-438). Semantics preserved:
+
+- **Dedup**: adding a key already queued (or dirty while processing) coalesces;
+  a key re-added while being processed is re-queued after done().
+- **Rate limiting**: per-key exponential backoff (base 5ms, cap 1000s — the
+  client-go defaults) via add_rate_limited(); forget() resets the failure
+  count, ≙ the Forget/AddRateLimited pair in processNextWorkItem.
+- **Shutdown**: get() returns None after shutdown and the queue drains.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[str] = []
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._shutdown = False
+        self._base = base_delay
+        self._cap = max_delay
+        self._timers: List[threading.Timer] = []
+
+    # -- core (client-go Type) ---------------------------------------------
+
+    def add(self, key: str) -> None:
+        with self._cond:
+            if self._shutdown or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key not in self._processing:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Blocks until an item is available; returns None on shutdown or
+        timeout. The caller must call done(key) when finished."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutdown:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if not self._queue:
+                return None  # shutdown
+            key = self._queue.pop(0)
+            self._dirty.discard(key)
+            self._processing.add(key)
+            return key
+
+    def done(self, key: str) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty and key not in self._queue:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- rate limiting ------------------------------------------------------
+
+    def num_requeues(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self._base * (2**n), self._cap)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        t = threading.Timer(delay, self.add, args=(key,))
+        t.daemon = True
+        with self._lock:
+            if self._shutdown:
+                return
+            self._timers.append(t)
+            self._timers = [x for x in self._timers if x.is_alive() or not x.finished.is_set()]
+        t.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._lock:
+            return self._shutdown

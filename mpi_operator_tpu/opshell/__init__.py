@@ -1,0 +1,5 @@
+"""Operational shell: metrics, health, leader election, CLI entry points.
+
+≙ /root/reference/v2/cmd/mpi-operator/ (flags, leader election, /healthz,
+Prometheus wiring, SURVEY.md §2.3/§5.5).
+"""

@@ -1,0 +1,97 @@
+"""Prometheus-style metrics registry.
+
+≙ the promauto counters of the reference
+(v2/pkg/controller/mpi_job_controller.go:119-135 —
+mpi_operator_jobs_created_total / _successful_total / _failed_total /
+mpi_operator_job_info — and mpi_operator_is_leader,
+v2/cmd/mpi-operator/app/server.go:73-78). Same metric names with the
+``tpu_operator_`` prefix; rendered in Prometheus text exposition format by
+``render()`` for the /metrics endpoint (opshell.server).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind  # counter | gauge
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted(labels.items()))
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for k, v in sorted(self._values.items()):
+                if k:
+                    lbl = "{" + ",".join(f'{a}="{b}"' for a, b in k) + "}"
+                else:
+                    lbl = ""
+                lines.append(f"{self.name}{lbl} {v:g}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str) -> _Metric:
+        return self._register(name, help_, "counter")
+
+    def gauge(self, name: str, help_: str) -> _Metric:
+        return self._register(name, help_, "gauge")
+
+    def _register(self, name: str, help_: str, kind: str) -> _Metric:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = _Metric(name, help_, kind)
+            return self._metrics[name]
+
+    def render(self) -> str:
+        with self._lock:
+            return "\n".join(m.render() for m in self._metrics.values()) + "\n"
+
+
+REGISTRY = Registry()
+
+jobs_created = REGISTRY.counter(
+    "tpu_operator_jobs_created_total", "Counts number of TPU jobs created"
+)
+jobs_successful = REGISTRY.counter(
+    "tpu_operator_jobs_successful_total", "Counts number of TPU jobs successful"
+)
+jobs_failed = REGISTRY.counter(
+    "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed"
+)
+jobs_restarted = REGISTRY.counter(
+    "tpu_operator_jobs_restarted_total", "Counts number of TPU job restarts"
+)
+job_info = REGISTRY.gauge(
+    "tpu_operator_job_info", "Info about a TPU job (coordinator pod, namespace)"
+)
+is_leader = REGISTRY.gauge(
+    "tpu_operator_is_leader", "1 when this replica holds the leader lease"
+)

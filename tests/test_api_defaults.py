@@ -70,3 +70,17 @@ def test_explicit_chips_per_host_preserved():
     )
     set_defaults(job)
     assert job.spec.slice.chips_per_host == 1  # explicit value survives
+
+
+def test_tpu_family_slots_default_to_family_chips():
+    from mpi_operator_tpu.api import SliceSpec
+
+    job = TPUJob(
+        metadata=ObjectMeta(name="j"),
+        spec=TPUJobSpec(
+            worker=ReplicaSpec(replicas=3), slice=SliceSpec(accelerator="v5e")
+        ),
+    )
+    set_defaults(job)
+    assert job.spec.slots_per_worker == 4  # v5e hosts own a 2x2 chip block
+    assert job.spec.slice.chips_per_host == 4

@@ -68,10 +68,13 @@ def test_hostname_worst_case_length():
 
 
 def test_topology_chip_count_must_match():
+    # cpu hosts are 1-D blocks of chips_per_host; topology dims must match
     job = make_job(replicas=2, slots=4)
-    job.spec.slice.topology = "2x2x2"  # 8 chips == 2*4 → ok
+    job.spec.slice.topology = "8"  # 2 hosts × 4 chips → ok
     assert errs_for(job) == []
-    job.spec.slice.topology = "2x2x4"  # 16 != 8
+    job.spec.slice.topology = "16"  # 4 hosts != 2 workers
+    assert any("spec.slice.topology" in e for e in errs_for(job))
+    job.spec.slice.topology = "2x2x2"  # cpu topologies are 1-D
     assert any("spec.slice.topology" in e for e in errs_for(job))
 
 
@@ -133,7 +136,55 @@ def test_topology_checks_chips_per_host():
     from mpi_operator_tpu.api import SliceSpec
 
     job = make_job(replicas=2, slots=4)
-    job.spec.slice = SliceSpec(accelerator="v5p", chips_per_host=4, topology="2x4")
-    assert errs_for(job) == []
-    job.spec.slice.topology = "2x1"
+    job.spec.slice = SliceSpec(accelerator="v5e", chips_per_host=4, topology="2x4")
+    assert errs_for(job) == []  # 2x4 / 2x2 blocks → 1x2 = 2 hosts ✓
+    job.spec.slice.topology = "4x4"  # 2x2 hosts = 4 != 2 workers
     assert any("spec.slice.topology" in e for e in errs_for(job))
+
+
+def test_multihost_tpu_slots_must_match_family():
+    job = make_job(replicas=2, slots=2)
+    job.spec.slice.accelerator = "v5p"
+    job.spec.slice.chips_per_host = 2
+    assert any("spec.slots_per_worker" in e for e in errs_for(job))
+    # single-worker sub-host slices are allowed (e.g. v5e-1)
+    job2 = make_job(replicas=1, slots=2)
+    job2.spec.slice.accelerator = "v5e"
+    job2.spec.slice.chips_per_host = 2
+    assert errs_for(job2) == []
+
+
+def test_illegal_subhost_chips_rejected():
+    # 3 chips/host is never a legal TPU host configuration
+    job = make_job(replicas=1, slots=3)
+    job.spec.slice.accelerator = "v5e"
+    job.spec.slice.chips_per_host = 3
+    assert any("spec.slots_per_worker" in e for e in errs_for(job))
+    # 8 chips on one v5e host is impossible too
+    job.spec.slice.chips_per_host = 8
+    job.spec.slots_per_worker = 8
+    assert any("spec.slots_per_worker" in e for e in errs_for(job))
+
+
+def test_topology_per_axis_divisibility_rejected_at_admission():
+    # product matches (16 = 4x4) but 16x1 can't be tiled by 2x2 host blocks
+    job = make_job(replicas=4, slots=4)
+    job.spec.slice.accelerator = "v5e"
+    job.spec.slice.chips_per_host = 4
+    job.spec.slice.topology = "16x1"
+    assert any("not divisible" in e for e in errs_for(job))
+    job.spec.slice.topology = "4x4"
+    assert errs_for(job) == []
+
+
+def test_validated_subhost_spec_is_placeable():
+    # admission and placement share geometry: what validates must place
+    from mpi_operator_tpu.controller.placement import place_workers
+
+    job = make_job(replicas=1, slots=2)
+    job.spec.slice.accelerator = "v5e"
+    job.spec.slice.chips_per_host = 2
+    assert errs_for(job) == []
+    p = place_workers(job.spec.slice, 1)
+    assert p.host_block == (2, 1)
+    assert p.topology == (2, 1)

@@ -1,0 +1,374 @@
+"""Controller fixture tests.
+
+≙ /root/reference/v2/pkg/controller/mpi_job_controller_test.go (1350 LoC):
+a fixture preloads objects into the store, runs one reconcile synchronously,
+and asserts on created dependents / job conditions / emitted events —
+TestLauncherSucceeded/Failed (:526,562), ownership conflicts (:476-740),
+TestShutdownWorker (:694), worker-readiness→Running (:771-935), golden
+object construction TestNewLauncherAndWorker (:937). Pod phase transitions
+are simulated by the test, exactly like the reference's fake-kubelet trick
+(SURVEY.md §4.1-2)."""
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import ConditionType, conditions
+from mpi_operator_tpu.api.types import RestartPolicy
+from mpi_operator_tpu.controller import ControllerOptions, TPUJobController
+from mpi_operator_tpu.controller.controller import (
+    ENV_COORDINATOR,
+    ENV_HOST_COORD,
+    ENV_HOST_ID,
+    ENV_NUM_HOSTS,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+)
+from mpi_operator_tpu.machinery import EventRecorder, ObjectStore, PodPhase
+from tests.test_api_types import make_job
+
+
+class Fixture:
+    """≙ the `fixture` struct of mpi_job_controller_test.go:59-81."""
+
+    def __init__(self):
+        self.store = ObjectStore()
+        self.recorder = EventRecorder(self.store)
+        self.controller = TPUJobController(self.store, self.recorder)
+
+    def create_job(self, job):
+        return self.store.create(job)
+
+    def sync(self, job):
+        return self.controller.sync_handler(job.metadata.key())
+
+    def job(self, job):
+        return self.store.get("TPUJob", job.namespace, job.name)
+
+    def pods(self, job):
+        return self.store.list("Pod", job.namespace, selector={LABEL_JOB_NAME: job.name})
+
+    def set_pod_phase(self, job, index, phase, reason="", exit_code=None):
+        """Fake kubelet (≙ updatePodsToPhase in the reference integration
+        tests)."""
+        pod = self.store.get("Pod", job.namespace, job.worker_name(index))
+        pod.status.phase = phase
+        pod.status.ready = phase == PodPhase.RUNNING
+        pod.status.reason = reason
+        pod.status.exit_code = exit_code
+        self.store.update(pod, force=True)
+
+    def run_to_phase(self, job, phase=PodPhase.RUNNING):
+        self.sync(job)
+        for i in range(job.spec.worker.replicas):
+            self.set_pod_phase(job, i, phase)
+        self.sync(job)
+
+
+@pytest.fixture
+def f():
+    return Fixture()
+
+
+def test_creates_all_dependents(f):
+    job = f.create_job(make_job(name="pi", replicas=2))
+    assert f.sync(job)
+    svc = f.store.get("Service", "default", "pi-worker")
+    assert svc.spec.cluster_ip == "None"
+    assert svc.metadata.owner_references[0].name == "pi"
+    cm = f.store.get("ConfigMap", "default", "pi-config")
+    assert "pi-worker-0.pi-worker slots=1" in cm.data["hostfile"]
+    assert "pi-worker-1.pi-worker slots=1" in cm.data["hostfile"]
+    assert cm.data["coordinator"] == "pi-worker-0.pi-worker:8476"
+    pg = f.store.get("PodGroup", "default", "pi")
+    assert pg.spec.min_member == 2  # workers, no +1: launcher-less
+    pods = f.pods(job)
+    assert [p.metadata.name for p in pods] == ["pi-worker-0", "pi-worker-1"]
+    st = f.job(job).status
+    assert conditions.is_created(st)
+    assert st.start_time is not None
+    assert st.replica_statuses["Worker"].active == 0
+
+
+def test_golden_worker_pod(f):
+    """≙ TestNewLauncherAndWorker (:937): exact object construction."""
+    job = f.create_job(make_job(name="train", replicas=2, slots=1))
+    f.sync(job)
+    pod = f.store.get("Pod", "default", "train-worker-1")
+    assert pod.spec.hostname == "train-worker-1"
+    assert pod.spec.subdomain == "train-worker"
+    assert pod.metadata.labels[LABEL_REPLICA_INDEX] == "1"
+    env = pod.spec.container.env
+    assert env[ENV_COORDINATOR] == "train-worker-0.train-worker:8476"
+    assert env[ENV_NUM_HOSTS] == "2"
+    assert env[ENV_HOST_ID] == "1"
+    assert env[ENV_HOST_COORD] == "1"
+    assert pod.metadata.annotations["tpujob.dev/host-mesh"] == "2"
+    assert pod.metadata.owner_references[0].uid == job.metadata.uid
+
+
+def test_exit_code_restart_policy_maps_to_never(f):
+    job = make_job(name="ec", replicas=1)
+    job.spec.worker.restart_policy = RestartPolicy.EXIT_CODE
+    job = f.create_job(job)
+    f.sync(job)
+    pod = f.pods(job)[0]
+    assert pod.spec.restart_policy == RestartPolicy.NEVER
+
+
+def test_all_workers_running_sets_running(f):
+    job = f.create_job(make_job(name="run", replicas=3))
+    f.sync(job)
+    f.set_pod_phase(job, 0, PodPhase.RUNNING)
+    f.set_pod_phase(job, 1, PodPhase.RUNNING)
+    f.sync(job)
+    st = f.job(job).status
+    assert not conditions.is_running(st)  # only 2/3 running
+    assert st.replica_statuses["Worker"].active == 2
+    f.set_pod_phase(job, 2, PodPhase.RUNNING)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_running(st)
+    # discover_hosts.sh lists only Running pods, sorted (≙ :1116-1138)
+    cm = f.store.get("ConfigMap", "default", "run-config")
+    lines = cm.data["discover_hosts.sh"].strip().splitlines()[1:]
+    assert lines == [
+        "echo run-worker-0.run-worker:1",
+        "echo run-worker-1.run-worker:1",
+        "echo run-worker-2.run-worker:1",
+    ]
+
+
+def test_coordinator_succeeded_job_succeeds(f):
+    """≙ TestLauncherSucceeded (:526), launcher → worker 0."""
+    job = f.create_job(make_job(name="ok", replicas=2))
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.SUCCEEDED)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_succeeded(st)
+    assert st.completion_time is not None
+    assert st.replica_statuses["Worker"].succeeded == 1
+    assert "TPUJobSucceeded" in f.recorder.reasons_for(job)
+    # finished + cleanPodPolicy=None: pods stay, podgroup removed (≙ :492-505)
+    f.sync(job)
+    assert len(f.pods(job)) == 2
+    assert f.store.try_get("PodGroup", "default", "ok") is None
+
+
+def test_clean_pod_policy_running(f):
+    job = make_job(name="cpr", replicas=2)
+    job.spec.run_policy.clean_pod_policy = "Running"
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.SUCCEEDED)
+    f.sync(job)  # marks succeeded
+    f.sync(job)  # finished branch: cleanup
+    remaining = [p.metadata.name for p in f.pods(job)]
+    assert remaining == ["cpr-worker-0"]  # running worker 1 deleted
+
+
+def test_worker_failed_never_fails_job(f):
+    """≙ TestLauncherFailed (:562) generalized to any worker."""
+    job = f.create_job(make_job(name="bad", replicas=2))
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 1, PodPhase.FAILED, reason="Error", exit_code=1)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_failed(st)
+    assert st.replica_statuses["Worker"].failed == 1
+    assert "TPUJobFailed" in f.recorder.reasons_for(job)
+
+
+def test_evicted_worker_restarts(f):
+    """Eviction is retryable (≙ the evicted delete+requeue of :506-529)."""
+    job = f.create_job(make_job(name="ev", replicas=2))
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 1, PodPhase.FAILED, reason="Evicted")
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.has_condition(st, ConditionType.RESTARTING)
+    assert not conditions.is_finished(st)
+    assert st.restart_count == 1
+    # failed pod deleted; next reconcile recreates it
+    f.sync(job)
+    pods = f.pods(job)
+    assert len(pods) == 2
+    assert pods[1].status.phase == PodPhase.PENDING
+
+
+def test_exit_code_retryable_vs_permanent(f):
+    job = make_job(name="ecr", replicas=2)
+    job.spec.worker.restart_policy = RestartPolicy.EXIT_CODE
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=137)  # SIGKILL → retry
+    f.sync(job)
+    assert conditions.has_condition(f.job(job).status, ConditionType.RESTARTING)
+    f.sync(job)  # recreate
+    f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=1)  # app error → permanent
+    f.sync(job)
+    assert conditions.is_failed(f.job(job).status)
+
+
+def test_backoff_limit_exceeded(f):
+    job = make_job(name="bo", replicas=1)
+    job.spec.run_policy.backoff_limit = 1
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.FAILED, reason="Evicted")
+    f.sync(job)
+    assert f.job(job).status.restart_count == 1
+    f.sync(job)  # recreate
+    f.set_pod_phase(job, 0, PodPhase.FAILED, reason="Evicted")
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_failed(st)
+    assert conditions.get_condition(st, ConditionType.FAILED).reason == "TPUJobBackoffLimitExceeded"
+
+
+def test_elastic_scale_down_deletes_highest_indices(f):
+    """≙ TestShutdownWorker / scale-down :833-849."""
+    job = f.create_job(make_job(name="el", replicas=4))
+    f.sync(job)
+    assert len(f.pods(job)) == 4
+    stored = f.job(job)
+    stored.spec.worker.replicas = 2
+    f.store.update(stored)
+    f.sync(job)
+    assert [p.metadata.name for p in f.pods(job)] == ["el-worker-0", "el-worker-1"]
+    cm = f.store.get("ConfigMap", "default", "el-config")
+    assert "el-worker-3" not in cm.data["hostfile"]
+
+
+def test_deleted_worker_recreated(f):
+    job = f.create_job(make_job(name="rec", replicas=2))
+    f.sync(job)
+    f.store.delete("Pod", "default", "rec-worker-1")
+    f.sync(job)
+    assert len(f.pods(job)) == 2
+
+
+def test_ownership_conflict_emits_warning_and_requeues(f):
+    """≙ the *NotControlledByUs cases (:476-740)."""
+    from mpi_operator_tpu.machinery.objects import Service
+
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    f.store.create(
+        Service(metadata=ObjectMeta(name="own-worker", namespace="default"))
+    )
+    job = f.create_job(make_job(name="own", replicas=1))
+    assert not f.sync(job)  # requeue
+    assert "IneligibleOwnership" in f.recorder.reasons_for(job)
+    assert f.pods(job) == []
+
+
+def test_validation_error_drops_without_requeue(f):
+    job = make_job(name="inv", replicas=2)
+    job.spec.slots_per_worker = 0
+    job = f.create_job(job)
+    assert f.sync(job)  # dropped, not requeued (≙ :482-487)
+    assert "ValidationError" in f.recorder.reasons_for(job)
+    assert f.pods(job) == []
+
+
+def test_suspend_and_resume(f):
+    job = make_job(name="sus", replicas=2)
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    stored = f.job(job)
+    stored.spec.run_policy.suspend = True
+    f.store.update(stored)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_suspended(st)
+    assert f.pods(job) == []
+    assert f.store.try_get("PodGroup", "default", "sus") is None
+    stored = f.job(job)
+    stored.spec.run_policy.suspend = False
+    f.store.update(stored)
+    f.sync(job)
+    st = f.job(job).status
+    assert not conditions.is_suspended(st)
+    assert len(f.pods(job)) == 2
+    assert "TPUJobResumed" in f.recorder.reasons_for(job)
+
+
+def test_active_deadline_exceeded(f):
+    job = make_job(name="dl", replicas=1)
+    job.spec.run_policy.active_deadline_seconds = 1
+    job = f.create_job(job)
+    f.sync(job)
+    stored = f.job(job)
+    stored.status.start_time = time.time() - 10
+    f.store.update(stored)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.is_failed(st)
+    assert conditions.get_condition(st, ConditionType.FAILED).reason == "TPUJobDeadlineExceeded"
+
+
+def test_ttl_after_finished_deletes_job(f):
+    job = make_job(name="ttl", replicas=1)
+    job.spec.run_policy.ttl_seconds_after_finished = 0
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.SUCCEEDED)
+    f.sync(job)
+    f.sync(job)  # finished branch: ttl elapsed → job deleted
+    assert f.store.try_get("TPUJob", "default", "ttl") is None
+
+
+def test_run_loop_end_to_end():
+    """Full async loop: watches → queue → reconcile, phases simulated
+    (≙ the envtest integration tier, SURVEY.md §4.2)."""
+    fx = Fixture()
+    fx.controller.run()
+    try:
+        job = fx.create_job(make_job(name="e2e", replicas=2))
+
+        def wait_for(pred, timeout=5.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.02)
+            return False
+
+        assert wait_for(lambda: len(fx.pods(job)) == 2)
+        fx.set_pod_phase(job, 0, PodPhase.RUNNING)
+        fx.set_pod_phase(job, 1, PodPhase.RUNNING)
+        assert wait_for(lambda: conditions.is_running(fx.job(job).status))
+        fx.set_pod_phase(job, 0, PodPhase.SUCCEEDED)
+        assert wait_for(lambda: conditions.is_succeeded(fx.job(job).status))
+        reasons = fx.recorder.reasons_for(job)
+        assert reasons[0] == "TPUJobCreated"
+        assert "TPUJobRunning" in reasons
+        assert reasons[-1] == "TPUJobSucceeded"
+    finally:
+        fx.controller.stop()
+
+
+def test_podgroup_honors_min_available_across_reconciles(f):
+    from mpi_operator_tpu.api.types import SchedulingPolicy
+
+    job = make_job(name="ma", replicas=4)
+    job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+        min_available=2, priority_class="high"
+    )
+    job = f.create_job(job)
+    f.sync(job)
+    pg = f.store.get("PodGroup", "default", "ma")
+    assert pg.spec.min_member == 2
+    f.sync(job)  # second reconcile must not stomp it back to replicas
+    pg = f.store.get("PodGroup", "default", "ma")
+    assert pg.spec.min_member == 2
+    # pods inherit the scheduling policy's priority class, not the job name
+    assert f.pods(job)[0].spec.priority_class == "high"
+
+
+def test_pod_priority_class_empty_by_default(f):
+    job = f.create_job(make_job(name="pc", replicas=1))
+    f.sync(job)
+    assert f.pods(job)[0].spec.priority_class == ""

@@ -1,0 +1,68 @@
+"""Placement tests: ICI-topology-aware gang layout (controller/placement.py).
+
+No reference analogue (the reference's gang unit is just minMember, SURVEY.md
+§2.5); these pin down the TPU-specific host-mesh math the runtime relies on."""
+
+import pytest
+
+from mpi_operator_tpu.api.types import SliceSpec
+from mpi_operator_tpu.controller.placement import (
+    PlacementError,
+    place_workers,
+)
+
+
+def test_cpu_family_1d():
+    p = place_workers(SliceSpec(accelerator="cpu"), 4)
+    assert p.topology == (4,)
+    assert p.host_mesh == (4,)
+    assert p.host_coords == [(0,), (1,), (2,), (3,)]
+
+
+def test_v5p_explicit_topology():
+    # 4x4x4 = 64 chips; v5p host block 2x2x1 → host mesh 2x2x4 = 16 hosts
+    p = place_workers(SliceSpec(accelerator="v5p", topology="4x4x4"), 16)
+    assert p.host_mesh == (2, 2, 4)
+    assert p.num_hosts == 16
+    # row-major enumeration: index 0 at origin, index 1 advances last axis
+    assert p.host_coords[0] == (0, 0, 0)
+    assert p.host_coords[1] == (0, 0, 1)
+    assert p.host_coords[4] == (0, 1, 0)
+    # chip base = host coord * block
+    assert p.chip_bases[5] == (0, 2, 1)
+
+
+def test_v5e_2d():
+    p = place_workers(SliceSpec(accelerator="v5e", topology="4x8"), 8)
+    assert p.host_mesh == (2, 4)
+    assert p.chip_bases[-1] == (2, 6)
+
+
+def test_default_topology_derived():
+    p = place_workers(SliceSpec(accelerator="v5p"), 4)
+    assert p.topology == (8, 2, 1)  # 4 hosts × 2x2x1 block along first axis
+    assert p.num_hosts == 4
+
+
+def test_gang_is_all_or_nothing():
+    with pytest.raises(PlacementError):
+        place_workers(SliceSpec(accelerator="v5p", topology="4x4x4"), 8)
+
+
+def test_indivisible_topology_rejected():
+    with pytest.raises(PlacementError):
+        place_workers(SliceSpec(accelerator="v5p", topology="3x4x4"), 12)
+
+
+def test_wrong_dimensionality_rejected():
+    with pytest.raises(PlacementError):
+        place_workers(SliceSpec(accelerator="v5e", topology="4x4x4"), 4)
+
+
+def test_annotations():
+    p = place_workers(SliceSpec(accelerator="v5p", topology="4x4x4"), 16)
+    a = p.annotations_for(5)
+    assert a["tpujob.dev/host-coord"] == "0x1x1"
+    assert a["tpujob.dev/chip-base"] == "0x2x1"
+    assert a["tpujob.dev/host-mesh"] == "2x2x4"
+    assert a["tpujob.dev/topology"] == "4x4x4"

@@ -1,0 +1,33 @@
+"""Parallelism layer: collectives, sharding rules, sequence parallelism.
+
+The reference's collective fabric is external — OpenMPI/Intel/MPICH plus
+Horovod's NCCL ring, shipped inside user images and merely *wired up* by the
+operator (SURVEY.md §1 layer 6, §5.8). Here the fabric is XLA itself and this
+package is its thin, named API:
+
+- :mod:`collectives` — psum/all_gather/reduce_scatter/ppermute wrappers with
+  the MPI correspondence documented per-op (the capability contract of
+  /root/reference/examples/pi/pi.cc's ``MPI_Reduce`` and Horovod's allreduce).
+- :mod:`sharding` — logical-axis → mesh-axis rules so models declare *what*
+  an axis means and deployment picks *where* it shards.
+- :mod:`ring_attention` — blockwise ring attention over the ``sequence``
+  mesh axis via ``ppermute`` (the long-context capability; SURVEY.md §5.7).
+"""
+
+from mpi_operator_tpu.parallel import collectives
+from mpi_operator_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_spec,
+    named_sharding,
+    with_logical_constraint,
+)
+from mpi_operator_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "collectives",
+    "DEFAULT_RULES",
+    "logical_spec",
+    "named_sharding",
+    "with_logical_constraint",
+    "ring_attention",
+]

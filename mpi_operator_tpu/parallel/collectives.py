@@ -1,0 +1,110 @@
+"""Named collective API over XLA (≙ the MPI/Horovod verbs).
+
+Capability mapping from the reference stack (SURVEY.md §5.8) — each function
+notes the MPI/Horovod verb it replaces. All of these are XLA collectives:
+inside ``jit`` under ``shard_map``/``pjit`` they lower to ICI/DCN primitives
+and fuse with surrounding compute; none of them touch the host.
+
+| here              | reference stack                                        |
+|-------------------|--------------------------------------------------------|
+| ``psum``          | ``MPI_Allreduce(SUM)`` / Horovod allreduce (ring/NCCL) |
+| ``pmean``         | Horovod's averaged allreduce (DistributedOptimizer)    |
+| ``reduce_to_root``| ``MPI_Reduce`` to rank 0 (examples/pi/pi.cc:44)        |
+| ``all_gather``    | ``MPI_Allgather``                                      |
+| ``reduce_scatter``| ``MPI_Reduce_scatter``                                 |
+| ``ring_shift``    | the ring topology Horovod builds internally            |
+| ``all_to_all``    | ``MPI_Alltoall`` (MoE dispatch)                        |
+| ``broadcast_root``| ``MPI_Bcast`` / ``hvd.broadcast_global_variables``     |
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def axis_index(axis: AxisName):
+    """This device's coordinate along a mesh axis (≙ MPI_Comm_rank)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    """Devices along a mesh axis (≙ MPI_Comm_size)."""
+    return lax.psum(1, axis)
+
+
+def psum(x, axis: AxisName):
+    """Sum-allreduce along ``axis`` (≙ MPI_Allreduce(SUM) / hvd.allreduce)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    """Mean-allreduce (≙ Horovod's DistributedOptimizer gradient average)."""
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def pmin(x, axis: AxisName):
+    return lax.pmin(x, axis)
+
+
+def reduce_to_root(x, axis: AxisName):
+    """Sum-reduce with the result kept only on index 0 (zeros elsewhere) —
+    the π example's ``MPI_Reduce(&in, &out, 1, MPI_SUM, 0)``. XLA has no
+    rooted reduce; psum + mask compiles to the same ring with a cheap
+    select."""
+    total = lax.psum(x, axis)
+    return jnp.where(lax.axis_index(axis) == 0, total, jnp.zeros_like(total))
+
+
+def broadcast_root(x, axis: AxisName):
+    """Broadcast index 0's value to all (≙ MPI_Bcast; Horovod's initial
+    variable broadcast). Implemented as mask + psum: only root contributes."""
+    contrib = jnp.where(lax.axis_index(axis) == 0, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = False):
+    """Concatenate every device's shard along ``gather_axis``
+    (≙ MPI_Allgather)."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    """Sum-reduce then scatter shards (≙ MPI_Reduce_scatter). The
+    bandwidth-optimal half of a ring allreduce; XLA emits it directly."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ring_shift(x, axis: AxisName, *, shift: int = 1):
+    """Rotate shards around the ring: device i's block moves to device
+    (i+shift) mod N. The building block of ring attention and pipeline
+    hand-off; lowers to a single ICI ppermute (neighbour hop when
+    |shift|=1)."""
+    n = axis_size_static(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    """Transpose shard ownership (≙ MPI_Alltoall): split local data along
+    ``split_axis`` into N pieces, send piece j to device j, concatenate
+    received pieces along ``concat_axis``. MoE token dispatch and
+    DeepSpeed-Ulysses-style head↔sequence reshard use exactly this."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def axis_size_static(axis: AxisName) -> int:
+    """Static size of a mesh axis (a Python int even at trace time — psum of
+    a Python constant folds to the axis size; needed for building ppermute
+    tables, which require concrete ints)."""
+    return int(lax.psum(1, axis))

@@ -1,0 +1,46 @@
+"""Multi-host runtime layer: rendezvous, topology, meshes.
+
+This package replaces the reference's entire "MPI runtime & comm backend"
+layer (SURVEY.md §1 layer 6): where an MPIJob's launcher runs ``mpirun`` which
+ssh-es into workers (/root/reference/v2/pkg/controller/mpi_job_controller.go:176-200)
+and ranks talk via OpenMPI/NCCL, a TPUJob's workers all boot the *same* SPMD
+program, call :func:`initialize` (coordinator rendezvous, ≙ orted wireup), and
+communicate through XLA collectives over ICI/DCN.
+
+There is no per-rank spawn, no hostfile, no SSH: the controller injects the
+``TPUJOB_*`` env (controller/controller.py) and this package consumes it.
+"""
+
+from mpi_operator_tpu.runtime.bootstrap import (
+    RuntimeContext,
+    context_from_env,
+    initialize,
+)
+from mpi_operator_tpu.runtime.topology import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MESH_AXES,
+    MeshPlan,
+    build_mesh,
+    mesh_from_context,
+)
+
+__all__ = [
+    "RuntimeContext",
+    "context_from_env",
+    "initialize",
+    "MeshPlan",
+    "build_mesh",
+    "mesh_from_context",
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_TENSOR",
+    "AXIS_SEQ",
+    "AXIS_EXPERT",
+    "AXIS_PIPE",
+    "MESH_AXES",
+]

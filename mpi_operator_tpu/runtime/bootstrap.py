@@ -1,0 +1,155 @@
+"""SPMD boot + coordinator rendezvous (≙ mpirun/orted/SSH wireup).
+
+The reference's bootstrap is rank-spawn: the launcher's ``mpirun`` reads a
+hostfile and ssh-es into each worker to start ``orted``
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:176-200, SURVEY.md
+§3.3). On TPU the bootstrap is inverted (SURVEY.md §7 "hard parts"): every
+host boots the same program; rendezvous is a coordinator handshake
+(``jax.distributed``), after which ``jax.devices()`` spans the whole slice and
+XLA collectives ride ICI.
+
+The handshake inputs come from the ``TPUJOB_*`` env the controller injects
+into every worker pod (controller/controller.py ENV_*), which is this
+framework's replacement for ``OMPI_MCA_orte_default_hostfile`` /
+``I_MPI_HYDRA_HOST_FILE``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Mapping, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# Env names are deliberately duplicated from controller/controller.py: worker
+# images ship only the runtime package, so bootstrap cannot import the
+# controller. tests/test_runtime.py asserts both copies stay identical.
+ENV_JOB_NAME = "TPUJOB_NAME"
+ENV_NAMESPACE = "TPUJOB_NAMESPACE"
+ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_NUM_HOSTS = "TPUJOB_NUM_HOSTS"
+ENV_HOST_ID = "TPUJOB_HOST_ID"
+ENV_CHIPS_PER_HOST = "TPUJOB_CHIPS_PER_HOST"
+ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"
+ENV_TOPOLOGY = "TPUJOB_TOPOLOGY"
+ENV_HOST_MESH = "TPUJOB_HOST_MESH"
+ENV_HOST_COORD = "TPUJOB_HOST_COORD"
+
+
+def _parse_shape(s: str) -> Tuple[int, ...]:
+    return tuple(int(p) for p in s.split("x")) if s else ()
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeContext:
+    """One host's view of the gang — everything the reference smeared across
+    hostfile + env + pod identity, in one immutable record."""
+
+    job_name: str = "local"
+    namespace: str = "default"
+    coordinator_address: str = ""
+    num_hosts: int = 1
+    host_id: int = 0
+    chips_per_host: int = 0  # 0 = undeclared; local_chips() discovers
+    accelerator: str = "cpu"
+    topology: Tuple[int, ...] = ()
+    host_mesh: Tuple[int, ...] = ()
+    host_coord: Tuple[int, ...] = ()
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_hosts > 1
+
+    def local_chips(self) -> int:
+        """Declared chips per host, or (when the controller didn't declare —
+        local dev runs) whatever XLA actually attached to this host."""
+        if self.chips_per_host:
+            return self.chips_per_host
+        import jax
+
+        return jax.local_device_count()
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Host 0 absorbs the reference's launcher role (SURVEY.md §7 phase 3:
+        the Launcher/Worker split collapses; host 0's exit status is the
+        job's)."""
+        return self.host_id == 0
+
+
+def context_from_env(environ: Optional[Mapping[str, str]] = None) -> RuntimeContext:
+    """Build the host's RuntimeContext from controller-injected env.
+
+    Absent env falls back to a single-host local context, so the same training
+    script runs unmodified on a dev machine (the reference has no analogue —
+    an MPIJob image cannot run outside ``mpirun``)."""
+    env = os.environ if environ is None else environ
+    return RuntimeContext(
+        job_name=env.get(ENV_JOB_NAME, "local"),
+        namespace=env.get(ENV_NAMESPACE, "default"),
+        coordinator_address=env.get(ENV_COORDINATOR, ""),
+        num_hosts=int(env.get(ENV_NUM_HOSTS, "1")),
+        host_id=int(env.get(ENV_HOST_ID, "0")),
+        chips_per_host=int(env.get(ENV_CHIPS_PER_HOST, "0") or 0),
+        accelerator=env.get(ENV_ACCELERATOR, "cpu"),
+        topology=_parse_shape(env.get(ENV_TOPOLOGY, "")),
+        host_mesh=_parse_shape(env.get(ENV_HOST_MESH, "")),
+        host_coord=_parse_shape(env.get(ENV_HOST_COORD, "")),
+    )
+
+
+_initialized_ctx: Optional[RuntimeContext] = None
+
+
+def initialize(
+    ctx: Optional[RuntimeContext] = None,
+    *,
+    environ: Optional[Mapping[str, str]] = None,
+) -> RuntimeContext:
+    """Rendezvous with the gang. Idempotent; returns the active context.
+
+    Single-host contexts skip the distributed handshake entirely (≙ running
+    ``mpirun -n 1`` without any hostfile). Multi-host contexts call
+    ``jax.distributed.initialize`` — the coordinator (host 0) binds the port
+    the controller advertised via the headless service DNS name; every other
+    host dials it. This is the TPU-native replacement for the v2 SSH wireup
+    (SURVEY.md §3.3) and the v1 kubectl-exec path (§3.4).
+    """
+    global _initialized_ctx
+    if _initialized_ctx is not None:
+        return _initialized_ctx
+    if ctx is None:
+        ctx = context_from_env(environ)
+    if ctx.is_distributed:
+        import jax
+
+        if not ctx.coordinator_address:
+            raise RuntimeError(
+                f"{ENV_NUM_HOSTS}={ctx.num_hosts} but {ENV_COORDINATOR} is "
+                "unset — the controller always injects both; refusing to guess"
+            )
+        log.info(
+            "rendezvous: job=%s host %d/%d coordinator=%s",
+            ctx.job_name,
+            ctx.host_id,
+            ctx.num_hosts,
+            ctx.coordinator_address,
+        )
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_hosts,
+            process_id=ctx.host_id,
+        )
+    _initialized_ctx = ctx
+    return ctx
+
+
+def active_context() -> Optional[RuntimeContext]:
+    return _initialized_ctx
+
+
+def _reset_for_tests() -> None:
+    global _initialized_ctx
+    _initialized_ctx = None

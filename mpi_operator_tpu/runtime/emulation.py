@@ -1,0 +1,124 @@
+"""Local multi-host gang emulation (the envtest trick for the runtime layer).
+
+The reference tests its controller against a real apiserver with *simulated*
+pod phases (SURVEY.md §4.2) because no kubelet exists in CI. The equivalent
+problem here is testing the multi-host rendezvous + collectives without a
+TPU pod slice. Solution: spawn N local OS processes, each pinned to CPU
+(``JAX_PLATFORMS=cpu``), each given exactly the ``TPUJOB_*`` env the
+controller would inject (controller/controller.py:440-452), all
+rendezvousing over localhost TCP via ``jax.distributed``. Real handshake,
+real collectives (XLA's CPU ring), zero hardware — N processes ≙ N hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from mpi_operator_tpu.runtime import bootstrap
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class GangResult:
+    returncodes: List[int]
+    stdouts: List[str]
+    stderrs: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+
+@dataclass
+class LocalGang:
+    """Launch ``num_hosts`` copies of a worker script as an SPMD gang.
+
+    This is also what the pi smoke test (examples/pi ≙
+    /root/reference/examples/pi/pi.cc) runs under: the same program on every
+    host, sum-reduce to host 0, host 0 prints.
+    """
+
+    num_hosts: int
+    job_name: str = "local-gang"
+    chips_per_host: int = 1
+    extra_env: Dict[str, str] = field(default_factory=dict)
+    timeout: float = 120.0
+
+    def env_for(self, host_id: int, coordinator_port: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                bootstrap.ENV_JOB_NAME: self.job_name,
+                bootstrap.ENV_NAMESPACE: "default",
+                bootstrap.ENV_COORDINATOR: f"127.0.0.1:{coordinator_port}",
+                bootstrap.ENV_NUM_HOSTS: str(self.num_hosts),
+                bootstrap.ENV_HOST_ID: str(host_id),
+                bootstrap.ENV_CHIPS_PER_HOST: str(self.chips_per_host),
+                bootstrap.ENV_ACCELERATOR: "cpu",
+                bootstrap.ENV_TOPOLOGY: f"{self.num_hosts * self.chips_per_host}",
+                bootstrap.ENV_HOST_MESH: f"{self.num_hosts}",
+                bootstrap.ENV_HOST_COORD: str(host_id),
+            }
+        )
+        if self.chips_per_host > 1:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={self.chips_per_host}"
+            ).strip()
+        return env
+
+    def run(
+        self, script: str, args: Sequence[str] = (), cwd: Optional[str] = None
+    ) -> GangResult:
+        port = free_port()
+        procs = []
+        for host_id in range(self.num_hosts):
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, script, *args],
+                    env=self.env_for(host_id, port),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    cwd=cwd,
+                )
+            )
+        # Drain every pipe concurrently: sequential communicate() deadlocks
+        # the gang when a later-reaped host fills its pipe buffer mid-
+        # collective while an earlier host is still being waited on.
+        results: Dict[int, tuple] = {}
+
+        def _reap(i: int, p: subprocess.Popen) -> None:
+            try:
+                out, err = p.communicate(timeout=self.timeout)
+                results[i] = (p.returncode, out, err)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                results[i] = (-9, out, err + "\n[gang] timeout, killed")
+
+        threads = [
+            threading.Thread(target=_reap, args=(i, p), daemon=True)
+            for i, p in enumerate(procs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rcs = [results[i][0] for i in range(self.num_hosts)]
+        outs = [results[i][1] for i in range(self.num_hosts)]
+        errs = [results[i][2] for i in range(self.num_hosts)]
+        return GangResult(returncodes=rcs, stdouts=outs, stderrs=errs)

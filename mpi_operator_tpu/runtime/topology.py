@@ -1,0 +1,169 @@
+"""Slice topology → jax.sharding.Mesh construction.
+
+The reference has no notion of interconnect topology: MPI ranks are flat and
+Horovod's ring is formed at runtime over whatever TCP routes exist (SURVEY.md
+§2.5). On TPU the device mesh IS the performance model — collectives along a
+mesh axis ride ICI only if that axis maps onto physically adjacent chips —
+so mesh construction is a first-class runtime primitive here.
+
+Axis vocabulary (fixed, so every layer — models, trainer, bench — speaks the
+same names):
+
+- ``data``      batch sharding (pure DP; gradient psum ≙ Horovod allreduce)
+- ``fsdp``      batch + parameter sharding (ZeRO-3-style, rides ICI)
+- ``tensor``    megatron-style tensor parallelism (activations all-reduce)
+- ``sequence``  context/sequence parallelism (ring attention via ppermute)
+- ``expert``    MoE expert parallelism (all_to_all dispatch)
+- ``pipe``      pipeline stages (microbatched, ppermute between stages)
+
+A mesh never needs all six: :class:`MeshPlan` names only the axes with size>1
+and :func:`build_mesh` lays them out best-ICI-first. Across slices (DCN), the
+plan's ``dcn`` sizes produce a hybrid mesh where only the outermost
+(gradient-reduction) axes cross the slow network — the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_SEQ = "sequence"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
+AXIS_TENSOR = "tensor"
+
+# Canonical ordering, outermost (cheapest to put on DCN, reduced least often)
+# to innermost (hottest collectives, must sit on shortest ICI paths). This is
+# the order build_mesh lays axes onto the physical device array.
+MESH_AXES: Tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_EXPERT,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Logical mesh layout: axis name → size. ``dcn`` gives the per-axis
+    slice-count for multi-slice (DCN-spanning) meshes; only leading axes may
+    cross DCN."""
+
+    axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    dcn: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        for name in list(self.axes) + list(self.dcn):
+            if name not in MESH_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {name!r}; the vocabulary is {MESH_AXES}"
+                )
+
+    @property
+    def ici_size(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+    @property
+    def dcn_size(self) -> int:
+        return math.prod(self.dcn.values()) if self.dcn else 1
+
+    @property
+    def total_devices(self) -> int:
+        return self.ici_size * self.dcn_size
+
+    def ordered(self) -> Tuple[Tuple[str, int], ...]:
+        """All axes in canonical order with combined (dcn*ici) sizes."""
+        out = []
+        for name in MESH_AXES:
+            size = self.axes.get(name, 1) * self.dcn.get(name, 1)
+            if size > 1 or name in self.axes or name in self.dcn:
+                out.append((name, size))
+        if not out:
+            out.append((AXIS_DATA, 1))
+        return tuple(out)
+
+    @staticmethod
+    def data_parallel(n: int) -> "MeshPlan":
+        return MeshPlan(axes={AXIS_DATA: n})
+
+
+def _cpu_or_flat_mesh(shape: Sequence[int], devices) -> np.ndarray:
+    return np.asarray(devices).reshape(tuple(shape))
+
+
+def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None):
+    """Materialize the plan as a ``jax.sharding.Mesh``.
+
+    On TPU backends this delegates to ``mesh_utils.create_device_mesh`` (and
+    ``create_hybrid_device_mesh`` when the plan spans DCN), which permutes
+    devices so that the innermost logical axes land on physical ICI rings.
+    On CPU/emulated backends (tests, the driver's virtual 8-device mesh) the
+    device list is reshaped row-major — there is no physical topology to
+    optimize.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(n for n, _ in plan.ordered())
+    sizes = tuple(s for _, s in plan.ordered())
+    total = math.prod(sizes)
+    if total != len(devices):
+        raise ValueError(
+            f"mesh plan wants {total} devices ({dict(plan.ordered())}) but "
+            f"{len(devices)} are visible — gang placement and plan disagree"
+        )
+
+    platform = getattr(devices[0], "platform", "cpu")
+    if platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        if plan.dcn_size > 1:
+            ici_shape = [plan.axes.get(n, 1) for n in names]
+            dcn_shape = [plan.dcn.get(n, 1) for n in names]
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        else:
+            dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    else:
+        dev_array = _cpu_or_flat_mesh(sizes, devices)
+    return Mesh(dev_array, names)
+
+
+def mesh_from_context(
+    ctx,
+    plan: Optional[MeshPlan] = None,
+):
+    """Build the job-wide mesh for a bootstrapped host.
+
+    With no explicit plan, defaults to pure data parallelism over every chip
+    in the slice — the moral equivalent of the reference's Horovod ring over
+    all ranks (examples/horovod/tensorflow_mnist.py, SURVEY.md §2.5).
+
+    Fails fast when the gang the controller declared (num_hosts ×
+    chips_per_host) disagrees with what XLA sees after rendezvous — the
+    TPU-side analogue of mpirun's "not enough slots" error; without it a
+    worker with mangled env would silently train on a local-only mesh.
+    """
+    import jax
+
+    if ctx is not None and ctx.chips_per_host:
+        expected = ctx.num_hosts * ctx.chips_per_host
+        if expected != jax.device_count():
+            raise RuntimeError(
+                f"gang declares {ctx.num_hosts} hosts × {ctx.chips_per_host} "
+                f"chips = {expected} devices but XLA sees "
+                f"{jax.device_count()} — rendezvous and placement disagree"
+            )
+    if plan is None:
+        plan = MeshPlan.data_parallel(jax.device_count())
+    return build_mesh(plan)

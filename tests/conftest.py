@@ -1,19 +1,25 @@
 """Test configuration.
 
-All tests run on a virtual 8-device CPU mesh (the envtest-equivalent trick from
-SURVEY.md §4: real semantics, no TPU hardware) — JAX must see the flags before
-first import, so they are set at conftest import time.
+All tests run on a virtual 8-device CPU mesh (the envtest-equivalent trick
+from SURVEY.md §4: real semantics, no TPU hardware). In this environment jax
+is already imported at interpreter startup (a sitecustomize registers a TPU
+backend and pins JAX_PLATFORMS), so env vars alone don't switch platform —
+the jax.config update below is what actually forces CPU. XLA_FLAGS still
+applies because no backend has been initialized yet at conftest import time.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

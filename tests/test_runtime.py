@@ -1,0 +1,127 @@
+"""Runtime layer tests: env contract, context, mesh construction.
+
+Mirrors the reference's env-wiring tests (the launcher env assertions inside
+TestNewLauncherAndWorker, /root/reference/v2/pkg/controller/
+mpi_job_controller_test.go:937) — here the consumer side is tested too,
+which the reference cannot do (its consumer is mpirun)."""
+
+import jax
+import pytest
+
+from mpi_operator_tpu.runtime import (
+    MeshPlan,
+    RuntimeContext,
+    build_mesh,
+    context_from_env,
+    mesh_from_context,
+)
+from mpi_operator_tpu.runtime import bootstrap
+from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_SEQ, AXIS_TENSOR
+
+
+def test_env_names_match_controller_contract():
+    """bootstrap deliberately duplicates the controller's env names (worker
+    images don't ship the controller); this pins the two copies together."""
+    from mpi_operator_tpu.controller import controller as ctrl
+
+    for name in (
+        "ENV_JOB_NAME",
+        "ENV_NAMESPACE",
+        "ENV_COORDINATOR",
+        "ENV_NUM_HOSTS",
+        "ENV_HOST_ID",
+        "ENV_CHIPS_PER_HOST",
+        "ENV_ACCELERATOR",
+        "ENV_TOPOLOGY",
+        "ENV_HOST_MESH",
+        "ENV_HOST_COORD",
+    ):
+        assert getattr(bootstrap, name) == getattr(ctrl, name), name
+
+
+def test_local_chips_discovery():
+    assert RuntimeContext(chips_per_host=4).local_chips() == 4
+    assert RuntimeContext().local_chips() == jax.local_device_count()
+
+
+def test_mesh_from_context_gang_mismatch_fails_fast():
+    ctx = RuntimeContext(num_hosts=3, chips_per_host=4)
+    with pytest.raises(RuntimeError, match="rendezvous and placement disagree"):
+        mesh_from_context(ctx)
+
+
+def test_context_from_empty_env_is_local():
+    ctx = context_from_env({})
+    assert ctx.num_hosts == 1
+    assert not ctx.is_distributed
+    assert ctx.is_coordinator
+    assert ctx.accelerator == "cpu"
+
+
+def test_context_parses_controller_env():
+    env = {
+        bootstrap.ENV_JOB_NAME: "train",
+        bootstrap.ENV_NAMESPACE: "ml",
+        bootstrap.ENV_COORDINATOR: "train-worker-0.train-worker:8476",
+        bootstrap.ENV_NUM_HOSTS: "16",
+        bootstrap.ENV_HOST_ID: "5",
+        bootstrap.ENV_CHIPS_PER_HOST: "4",
+        bootstrap.ENV_ACCELERATOR: "v5p",
+        bootstrap.ENV_TOPOLOGY: "4x4x4",
+        bootstrap.ENV_HOST_MESH: "2x2x4",
+        bootstrap.ENV_HOST_COORD: "0x1x1",
+    }
+    ctx = context_from_env(env)
+    assert ctx.is_distributed and not ctx.is_coordinator
+    assert ctx.topology == (4, 4, 4)
+    assert ctx.host_mesh == (2, 2, 4)
+    assert ctx.host_coord == (0, 1, 1)
+    assert ctx.chips_per_host == 4
+
+
+def test_initialize_single_host_skips_handshake():
+    bootstrap._reset_for_tests()
+    ctx = bootstrap.initialize(environ={})
+    assert ctx.num_hosts == 1
+    assert bootstrap.active_context() is ctx
+    # idempotent
+    assert bootstrap.initialize() is ctx
+    bootstrap._reset_for_tests()
+
+
+def test_initialize_distributed_requires_coordinator():
+    bootstrap._reset_for_tests()
+    with pytest.raises(RuntimeError, match="COORDINATOR"):
+        bootstrap.initialize(environ={bootstrap.ENV_NUM_HOSTS: "4"})
+    bootstrap._reset_for_tests()
+
+
+def test_mesh_plan_ordering_and_sizes():
+    plan = MeshPlan(axes={AXIS_TENSOR: 2, AXIS_DATA: 4})
+    assert plan.total_devices == 8
+    # canonical order puts data before tensor regardless of dict order
+    assert [n for n, _ in plan.ordered()] == [AXIS_DATA, AXIS_TENSOR]
+
+
+def test_mesh_plan_rejects_unknown_axis():
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        MeshPlan(axes={"rows": 2})
+
+
+def test_build_mesh_cpu():
+    plan = MeshPlan(axes={AXIS_DATA: 2, AXIS_SEQ: 4})
+    mesh = build_mesh(plan)
+    assert mesh.axis_names == (AXIS_DATA, AXIS_SEQ)
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_build_mesh_device_count_mismatch():
+    with pytest.raises(ValueError, match="disagree"):
+        build_mesh(MeshPlan(axes={AXIS_DATA: 3}))
+
+
+def test_mesh_from_context_defaults_to_pure_dp():
+    ctx = RuntimeContext()
+    mesh = mesh_from_context(ctx)
+    assert mesh.axis_names == (AXIS_DATA,)
+    assert mesh.devices.size == jax.device_count()

@@ -1,0 +1,70 @@
+"""Logical-axis sharding rule tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from mpi_operator_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_spec,
+    mesh_filtered_spec,
+    named_sharding,
+    with_logical_constraint,
+)
+from mpi_operator_tpu.runtime import MeshPlan, build_mesh
+from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_TENSOR
+
+
+def test_logical_spec_basic():
+    assert logical_spec(["batch", "seq", "embed"]) == P(
+        ("data", "fsdp"), "sequence", "fsdp"
+    ) or logical_spec(["batch", "seq", "embed"]) == P(("data", "fsdp"), "sequence")
+
+
+def test_logical_spec_no_duplicate_mesh_axes():
+    # "embed" wants fsdp but batch already consumed it → embed replicates
+    spec = logical_spec(["batch", "embed"])
+    assert spec[0] == ("data", "fsdp")
+    assert len(spec) == 1  # trailing None trimmed
+
+
+def test_logical_spec_replicated_axes():
+    assert logical_spec([None, "stats"]) == P()
+
+
+def test_mesh_filtered_spec_drops_absent_axes():
+    mesh = build_mesh(MeshPlan(axes={AXIS_DATA: 8}))
+    spec = logical_spec(["batch", "heads"])
+    filtered = mesh_filtered_spec(spec, mesh)
+    assert filtered == P("data")
+
+
+def test_named_sharding_places_batch():
+    mesh = build_mesh(MeshPlan(axes={AXIS_DATA: 4, AXIS_TENSOR: 2}))
+    ns = named_sharding(mesh, ["batch", "mlp"])
+    x = jax.device_put(jnp.zeros((8, 16)), ns)
+    assert x.sharding.spec == P(("data",), "tensor") or x.sharding.spec == P(
+        "data", "tensor"
+    )
+
+
+def test_with_logical_constraint_in_jit():
+    mesh = build_mesh(MeshPlan(axes={AXIS_DATA: 8}))
+
+    @jax.jit
+    def f(x):
+        return with_logical_constraint(x * 2, ["batch", "embed"], mesh=mesh)
+
+    out = f(jnp.ones((16, 4)))
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_with_logical_constraint_noop_without_mesh():
+    out = with_logical_constraint(jnp.ones(4), ["batch"])
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_default_rules_cover_model_axes():
+    for ax in ["batch", "seq", "embed", "heads", "mlp", "vocab", "expert"]:
+        assert ax in DEFAULT_RULES

@@ -1,0 +1,30 @@
+"""Workload model library (≙ the reference's examples/ images).
+
+The reference ships training workloads as opaque container images — TF
+benchmarks ResNet-101, Horovod TF MNIST, MXNet MNIST
+(/root/reference/examples/, SURVEY.md §2.6). Here the workloads are a
+first-class library, TPU-native:
+
+- plain functional JAX (init/apply pairs over param pytrees) so pjit sees
+  every array;
+- every model exposes a ``logical_axes`` pytree (same structure as params)
+  consumed by parallel/sharding.py — the same model runs pure-DP, FSDP, TP,
+  or sequence-parallel by swapping the rule table, never by editing the model;
+- bf16 compute / f32 params+optimizer by default (MXU-native);
+- ``flops_per_sample`` accounting so bench.py can report MFU.
+
+Families: mnist (≙ examples/horovod/tensorflow_mnist.py and the MXNet MNIST),
+resnet (≙ tf_cnn_benchmarks --model=resnet101, the headline benchmark),
+llama (the BASELINE.md Llama-3-8B DP/long-context config).
+"""
+
+from mpi_operator_tpu.models import llama, mnist, resnet
+
+MODELS = {
+    "mnist": mnist,
+    "resnet50": resnet,
+    "resnet101": resnet,
+    "llama": llama,
+}
+
+__all__ = ["mnist", "resnet", "llama", "MODELS"]

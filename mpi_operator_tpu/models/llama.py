@@ -1,0 +1,248 @@
+"""Llama-family decoder (the BASELINE.md Llama-3-8B config).
+
+The reference has no LLM workload — its examples top out at CNN scale
+(SURVEY.md §2.6) — but BASELINE.md's acceptance configs require a
+Llama-3-8B-class data-parallel + long-context workload. TPU-native design:
+
+- scan-over-layers: all layer params stacked on a leading axis and the
+  decoder body is one ``lax.scan`` — O(1) HLO size regardless of depth,
+  which is what keeps 32-layer compile times sane on TPU;
+- bf16 compute, f32 params/optimizer;
+- GQA (grouped-query attention) with RoPE; K/V heads expanded to Q heads
+  only at the attention call;
+- long context via parallel/ring_attention.py when the mesh has a
+  ``sequence`` axis — RoPE and norms operate on global [B,T,D] arrays (XLA
+  global-view), only the attention inner loop is manually ring-scheduled;
+- logical-axis pytree drives DP/FSDP/TP/SP resharding with zero model edits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_operator_tpu.parallel.ring_attention import (
+    _single_device_attention,
+    ring_attention,
+)
+from mpi_operator_tpu.parallel.sharding import with_logical_constraint
+from mpi_operator_tpu.runtime.topology import AXIS_SEQ
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab: int = 128_256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    d_ff: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+def llama3_8b() -> Config:
+    return Config()
+
+
+def tiny(vocab: int = 256) -> Config:
+    """Test-scale config with the same architecture (GQA ratio included)."""
+    return Config(
+        vocab=vocab, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, d_ff=128, rope_theta=10_000.0,
+    )
+
+
+def _normal(key, shape, scale):
+    return jax.random.normal(key, shape, jnp.float32) * scale
+
+
+def init(config: Config, key) -> Params:
+    c = config
+    ke, kl, kh = jax.random.split(key, 3)
+    lk = jax.random.split(kl, 7)
+    n, d = c.n_layers, c.d_model
+    s_d = d**-0.5
+    s_ff = c.d_ff**-0.5
+    s_q = c.q_dim**-0.5
+    return {
+        "embed": {"w": _normal(ke, (c.vocab, d), 1.0)},
+        # all layers stacked on axis 0 → lax.scan over the leading axis
+        "layers": {
+            "attn_norm": {"scale": jnp.ones((n, d), jnp.float32)},
+            "wq": {"w": _normal(lk[0], (n, d, c.q_dim), s_d)},
+            "wk": {"w": _normal(lk[1], (n, d, c.kv_dim), s_d)},
+            "wv": {"w": _normal(lk[2], (n, d, c.kv_dim), s_d)},
+            "wo": {"w": _normal(lk[3], (n, c.q_dim, d), s_q)},
+            "mlp_norm": {"scale": jnp.ones((n, d), jnp.float32)},
+            "w_gate": {"w": _normal(lk[4], (n, d, c.d_ff), s_d)},
+            "w_up": {"w": _normal(lk[5], (n, d, c.d_ff), s_d)},
+            "w_down": {"w": _normal(lk[6], (n, c.d_ff, d), s_ff)},
+        },
+        "final_norm": {"scale": jnp.ones((d,), jnp.float32)},
+        "lm_head": {"w": _normal(kh, (d, c.vocab), s_d)},
+    }
+
+
+def logical_axes(config: Config) -> Params:
+    # leading "layers" stack axis is always replicated (None)
+    return {
+        "embed": {"w": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": {"scale": (None, "stats")},
+            "wq": {"w": (None, "embed", "heads")},
+            "wk": {"w": (None, "embed", "kv_heads")},
+            "wv": {"w": (None, "embed", "kv_heads")},
+            "wo": {"w": (None, "heads", "embed")},
+            "mlp_norm": {"scale": (None, "stats")},
+            "w_gate": {"w": (None, "embed", "mlp")},
+            "w_up": {"w": (None, "embed", "mlp")},
+            "w_down": {"w": (None, "mlp", "embed")},
+        },
+        "final_norm": {"scale": ("stats",)},
+        "lm_head": {"w": ("embed", "vocab")},
+    }
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _rope(x, theta):
+    """x [B,T,H,Dh] with global positions 0..T-1 (arrays are global-view;
+    sequence sharding is XLA's problem, not RoPE's)."""
+    b, t, h, dh = x.shape
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def apply(
+    config: Config,
+    params: Params,
+    tokens,
+    *,
+    mesh=None,
+    rules=None,
+) -> jnp.ndarray:
+    """tokens [B,T] int32 → logits [B,T,vocab] f32.
+
+    With a mesh that has a ``sequence`` axis, attention runs as ring
+    attention over ICI; otherwise dense causal attention. All other ops are
+    global-view and sharded by constraint propagation."""
+    c = config
+    dt = c.compute_dtype
+    use_ring = mesh is not None and AXIS_SEQ in mesh.axis_names and (
+        mesh.shape[AXIS_SEQ] > 1
+    )
+
+    def constrain(x, axes):
+        if mesh is None:
+            return x
+        return with_logical_constraint(x, axes, rules=rules, mesh=mesh)
+
+    x = params["embed"]["w"].astype(dt)[tokens]
+    x = constrain(x, ["batch", "seq", "embed"])
+
+    def layer(carry, lp):
+        h = carry
+        y = _rmsnorm(h, lp["attn_norm"]["scale"], c.norm_eps)
+        b, t, _ = y.shape
+        q = (y @ lp["wq"]["w"].astype(dt)).reshape(b, t, c.n_heads, c.head_dim)
+        k = (y @ lp["wk"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+        v = (y @ lp["wv"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+        q = _rope(q, c.rope_theta)
+        k = _rope(k, c.rope_theta)
+        # GQA: expand K/V groups to Q heads at the attention boundary
+        rep = c.n_heads // c.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        if use_ring:
+            attn = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            attn = _single_device_attention(
+                q, k, v, causal=True, scale=c.head_dim**-0.5
+            )
+        attn = attn.reshape(b, t, c.q_dim)
+        h = h + attn @ lp["wo"]["w"].astype(dt)
+        h = constrain(h, ["batch", "seq", "embed"])
+        y = _rmsnorm(h, lp["mlp_norm"]["scale"], c.norm_eps)
+        gate = jax.nn.silu(y @ lp["w_gate"]["w"].astype(dt))
+        up = y @ lp["w_up"]["w"].astype(dt)
+        h = h + (gate * up) @ lp["w_down"]["w"].astype(dt)
+        h = constrain(h, ["batch", "seq", "embed"])
+        return h, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"]["scale"], c.norm_eps)
+    logits = x @ params["lm_head"]["w"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    config: Config,
+    params: Params,
+    batch,
+    *,
+    mesh=None,
+    rules=None,
+) -> jnp.ndarray:
+    """Next-token cross-entropy. batch = {"tokens": [B,T]}; position t
+    predicts token t+1; the final position is dropped."""
+    tokens = batch["tokens"]
+    logits = apply(config, params, tokens, mesh=mesh, rules=rules)
+    targets = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1])
+    ll = jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def param_count(config: Config) -> int:
+    c = config
+    per_layer = (
+        c.d_model * (c.q_dim + 2 * c.kv_dim)
+        + c.q_dim * c.d_model
+        + 3 * c.d_model * c.d_ff
+        + 2 * c.d_model
+    )
+    return (
+        c.vocab * c.d_model
+        + c.n_layers * per_layer
+        + c.d_model
+        + c.d_model * c.vocab
+    )
+
+
+def flops_per_token(config: Config, seq_len: int) -> float:
+    """Forward matmul FLOPs per token (2·MACs); attention term included."""
+    c = config
+    matmul_params = (
+        c.d_model * (c.q_dim + 2 * c.kv_dim)
+        + c.q_dim * c.d_model
+        + 3 * c.d_model * c.d_ff
+    )
+    per_layer = 2 * matmul_params + 4 * seq_len * c.q_dim  # scores + PV
+    return float(c.n_layers * per_layer + 2 * c.d_model * c.vocab)

@@ -1,0 +1,97 @@
+"""MNIST convnet (≙ examples/horovod/tensorflow_mnist.py and
+examples/mxnet/mxnet_mnist.py in the reference — both small Horovod-DP
+convnets; SURVEY.md §2.6).
+
+Same shape as the reference workload: two conv+pool blocks, two dense
+layers, softmax cross-entropy. NHWC, bf16 compute."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_classes: int = 10
+    image_size: int = 28
+    channels: int = 1
+    hidden: int = 128
+    compute_dtype: Any = jnp.bfloat16
+
+
+Params = Dict[str, Any]
+
+
+def _he(key, shape, fan_in):
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def init(config: Config, key) -> Params:
+    k = jax.random.split(key, 4)
+    s = config.image_size // 4  # two 2x2 pools
+    flat = s * s * 64
+    return {
+        "conv1": {"w": _he(k[0], (5, 5, config.channels, 32), 25 * config.channels)},
+        "conv2": {"w": _he(k[1], (5, 5, 32, 64), 25 * 32)},
+        "dense1": {
+            "w": _he(k[2], (flat, config.hidden), flat),
+            "b": jnp.zeros((config.hidden,), jnp.float32),
+        },
+        "dense2": {
+            "w": _he(k[3], (config.hidden, config.num_classes), config.hidden),
+            "b": jnp.zeros((config.num_classes,), jnp.float32),
+        },
+    }
+
+
+def logical_axes(config: Config) -> Params:
+    return {
+        "conv1": {"w": ("conv_kernel", "conv_kernel", "conv_in", "conv_out")},
+        "conv2": {"w": ("conv_kernel", "conv_kernel", "conv_in", "conv_out")},
+        "dense1": {"w": ("embed", "mlp"), "b": ("mlp",)},
+        "dense2": {"w": ("mlp", "vocab"), "b": ("vocab",)},
+    }
+
+
+def _conv_pool(x, w):
+    x = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    x = jax.nn.relu(x)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def apply(config: Config, params: Params, images) -> jnp.ndarray:
+    """images [B, H, W, C] → logits [B, num_classes]."""
+    dt = config.compute_dtype
+    x = images.astype(dt)
+    x = _conv_pool(x, params["conv1"]["w"].astype(dt))
+    x = _conv_pool(x, params["conv2"]["w"].astype(dt))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense1"]["w"].astype(dt) + params["dense1"]["b"].astype(dt))
+    logits = x @ params["dense2"]["w"].astype(dt) + params["dense2"]["b"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(config: Config, params: Params, batch) -> jnp.ndarray:
+    logits = apply(config, params, batch["image"])
+    labels = jax.nn.one_hot(batch["label"], config.num_classes)
+    return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+
+def flops_per_sample(config: Config) -> float:
+    s = config.image_size
+    c1 = 2 * 25 * config.channels * 32 * s * s
+    c2 = 2 * 25 * 32 * 64 * (s // 2) ** 2
+    flat = (s // 4) ** 2 * 64
+    d1 = 2 * flat * config.hidden
+    d2 = 2 * config.hidden * config.num_classes
+    return float(c1 + c2 + d1 + d2)

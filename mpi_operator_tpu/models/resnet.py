@@ -1,0 +1,218 @@
+"""ResNet v1.5 (50/101) — the headline benchmark workload.
+
+≙ the reference's ``tf_cnn_benchmarks --model=resnet101`` image
+(/root/reference/examples/v1/tensorflow-benchmarks.yaml, README.md:166-176;
+baseline 154.2 images/sec/GPU, BASELINE.md). TPU-native choices: NHWC layout
+(MXU-friendly; the reference runs NCHW for cuDNN), bf16 compute with f32
+params and batch-norm statistics, and *global* batch norm for free — under
+jit with the batch sharded over data axes, the reduction in the BN mean/var
+IS the cross-replica mean, so there is no separate sync-BN machinery.
+
+Functional: ``init``/``apply`` over (params, state) pytrees; state carries BN
+running stats (threaded, not mutated)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+STAGE_BLOCKS = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    depth: str = "resnet101"
+    num_classes: int = 1000
+    image_size: int = 224
+    channels: int = 3
+    width: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @property
+    def stage_blocks(self) -> Tuple[int, ...]:
+        return STAGE_BLOCKS[self.depth]
+
+
+def _he(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2] if len(shape) == 4 else shape[0]
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _bn_init(c):
+    return (
+        {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)},
+        {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)},
+    )
+
+
+def _block_channels(config: Config) -> List[Tuple[int, int, int]]:
+    """(in, mid, out) per block, flattened over stages."""
+    chans = []
+    w = config.width
+    c_in = w
+    for stage, n_blocks in enumerate(config.stage_blocks):
+        mid = w * 2**stage
+        out = mid * 4
+        for _ in range(n_blocks):
+            chans.append((c_in, mid, out))
+            c_in = out
+    return chans
+
+
+def init(config: Config, key) -> Tuple[Params, Params]:
+    keys = iter(jax.random.split(key, 4 * len(_block_channels(config)) + 8))
+    params: Params = {}
+    state: Params = {}
+    params["stem"] = {"w": _he(next(keys), (7, 7, config.channels, config.width))}
+    params["stem_bn"], state["stem_bn"] = _bn_init(config.width)
+    for i, (c_in, mid, out) in enumerate(_block_channels(config)):
+        blk: Params = {
+            "conv1": {"w": _he(next(keys), (1, 1, c_in, mid))},
+            "conv2": {"w": _he(next(keys), (3, 3, mid, mid))},
+            "conv3": {"w": _he(next(keys), (1, 1, mid, out))},
+        }
+        blk["bn1"], s1 = _bn_init(mid)
+        blk["bn2"], s2 = _bn_init(mid)
+        blk["bn3"], s3 = _bn_init(out)
+        sblk = {"bn1": s1, "bn2": s2, "bn3": s3}
+        if c_in != out:
+            blk["proj"] = {"w": _he(next(keys), (1, 1, c_in, out))}
+            blk["proj_bn"], sproj = _bn_init(out)
+            sblk["proj_bn"] = sproj
+        params[f"block{i}"] = blk
+        state[f"block{i}"] = sblk
+    final = _block_channels(config)[-1][2]
+    params["head"] = {
+        "w": _he(next(keys), (final, config.num_classes)),
+        "b": jnp.zeros((config.num_classes,), jnp.float32),
+    }
+    return params, state
+
+
+def logical_axes(config: Config) -> Tuple[Params, Params]:
+    conv = {"w": ("conv_kernel", "conv_kernel", "conv_in", "conv_out")}
+    bn = {"scale": ("stats",), "bias": ("stats",)}
+    bns = {"mean": ("stats",), "var": ("stats",)}
+    params: Params = {"stem": conv, "stem_bn": bn}
+    state: Params = {"stem_bn": bns}
+    for i, (c_in, _, out) in enumerate(_block_channels(config)):
+        blk = {"conv1": conv, "conv2": conv, "conv3": conv,
+               "bn1": bn, "bn2": bn, "bn3": bn}
+        sblk = {"bn1": bns, "bn2": bns, "bn3": bns}
+        if c_in != out:
+            blk["proj"] = conv
+            blk["proj_bn"] = bn
+            sblk["proj_bn"] = bns
+        params[f"block{i}"] = blk
+        state[f"block{i}"] = sblk
+    params["head"] = {"w": ("embed", "vocab"), "b": ("vocab",)}
+    return params, state
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(w.shape[0] // 2,) * 2, (w.shape[1] // 2,) * 2],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(config, x, p, s, train):
+    """BN in f32 (bf16 variance underflows). Returns (y, new_running)."""
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        mom = config.bn_momentum
+        new_s = {
+            "mean": mom * s["mean"] + (1 - mom) * mean,
+            "var": mom * s["var"] + (1 - mom) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    y = (x32 - mean) * lax.rsqrt(var + config.bn_epsilon)
+    y = y * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def apply(config: Config, params: Params, state: Params, images, train: bool = True):
+    """images [B,H,W,C] → (logits [B,classes] f32, new_state)."""
+    dt = config.compute_dtype
+    new_state: Params = {}
+    x = images.astype(dt)
+    x = _conv(x, params["stem"]["w"].astype(dt), stride=2)
+    x, new_state["stem_bn"] = _bn(config, x, params["stem_bn"], state["stem_bn"], train)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)]
+    )
+    block_idx = 0
+    for stage, n_blocks in enumerate(config.stage_blocks):
+        for b in range(n_blocks):
+            blk = params[f"block{block_idx}"]
+            sblk = state[f"block{block_idx}"]
+            nblk: Params = {}
+            stride = 2 if (stage > 0 and b == 0) else 1
+            shortcut = x
+            y = _conv(x, blk["conv1"]["w"].astype(dt))
+            y, nblk["bn1"] = _bn(config, y, blk["bn1"], sblk["bn1"], train)
+            y = jax.nn.relu(y)
+            # v1.5: the 3x3 carries the stride (not the 1x1)
+            y = _conv(y, blk["conv2"]["w"].astype(dt), stride=stride)
+            y, nblk["bn2"] = _bn(config, y, blk["bn2"], sblk["bn2"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv3"]["w"].astype(dt))
+            y, nblk["bn3"] = _bn(config, y, blk["bn3"], sblk["bn3"], train)
+            if "proj" in blk:
+                shortcut = _conv(x, blk["proj"]["w"].astype(dt), stride=stride)
+                shortcut, nblk["proj_bn"] = _bn(
+                    config, shortcut, blk["proj_bn"], sblk["proj_bn"], train
+                )
+            elif stride != 1:  # pragma: no cover - never hit in v1.5 layouts
+                shortcut = shortcut[:, ::stride, ::stride]
+            x = jax.nn.relu(y + shortcut)
+            new_state[f"block{block_idx}"] = nblk
+            block_idx += 1
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_state
+
+
+def loss_fn(config: Config, params: Params, state: Params, batch, train: bool = True):
+    logits, new_state = apply(config, params, state, batch["image"], train)
+    labels = jax.nn.one_hot(batch["label"], config.num_classes)
+    loss = -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+    return loss, new_state
+
+
+def flops_per_sample(config: Config) -> float:
+    """Analytic forward-pass matmul/conv FLOPs per image (2·MACs)."""
+    size = config.image_size
+    total = 0.0
+    h = size // 2  # stem stride 2
+    total += 2 * 49 * config.channels * config.width * h * h
+    h = (h + 1) // 2  # maxpool stride 2
+    block_idx = 0
+    for stage, n_blocks in enumerate(config.stage_blocks):
+        for b in range(n_blocks):
+            c_in, mid, out = _block_channels(config)[block_idx]
+            stride = 2 if (stage > 0 and b == 0) else 1
+            total += 2 * c_in * mid * h * h  # 1x1
+            h_out = h // stride
+            total += 2 * 9 * mid * mid * h_out * h_out  # 3x3 (strided)
+            total += 2 * mid * out * h_out * h_out  # 1x1
+            if c_in != out:
+                total += 2 * c_in * out * h_out * h_out
+            h = h_out
+            block_idx += 1
+    total += 2 * _block_channels(config)[-1][2] * config.num_classes
+    return float(total)

@@ -1,0 +1,27 @@
+"""Training ops: distributed trainer, input pipelines, checkpointing.
+
+≙ the glue the reference delegates to Horovod + the user script:
+``hvd.DistributedOptimizer`` (gradient allreduce), ``hvd.broadcast_global_
+variables`` (initial sync), tf.data input pipelines, and — absent from the
+reference entirely (SURVEY.md §5.4) — checkpoint/resume, which TPU preemption
+makes mandatory here.
+
+TPU-native: the trainer compiles ONE global-view jit train step whose batch
+is sharded over (data, fsdp) and whose params follow the model's logical
+axes; XLA inserts the gradient reductions (there is no explicit allreduce to
+call — the psum is implied by the sharding, which is the whole point of the
+pjit programming model)."""
+
+from mpi_operator_tpu.ops.trainer import Trainer, TrainerConfig, TrainState
+from mpi_operator_tpu.ops.data import synthetic_imagenet, synthetic_tokens, prefetch
+from mpi_operator_tpu.ops.checkpoint import CheckpointManager
+
+__all__ = [
+    "Trainer",
+    "TrainerConfig",
+    "TrainState",
+    "synthetic_imagenet",
+    "synthetic_tokens",
+    "prefetch",
+    "CheckpointManager",
+]

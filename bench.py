@@ -1,0 +1,112 @@
+"""Headline benchmark: ResNet-101 training throughput (images/sec/chip).
+
+≙ the reference's only published benchmark — tf_cnn_benchmarks ResNet-101,
+batch 64/device, synthetic ImageNet, SGD+momentum, Horovod DP
+(/root/reference/README.md:166-199; 154.2 images/sec per GPU, BASELINE.md).
+Same workload shape here, TPU-native: NHWC bf16 ResNet-101 under a
+global-view jit over all visible chips.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/154.2, ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 154.2  # reference README.md:184-199
+
+# bf16 peak FLOPs/s per chip by device kind (scaling-book table)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e11,  # nominal, so the script runs anywhere
+}
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from mpi_operator_tpu.models import resnet
+    from mpi_operator_tpu.ops import Trainer, TrainerConfig
+    from mpi_operator_tpu.ops.data import make_global_batch, synthetic_imagenet
+    from mpi_operator_tpu.runtime import MeshPlan, build_mesh
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    kind = getattr(devices[0], "device_kind", devices[0].platform)
+    peak = next(
+        (v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), PEAK_FLOPS["cpu"]
+    )
+    print(f"[bench] {n_chips} x {kind}", file=sys.stderr)
+
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    global_batch = per_chip_batch * n_chips
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))  # ≥1: first
+    # step compiles and binds `metrics` for the sync below
+
+    cfg = resnet.Config(depth="resnet101")
+    mesh = build_mesh(MeshPlan.data_parallel(n_chips))
+    params, mstate = resnet.init(cfg, jax.random.PRNGKey(0))
+    paxes, saxes = resnet.logical_axes(cfg)
+    trainer = Trainer(
+        lambda p, s, b: resnet.loss_fn(cfg, p, s, b),
+        paxes,
+        mesh,
+        TrainerConfig(learning_rate=0.1, optimizer="momentum", grad_clip_norm=0.0),
+        has_model_state=True,
+        model_state_axes=saxes,
+    )
+    state = trainer.init_state(params, mstate)
+    batch = make_global_batch(
+        mesh,
+        next(synthetic_imagenet(global_batch=global_batch, image_size=cfg.image_size)),
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    print(
+        f"[bench] compile+warmup {time.perf_counter() - t0:.1f}s, "
+        f"loss={float(metrics['loss']):.3f}",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = global_batch * steps / dt
+    per_chip = imgs_per_sec / n_chips
+    # train step ≈ 3x forward FLOPs (fwd + dL/dx + dL/dw)
+    mfu = 3 * resnet.flops_per_sample(cfg) * per_chip / peak
+    print(
+        json.dumps(
+            {
+                "metric": "resnet101_train_throughput_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+                "chips": n_chips,
+                "device": kind,
+                "global_batch": global_batch,
+                "mfu": round(mfu, 4),
+                "step_ms": round(1000 * dt / steps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,11 +20,14 @@ llama (the BASELINE.md Llama-3-8B DP/long-context config).
 
 from mpi_operator_tpu.models import llama, mnist, resnet
 
+# name → (module, config factory); the factory bakes in the depth/preset so
+# registry users can't get a module whose default Config contradicts the name
 MODELS = {
-    "mnist": mnist,
-    "resnet50": resnet,
-    "resnet101": resnet,
-    "llama": llama,
+    "mnist": (mnist, mnist.Config),
+    "resnet50": (resnet, lambda: resnet.Config(depth="resnet50")),
+    "resnet101": (resnet, lambda: resnet.Config(depth="resnet101")),
+    "llama3-8b": (llama, llama.llama3_8b),
+    "llama-tiny": (llama, llama.tiny),
 }
 
 __all__ = ["mnist", "resnet", "llama", "MODELS"]

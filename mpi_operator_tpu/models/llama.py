@@ -26,11 +26,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_operator_tpu.parallel.ring_attention import (
-    _single_device_attention,
+    dense_attention,
     ring_attention,
 )
 from mpi_operator_tpu.parallel.sharding import with_logical_constraint
-from mpi_operator_tpu.runtime.topology import AXIS_SEQ
 
 Params = Dict[str, Any]
 
@@ -155,9 +154,6 @@ def apply(
     global-view and sharded by constraint propagation."""
     c = config
     dt = c.compute_dtype
-    use_ring = mesh is not None and AXIS_SEQ in mesh.axis_names and (
-        mesh.shape[AXIS_SEQ] > 1
-    )
 
     def constrain(x, axes):
         if mesh is None:
@@ -176,16 +172,14 @@ def apply(
         v = (y @ lp["wv"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
         q = _rope(q, c.rope_theta)
         k = _rope(k, c.rope_theta)
-        # GQA: expand K/V groups to Q heads at the attention boundary
-        rep = c.n_heads // c.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        if use_ring:
+        # K/V stay at n_kv_heads: the attention kernels are GQA-aware, so
+        # the ring never carries expanded K/V
+        if mesh is not None:
+            # ring attention over the sequence axis; ring_attention itself
+            # falls back to dense when the mesh has no sequence axis
             attn = ring_attention(q, k, v, mesh, causal=True)
         else:
-            attn = _single_device_attention(
-                q, k, v, causal=True, scale=c.head_dim**-0.5
-            )
+            attn = dense_attention(q, k, v, causal=True, scale=c.head_dim**-0.5)
         attn = attn.reshape(b, t, c.q_dim)
         h = h + attn @ lp["wo"]["w"].astype(dt)
         h = constrain(h, ["batch", "seq", "embed"])

@@ -201,10 +201,11 @@ def flops_per_sample(config: Config) -> float:
     h = size // 2  # stem stride 2
     total += 2 * 49 * config.channels * config.width * h * h
     h = (h + 1) // 2  # maxpool stride 2
+    chans = _block_channels(config)
     block_idx = 0
     for stage, n_blocks in enumerate(config.stage_blocks):
         for b in range(n_blocks):
-            c_in, mid, out = _block_channels(config)[block_idx]
+            c_in, mid, out = chans[block_idx]
             stride = 2 if (stage > 0 and b == 0) else 1
             total += 2 * c_in * mid * h * h  # 1x1
             h_out = h // stride
@@ -214,5 +215,5 @@ def flops_per_sample(config: Config) -> float:
                 total += 2 * c_in * out * h_out * h_out
             h = h_out
             block_idx += 1
-    total += 2 * _block_channels(config)[-1][2] * config.num_classes
+    total += 2 * chans[-1][2] * config.num_classes
     return float(total)

@@ -1,0 +1,102 @@
+"""ctypes binding for the native host-collective runtime (libtpucoll).
+
+Python side of native/ (SURVEY.md §2.4's "native parity" deliverable). The
+C library and this binding share the controller's TPUJOB_* rendezvous env
+with the JAX runtime — one bootstrap contract for every language in the job.
+Python↔C via ctypes per the environment's no-pybind11 constraint.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "native", "build", "libtpucoll.so"),
+    "libtpucoll.so",
+)
+
+
+def _load() -> ctypes.CDLL:
+    last: Optional[Exception] = None
+    for p in _LIB_PATHS:
+        try:
+            lib = ctypes.CDLL(os.path.abspath(p) if os.path.sep in p else p)
+            break
+        except OSError as e:
+            last = e
+    else:
+        raise RuntimeError(
+            f"libtpucoll.so not found (build with `make -C native`): {last}"
+        )
+    lib.tpucoll_init.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+    lib.tpucoll_init.restype = ctypes.c_int
+    for fn in (lib.tpucoll_rank, lib.tpucoll_size):
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ctypes.c_int
+    for fn in (lib.tpucoll_allreduce_sum_f64, lib.tpucoll_reduce_sum_f64):
+        fn.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_size_t,
+        ]
+        fn.restype = ctypes.c_int
+    for fn in (lib.tpucoll_barrier, lib.tpucoll_finalize):
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ctypes.c_int
+    return lib
+
+
+class HostCollectives:
+    """RAII wrapper: ``with HostCollectives() as hc: hc.allreduce([...])``."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._ctx = ctypes.c_void_p()
+        rc = self._lib.tpucoll_init(ctypes.byref(self._ctx))
+        if rc != 0:
+            raise RuntimeError(f"tpucoll_init failed: {rc}")
+
+    @property
+    def rank(self) -> int:
+        return self._lib.tpucoll_rank(self._ctx)
+
+    @property
+    def size(self) -> int:
+        return self._lib.tpucoll_size(self._ctx)
+
+    def _buf(self, values: Sequence[float]):
+        arr = (ctypes.c_double * len(values))(*values)
+        return arr
+
+    def allreduce_sum(self, values: Sequence[float]) -> list:
+        arr = self._buf(values)
+        rc = self._lib.tpucoll_allreduce_sum_f64(self._ctx, arr, len(values))
+        if rc != 0:
+            raise RuntimeError(f"allreduce failed: {rc}")
+        return list(arr)
+
+    def reduce_sum(self, values: Sequence[float]) -> list:
+        """Result is meaningful on host 0 only (others get their input back)."""
+        arr = self._buf(values)
+        rc = self._lib.tpucoll_reduce_sum_f64(self._ctx, arr, len(values))
+        if rc != 0:
+            raise RuntimeError(f"reduce failed: {rc}")
+        return list(arr)
+
+    def barrier(self) -> None:
+        rc = self._lib.tpucoll_barrier(self._ctx)
+        if rc != 0:
+            raise RuntimeError(f"barrier failed: {rc}")
+
+    def close(self) -> None:
+        if self._ctx:
+            self._lib.tpucoll_finalize(self._ctx)
+            self._ctx = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,79 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference has NO checkpointing — SURVEY.md §5.4: job-level "resume" is
+only launcher-pod retry, and elastic Horovod recovers from in-memory state.
+On TPU, preemption is routine and XLA can't re-form a ring in place
+(membership change ⇒ recompile), so durable checkpoints are the recovery
+primitive (SURVEY.md §7 phase 7): scale events save → re-mesh → restore.
+
+Restore is *reshard-on-load*: the target shardings come from the new mesh,
+so a checkpoint written on 16 hosts restores cleanly onto 8 or 32 — this is
+exactly the elastic-resume path the controller's scale-up/down drives."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax's CheckpointManager pinned to this
+    framework's TrainState layout and elastic-resume semantics."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1000,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save if the step hits the interval (or force). Multi-host safe:
+        every process must call this (orbax coordinates the barrier)."""
+        saved = self.manager.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, state_template: Any, *, step: Optional[int] = None) -> Any:
+        """Restore into the layout of ``state_template`` (an abstract or
+        concrete TrainState whose shardings describe the *current* mesh —
+        resharding across gang sizes happens here)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if hasattr(x, "sharding")
+            else x,
+            state_template,
+        )
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def wait(self) -> None:
+        """Block until any async save has committed."""
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()

@@ -1,0 +1,116 @@
+"""Input pipelines: synthetic datasets + device prefetch.
+
+≙ the reference benchmark's ``--data_name=imagenet`` *synthetic* mode
+(tf_cnn_benchmarks generates random images when no data_dir is given —
+that's what produced the 154.2 img/s baseline, /root/reference/README.md:166-199)
+and Horovod's sharded tf.data feeds.
+
+TPU-native: batches are built host-locally and assembled into global arrays
+(each host owns its (data, fsdp) shard — jax.make_array_from_process_local_data),
+and :func:`prefetch` keeps a small queue of device-resident batches so the
+infeed overlaps the train step (the double-buffering SURVEY.md §7 flags as a
+prerequisite for ≥50% MFU on conv nets)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from mpi_operator_tpu.parallel.sharding import logical_spec, mesh_filtered_spec
+
+
+def _batch_sharding(mesh: Mesh, rules=None) -> NamedSharding:
+    return NamedSharding(
+        mesh, mesh_filtered_spec(logical_spec(["batch"], rules), mesh)
+    )
+
+
+def make_global_batch(mesh: Mesh, host_local: Dict[str, np.ndarray], rules=None):
+    """Assemble per-host numpy arrays into global sharded jax.Arrays.
+
+    Single-process (tests, one-host slices): a plain device_put with the
+    batch sharding. Multi-host: each process contributes its local shard."""
+    sh = _batch_sharding(mesh, rules)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sh) for k, v in host_local.items()}
+    return {
+        k: jax.make_array_from_process_local_data(sh, v)
+        for k, v in host_local.items()
+    }
+
+
+def synthetic_imagenet(
+    *,
+    global_batch: int,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Host-local synthetic ImageNet stream (the baseline workload's data).
+
+    Yields this host's share of each global batch. Images are fixed random
+    tensors re-used every step (matching tf_cnn_benchmarks' synthetic data,
+    which measures compute, not IO)."""
+    n_proc = jax.process_count()
+    local = global_batch // n_proc
+    rng = np.random.default_rng(seed + jax.process_index())
+    images = rng.standard_normal((local, image_size, image_size, 3), np.float32)
+    labels = rng.integers(0, num_classes, (local,)).astype(np.int32)
+    while True:
+        yield {"image": images, "label": labels}
+
+
+def synthetic_tokens(
+    *,
+    global_batch: int,
+    seq_len: int,
+    vocab: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Host-local synthetic LM token stream (Llama workload)."""
+    n_proc = jax.process_count()
+    local = global_batch // n_proc
+    rng = np.random.default_rng(seed + jax.process_index())
+    tokens = rng.integers(0, vocab, (local, seq_len)).astype(np.int32)
+    while True:
+        yield {"tokens": tokens}
+
+
+def prefetch(
+    it: Iterator[Dict[str, np.ndarray]],
+    mesh: Mesh,
+    *,
+    depth: int = 2,
+    transform: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None,
+) -> Iterator[Any]:
+    """Device prefetch: a background thread keeps ``depth`` global batches
+    resident on device so the infeed overlaps compute (double-buffered at
+    depth=2). The thread only does host→device transfers; assembly order is
+    preserved."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    done = object()
+
+    def producer():
+        try:
+            for item in it:
+                if transform is not None:
+                    item = transform(item)
+                q.put(make_global_batch(mesh, item))
+            q.put(done)
+        except BaseException as e:  # propagate to the consumer, never hang it
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is done:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -31,6 +31,29 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
                   # for fully-masked blocks
 
 
+def _scores(q, k, scale):
+    """Attention scores with GQA grouping: q [B,Tq,H,D], k [B,Tk,Hkv,D] with
+    H = Hkv·G (consecutive q heads share a kv head) → [B,H,Tq,Tk]. K/V are
+    never expanded to H heads — the grouped einsum keeps K/V bytes at Hkv
+    through the ring (4x less ICI traffic at Llama-3-8B's 32/8 ratio)."""
+    b, t_q, h, d = q.shape
+    h_kv = k.shape[2]
+    g = h // h_kv
+    q5 = q.reshape(b, t_q, h_kv, g, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k, preferred_element_type=jnp.float32)
+    return s.reshape(b, h, t_q, k.shape[1]) * scale
+
+
+def _weighted_v(p, v):
+    """p [B,H,Tq,Tk] × v [B,Tk,Hkv,D] → [B,Tq,H,D] (grouped, see _scores)."""
+    b, h, t_q, t_k = p.shape
+    h_kv = v.shape[2]
+    g = h // h_kv
+    p5 = p.reshape(b, h_kv, g, t_q, t_k)
+    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p5, v.astype(p.dtype))
+    return pv.reshape(b, t_q, h, v.shape[3])
+
+
 def _block(q, k, v, bias, carry, scale):
     """Fold one K/V block into the online-softmax accumulator.
 
@@ -38,15 +61,14 @@ def _block(q, k, v, bias, carry, scale):
     max, l [B,H,Tq] running denominator.
     """
     o, m, l = carry
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale
+    s = _scores(q, k, scale)
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m - m_new)
     l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype))
+    pv = _weighted_v(p, v)
     o_new = o * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
     return o_new, m_new, l_new
 
@@ -109,9 +131,10 @@ def ring_attention(
     head_axis: Optional[str] = "tensor",
 ):
     """Exact multi-head attention with the sequence dim sharded over
-    ``axis_name``. Shapes are the *global* [B, T, H, D]; sharding is handled
-    internally via shard_map. K/V head count must equal Q head count (expand
-    GQA groups before calling — models/llama.py does).
+    ``axis_name``. Shapes are the *global* q [B,T,H,D], k/v [B,T,Hkv,D] with
+    H a multiple of Hkv (GQA; consecutive q heads share a kv head — pass
+    Hkv=H for plain MHA). Sharding is handled internally via shard_map; K/V
+    stay at Hkv heads through the ring.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -130,21 +153,19 @@ def ring_attention(
     )
     if seq_part is None:
         # No sequence axis in this mesh: single-shard attention, no ring.
-        return _single_device_attention(q, k, v, causal=causal, scale=scale)
+        return dense_attention(q, k, v, causal=causal, scale=scale)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
 
-def _single_device_attention(q, k, v, *, causal: bool, scale: float):
+def dense_attention(q, k, v, *, causal: bool, scale: float):
     """Reference (and no-sequence-axis fallback) attention; also the oracle
-    the tests compare ring attention against."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale
+    the tests compare ring attention against. GQA-aware like the ring path."""
+    s = _scores(q, k, scale)
     if causal:
         t_q, t_k = q.shape[1], k.shape[1]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype))
-    return out.astype(q.dtype)
+    return _weighted_v(p, v).astype(q.dtype)

@@ -1,0 +1,55 @@
+/* π smoke test against the tpucoll C API.
+ *
+ * Capability parity with /root/reference/examples/pi/pi.cc:19-50 (Monte-Carlo
+ * π with a sum-reduce to rank 0 over MPI), re-built on the framework's own
+ * native runtime: rendezvous via the controller's TPUJOB_* env, reduce over
+ * the tpucoll coordinator. New code, new API — no MPI.
+ *
+ * Run under the gang launcher (runtime/emulation.py) or as a TPUJob whose
+ * workers invoke this binary.
+ */
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+
+#include "tpucoll.h"
+
+int main(int argc, char **argv) {
+  tpucoll_ctx *ctx = nullptr;
+  int rc = tpucoll_init(&ctx);
+  if (rc != 0) {
+    fprintf(stderr, "tpucoll_init failed: %d\n", rc);
+    return 1;
+  }
+  const int rank = tpucoll_rank(ctx);
+  const int size = tpucoll_size(ctx);
+  const int64_t samples = argc > 1 ? atoll(argv[1]) : 10000000LL;
+
+  /* xorshift PRNG seeded by rank: deterministic per host, distinct streams */
+  uint64_t s = 0x9E3779B97F4A7C15ULL + static_cast<uint64_t>(rank);
+  auto next_unit = [&s]() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return static_cast<double>(s >> 11) / 9007199254740992.0; /* 2^53 */
+  };
+
+  int64_t inside = 0;
+  for (int64_t i = 0; i < samples; ++i) {
+    double x = next_unit(), y = next_unit();
+    if (x * x + y * y < 1.0) ++inside;
+  }
+
+  double total = static_cast<double>(inside);
+  rc = tpucoll_reduce_sum_f64(ctx, &total, 1);
+  if (rc != 0) {
+    fprintf(stderr, "reduce failed on rank %d: %d\n", rank, rc);
+    return 1;
+  }
+  if (rank == 0) {
+    double pi = 4.0 * total / (static_cast<double>(samples) * size);
+    printf("pi is approximately %.8f (%d hosts, %" PRId64 " samples each)\n",
+           pi, size, samples);
+  }
+  return tpucoll_finalize(ctx) == 0 ? 0 : 1;
+}

@@ -1,0 +1,55 @@
+/* tpucoll: host-level collective runtime (C API).
+ *
+ * The native component of the framework (SURVEY.md §2.4): where the
+ * reference's native layer is the external MPI runtime that examples/pi/pi.cc
+ * links against (MPI_Init/Comm_rank/Comm_size/Reduce,
+ * /root/reference/examples/pi/pi.cc:19-50), this is a from-scratch,
+ * TPU-job-native equivalent: rendezvous comes from the SAME TPUJOB_* env the
+ * controller injects for the JAX runtime (no hostfile, no SSH), and the
+ * collectives run over plain TCP to the coordinator (host 0) — the
+ * control/DCN path. Chip-level collectives are XLA's job, not this library's;
+ * tpucoll is for host-side tooling: smoke tests, scalar metric reduction,
+ * barriers around checkpoints.
+ *
+ * Wire format: little-endian, homogeneous hosts assumed (a TPU pod slice).
+ * All calls are collective and must be made by every host in the same order.
+ */
+#ifndef TPUCOLL_H_
+#define TPUCOLL_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpucoll_ctx tpucoll_ctx;
+
+/* Reads TPUJOB_NUM_HOSTS / TPUJOB_HOST_ID / TPUJOB_COORDINATOR_ADDRESS from
+ * the environment (the controller's rendezvous contract). Host 0 binds the
+ * coordinator port and serves; every host (0 included) connects. Returns 0
+ * on success, negative errno-style codes on failure. */
+int tpucoll_init(tpucoll_ctx **out);
+
+int tpucoll_rank(const tpucoll_ctx *ctx);
+int tpucoll_size(const tpucoll_ctx *ctx);
+
+/* In-place sum-allreduce of n doubles (≙ MPI_Allreduce(SUM)). */
+int tpucoll_allreduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n);
+
+/* Sum-reduce to host 0 (≙ MPI_Reduce to root, pi.cc:44): on host 0 buf holds
+ * the sum on return; on other hosts buf is unchanged. */
+int tpucoll_reduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n);
+
+/* All hosts block until every host arrives (≙ MPI_Barrier). */
+int tpucoll_barrier(tpucoll_ctx *ctx);
+
+/* Collective teardown; frees ctx. */
+int tpucoll_finalize(tpucoll_ctx *ctx);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUCOLL_H_ */

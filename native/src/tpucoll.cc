@@ -1,0 +1,284 @@
+/* tpucoll implementation: star-topology TCP collectives.
+ *
+ * Host 0 runs a coordinator thread; every host (host 0 included, over
+ * loopback) is a client. Each collective is one round: every client sends
+ * (op, count, payload), the coordinator reduces and answers. A star is the
+ * right shape here: this library carries host-side control traffic (scalars,
+ * barriers) for jobs whose bulk data plane is XLA/ICI — simplicity and
+ * debuggability beat ring bandwidth at count≈O(10).
+ *
+ * No MPI, no code from the reference: the capability contract is
+ * /root/reference/examples/pi/pi.cc's MPI usage; the design is new.
+ */
+#include "tpucoll.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kOpAllreduce = 1;
+constexpr uint8_t kOpReduceRoot = 2;
+constexpr uint8_t kOpBarrier = 3;
+constexpr uint8_t kOpFinalize = 4;
+constexpr int kConnectTimeoutMs = 30000;
+constexpr int kConnectRetryMs = 100;
+
+struct Request {
+  uint8_t op;
+  uint64_t count;
+};
+
+bool read_full(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+struct tpucoll_ctx {
+  int rank = 0;
+  int size = 1;
+  int sock = -1;          // client connection to the coordinator
+  int listen_fd = -1;     // coordinator only
+  std::thread server;     // coordinator only
+  std::vector<int> peers; // coordinator only: fd per rank
+};
+
+namespace {
+
+/* Coordinator loop: one round = one matching request from every rank.
+ * Answers allreduce with the sum to all; reduce-root with the sum to rank 0
+ * and an empty ack to others; barrier with an ack. Exits after a full round
+ * of finalize. */
+void serve(tpucoll_ctx *ctx) {
+  const int n = ctx->size;
+  std::vector<double> acc;
+  for (;;) {
+    Request first{};
+    std::vector<std::vector<double>> payloads(static_cast<size_t>(n));
+    for (int r = 0; r < n; ++r) {
+      Request req{};
+      if (!read_full(ctx->peers[r], &req.op, 1) ||
+          !read_full(ctx->peers[r], &req.count, 8)) {
+        return;  // peer died: tear down; clients will see EOF
+      }
+      if (r == 0) {
+        first = req;
+      } else if (req.op != first.op || req.count != first.count) {
+        fprintf(stderr,
+                "tpucoll: collective mismatch (rank %d sent op %d/%llu, "
+                "rank 0 sent op %d/%llu)\n",
+                r, req.op, (unsigned long long)req.count, first.op,
+                (unsigned long long)first.count);
+        return;
+      }
+      payloads[r].resize(req.count);
+      if (req.count > 0 &&
+          !read_full(ctx->peers[r], payloads[r].data(), req.count * 8)) {
+        return;
+      }
+    }
+    if (first.op == kOpFinalize) {
+      uint8_t ack = 0;
+      for (int r = 0; r < n; ++r) write_full(ctx->peers[r], &ack, 1);
+      return;
+    }
+    acc.assign(first.count, 0.0);
+    for (int r = 0; r < n; ++r)
+      for (uint64_t i = 0; i < first.count; ++i) acc[i] += payloads[r][i];
+    for (int r = 0; r < n; ++r) {
+      bool wants_data =
+          first.op == kOpAllreduce || (first.op == kOpReduceRoot && r == 0);
+      uint8_t ack = wants_data ? 1 : 0;
+      if (!write_full(ctx->peers[r], &ack, 1)) return;
+      if (wants_data && first.count > 0 &&
+          !write_full(ctx->peers[r], acc.data(), first.count * 8))
+        return;
+    }
+  }
+}
+
+int round_trip(tpucoll_ctx *ctx, uint8_t op, double *buf, size_t n,
+               bool expect_data) {
+  if (ctx->size == 1) return 0;  // single host: every collective is identity
+  uint64_t count = n;
+  if (!write_full(ctx->sock, &op, 1) || !write_full(ctx->sock, &count, 8))
+    return -EIO;
+  if (n > 0 && !write_full(ctx->sock, buf, n * 8)) return -EIO;
+  uint8_t has_data = 0;
+  if (!read_full(ctx->sock, &has_data, 1)) return -EIO;
+  if (has_data) {
+    if (!expect_data && has_data) return -EPROTO;
+    if (!read_full(ctx->sock, buf, n * 8)) return -EIO;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpucoll_init(tpucoll_ctx **out) {
+  auto *ctx = new tpucoll_ctx();
+  const char *num = getenv("TPUJOB_NUM_HOSTS");
+  const char *id = getenv("TPUJOB_HOST_ID");
+  const char *coord = getenv("TPUJOB_COORDINATOR_ADDRESS");
+  ctx->size = num ? atoi(num) : 1;
+  ctx->rank = id ? atoi(id) : 0;
+  if (ctx->size <= 1) {
+    *out = ctx;
+    return 0;
+  }
+  if (!coord) {
+    delete ctx;
+    return -EINVAL;
+  }
+  std::string addr(coord);
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) {
+    delete ctx;
+    return -EINVAL;
+  }
+  std::string host = addr.substr(0, colon);
+  int port = atoi(addr.c_str() + colon + 1);
+
+  if (ctx->rank == 0) {
+    ctx->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(ctx->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = INADDR_ANY;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(ctx->listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) !=
+            0 ||
+        listen(ctx->listen_fd, ctx->size) != 0) {
+      delete ctx;
+      return -errno;
+    }
+    ctx->peers.assign(static_cast<size_t>(ctx->size), -1);
+    // Accept in a thread so rank 0 can connect to itself below.
+    tpucoll_ctx *c = ctx;
+    ctx->server = std::thread([c] {
+      for (int i = 0; i < c->size; ++i) {
+        int fd = accept(c->listen_fd, nullptr, nullptr);
+        if (fd < 0) return;
+        int one2 = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+        uint32_t peer_rank = 0;
+        if (!read_full(fd, &peer_rank, 4) || peer_rank >= (uint32_t)c->size) {
+          close(fd);
+          return;
+        }
+        c->peers[peer_rank] = fd;
+      }
+      serve(c);
+    });
+  }
+
+  // Everyone (rank 0 included) dials the coordinator, with retry to absorb
+  // start skew (≙ OMPI_MCA_plm_rsh ConnectionAttempts=10,
+  // /root/reference/v2/pkg/controller/mpi_job_controller.go:186-189).
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo *res = nullptr;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res) {
+    delete ctx;
+    return -EHOSTUNREACH;
+  }
+  sockaddr_in target = *reinterpret_cast<sockaddr_in *>(res->ai_addr);
+  target.sin_port = htons(static_cast<uint16_t>(port));
+  freeaddrinfo(res);
+
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(kConnectTimeoutMs);
+  for (;;) {
+    ctx->sock = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(ctx->sock, reinterpret_cast<sockaddr *>(&target),
+                sizeof(target)) == 0)
+      break;
+    close(ctx->sock);
+    ctx->sock = -1;
+    if (std::chrono::steady_clock::now() > deadline) {
+      delete ctx;
+      return -ETIMEDOUT;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(kConnectRetryMs));
+  }
+  int one = 1;
+  setsockopt(ctx->sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint32_t my_rank = static_cast<uint32_t>(ctx->rank);
+  if (!write_full(ctx->sock, &my_rank, 4)) {
+    delete ctx;
+    return -EIO;
+  }
+  *out = ctx;
+  return 0;
+}
+
+int tpucoll_rank(const tpucoll_ctx *ctx) { return ctx->rank; }
+int tpucoll_size(const tpucoll_ctx *ctx) { return ctx->size; }
+
+int tpucoll_allreduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
+  return round_trip(ctx, kOpAllreduce, buf, n, true);
+}
+
+int tpucoll_reduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
+  return round_trip(ctx, kOpReduceRoot, buf, n, ctx->rank == 0);
+}
+
+int tpucoll_barrier(tpucoll_ctx *ctx) {
+  return round_trip(ctx, kOpBarrier, nullptr, 0, false);
+}
+
+int tpucoll_finalize(tpucoll_ctx *ctx) {
+  int rc = round_trip(ctx, kOpFinalize, nullptr, 0, false);
+  if (ctx->sock >= 0) close(ctx->sock);
+  if (ctx->server.joinable()) ctx->server.join();
+  if (ctx->listen_fd >= 0) close(ctx->listen_fd);
+  for (int fd : ctx->peers)
+    if (fd >= 0) close(fd);
+  delete ctx;
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,15 @@
+"""Gang worker used by test_native.py: allreduce + reduce via ctypes."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from mpi_operator_tpu.native import HostCollectives
+
+with HostCollectives() as hc:
+    r = float(hc.rank)
+    print("ALLREDUCE", hc.allreduce_sum([r, 10.0]))
+    rooted = hc.reduce_sum([r])
+    if hc.rank == 0:
+        print("ROOT_REDUCE", rooted[0])
+    hc.barrier()

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from mpi_operator_tpu.parallel.ring_attention import (
-    _single_device_attention,
+    dense_attention,
     ring_attention,
 )
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_SEQ, MeshPlan
@@ -35,7 +35,7 @@ def _rand_qkv(key, b=2, t=32, h=4, d=8, dtype=jnp.float32):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_dense(seq_mesh, causal):
     q, k, v = _rand_qkv(jax.random.PRNGKey(0))
-    want = _single_device_attention(q, k, v, causal=causal, scale=q.shape[-1] ** -0.5)
+    want = dense_attention(q, k, v, causal=causal, scale=q.shape[-1] ** -0.5)
     got = ring_attention(q, k, v, seq_mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
@@ -44,7 +44,7 @@ def test_ring_under_jit(seq_mesh):
     q, k, v = _rand_qkv(jax.random.PRNGKey(1))
     f = jax.jit(lambda a, b_, c_: ring_attention(a, b_, c_, seq_mesh, causal=True))
     got = f(q, k, v)
-    want = _single_device_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
@@ -52,7 +52,7 @@ def test_no_sequence_axis_falls_back(seq_mesh):
     dp_mesh = build_mesh(MeshPlan(axes={AXIS_DATA: 8}))
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), b=8)
     got = ring_attention(q, k, v, dp_mesh, causal=True)
-    want = _single_device_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
@@ -65,11 +65,27 @@ def test_causal_first_token_attends_only_itself(seq_mesh):
     )
 
 
+def test_gqa_matches_expanded_mha(seq_mesh):
+    """GQA (Hkv < H) through the ring must equal plain MHA over explicitly
+    repeated K/V — proving the grouped kernels never expand K/V yet compute
+    the same attention."""
+    key = jax.random.split(jax.random.PRNGKey(7), 3)
+    b, t, h, hkv, d = 2, 32, 8, 2, 8
+    q = jax.random.normal(key[0], (b, t, h, d))
+    k = jax.random.normal(key[1], (b, t, hkv, d))
+    v = jax.random.normal(key[2], (b, t, hkv, d))
+    got = ring_attention(q, k, v, seq_mesh, causal=True)
+    k_full = jnp.repeat(k, h // hkv, axis=2)
+    v_full = jnp.repeat(v, h // hkv, axis=2)
+    want = dense_attention(q, k_full, v_full, causal=True, scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
 def test_bfloat16_inputs(seq_mesh):
     q, k, v = _rand_qkv(jax.random.PRNGKey(4), dtype=jnp.bfloat16)
     got = ring_attention(q, k, v, seq_mesh, causal=True)
     assert got.dtype == jnp.bfloat16
-    want = _single_device_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
     )

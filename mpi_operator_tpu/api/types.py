@@ -279,11 +279,18 @@ class Container(_Dictable):
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Container":
+        # env accepts both the native mapping form and the k8s list form
+        # [{name: ..., value: ...}] so reference-shaped manifests port
+        # mechanically (a plain dict() of the list form would silently
+        # produce {"name": "value"}).
+        env = d.get("env", {})
+        if isinstance(env, list):
+            env = {e["name"]: str(e.get("value", "")) for e in env}
         return Container(
             image=d.get("image", ""),
             command=list(d.get("command", [])),
             args=list(d.get("args", [])),
-            env=dict(d.get("env", {})),
+            env=dict(env),
             resources=dict(d.get("resources", {})),
             working_dir=d.get("working_dir", ""),
         )
@@ -300,10 +307,18 @@ class PodTemplate(_Dictable):
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "PodTemplate":
+        # Accept both the native singular form and the k8s-style plural list
+        # (first entry is the main container, ≙ the v1 API's MainContainer
+        # convention, reference pkg/apis/kubeflow/v1/types.go:55-62) so
+        # reference-shaped manifests port mechanically.
+        cont = d.get("container")
+        if cont is None:
+            plural = d.get("containers") or [{}]
+            cont = plural[0]
         return PodTemplate(
             labels=dict(d.get("labels", {})),
             annotations=dict(d.get("annotations", {})),
-            container=Container.from_dict(d.get("container", {})),
+            container=Container.from_dict(cont),
             node_selector=dict(d.get("node_selector", {})),
             scheduler_name=d.get("scheduler_name", ""),
             priority_class=d.get("priority_class", ""),

@@ -1,0 +1,13 @@
+"""Pod executors: turn controller-created Pod objects into running work.
+
+The reference has no executor of its own — kubelet plays this role, and the
+reference's CI therefore cannot run a single job end-to-end (SURVEY.md §4:
+pod phases are *simulated* in envtest). Because this framework's pods are
+plain process specs, a real local executor is cheap, and the whole stack —
+job YAML → reconcile → gang placement → SPMD boot → collectives → status
+mirror — runs end-to-end in-suite with zero cluster.
+"""
+
+from mpi_operator_tpu.executor.local import LocalExecutor
+
+__all__ = ["LocalExecutor"]

@@ -1,0 +1,222 @@
+"""LocalExecutor: a process-level kubelet for TPUJob worker pods.
+
+Watches the ObjectStore for Pods, launches each pod's container command as an
+OS process with the pod's env (the controller-injected TPUJOB_* rendezvous
+contract included), and mirrors the process lifecycle back into pod status:
+
+  PENDING → (spawn) → RUNNING → SUCCEEDED | FAILED(exit code)
+
+which is exactly the signal the controller's status mirror consumes
+(≙ kubelet feeding updateMPIJobStatus,
+/root/reference/v2/pkg/controller/mpi_job_controller.go:921-996).
+
+Local DNS shim: pod hostnames like ``<job>-worker-0.<job>-worker`` only
+resolve inside a cluster's headless service; locally every "host" shares the
+loopback interface, so the coordinator address env is rewritten to
+127.0.0.1 (ports disambiguate jobs). This mirrors what the reference's
+Intel entrypoint does when it pre-resolves worker hostnames
+(examples/pi/intel-entrypoint.sh:27-33) — resolution is an executor concern,
+not a workload concern.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from mpi_operator_tpu.machinery.objects import Pod, PodPhase
+from mpi_operator_tpu.machinery.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    NotFound,
+    ObjectStore,
+)
+
+log = logging.getLogger("tpujob.executor")
+
+ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
+
+
+class LocalExecutor:
+    """Runs every Pod in the store as a local OS process."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        *,
+        loopback_rewrite: bool = True,
+        extra_env: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+    ):
+        self.store = store
+        self.loopback_rewrite = loopback_rewrite
+        self.extra_env = dict(extra_env or {})
+        self.workdir = workdir
+        self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
+        self.logs: Dict[str, tuple] = {}  # pod key → (stdout, stderr)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._watch_q = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch_q = self.store.watch("Pod")
+        t = threading.Thread(target=self._run, name="local-executor", daemon=True)
+        t.start()
+        self._threads.append(t)
+        # adopt pods that existed before the watch began
+        for pod in self.store.list("Pod"):
+            self._maybe_launch(pod)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+        with self._lock:
+            for p in self._procs.values():
+                if p.poll() is None:
+                    p.kill()
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no managed process is still running (for tests/CLI)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if all(p.poll() is not None for p in self._procs.values()):
+                    return True
+            time.sleep(0.05)
+        return False
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.2)
+            except Exception:
+                continue
+            if ev.type in (ADDED, MODIFIED):
+                self._maybe_launch(ev.obj)
+            elif ev.type == DELETED:
+                self._forget(ev.obj)
+
+    def _pod_key(self, pod: Pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def _forget(self, pod: Pod) -> None:
+        """Pod deleted (controller restart path / cleanup policy): kill any
+        live process and drop all per-pod state, so a recreated pod with the
+        same name launches fresh and long-lived executors don't leak."""
+        key = self._pod_key(pod)
+        with self._lock:
+            proc = self._procs.pop(key, None)
+            self.logs.pop(key, None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def _maybe_launch(self, pod: Pod) -> None:
+        if pod.status.phase != PodPhase.PENDING:
+            return
+        key = self._pod_key(pod)
+        with self._lock:
+            if key in self._procs:
+                return
+            container = pod.spec.container
+            argv = list(container.command) + list(container.args)
+            if not argv:
+                self._set_phase(pod, PodPhase.FAILED, reason="NoCommand")
+                return
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update(container.env)
+            if self.loopback_rewrite and ENV_COORDINATOR in env:
+                _, _, port = env[ENV_COORDINATOR].rpartition(":")
+                env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+            # The executor owns the device inventory (≙ kubelet device
+            # plugin): for cpu-family pods, pin the emulated chip count to
+            # the pod's declared chips_per_host, overriding any inherited
+            # XLA_FLAGS (e.g. a test harness's 8-device mesh).
+            if env.get("TPUJOB_ACCELERATOR", "") == "cpu":
+                chips = env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1"
+                flags = [
+                    f
+                    for f in env.get("XLA_FLAGS", "").split()
+                    if "xla_force_host_platform_device_count" not in f
+                ]
+                flags.append(f"--xla_force_host_platform_device_count={chips}")
+                env["XLA_FLAGS"] = " ".join(flags)
+            try:
+                proc = subprocess.Popen(
+                    argv,
+                    env=env,
+                    cwd=self.workdir,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            except OSError as e:
+                log.warning("pod %s failed to start: %s", key, e)
+                self._set_phase(pod, PodPhase.FAILED, reason=f"StartError: {e}")
+                return
+            self._procs[key] = proc
+        self._set_phase(pod, PodPhase.RUNNING, ip="127.0.0.1")
+        t = threading.Thread(
+            target=self._reap, args=(pod, proc), name=f"reap-{key}", daemon=True
+        )
+        t.start()
+        # prune finished reap threads so per-pod state doesn't accumulate
+        self._threads = [th for th in self._threads if th.is_alive()]
+        self._threads.append(t)
+
+    def _reap(self, pod: Pod, proc: subprocess.Popen) -> None:
+        out, err = proc.communicate()
+        self.logs[self._pod_key(pod)] = (out, err)
+        if proc.returncode == 0:
+            self._set_phase(pod, PodPhase.SUCCEEDED, exit_code=0)
+        else:
+            tail = (err or out or "").strip()[-1024:]  # ≙ truncateMessage(:1524)
+            self._set_phase(
+                pod, PodPhase.FAILED, reason=f"ExitCode{proc.returncode}",
+                message=tail, exit_code=proc.returncode,
+            )
+        log.info(
+            "pod %s exited rc=%d", self._pod_key(pod), proc.returncode
+        )
+
+    def _set_phase(
+        self,
+        pod: Pod,
+        phase: str,
+        *,
+        reason: str = "",
+        ip: str = "",
+        message: str = "",
+        exit_code: Optional[int] = None,
+    ) -> None:
+        # re-read (controller may have updated the pod since); force-update
+        # status like a kubelet (status is the executor's to own)
+        try:
+            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            return
+        cur.status.phase = phase
+        cur.status.ready = phase == PodPhase.RUNNING
+        cur.status.reason = reason
+        if message:
+            cur.status.message = message
+        if ip:
+            cur.status.pod_ip = ip
+        if exit_code is not None:
+            cur.status.exit_code = exit_code
+        try:
+            self.store.update(cur, force=True)
+        except NotFound:
+            pass

@@ -1,0 +1,105 @@
+"""Operator main: flags → election → controller + executor + ops endpoints.
+
+≙ /root/reference/v2/cmd/mpi-operator/ (main.go + app/server.go + options):
+parse flags, start /healthz+/metrics, run leader election, and reconcile as
+leader. The in-process ObjectStore plays the apiserver; `--executor local`
+additionally runs pods as OS processes (a dev/single-host deployment — the
+k8s-backed store adapter is a deployment-target concern, not a framework
+one).
+
+  python -m mpi_operator_tpu.opshell --namespace ml --monitoring-port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobController
+from mpi_operator_tpu.executor import LocalExecutor
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.opshell.election import ElectionConfig, LeaderElector
+from mpi_operator_tpu.opshell.server import OpsServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # flag surface ≙ options.go:46-74
+    ap = argparse.ArgumentParser(prog="tpu-operator", description=__doc__)
+    ap.add_argument("--namespace", default=None,
+                    help="watch one namespace (default: all)")
+    ap.add_argument("--threadiness", type=int, default=2)
+    ap.add_argument("--monitoring-port", type=int, default=8080)
+    ap.add_argument("--lock-namespace", default="kube-system")
+    ap.add_argument("--no-gang-scheduling", action="store_true")
+    ap.add_argument("--executor", choices=["none", "local"], default="none",
+                    help="'local' runs worker pods as OS processes")
+    ap.add_argument("--coordinator-port", type=int, default=8476)
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(
+        store,
+        recorder,
+        ControllerOptions(
+            namespace=args.namespace,
+            threadiness=args.threadiness,
+            coordinator_port=args.coordinator_port,
+            gang_scheduling=not args.no_gang_scheduling,
+        ),
+    )
+    executor = LocalExecutor(store) if args.executor == "local" else None
+
+    stop = threading.Event()
+
+    def on_started():
+        controller.run()
+        if executor:
+            executor.start()
+
+    def on_stopped():
+        # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
+        # stops reconciling immediately
+        controller.stop()
+        if executor:
+            executor.stop()
+        stop.set()
+
+    elector = LeaderElector(
+        store,
+        config=ElectionConfig(namespace=args.lock_namespace),
+        on_started=on_started,
+        on_stopped=on_stopped,
+    )
+    ops = OpsServer(args.monitoring_port, healthy=lambda: True)
+    ops.start()
+
+    def on_signal(sig, frame):
+        elector.stop()
+        elector.release()
+        on_stopped()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    t = threading.Thread(target=elector.run, daemon=True)
+    t.start()
+    stop.wait()
+    ops.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Leader election over the object store.
+
+≙ the Endpoints-lock leader election of the reference
+(v2/cmd/mpi-operator/app/server.go:62-64, 210-257: 15s lease, 10s renew
+deadline, 5s retry; OnStartedLeading runs the controller, losing the lease
+is fatal). Same state machine here, with the lock record kept in the
+ObjectStore (the framework's apiserver equivalent) as a ConfigMap-shaped
+object — multiple operator replicas sharing a store (or, later, a replicated
+store backend) elect exactly one active reconciler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from mpi_operator_tpu.machinery.objects import ConfigMap
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from mpi_operator_tpu.opshell import metrics
+
+LOCK_NAME = "tpu-operator-leader-lock"
+KEY_HOLDER = "holderIdentity"
+KEY_RENEW = "renewTime"
+
+
+@dataclass
+class ElectionConfig:
+    lease_duration: float = 15.0  # ≙ server.go:62 leaseDuration
+    renew_deadline: float = 10.0  # ≙ renewDeadline
+    retry_period: float = 5.0     # ≙ retryPeriod
+    namespace: str = "kube-system"
+
+
+class LeaderElector:
+    """run() blocks: acquires (or waits for) the lease, calls on_started in a
+    thread, keeps renewing; calls on_stopped and returns if the lease is
+    lost. identity defaults to a uuid (≙ hostname+uuid, server.go:219)."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        *,
+        identity: Optional[str] = None,
+        config: Optional[ElectionConfig] = None,
+        on_started: Callable[[], None],
+        on_stopped: Callable[[], None],
+    ):
+        self.store = store
+        self.identity = identity or str(uuid.uuid4())
+        self.config = config or ElectionConfig()
+        self.on_started = on_started
+        self.on_stopped = on_stopped
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    # -- lock record -------------------------------------------------------
+
+    def _read(self) -> Optional[ConfigMap]:
+        try:
+            return self.store.get("ConfigMap", self.config.namespace, LOCK_NAME)
+        except NotFound:
+            return None
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        cur = self._read()
+        if cur is None:
+            cm = ConfigMap()
+            cm.metadata.name = LOCK_NAME
+            cm.metadata.namespace = self.config.namespace
+            cm.data = {KEY_HOLDER: self.identity, KEY_RENEW: str(now)}
+            try:
+                self.store.create(cm)
+                return True
+            except AlreadyExists:
+                return False
+        holder = cur.data.get(KEY_HOLDER, "")
+        renew = float(cur.data.get(KEY_RENEW, "0"))
+        if holder != self.identity and now - renew < self.config.lease_duration:
+            return False  # someone else holds a live lease
+        cur.data[KEY_HOLDER] = self.identity
+        cur.data[KEY_RENEW] = str(now)
+        try:
+            self.store.update(cur)  # optimistic: resource_version guards races
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    # -- loop --------------------------------------------------------------
+
+    def run(self) -> None:
+        cfg = self.config
+        # acquire
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            self._stop.wait(cfg.retry_period)
+        if self._stop.is_set():
+            return
+        self.is_leader = True
+        metrics.is_leader.set(1)
+        worker = threading.Thread(target=self.on_started, daemon=True)
+        worker.start()
+        # renew
+        last_renew = time.time()
+        while not self._stop.is_set():
+            self._stop.wait(cfg.retry_period)
+            if self._stop.is_set():
+                break
+            if self._try_acquire_or_renew():
+                last_renew = time.time()
+            elif time.time() - last_renew > cfg.renew_deadline:
+                break  # lease lost (≙ OnStoppedLeading → klog.Fatalf)
+        self.is_leader = False
+        metrics.is_leader.set(0)
+        self.on_stopped()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def release(self) -> None:
+        """Drop the lock record if we hold it (graceful shutdown)."""
+        cur = self._read()
+        if cur is not None and cur.data.get(KEY_HOLDER) == self.identity:
+            self.store.try_delete("ConfigMap", self.config.namespace, LOCK_NAME)

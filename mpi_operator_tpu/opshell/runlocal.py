@@ -1,0 +1,102 @@
+"""runlocal: run a TPUJob manifest end-to-end on this machine.
+
+The minimum end-to-end slice of SURVEY.md §7 phase 5, as a CLI:
+
+  python -m mpi_operator_tpu.opshell.runlocal examples/pi.yaml
+
+manifest → defaults → validation → controller reconcile (service, config,
+gang placement, worker pods) → LocalExecutor runs each worker as an OS
+process (SPMD boot via the injected TPUJOB_* env) → pod phases mirror into
+job conditions → exit 0 iff the job reaches Succeeded.
+
+≙ the reference's documented smoke-test flow `kubectl create -f
+examples/pi/pi.yaml && kubectl logs pi-launcher` (examples/pi/README.md) —
+with the cluster replaced by the in-process store + executor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import yaml
+
+from mpi_operator_tpu.api.conditions import is_finished, is_succeeded
+from mpi_operator_tpu.api.types import TPUJob
+from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobController
+from mpi_operator_tpu.executor import LocalExecutor
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.store import ObjectStore
+
+
+def load_job(path: str) -> TPUJob:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return TPUJob.from_dict(doc)
+
+
+def run_job(
+    job: TPUJob,
+    *,
+    timeout: float = 300.0,
+    workdir: str | None = None,
+) -> tuple:
+    """Drive one job to completion; returns (final job, worker logs dict)."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    executor = LocalExecutor(store, workdir=workdir)
+    store.create(job)
+    controller.run()
+    executor.start()
+    deadline = time.time() + timeout
+    final = None
+    try:
+        while time.time() < deadline:
+            cur = store.get("TPUJob", job.metadata.namespace, job.metadata.name)
+            if is_finished(cur.status):
+                final = cur
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(
+                f"job {job.metadata.name} did not finish within {timeout}s"
+            )
+    finally:
+        executor.stop()
+        controller.stop()
+    return final, dict(executor.logs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="runlocal", description=__doc__)
+    ap.add_argument("manifest", help="TPUJob YAML/JSON manifest")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--events", action="store_true", help="print the event log")
+    args = ap.parse_args(argv)
+
+    job = load_job(args.manifest)
+    store_job, logs = run_job(job, timeout=args.timeout, workdir=args.workdir)
+
+    # worker 0 plays the launcher; its output is the job's output
+    # (≙ `kubectl logs <job>-launcher`, examples/pi/README.md)
+    coord_key = f"{store_job.metadata.namespace}/{store_job.metadata.name}-worker-0"
+    if coord_key in logs and logs[coord_key][0].strip():
+        print(logs[coord_key][0].strip())
+
+    status = {
+        "job": f"{store_job.metadata.namespace}/{store_job.metadata.name}",
+        "conditions": [
+            {"type": c.type, "status": c.status, "reason": c.reason}
+            for c in store_job.status.conditions
+        ],
+    }
+    print(json.dumps(status, indent=2))
+    return 0 if is_succeeded(store_job.status) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Health + metrics HTTP endpoints.
+
+≙ the reference's /healthz on the monitoring port wired to the leader-
+election adaptor plus promhttp's /metrics
+(v2/cmd/mpi-operator/app/server.go:192-208, README.md:202-215)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from mpi_operator_tpu.opshell import metrics
+
+
+class OpsServer:
+    """Serves /healthz (200 iff healthy(), ≙ the election healthzAdaptor)
+    and /metrics (Prometheus text format)."""
+
+    def __init__(
+        self,
+        port: int = 8080,
+        *,
+        healthy: Optional[Callable[[], bool]] = None,
+        registry: metrics.Registry = metrics.REGISTRY,
+    ):
+        self.healthy = healthy or (lambda: True)
+        registry_ref = registry
+        healthy_ref = self.healthy
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    ok = False
+                    try:
+                        ok = healthy_ref()
+                    except Exception:
+                        ok = False
+                    body = json.dumps({"healthy": ok}).encode()
+                    self.send_response(200 if ok else 500)
+                    self.send_header("Content-Type", "application/json")
+                elif self.path == "/metrics":
+                    body = registry_ref.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self.httpd.server_address[1]  # resolved when port=0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="ops-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

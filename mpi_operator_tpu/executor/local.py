@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Dict, Optional
 
@@ -39,6 +40,8 @@ from mpi_operator_tpu.machinery.store import (
 log = logging.getLogger("tpujob.executor")
 
 ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_CONFIG_DIR = "TPUJOB_CONFIG_DIR"
+LABEL_JOB_NAME = "tpujob.dev/job-name"
 
 
 class LocalExecutor:
@@ -58,6 +61,7 @@ class LocalExecutor:
         self.workdir = workdir
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
         self.logs: Dict[str, tuple] = {}  # pod key → (stdout, stderr)
+        self._config_root = tempfile.mkdtemp(prefix="tpujob-config-")
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
@@ -66,11 +70,14 @@ class LocalExecutor:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        self._watch_q = self.store.watch("Pod")
+        self._watch_q = self.store.watch(None)
         t = threading.Thread(target=self._run, name="local-executor", daemon=True)
         t.start()
         self._threads.append(t)
-        # adopt pods that existed before the watch began
+        # adopt objects that existed before the watch began (configs first:
+        # pods read the projected dir at launch)
+        for cm in self.store.list("ConfigMap"):
+            self._project_config(cm)
         for pod in self.store.list("Pod"):
             self._maybe_launch(pod)
 
@@ -103,13 +110,34 @@ class LocalExecutor:
                 ev = self._watch_q.get(timeout=0.2)
             except Exception:
                 continue
-            if ev.type in (ADDED, MODIFIED):
+            if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):
+                self._project_config(ev.obj)
+            elif ev.kind == "Pod" and ev.type in (ADDED, MODIFIED):
                 self._maybe_launch(ev.obj)
-            elif ev.type == DELETED:
+            elif ev.kind == "Pod" and ev.type == DELETED:
                 self._forget(ev.obj)
 
     def _pod_key(self, pod: Pod) -> str:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def _config_dir(self, namespace: str, job_name: str) -> str:
+        return os.path.join(self._config_root, namespace, job_name)
+
+    def _project_config(self, cm) -> None:
+        """Project a job ConfigMap to files (≙ the kubelet's configMap volume
+        sync that elastic Horovod leans on — proposals/elastic-horovod.md:29
+        accepts ~1min lag; here it's immediate). Workers read
+        $TPUJOB_CONFIG_DIR/hostfile etc. (ops/elastic.declared_world_size)."""
+        job_name = cm.metadata.labels.get(LABEL_JOB_NAME, "")
+        if not job_name:
+            return
+        d = self._config_dir(cm.metadata.namespace, job_name)
+        os.makedirs(d, exist_ok=True)
+        for fname, content in cm.data.items():
+            tmp = os.path.join(d, f".{fname}.tmp")
+            with open(tmp, "w") as f:
+                f.write(content)
+            os.replace(tmp, os.path.join(d, fname))  # atomic swap, no torn reads
 
     def _forget(self, pod: Pod) -> None:
         """Pod deleted (controller restart path / cleanup policy): kill any
@@ -144,6 +172,11 @@ class LocalExecutor:
             # plugin): for cpu-family pods, pin the emulated chip count to
             # the pod's declared chips_per_host, overriding any inherited
             # XLA_FLAGS (e.g. a test harness's 8-device mesh).
+            job_name = pod.metadata.labels.get(LABEL_JOB_NAME, "")
+            if job_name:
+                env[ENV_CONFIG_DIR] = self._config_dir(
+                    pod.metadata.namespace, job_name
+                )
             if env.get("TPUJOB_ACCELERATOR", "") == "cpu":
                 chips = env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1"
                 flags = [

@@ -15,6 +15,12 @@ pjit programming model)."""
 from mpi_operator_tpu.ops.trainer import Trainer, TrainerConfig, TrainState
 from mpi_operator_tpu.ops.data import synthetic_imagenet, synthetic_tokens, prefetch
 from mpi_operator_tpu.ops.checkpoint import CheckpointManager
+from mpi_operator_tpu.ops.elastic import (
+    EXIT_RESTART,
+    ElasticConfig,
+    ElasticResult,
+    run_elastic,
+)
 
 __all__ = [
     "Trainer",
@@ -24,4 +30,8 @@ __all__ = [
     "synthetic_tokens",
     "prefetch",
     "CheckpointManager",
+    "EXIT_RESTART",
+    "ElasticConfig",
+    "ElasticResult",
+    "run_elastic",
 ]

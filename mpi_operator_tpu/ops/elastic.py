@@ -1,0 +1,130 @@
+"""Elastic training loop: membership changes → checkpoint → re-mesh → resume.
+
+≙ the reference's elastic-Horovod capability (SURVEY.md §3.5: controller
+publishes discover_hosts.sh, horovodrun re-forms the ring in place, in-memory
+state recovery) — redesigned for XLA's reality (SURVEY.md §7 "hard parts"):
+a compiled program is fixed to its mesh, so membership changes cannot re-form
+in place. The TPU-native protocol is restart-based:
+
+  1. every worker trains under a jit step compiled for the current gang;
+  2. a membership source (the controller-projected config file, or any
+     callable) reports the *desired* world size;
+  3. on change, every worker force-checkpoints and exits with
+     EXIT_RESTART (EX_TEMPFAIL) — a retryable code under
+     restart_policy: ExitCode;
+  4. the controller re-runs the gang at the new size; workers restore from
+     the checkpoint (reshard-on-load, ops/checkpoint.py) and continue at the
+     saved step.
+
+State survives via orbax instead of Horovod's in-memory rings because TPU
+preemption would lose in-memory state anyway — the checkpoint path must
+exist, so it IS the elasticity path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from mpi_operator_tpu.ops.checkpoint import CheckpointManager
+from mpi_operator_tpu.ops.trainer import Trainer, TrainState
+
+# EX_TEMPFAIL: the "re-run me" exit code workers use on membership change.
+# Job specs pair it with restart_policy: ExitCode (the controller treats the
+# exit as retryable and relaunches the gang, ≙ setRestartPolicy :1394-1400).
+EXIT_RESTART = 75
+
+ENV_CONFIG_DIR = "TPUJOB_CONFIG_DIR"
+HOSTFILE_NAME = "hostfile"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    checkpoint_dir: str = ""
+    save_interval_steps: int = 100
+    membership_check_every: int = 10
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    outcome: str  # "done" | "restart"
+    state: Any
+    last_step: int
+    metrics: Optional[Dict[str, float]] = None
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.outcome == "done" else EXIT_RESTART
+
+
+def declared_world_size() -> int:
+    """Desired gang size per the controller: hostfile lines in the projected
+    config dir (≙ discover_hosts.sh consumers; the executor/kubelet syncs
+    the file when the controller rescales)."""
+    cfg_dir = os.environ.get(ENV_CONFIG_DIR, "")
+    path = os.path.join(cfg_dir, HOSTFILE_NAME)
+    if not cfg_dir or not os.path.exists(path):
+        return int(os.environ.get("TPUJOB_NUM_HOSTS", "1"))
+    with open(path) as f:
+        return sum(1 for line in f if line.strip())
+
+
+def run_elastic(
+    trainer: Trainer,
+    batches: Iterator[Any],
+    *,
+    total_steps: int,
+    config: ElasticConfig,
+    init_state: Callable[[], TrainState],
+    membership: Callable[[], int] = declared_world_size,
+    current_world: Optional[int] = None,
+) -> ElasticResult:
+    """Train to total_steps or until membership changes.
+
+    ``init_state`` builds a fresh TrainState (used only when no checkpoint
+    exists); otherwise the latest checkpoint is restored INTO the current
+    mesh layout. Returns "restart" (caller exits EXIT_RESTART) or "done".
+    """
+    if current_world is None:
+        import jax
+
+        current_world = jax.process_count()
+    mgr = CheckpointManager(
+        config.checkpoint_dir,
+        save_interval_steps=config.save_interval_steps,
+    )
+    template = init_state()
+    if mgr.latest_step() is not None:
+        state = mgr.restore(template)
+    else:
+        state = template
+
+    step = int(state.step)
+    metrics = None
+    try:
+        while step < total_steps:
+            state, metrics = trainer.train_step(state, next(batches))
+            step = int(state.step)
+            mgr.save(step, state)
+            if (
+                step % config.membership_check_every == 0
+                and membership() != current_world
+            ):
+                if mgr.latest_step() != step:
+                    mgr.save(step, state, force=True)
+                mgr.wait()
+                return ElasticResult(
+                    "restart",
+                    state,
+                    step,
+                    {k: float(v) for k, v in (metrics or {}).items()},
+                )
+        if mgr.latest_step() != step:
+            mgr.save(step, state, force=True)
+        mgr.wait()
+    finally:
+        mgr.close()
+    return ElasticResult(
+        "done", state, step, {k: float(v) for k, v in (metrics or {}).items()}
+    )

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference has no kernels of its own — its hot path is Horovod/NCCL plus
+whatever cuDNN the workload images carry. Here the XLA-compiled model is
+already fast; these kernels target the ops where hand scheduling beats the
+compiler: attention (VMEM-resident online softmax, no [T,T] materialization).
+Written per /opt/skills/guides/pallas_guide.md; every kernel has an
+interpret-mode path so the CPU test suite checks numerics.
+"""
+
+from mpi_operator_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,247 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Blockwise online-softmax attention: Q tiles stream against K/V tiles held in
+VMEM, the [T, T] score matrix never exists, and each (batch, head, q-tile)
+program owns one output tile. GQA-aware: the kv head for a q head is derived
+in the BlockSpec index maps (no K/V expansion in HBM).
+
+Layout: [B, H, T, D] (heads-major — the kernel-friendly transpose of the
+model's [B, T, H, D]; the wrapper handles it). bf16 in, f32 accumulate, bf16
+out — MXU-native.
+
+Backward uses recompute-through-XLA via custom_vjp: the forward saves only
+(q, k, v) and the backward re-derives the attention blockwise (checkpointed
+q blocks under lax.map) — neither direction ever materializes [T,T].
+
+Pallas custom calls have no SPMD partitioning rule, so on a sharded mesh the
+kernel must run under shard_map; pass ``mesh`` and the wrapper shards batch
+over (data, fsdp) and heads over tensor, running the kernel on local shards.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+    causal: bool, scale: float, t_real: int
+):
+    """One program = one (b, h, q-tile). Refs:
+    q [1,1,BQ,D], k/v [1,1,Tpad,D], o [1,1,BQ,D], m/l [1,1,BQ]. K/V are
+    pre-padded to a block_k multiple (pl.ds clamps OOB starts, so unpadded
+    tail tiles would silently re-read earlier rows); t_real masks the pad."""
+    qb = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+    bq, d = q.shape
+    t = t_real
+    n_kb = pl.cdiv(t, block_k)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1
+        )
+        # tail K tiles are padded past t — padded keys must not attend
+        valid = k_idx < t
+        if causal:
+            q_idx = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            valid = jnp.logical_and(valid, q_idx >= k_idx)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    if causal:
+        # skip key tiles strictly above the diagonal for this q tile
+        n_kb = jnp.minimum(n_kb, pl.cdiv((qb + 1) * bq, block_k))
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(
+    q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """q [B,H,T,D], k/v [B,Hkv,T,D] → (o [B,H,T,D], m,l [B,H,T])."""
+    b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    grid = (b, h, pl.cdiv(t, bq))
+
+    # pad K/V up to a block multiple: pl.ds clamps OOB starts, so a partial
+    # tail tile would otherwise alias earlier rows
+    t_pad = ((t + bk - 1) // bk) * bk
+    if t_pad != t:
+        pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_k=bk, causal=causal, scale=scale, t_real=t
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return o
+
+
+def _block_reference(q_blk, k, v, q_offset, *, causal: bool, scale: float):
+    """Attention for one q block against full K/V (heads-major, GQA-aware).
+    q_blk [B,H,BQ,D], k/v [B,Hkv,T,D], q_offset scalar start index."""
+    b, h, bq, d = q_blk.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    q5 = q_blk.reshape(b, h_kv, g, bq, d).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", q5, k.astype(jnp.float32)) * scale
+    s = s.reshape(b, h, bq, k.shape[2])
+    if causal:
+        q_idx = q_offset + jnp.arange(bq)[:, None]
+        k_idx = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((q_idx >= k_idx)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p5 = p.reshape(b, h_kv, g, bq, k.shape[2])
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p5, v.astype(p.dtype))
+    return o.reshape(b, h, bq, d).astype(q_blk.dtype)
+
+
+def _chunked_reference(q, k, v, *, causal: bool, scale: float, block_q: int):
+    """Memory-bounded XLA attention: lax.map over checkpointed q blocks, so
+    its vjp stores only block inputs and recomputes scores blockwise —
+    backward memory stays O(BQ·T) instead of [T,T]. This is the function the
+    flash kernel's custom_vjp differentiates."""
+    b, h, t, d = q.shape
+    bq = min(block_q, t)
+    n = -(-t // bq)
+    t_pad = n * bq
+    q_p = jnp.pad(q, [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]) if t_pad != t else q
+    qr = q_p.reshape(b, h, n, bq, d).transpose(2, 0, 1, 3, 4)  # [n,B,H,BQ,D]
+    offsets = jnp.arange(n) * bq
+
+    blk = jax.checkpoint(
+        lambda qb, off: _block_reference(qb, k, v, off, causal=causal, scale=scale)
+    )
+    out = jax.lax.map(lambda args: blk(*args), (qr, offsets))  # [n,B,H,BQ,D]
+    out = out.transpose(1, 2, 0, 3, 4).reshape(b, h, t_pad, d)
+    return out[:, :, :t]
+
+
+def _dense_reference(q, k, v, *, causal: bool, scale: float):
+    """Unchunked XLA reference (numerics tests)."""
+    return _block_reference(q, k, v, 0, causal=causal, scale=scale)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o = _flash_fwd(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    # Recompute-through-XLA backward over checkpointed q blocks: exact
+    # gradients, O(BQ·T) live memory, never a [T,T] residual.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_reference(
+            q_, k_, v_, causal=causal, scale=scale, block_q=block_q
+        ),
+        q, k, v,
+    )
+    return vjp(do)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+    mesh=None,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+):
+    """Flash attention in model layout q [B,T,H,D], k/v [B,T,Hkv,D].
+
+    With ``mesh``, runs under shard_map (batch over ``batch_axes``, heads
+    over ``head_axis`` when divisible) — required for sharded inputs, since
+    the pallas call is not SPMD-partitionable. ``interpret`` defaults to
+    automatic: real kernel on TPU backends, interpreter elsewhere (tests).
+    Differentiable (blockwise recompute backward)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local(q_, k_, v_):
+        qt = q_.transpose(0, 2, 1, 3)
+        kt = k_.transpose(0, 2, 1, 3)
+        vt = v_.transpose(0, 2, 1, 3)
+        o = _flash(qt, kt, vt, causal, scale, block_q, block_k, interpret)
+        return o.transpose(0, 2, 1, 3)
+
+    if mesh is None:
+        return local(q, k, v)
+
+    from jax.sharding import PartitionSpec as P
+
+    b_part = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    h, h_kv = q.shape[2], k.shape[2]
+    tp = mesh.shape.get(head_axis, 1) if head_axis in mesh.axis_names else 1
+    # heads shard only when BOTH head counts divide: the GQA grouping must
+    # stay aligned on every shard
+    h_part = head_axis if (tp > 1 and h % tp == 0 and h_kv % tp == 0) else None
+    spec = P(b_part, None, h_part, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

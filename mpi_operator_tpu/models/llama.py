@@ -30,6 +30,7 @@ from mpi_operator_tpu.parallel.ring_attention import (
     ring_attention,
 )
 from mpi_operator_tpu.parallel.sharding import with_logical_constraint
+from mpi_operator_tpu.runtime.topology import AXIS_SEQ
 
 Params = Dict[str, Any]
 
@@ -46,6 +47,17 @@ class Config:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
+    # "auto": pallas flash kernel on TPU when the sequence isn't ring-sharded,
+    # XLA dense elsewhere; "dense"/"flash" force a path (a sharded sequence
+    # axis always takes the ring — it's the only exact option there)
+    attention_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("auto", "dense", "flash"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r}; "
+                "expected auto|dense|flash"
+            )
 
     @property
     def q_dim(self) -> int:
@@ -172,11 +184,28 @@ def apply(
         v = (y @ lp["wv"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
         q = _rope(q, c.rope_theta)
         k = _rope(k, c.rope_theta)
-        # K/V stay at n_kv_heads: the attention kernels are GQA-aware, so
-        # the ring never carries expanded K/V
-        if mesh is not None:
-            # ring attention over the sequence axis; ring_attention itself
-            # falls back to dense when the mesh has no sequence axis
+        # K/V stay at n_kv_heads: every attention path is GQA-aware, so the
+        # ring never carries expanded K/V
+        seq_sharded = (
+            mesh is not None
+            and AXIS_SEQ in mesh.axis_names
+            and mesh.shape[AXIS_SEQ] > 1
+        )
+        use_flash = c.attention_impl == "flash" or (
+            c.attention_impl == "auto" and jax.default_backend() == "tpu"
+        )
+        if seq_sharded:
+            # ring attention is the only exact option over a sharded sequence
+            attn = ring_attention(q, k, v, mesh, causal=True)
+        elif use_flash:
+            from mpi_operator_tpu.kernels import flash_attention
+
+            # mesh passed through: the pallas call must run under shard_map
+            # on sharded inputs (it is not SPMD-partitionable)
+            attn = flash_attention(
+                q, k, v, causal=True, scale=c.head_dim**-0.5, mesh=mesh
+            )
+        elif mesh is not None:
             attn = ring_attention(q, k, v, mesh, causal=True)
         else:
             attn = dense_attention(q, k, v, causal=True, scale=c.head_dim**-0.5)

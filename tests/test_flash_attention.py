@@ -1,0 +1,76 @@
+"""Pallas flash-attention kernel numerics (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.kernels import flash_attention
+from mpi_operator_tpu.parallel.ring_attention import dense_attention
+
+
+def _qkv(key, b=2, t=128, h=4, hkv=None, d=16, dtype=jnp.float32):
+    hkv = h if hkv is None else hkv
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), dtype),
+        jax.random.normal(kk, (b, t, hkv, d), dtype),
+        jax.random.normal(kv, (b, t, hkv, d), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    want = dense_attention(q, k, v, causal=causal, scale=q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_gqa():
+    q, k, v = _qkv(jax.random.PRNGKey(1), h=8, hkv=2)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_uneven_blocks():
+    # t not divisible by block sizes exercises the tail tiles
+    q, k, v = _qkv(jax.random.PRNGKey(2), t=96)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_bfloat16():
+    q, k, v = _qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert got.dtype == jnp.bfloat16
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_gradients_match_dense():
+    q, k, v = _qkv(jax.random.PRNGKey(4), t=64)
+
+    def f_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True, block_q=32, block_k=32) ** 2)
+
+    def f_dense(q_, k_, v_):
+        return jnp.sum(
+            dense_attention(q_, k_, v_, causal=True, scale=q.shape[-1] ** -0.5) ** 2
+        )
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
+
+
+def test_jit_compiles():
+    q, k, v = _qkv(jax.random.PRNGKey(5), t=64)
+    f = jax.jit(lambda *a: flash_attention(*a, causal=True, block_q=32, block_k=32))
+    out = f(q, k, v)
+    assert out.shape == q.shape

@@ -36,6 +36,7 @@ from mpi_operator_tpu.machinery.store import (
     NotFound,
     ObjectStore,
 )
+from mpi_operator_tpu.runtime.emulation import pin_host_device_count
 
 log = logging.getLogger("tpujob.executor")
 
@@ -178,14 +179,10 @@ class LocalExecutor:
                     pod.metadata.namespace, job_name
                 )
             if env.get("TPUJOB_ACCELERATOR", "") == "cpu":
-                chips = env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1"
-                flags = [
-                    f
-                    for f in env.get("XLA_FLAGS", "").split()
-                    if "xla_force_host_platform_device_count" not in f
-                ]
-                flags.append(f"--xla_force_host_platform_device_count={chips}")
-                env["XLA_FLAGS"] = " ".join(flags)
+                chips = int(env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1")
+                env["XLA_FLAGS"] = pin_host_device_count(
+                    env.get("XLA_FLAGS", ""), chips
+                )
             try:
                 proc = subprocess.Popen(
                     argv,

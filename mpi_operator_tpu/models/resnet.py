@@ -126,11 +126,16 @@ def _conv(x, w, stride=1):
 
 
 def _bn(config, x, p, s, train):
-    """BN in f32 (bf16 variance underflows). Returns (y, new_running)."""
-    x32 = x.astype(jnp.float32)
+    """Batch norm tuned for the MXU/HBM balance: statistics are one fused
+    f32 pass (E[x] and E[x²] reduce together; jnp.var would re-read the
+    activation), and the normalize is a single per-channel FMA in the
+    compute dtype — scale/offset are folded in f32 first, so bf16 touches
+    only the O(C) constants, never the variance math."""
     if train:
+        x32 = x.astype(jnp.float32)
         mean = jnp.mean(x32, axis=(0, 1, 2))
-        var = jnp.var(x32, axis=(0, 1, 2))
+        mean2 = jnp.mean(jnp.square(x32), axis=(0, 1, 2))
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
         mom = config.bn_momentum
         new_s = {
             "mean": mom * s["mean"] + (1 - mom) * mean,
@@ -139,9 +144,10 @@ def _bn(config, x, p, s, train):
     else:
         mean, var = s["mean"], s["var"]
         new_s = s
-    y = (x32 - mean) * lax.rsqrt(var + config.bn_epsilon)
-    y = y * p["scale"] + p["bias"]
-    return y.astype(x.dtype), new_s
+    inv = lax.rsqrt(var + config.bn_epsilon) * p["scale"]
+    offset = p["bias"] - mean * inv
+    y = x * inv.astype(x.dtype) + offset.astype(x.dtype)
+    return y, new_s
 
 
 def apply(config: Config, params: Params, state: Params, images, train: bool = True):

@@ -100,13 +100,17 @@ def run_elastic(
     else:
         state = template
 
+    # Track the step host-side: int(state.step) forces a device sync on a
+    # jit output, which would serialize dispatch of step N+1 behind compute
+    # of step N every iteration. One sync at restore, then a local counter.
     step = int(state.step)
     metrics = None
     try:
         while step < total_steps:
             state, metrics = trainer.train_step(state, next(batches))
-            step = int(state.step)
-            mgr.save(step, state)
+            step += 1
+            if step % config.save_interval_steps == 0:
+                mgr.save(step, state)
             if (
                 step % config.membership_check_every == 0
                 and membership() != current_world

@@ -29,6 +29,19 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def pin_host_device_count(flags: str, n: int) -> str:
+    """Return XLA_FLAGS with any inherited host-device-count pin replaced by
+    ``n``. Inherited pins (e.g. a test harness's 8-device mesh) would
+    otherwise leak into child processes whose declared chip count differs."""
+    kept = [
+        f
+        for f in (flags or "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(kept)
+
+
 @dataclass
 class GangResult:
     returncodes: List[int]
@@ -73,11 +86,9 @@ class LocalGang:
                 bootstrap.ENV_HOST_COORD: str(host_id),
             }
         )
-        if self.chips_per_host > 1:
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={self.chips_per_host}"
-            ).strip()
+        env["XLA_FLAGS"] = pin_host_device_count(
+            env.get("XLA_FLAGS", ""), self.chips_per_host
+        )
         return env
 
     def run(

@@ -37,6 +37,10 @@ constexpr uint8_t kOpBarrier = 3;
 constexpr uint8_t kOpFinalize = 4;
 constexpr int kConnectTimeoutMs = 30000;
 constexpr int kConnectRetryMs = 100;
+// This library carries host-side control traffic (scalars, barriers);
+// payloads are O(10) doubles. The cap keeps an untrusted peer from driving
+// a multi-GB allocation through the wire-format count field.
+constexpr uint64_t kMaxCount = 1 << 20;  // 8 MiB of doubles
 
 struct Request {
   uint8_t op;
@@ -100,6 +104,11 @@ void serve(tpucoll_ctx *ctx) {
           !read_full(ctx->peers[r], &req.count, 8)) {
         return;  // peer died: tear down; clients will see EOF
       }
+      if (req.count > kMaxCount) {
+        fprintf(stderr, "tpucoll: rank %d sent count %llu > max %llu\n", r,
+                (unsigned long long)req.count, (unsigned long long)kMaxCount);
+        return;
+      }
       if (r == 0) {
         first = req;
       } else if (req.op != first.op || req.count != first.count) {
@@ -134,6 +143,26 @@ void serve(tpucoll_ctx *ctx) {
         return;
     }
   }
+}
+
+/* Tear down a ctx whose init failed partway. Order matters: close the
+ * client socket first (EOFs any in-flight handshake read in the accept
+ * loop), then shut down the listener (unblocks a blocked accept()), then
+ * join the server thread — only after that is it safe to free ctx. */
+void destroy_ctx(tpucoll_ctx *ctx) {
+  if (ctx->sock >= 0) {
+    close(ctx->sock);
+    ctx->sock = -1;
+  }
+  if (ctx->listen_fd >= 0) {
+    shutdown(ctx->listen_fd, SHUT_RDWR);
+    close(ctx->listen_fd);
+    ctx->listen_fd = -1;
+  }
+  if (ctx->server.joinable()) ctx->server.join();
+  for (int fd : ctx->peers)
+    if (fd >= 0) close(fd);
+  delete ctx;
 }
 
 int round_trip(tpucoll_ctx *ctx, uint8_t op, double *buf, size_t n,
@@ -191,24 +220,37 @@ int tpucoll_init(tpucoll_ctx **out) {
     if (bind(ctx->listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) !=
             0 ||
         listen(ctx->listen_fd, ctx->size) != 0) {
-      delete ctx;
-      return -errno;
+      int err = errno;
+      destroy_ctx(ctx);
+      return -err;
     }
     ctx->peers.assign(static_cast<size_t>(ctx->size), -1);
-    // Accept in a thread so rank 0 can connect to itself below.
+    // Accept in a thread so rank 0 can connect to itself below. Connections
+    // that fail the rank handshake (bad rank, duplicate registration) are
+    // dropped without consuming a registration slot.
     tpucoll_ctx *c = ctx;
     ctx->server = std::thread([c] {
-      for (int i = 0; i < c->size; ++i) {
+      for (int registered = 0; registered < c->size;) {
         int fd = accept(c->listen_fd, nullptr, nullptr);
         if (fd < 0) return;
         int one2 = 1;
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+        // Bound the handshake read: a peer that connects but never sends
+        // its rank must not wedge this thread (destroy_ctx joins it, so a
+        // blocked read here would turn an init error into a process hang).
+        timeval tv{};
+        tv.tv_sec = 5;
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         uint32_t peer_rank = 0;
-        if (!read_full(fd, &peer_rank, 4) || peer_rank >= (uint32_t)c->size) {
+        if (!read_full(fd, &peer_rank, 4) || peer_rank >= (uint32_t)c->size ||
+            c->peers[peer_rank] != -1) {
           close(fd);
-          return;
+          continue;
         }
+        tv.tv_sec = 0;  // collectives block indefinitely by design
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         c->peers[peer_rank] = fd;
+        ++registered;
       }
       serve(c);
     });
@@ -222,7 +264,7 @@ int tpucoll_init(tpucoll_ctx **out) {
   hints.ai_socktype = SOCK_STREAM;
   addrinfo *res = nullptr;
   if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res) {
-    delete ctx;
+    destroy_ctx(ctx);
     return -EHOSTUNREACH;
   }
   sockaddr_in target = *reinterpret_cast<sockaddr_in *>(res->ai_addr);
@@ -239,7 +281,7 @@ int tpucoll_init(tpucoll_ctx **out) {
     close(ctx->sock);
     ctx->sock = -1;
     if (std::chrono::steady_clock::now() > deadline) {
-      delete ctx;
+      destroy_ctx(ctx);
       return -ETIMEDOUT;
     }
     std::this_thread::sleep_for(std::chrono::milliseconds(kConnectRetryMs));
@@ -248,7 +290,7 @@ int tpucoll_init(tpucoll_ctx **out) {
   setsockopt(ctx->sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   uint32_t my_rank = static_cast<uint32_t>(ctx->rank);
   if (!write_full(ctx->sock, &my_rank, 4)) {
-    delete ctx;
+    destroy_ctx(ctx);
     return -EIO;
   }
   *out = ctx;

@@ -1,4 +1,8 @@
-"""Pallas flash-attention kernel numerics (interpret mode on CPU)."""
+"""Pallas flash-attention kernel numerics.
+
+Every kernel test pins ``interpret=True`` so CPU runs exercise the actual
+kernel body (auto mode on non-TPU backends falls back to the XLA chunked
+reference, which would compare the reference against itself)."""
 
 import jax
 import jax.numpy as jnp
@@ -22,14 +26,14 @@ def _qkv(key, b=2, t=128, h=4, hkv=None, d=16, dtype=jnp.float32):
 @pytest.mark.parametrize("causal", [False, True])
 def test_matches_dense(causal):
     q, k, v = _qkv(jax.random.PRNGKey(0))
-    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32, interpret=True)
     want = dense_attention(q, k, v, causal=causal, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
 def test_gqa():
     q, k, v = _qkv(jax.random.PRNGKey(1), h=8, hkv=2)
-    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
     want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
@@ -37,14 +41,14 @@ def test_gqa():
 def test_uneven_blocks():
     # t not divisible by block sizes exercises the tail tiles
     q, k, v = _qkv(jax.random.PRNGKey(2), t=96)
-    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
     want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
 def test_bfloat16():
     q, k, v = _qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
-    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
     assert got.dtype == jnp.bfloat16
     want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(
@@ -56,7 +60,7 @@ def test_gradients_match_dense():
     q, k, v = _qkv(jax.random.PRNGKey(4), t=64)
 
     def f_flash(q_, k_, v_):
-        return jnp.sum(flash_attention(q_, k_, v_, causal=True, block_q=32, block_k=32) ** 2)
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True, block_q=32, block_k=32, interpret=True) ** 2)
 
     def f_dense(q_, k_, v_):
         return jnp.sum(
@@ -71,6 +75,17 @@ def test_gradients_match_dense():
 
 def test_jit_compiles():
     q, k, v = _qkv(jax.random.PRNGKey(5), t=64)
-    f = jax.jit(lambda *a: flash_attention(*a, causal=True, block_q=32, block_k=32))
+    f = jax.jit(lambda *a: flash_attention(*a, causal=True, block_q=32, block_k=32, interpret=True))
     out = f(q, k, v)
     assert out.shape == q.shape
+
+
+def test_auto_mode_falls_back_off_tpu():
+    # interpret=None on a non-TPU backend must use the XLA chunked reference
+    # (exact vs dense), never the interpreted kernel.
+    if jax.default_backend() == "tpu":
+        pytest.skip("auto mode uses the real kernel on TPU")
+    q, k, v = _qkv(jax.random.PRNGKey(6))
+    got = flash_attention(q, k, v, causal=True)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)

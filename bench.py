@@ -47,7 +47,9 @@ def main():
     )
     print(f"[bench] {n_chips} x {kind}", file=sys.stderr)
 
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    # 128/chip measured best on v5e (MFU .407 vs .392 at 64); the reference
+    # ran 64/GPU, but per-chip batch is a tuning knob, not workload shape
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128"))
     global_batch = per_chip_batch * n_chips
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))  # ≥1: first

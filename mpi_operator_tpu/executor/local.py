@@ -55,9 +55,13 @@ class LocalExecutor:
         loopback_rewrite: bool = True,
         extra_env: Optional[Dict[str, str]] = None,
         workdir: Optional[str] = None,
+        require_binding: bool = False,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
+        # kubelet semantics: with a scheduler in play, only bound pods run
+        # (spec.node_name set by scheduler/gang.py's atomic admission)
+        self.require_binding = require_binding
         self.extra_env = dict(extra_env or {})
         self.workdir = workdir
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
@@ -154,6 +158,8 @@ class LocalExecutor:
     def _maybe_launch(self, pod: Pod) -> None:
         if pod.status.phase != PodPhase.PENDING:
             return
+        if self.require_binding and not pod.spec.node_name:
+            return  # waiting for gang admission; binding event re-triggers
         key = self._pod_key(pod)
         with self._lock:
             if key in self._procs:
@@ -179,7 +185,10 @@ class LocalExecutor:
                     pod.metadata.namespace, job_name
                 )
             if env.get("TPUJOB_ACCELERATOR", "") == "cpu":
-                chips = int(env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1")
+                try:
+                    chips = max(1, int(env.get("TPUJOB_CHIPS_PER_HOST", "1") or "1"))
+                except ValueError:
+                    chips = 1  # malformed env must not kill the watch loop
                 env["XLA_FLAGS"] = pin_host_device_count(
                     env.get("XLA_FLAGS", ""), chips
                 )

@@ -215,8 +215,10 @@ def flash_attention(
 
     With ``mesh``, runs under shard_map (batch over ``batch_axes``, heads
     over ``head_axis`` when divisible) — required for sharded inputs, since
-    the pallas call is not SPMD-partitionable. ``interpret`` defaults to
-    automatic: real kernel on TPU backends, interpreter elsewhere (tests).
+    the pallas call is not SPMD-partitionable. ``interpret=None`` (auto)
+    runs the real kernel on TPU and the exact chunked XLA reference on any
+    other backend — never the Pallas interpreter; pass ``interpret=True``
+    explicitly to exercise the kernel body off-TPU (kernel tests do).
     Differentiable (blockwise recompute backward)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5

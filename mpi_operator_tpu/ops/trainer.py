@@ -227,8 +227,12 @@ class Trainer:
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates)
-            gnorm = optax.global_norm(grads)
-            metrics = {"loss": loss, "grad_norm": gnorm}
+            metrics = {"loss": loss}
+            if self.config.grad_clip_norm > 0:
+                # free when clipping: XLA CSEs this with the clip's norm.
+                # When not clipping it would be an extra full pass over the
+                # gradients, so the metric is only emitted alongside a clip.
+                metrics["grad_norm"] = optax.global_norm(grads)
             return (
                 TrainState(
                     step=state.step + 1,
@@ -240,10 +244,9 @@ class Trainer:
             )
 
         state_sh = self.state_sharding()
-        metrics_sh = {
-            "loss": NamedSharding(self.mesh, PartitionSpec()),
-            "grad_norm": NamedSharding(self.mesh, PartitionSpec()),
-        }
+        metrics_sh = {"loss": NamedSharding(self.mesh, PartitionSpec())}
+        if self.config.grad_clip_norm > 0:
+            metrics_sh["grad_norm"] = NamedSharding(self.mesh, PartitionSpec())
         return jax.jit(
             step,
             in_shardings=(state_sh, self.batch_sharding(batch_example)),

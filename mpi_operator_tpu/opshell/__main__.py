@@ -24,6 +24,7 @@ from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
 from mpi_operator_tpu.opshell.election import ElectionConfig, LeaderElector
 from mpi_operator_tpu.opshell.server import OpsServer
+from mpi_operator_tpu.scheduler import GangScheduler
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -38,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--executor", choices=["none", "local"], default="none",
                     help="'local' runs worker pods as OS processes")
     ap.add_argument("--coordinator-port", type=int, default=8476)
+    ap.add_argument("--inventory-chips", type=int, default=None,
+                    help="finite chip inventory for gang admission "
+                         "(default: unbounded)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     return ap
 
@@ -60,12 +64,27 @@ def main(argv=None) -> int:
             gang_scheduling=not args.no_gang_scheduling,
         ),
     )
-    executor = LocalExecutor(store) if args.executor == "local" else None
+    gang = not args.no_gang_scheduling
+    if args.inventory_chips is not None and not gang:
+        print(
+            "error: --inventory-chips requires gang scheduling "
+            "(remove --no-gang-scheduling)",
+            file=sys.stderr,
+        )
+        return 2
+    scheduler = GangScheduler(store, recorder, chips=args.inventory_chips) if gang else None
+    executor = (
+        LocalExecutor(store, require_binding=gang)
+        if args.executor == "local"
+        else None
+    )
 
     stop = threading.Event()
 
     def on_started():
         controller.run()
+        if scheduler:
+            scheduler.start()
         if executor:
             executor.start()
 
@@ -73,6 +92,8 @@ def main(argv=None) -> int:
         # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
         # stops reconciling immediately
         controller.stop()
+        if scheduler:
+            scheduler.stop()
         if executor:
             executor.stop()
         stop.set()

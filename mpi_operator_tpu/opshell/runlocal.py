@@ -29,6 +29,7 @@ from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobCont
 from mpi_operator_tpu.executor import LocalExecutor
 from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler import GangScheduler
 
 
 def load_job(path: str) -> TPUJob:
@@ -42,14 +43,21 @@ def run_job(
     *,
     timeout: float = 300.0,
     workdir: str | None = None,
+    chips: int | None = None,
 ) -> tuple:
-    """Drive one job to completion; returns (final job, worker logs dict)."""
+    """Drive one job to completion; returns (final job, worker logs dict).
+
+    ``chips`` bounds the gang scheduler's inventory (None = unbounded);
+    either way admission is enforced: pods launch only once the whole gang
+    is bound (scheduler/gang.py)."""
     store = ObjectStore()
     recorder = EventRecorder(store)
     controller = TPUJobController(store, recorder, ControllerOptions())
-    executor = LocalExecutor(store, workdir=workdir)
+    scheduler = GangScheduler(store, recorder, chips=chips)
+    executor = LocalExecutor(store, workdir=workdir, require_binding=True)
     store.create(job)
     controller.run()
+    scheduler.start()
     executor.start()
     deadline = time.time() + timeout
     final = None
@@ -66,6 +74,7 @@ def run_job(
             )
     finally:
         executor.stop()
+        scheduler.stop()
         controller.stop()
     return final, dict(executor.logs)
 
@@ -75,11 +84,15 @@ def main(argv=None) -> int:
     ap.add_argument("manifest", help="TPUJob YAML/JSON manifest")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--chips", type=int, default=None,
+                    help="bound the scheduler's chip inventory")
     ap.add_argument("--events", action="store_true", help="print the event log")
     args = ap.parse_args(argv)
 
     job = load_job(args.manifest)
-    store_job, logs = run_job(job, timeout=args.timeout, workdir=args.workdir)
+    store_job, logs = run_job(
+        job, timeout=args.timeout, workdir=args.workdir, chips=args.chips
+    )
 
     # worker 0 plays the launcher; its output is the job's output
     # (≙ `kubectl logs <job>-launcher`, examples/pi/README.md)

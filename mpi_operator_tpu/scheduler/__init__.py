@@ -1,0 +1,3 @@
+from mpi_operator_tpu.scheduler.gang import GangScheduler, pod_cost
+
+__all__ = ["GangScheduler", "pod_cost"]

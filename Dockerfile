@@ -1,0 +1,29 @@
+# Operator image. ≙ /root/reference/Dockerfile:1-14 (two-stage distroless Go
+# build selecting a controller binary); here stage 1 compiles the native
+# collective library and stage 2 is a slim Python runtime carrying the
+# operator package, the compiled libtpucoll, and the deploy schema.
+#
+#   docker build -t tpu-operator .
+#   docker run tpu-operator --store sqlite:/data/store.db --executor local
+
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir pyyaml \
+    # jax/flax/optax/orbax are workload deps: bake the TPU wheel matching the
+    # target fleet here (kept out of the base image on purpose — the operator
+    # itself only needs the stdlib + yaml)
+    && true
+WORKDIR /app
+COPY mpi_operator_tpu/ mpi_operator_tpu/
+COPY examples/ examples/
+COPY deploy/tpujob-schema.json deploy/tpujob-schema.json
+COPY --from=build /src/native/build/libtpucoll.so native/build/libtpucoll.so
+COPY --from=build /src/native/build/pi native/build/pi
+ENTRYPOINT ["python", "-m", "mpi_operator_tpu.opshell"]
+CMD ["--monitoring-port", "8080"]

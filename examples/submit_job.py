@@ -1,0 +1,95 @@
+"""Submit a TPUJob programmatically with the typed client.
+
+≙ the reference SDK example (/root/reference/sdk/python/examples/
+tensorflow-mnist.py: build a V1MPIJob from models, submit via the k8s
+client, poll status). Here the client talks to any store backend:
+
+  python examples/submit_job.py                  # in-process stack
+  python examples/submit_job.py sqlite:/tmp/s.db # against a shared store
+                                                 # (an operator replica must
+                                                 # be running on it)
+
+With a sqlite path this is a true two-process deployment: the operator
+(`python -m mpi_operator_tpu.opshell --store sqlite:... --executor local`)
+reconciles in its own process; this script only creates the job and watches
+status — exactly the reference's SDK-submits-to-apiserver split.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_operator_tpu.api import TPUJobClient  # noqa: E402
+from mpi_operator_tpu.api.conditions import is_finished, is_succeeded  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MANIFEST = {
+    "apiVersion": "tpujob.dev/v1",
+    "kind": "TPUJob",
+    "metadata": {"name": "pi-sdk"},
+    "spec": {
+        "slotsPerWorker": 1,
+        "runPolicy": {"cleanPodPolicy": "Running"},
+        "worker": {
+            "replicas": 2,
+            "template": {
+                "containers": [
+                    {
+                        "name": "worker",
+                        "image": "local",
+                        "command": ["python", "examples/pi_worker.py", "50000"],
+                    }
+                ]
+            },
+        },
+        "slice": {"accelerator": "cpu", "chipsPerHost": 1},
+    },
+}
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1].startswith("sqlite:"):
+        from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+        store = SqliteStore(sys.argv[1][len("sqlite:"):])
+        stack = None
+    else:
+        # self-contained demo: run the whole operator stack in-process
+        from mpi_operator_tpu.controller.controller import (
+            ControllerOptions,
+            TPUJobController,
+        )
+        from mpi_operator_tpu.executor import LocalExecutor
+        from mpi_operator_tpu.machinery.events import EventRecorder
+        from mpi_operator_tpu.machinery.store import ObjectStore
+        from mpi_operator_tpu.scheduler import GangScheduler
+
+        store = ObjectStore()
+        recorder = EventRecorder(store)
+        controller = TPUJobController(store, recorder, ControllerOptions())
+        scheduler = GangScheduler(store, recorder)
+        executor = LocalExecutor(store, workdir=REPO, require_binding=True)
+        controller.run()
+        scheduler.start()
+        executor.start()
+        stack = (controller, scheduler, executor)
+
+    client = TPUJobClient(store)
+    job = client.create(MANIFEST)
+    print(f"created TPUJob {job.metadata.namespace}/{job.metadata.name} "
+          f"(uid {job.metadata.uid})")
+    final = client.wait(job.metadata.name, until=is_finished, timeout=120)
+    ok = is_succeeded(final.status)
+    for c in final.status.conditions:
+        print(f"  condition {c.type}: {c.status} ({c.reason})")
+    if stack is not None:
+        for component in reversed(stack):
+            component.stop()
+    print("SUCCEEDED" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

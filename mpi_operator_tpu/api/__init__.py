@@ -29,3 +29,10 @@ from mpi_operator_tpu.api.types import (  # noqa: F401
 from mpi_operator_tpu.api.defaults import set_defaults  # noqa: F401
 from mpi_operator_tpu.api.validation import ValidationError, validate_tpujob  # noqa: F401
 from mpi_operator_tpu.api import conditions  # noqa: F401
+from mpi_operator_tpu.api.schema import (  # noqa: F401
+    ManifestError,
+    check_manifest,
+    json_schema,
+    parse_tpujob,
+)
+from mpi_operator_tpu.api.client import TPUJobClient, ValidationRejected  # noqa: F401

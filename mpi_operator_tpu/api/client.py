@@ -1,0 +1,122 @@
+"""TPUJobClient: the typed SDK surface.
+
+≙ the reference's generated Python SDK (/root/reference/sdk/python/mpijob/:
+``V1MPIJob`` models + a kubernetes client, used by
+sdk/python/examples/tensorflow-mnist.py to submit a job programmatically).
+Here the dataclasses ARE the models, so the client is a thin typed facade
+over any store backend (in-process ObjectStore or the shared SqliteStore):
+
+    client = TPUJobClient(store)
+    job = client.create({...manifest dict...})     # strict-parsed
+    client.wait(job.name, until=is_succeeded)
+    client.delete(job.name)
+
+``create`` accepts a TPUJob or a manifest dict; dicts go through the strict
+structural schema (api/schema.py) — unknown fields fail loudly, exactly the
+apiserver-CRD behavior the reference relies on — and are admission-validated
+(defaulted copy) so bad specs are rejected at submit time, not at reconcile.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+from mpi_operator_tpu.api.defaults import set_defaults
+from mpi_operator_tpu.api.schema import ManifestError, parse_tpujob
+from mpi_operator_tpu.api.types import TPUJob
+from mpi_operator_tpu.api.validation import validate_tpujob
+
+
+class ValidationRejected(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("TPUJob rejected:\n  " + "\n  ".join(errors))
+
+
+class TPUJobClient:
+    """Typed create/get/list/watch/delete for TPUJobs over a store."""
+
+    KIND = "TPUJob"
+
+    def __init__(self, store, namespace: str = "default"):
+        self.store = store
+        self.namespace = namespace
+
+    # -- admission ----------------------------------------------------------
+
+    @staticmethod
+    def load(manifest: Union[TPUJob, Dict[str, Any]]) -> TPUJob:
+        """dict → TPUJob through the strict schema; TPUJob passes through."""
+        if isinstance(manifest, TPUJob):
+            return manifest
+        return parse_tpujob(manifest)
+
+    def create(self, manifest: Union[TPUJob, Dict[str, Any]]) -> TPUJob:
+        job = self.load(manifest).deepcopy()
+        if not job.metadata.namespace or job.metadata.namespace == "default":
+            job.metadata.namespace = self.namespace
+        # admission: validate a defaulted copy (the controller re-defaults at
+        # reconcile; stored spec stays exactly what the user wrote)
+        errors = validate_tpujob(set_defaults(job.deepcopy()))
+        if errors:
+            raise ValidationRejected(errors)
+        return self.store.create(job)
+
+    # -- read ---------------------------------------------------------------
+
+    def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:
+        return self.store.get(self.KIND, namespace or self.namespace, name)
+
+    def list(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        return self.store.list(self.KIND, namespace or self.namespace)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> TPUJob:
+        return self.store.delete(self.KIND, namespace or self.namespace, name)
+
+    # -- watch / wait -------------------------------------------------------
+
+    def watch(self, timeout: Optional[float] = None) -> Iterator[TPUJob]:
+        """Yield job objects as they change (ADDED/MODIFIED), until timeout
+        (None = forever; the caller breaks out)."""
+        q = self.store.watch(self.KIND)
+        deadline = None if timeout is None else time.time() + timeout
+        try:
+            while True:
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return
+                try:
+                    ev = q.get(timeout=remaining if remaining is not None else 1.0)
+                except queue.Empty:
+                    if deadline is None:
+                        continue
+                    return
+                if ev.type in ("ADDED", "MODIFIED"):
+                    yield ev.obj
+        finally:
+            self.store.stop_watch(q)
+
+    def wait(
+        self,
+        name: str,
+        *,
+        until: Callable[[Any], bool],
+        timeout: float = 300.0,
+        namespace: Optional[str] = None,
+        poll: float = 0.1,
+    ) -> TPUJob:
+        """Block until ``until(job.status)`` holds; raises TimeoutError.
+        Polling (not watch-based) so it works identically on every backend."""
+        ns = namespace or self.namespace
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.store.get(self.KIND, ns, name)
+            if until(job.status):
+                return job
+            time.sleep(poll)
+        raise TimeoutError(f"TPUJob {ns}/{name} did not reach the desired state")
+
+
+__all__ = ["TPUJobClient", "ValidationRejected", "ManifestError"]

@@ -1,0 +1,35 @@
+"""Regenerate deploy/tpujob-schema.json from the API dataclasses.
+
+≙ hack/update-codegen.sh + hack/python-sdk/gen-sdk.sh in the reference
+(generate artifacts from the Go types); here the schema derives from the
+dataclasses, so this is the whole generator.
+
+  python -m mpi_operator_tpu.api.gen_schema [out-path]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from mpi_operator_tpu.api.schema import json_schema
+
+DEFAULT_OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "deploy",
+    "tpujob-schema.json",
+)
+
+
+def main(argv=None) -> int:
+    out = (argv or sys.argv[1:] or [DEFAULT_OUT])[0]
+    with open(out, "w") as f:
+        json.dump(json_schema(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -355,14 +355,19 @@ class SliceSpec(_Dictable):
 
     ``accelerator`` names the slice family (e.g. ``v5p``, ``v5e``, or ``cpu``
     for the multiprocess CPU test backend, §4 of SURVEY.md). ``topology`` is
-    the ICI mesh shape (e.g. ``4x4x4``); empty means derive from worker count.
-    ``chips_per_host`` is fixed per family (4 for v5p hosts); ``None`` means
-    "derive from slots_per_worker" at defaulting time.
+    the per-slice ICI mesh shape (e.g. ``4x4x4``); empty means derive from
+    worker count. ``chips_per_host`` is fixed per family (4 for v5p hosts);
+    ``None`` means "derive from slots_per_worker" at defaulting time.
+    ``num_slices > 1`` requests a multi-slice job: ``num_slices`` identical
+    ICI slices joined over DCN (workers divide evenly across slices; the
+    runtime builds a hybrid mesh whose DCN axes are outermost — SURVEY.md
+    §5.8).
     """
 
     accelerator: str = "cpu"
     topology: str = ""
     chips_per_host: Optional[int] = None
+    num_slices: int = 1
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "SliceSpec":
@@ -370,6 +375,7 @@ class SliceSpec(_Dictable):
             accelerator=d.get("accelerator", "cpu"),
             topology=d.get("topology", ""),
             chips_per_host=d.get("chips_per_host"),
+            num_slices=d.get("num_slices", 1),
         )
 
 

@@ -188,12 +188,28 @@ def validate_tpujob(job: TPUJob) -> List[str]:
                 hosts = 1
                 for m in mesh:
                     hosts *= m
-                if hosts != spec.worker.replicas:
+                # topology describes ONE slice; a multi-slice job repeats it
+                ns_eff = max(spec.slice.num_slices, 1)
+                expected = spec.worker.replicas
+                if ns_eff > 1 and spec.worker.replicas % ns_eff == 0:
+                    expected = spec.worker.replicas // ns_eff
+                if hosts != expected:
                     errs.append(
                         f"spec.slice.topology: topology {spec.slice.topology!r} "
-                        f"holds {hosts} hosts but the job has "
-                        f"{spec.worker.replicas} workers"
+                        f"holds {hosts} hosts per slice but the job has "
+                        f"{expected} workers per slice"
                     )
+
+    # --- multi-slice coherence (SURVEY.md §5.8: DCN-joined slices) ---
+    ns = spec.slice.num_slices
+    if ns < 1:
+        errs.append("spec.slice.num_slices: must be >= 1")
+    elif ns > 1 and spec.worker.replicas:
+        if spec.worker.replicas % ns != 0:
+            errs.append(
+                f"spec.slice.num_slices: {spec.worker.replicas} workers do "
+                f"not divide evenly across {ns} slices"
+            )
 
     # --- elastic bounds (≙ horovod -np/min-np/max-np sanity) ---
     el: Optional[ElasticPolicy] = spec.elastic

@@ -87,6 +87,8 @@ ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"
 ENV_TOPOLOGY = "TPUJOB_TOPOLOGY"
 ENV_HOST_MESH = "TPUJOB_HOST_MESH"
 ENV_HOST_COORD = "TPUJOB_HOST_COORD"
+ENV_SLICE_ID = "TPUJOB_SLICE_ID"
+ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 
 DEFAULT_COORDINATOR_PORT = 8476
 
@@ -449,6 +451,8 @@ class TPUJobController:
                 ENV_TOPOLOGY: "x".join(map(str, placement.topology)),
                 ENV_HOST_MESH: "x".join(map(str, placement.host_mesh)),
                 ENV_HOST_COORD: "x".join(map(str, placement.host_coords[index])),
+                ENV_SLICE_ID: str(placement.slice_ids[index]),
+                ENV_NUM_SLICES: str(placement.num_slices),
             }
         )
         container.env = env

@@ -39,6 +39,8 @@ ANNOTATION_HOST_COORD = "tpujob.dev/host-coord"
 ANNOTATION_CHIP_BASE = "tpujob.dev/chip-base"
 ANNOTATION_HOST_MESH = "tpujob.dev/host-mesh"
 ANNOTATION_TOPOLOGY = "tpujob.dev/topology"
+ANNOTATION_SLICE_ID = "tpujob.dev/slice-id"
+ANNOTATION_NUM_SLICES = "tpujob.dev/num-slices"
 
 
 class PlacementError(ValueError):
@@ -49,15 +51,21 @@ class PlacementError(ValueError):
 class SlicePlacement:
     """The computed layout for one job's gang."""
 
-    topology: Tuple[int, ...]  # chip mesh shape
+    topology: Tuple[int, ...]  # per-slice chip mesh shape
     host_block: Tuple[int, ...]  # chips-per-host block shape
-    host_mesh: Tuple[int, ...]  # host mesh shape (topology / host_block)
+    host_mesh: Tuple[int, ...]  # per-slice host mesh (topology / host_block)
     host_coords: List[Tuple[int, ...]] = field(default_factory=list)  # per worker index
     chip_bases: List[Tuple[int, ...]] = field(default_factory=list)
+    num_slices: int = 1
+    slice_ids: List[int] = field(default_factory=list)  # per worker index
 
     @property
     def num_hosts(self) -> int:
         return len(self.host_coords)
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return len(self.host_coords) // max(self.num_slices, 1)
 
     def annotations_for(self, index: int) -> Dict[str, str]:
         return {
@@ -65,6 +73,8 @@ class SlicePlacement:
             ANNOTATION_CHIP_BASE: "x".join(map(str, self.chip_bases[index])),
             ANNOTATION_HOST_MESH: "x".join(map(str, self.host_mesh)),
             ANNOTATION_TOPOLOGY: "x".join(map(str, self.topology)),
+            ANNOTATION_SLICE_ID: str(self.slice_ids[index]),
+            ANNOTATION_NUM_SLICES: str(self.num_slices),
         }
 
 
@@ -80,7 +90,13 @@ def place_workers(slice_spec: SliceSpec, num_workers: int) -> SlicePlacement:
     """Compute the gang layout. Raises PlacementError when the topology cannot
     host exactly ``num_workers`` hosts (atomic/gang: no partial placement).
     Uses the same host_block_for/compute_host_mesh helpers as admission
-    validation, so a validated spec is always placeable."""
+    validation, so a validated spec is always placeable.
+
+    Multi-slice (``num_slices > 1``): workers divide evenly into
+    ``num_slices`` identical ICI slices; worker i sits in slice
+    ``i // hosts_per_slice`` at within-slice coordinate
+    ``i % hosts_per_slice``. Slice identity is stamped on each pod so the
+    runtime can build the hybrid ICI×DCN mesh (runtime/topology.py)."""
     family = slice_spec.accelerator
     if family not in HOST_BLOCK:
         raise PlacementError(f"unknown accelerator family {family!r}")
@@ -91,10 +107,18 @@ def place_workers(slice_spec: SliceSpec, num_workers: int) -> SlicePlacement:
             f"{family} host configuration"
         )
 
+    num_slices = max(slice_spec.num_slices, 1)
+    if num_workers % num_slices != 0:
+        raise PlacementError(
+            f"{num_workers} workers do not divide evenly across "
+            f"{num_slices} slices — gang placement is all-or-nothing"
+        )
+    per_slice = num_workers // num_slices
+
     if slice_spec.topology:
         topo = tuple(int(p) for p in slice_spec.topology.split("x"))
     else:
-        topo = _default_topology(block, num_workers)
+        topo = _default_topology(block, per_slice)
     host_mesh_t = compute_host_mesh(topo, block)
     if host_mesh_t is None:
         raise PlacementError(
@@ -104,25 +128,30 @@ def place_workers(slice_spec: SliceSpec, num_workers: int) -> SlicePlacement:
     total_hosts = 1
     for h in host_mesh:
         total_hosts *= h
-    if total_hosts != num_workers:
+    if total_hosts != per_slice:
         raise PlacementError(
             f"topology {'x'.join(map(str, topo))} holds {total_hosts} "
-            f"{family} hosts but the job has {num_workers} workers — gang "
-            f"placement is all-or-nothing"
+            f"{family} hosts but the job has {per_slice} workers per slice "
+            f"— gang placement is all-or-nothing"
         )
 
     # Row-major host enumeration: worker index i ↔ host coordinate. Row-major
     # matches jax mesh_utils' device ordering so mesh axes line up with ICI.
     placement = SlicePlacement(
-        topology=topo, host_block=block, host_mesh=tuple(host_mesh)
+        topology=topo,
+        host_block=block,
+        host_mesh=tuple(host_mesh),
+        num_slices=num_slices,
     )
     for i in range(num_workers):
+        within = i % per_slice
         coord = []
-        rem = i
+        rem = within
         for dim in reversed(host_mesh):
             coord.append(rem % dim)
             rem //= dim
         coord = tuple(reversed(coord))
         placement.host_coords.append(coord)
         placement.chip_bases.append(tuple(c * b for c, b in zip(coord, block)))
+        placement.slice_ids.append(i // per_slice)
     return placement

@@ -1,0 +1,76 @@
+"""Kind registry + generic dataclass (de)serialization.
+
+The in-process ObjectStore passes live objects around, so it never needs to
+serialize. A shared backend (machinery/sqlite_store.py) does: every stored
+kind must round-trip through plain dicts. API types carry hand-written
+``from_dict`` (manifest-facing, with aliases); the machinery kinds decode
+generically from their dataclass shape here.
+
+≙ the scheme/codec registration the reference generates per API group
+(v2/pkg/apis/kubeflow/v2beta1/register.go:52, zz_generated.deepcopy.go) —
+one registry instead of 39k generated lines, because the dataclasses are
+their own schema.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Type
+
+from mpi_operator_tpu.api.types import TPUJob
+from mpi_operator_tpu.machinery import objects as mo
+
+
+def _decode_value(tp: Any, v: Any) -> Any:
+    """Decode ``v`` into type ``tp`` (a typing annotation)."""
+    if v is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _decode_value(args[0], v) if args else v
+    if origin in (dict, Dict):
+        kt, vt = typing.get_args(tp) or (str, Any)
+        return {k: _decode_value(vt, x) for k, x in v.items()}
+    if origin in (list, typing.List):
+        (et,) = typing.get_args(tp) or (Any,)
+        return [_decode_value(et, x) for x in v]
+    if dataclasses.is_dataclass(tp):
+        return decode_dataclass(tp, v)
+    return v
+
+
+def decode_dataclass(cls: Type, d: Dict[str, Any]) -> Any:
+    """Build ``cls`` from a dict produced by ``to_dict`` (pruned: missing
+    keys take field defaults). Prefers the class's own ``from_dict``."""
+    own = cls.__dict__.get("from_dict")
+    if own is not None:
+        return cls.from_dict(d)
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in d:
+            kwargs[f.name] = _decode_value(hints.get(f.name, Any), d[f.name])
+    return cls(**kwargs)
+
+
+KIND_CLASSES: Dict[str, Type] = {
+    "TPUJob": TPUJob,
+    "Pod": mo.Pod,
+    "Service": mo.Service,
+    "ConfigMap": mo.ConfigMap,
+    "PodGroup": mo.PodGroup,
+    "Event": mo.Event,
+}
+
+
+def encode(obj: Any) -> Dict[str, Any]:
+    return obj.to_dict()
+
+
+def decode(kind: str, d: Dict[str, Any]) -> Any:
+    cls = KIND_CLASSES.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown kind {kind!r}")
+    return decode_dataclass(cls, d)

@@ -28,6 +28,7 @@ import os
 from typing import Any, Callable, Dict, Iterator, Optional
 
 from mpi_operator_tpu.ops.checkpoint import CheckpointManager
+from mpi_operator_tpu.ops.profiling import StepProfiler
 from mpi_operator_tpu.ops.trainer import Trainer, TrainState
 
 # EX_TEMPFAIL: the "re-run me" exit code workers use on membership change.
@@ -105,10 +106,12 @@ def run_elastic(
     # of step N every iteration. One sync at restore, then a local counter.
     step = int(state.step)
     metrics = None
+    profiler = StepProfiler()  # no-op unless TPUJOB_PROFILE_DIR is set
     try:
         while step < total_steps:
             state, metrics = trainer.train_step(state, next(batches))
             step += 1
+            profiler.observe(step)
             if step % config.save_interval_steps == 0:
                 mgr.save(step, state)
             if (
@@ -128,6 +131,7 @@ def run_elastic(
             mgr.save(step, state, force=True)
         mgr.wait()
     finally:
+        profiler.close()
         mgr.close()
     return ElasticResult(
         "done", state, step, {k: float(v) for k, v in (metrics or {}).items()}

@@ -1,0 +1,66 @@
+"""XLA profiler hook for training loops.
+
+≙ SURVEY.md §5.1's TPU-build obligation: the reference punts workload
+profiling to the roadmap (Horovod Timeline, /root/reference/ROADMAP.md:14);
+here every worker can capture an XLA trace of a step window with zero code
+changes — the controller passes container env through, so setting
+
+    TPUJOB_PROFILE_DIR=/tmp/trace        (per-host subdir appended)
+    TPUJOB_PROFILE_START=10              (first step to trace, default 10)
+    TPUJOB_PROFILE_STEPS=5               (how many steps, default 5)
+
+on a job's worker template makes each host write an xplane trace readable
+with xprof/tensorboard (see PERF.md for the analysis recipe).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_DIR = "TPUJOB_PROFILE_DIR"
+ENV_START = "TPUJOB_PROFILE_START"
+ENV_STEPS = "TPUJOB_PROFILE_STEPS"
+
+
+class StepProfiler:
+    """Drive from a training loop: call observe(step) once per step; the
+    trace starts/stops itself around the configured window. No-op (and
+    import-free) when TPUJOB_PROFILE_DIR is unset."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory if directory is not None else os.environ.get(ENV_DIR, "")
+        self.start_step = int(os.environ.get(ENV_START, "10") or "10")
+        self.num_steps = max(1, int(os.environ.get(ENV_STEPS, "5") or "5"))
+        self._active = False
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory)
+
+    def _trace_dir(self) -> str:
+        import jax
+
+        return os.path.join(self.directory, f"host{jax.process_index()}")
+
+    def observe(self, step: int) -> None:
+        if not self.enabled or self._done:
+            return
+        import jax
+
+        if not self._active and self.start_step <= step < self.start_step + self.num_steps:
+            jax.profiler.start_trace(self._trace_dir())
+            self._active = True
+        elif self._active and step >= self.start_step + self.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

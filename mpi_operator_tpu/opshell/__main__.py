@@ -2,12 +2,14 @@
 
 ≙ /root/reference/v2/cmd/mpi-operator/ (main.go + app/server.go + options):
 parse flags, start /healthz+/metrics, run leader election, and reconcile as
-leader. The in-process ObjectStore plays the apiserver; `--executor local`
-additionally runs pods as OS processes (a dev/single-host deployment — the
-k8s-backed store adapter is a deployment-target concern, not a framework
-one).
+leader. ``--store memory`` keeps everything in-process; ``--store
+sqlite:/path/db`` backs the store with a shared sqlite file, so multiple
+operator replicas (and the tpujob CLI/client) share one apiserver-equivalent
+and leader election elects exactly one active reconciler across processes.
+`--executor local` additionally runs pods as OS processes.
 
-  python -m mpi_operator_tpu.opshell --namespace ml --monitoring-port 8080
+  python -m mpi_operator_tpu.opshell --store sqlite:/var/lib/tpujob/store.db \\
+      --executor local --monitoring-port 8080
 """
 
 from __future__ import annotations
@@ -42,17 +44,37 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--inventory-chips", type=int, default=None,
                     help="finite chip inventory for gang admission "
                          "(default: unbounded)")
+    ap.add_argument("--store", default="memory",
+                    help="'memory' (in-process) or 'sqlite:PATH' "
+                         "(shared across processes/replicas)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
+    ap.add_argument("--version", action="store_true",
+                    help="print version/build info and exit")
     return ap
+
+
+def build_store(spec: str):
+    if spec == "memory":
+        return ObjectStore()
+    if spec.startswith("sqlite:"):
+        from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+        return SqliteStore(spec[len("sqlite:"):])
+    raise SystemExit(f"error: unknown --store {spec!r}")
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.version:
+        from mpi_operator_tpu.version import version_string
+
+        print(version_string())
+        return 0
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    store = ObjectStore()
+    store = build_store(args.store)
     recorder = EventRecorder(store)
     controller = TPUJobController(
         store,

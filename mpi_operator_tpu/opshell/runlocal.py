@@ -24,6 +24,7 @@ import time
 import yaml
 
 from mpi_operator_tpu.api.conditions import is_finished, is_succeeded
+from mpi_operator_tpu.api.schema import parse_tpujob
 from mpi_operator_tpu.api.types import TPUJob
 from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobController
 from mpi_operator_tpu.executor import LocalExecutor
@@ -33,9 +34,11 @@ from mpi_operator_tpu.scheduler import GangScheduler
 
 
 def load_job(path: str) -> TPUJob:
+    """Load a manifest through the strict structural schema: unknown or
+    typo'd fields fail loudly (≙ apiserver CRD schema rejection)."""
     with open(path) as f:
         doc = yaml.safe_load(f)
-    return TPUJob.from_dict(doc)
+    return parse_tpujob(doc)
 
 
 def run_job(

@@ -36,6 +36,8 @@ ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"
 ENV_TOPOLOGY = "TPUJOB_TOPOLOGY"
 ENV_HOST_MESH = "TPUJOB_HOST_MESH"
 ENV_HOST_COORD = "TPUJOB_HOST_COORD"
+ENV_SLICE_ID = "TPUJOB_SLICE_ID"
+ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 
 
 def _parse_shape(s: str) -> Tuple[int, ...]:
@@ -57,6 +59,8 @@ class RuntimeContext:
     topology: Tuple[int, ...] = ()
     host_mesh: Tuple[int, ...] = ()
     host_coord: Tuple[int, ...] = ()
+    slice_id: int = 0
+    num_slices: int = 1
 
     @property
     def is_distributed(self) -> bool:
@@ -97,6 +101,8 @@ def context_from_env(environ: Optional[Mapping[str, str]] = None) -> RuntimeCont
         topology=_parse_shape(env.get(ENV_TOPOLOGY, "")),
         host_mesh=_parse_shape(env.get(ENV_HOST_MESH, "")),
         host_coord=_parse_shape(env.get(ENV_HOST_COORD, "")),
+        slice_id=int(env.get(ENV_SLICE_ID, "0") or 0),
+        num_slices=int(env.get(ENV_NUM_SLICES, "1") or 1),
     )
 
 

@@ -98,6 +98,24 @@ def _cpu_or_flat_mesh(shape: Sequence[int], devices) -> np.ndarray:
     return np.asarray(devices).reshape(tuple(shape))
 
 
+def _hybrid_flat_mesh(
+    ici_shape: Sequence[int], dcn_shape: Sequence[int], devices
+) -> np.ndarray:
+    """Hybrid mesh layout for backends without physical topology (CPU/tests).
+
+    Same device-placement contract as mesh_utils.create_hybrid_device_mesh:
+    devices arrive slice-major (slice i owns the i-th contiguous block of
+    ici_size devices), and each logical axis of combined size dcn*ici is
+    laid out [dcn, ici] with the DCN factor outermost — so a collective
+    along an axis with dcn==1 never leaves its slice, and gradient
+    reductions along the leading (dcn>1) axes are the only DCN traffic."""
+    n = len(ici_shape)
+    arr = np.asarray(devices).reshape(tuple(dcn_shape) + tuple(ici_shape))
+    perm = [a for i in range(n) for a in (i, n + i)]
+    arr = arr.transpose(perm)
+    return arr.reshape(tuple(d * i for d, i in zip(dcn_shape, ici_shape)))
+
+
 def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None):
     """Materialize the plan as a ``jax.sharding.Mesh``.
 
@@ -123,17 +141,22 @@ def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None):
         )
 
     platform = getattr(devices[0], "platform", "cpu")
-    if platform == "tpu":
-        from jax.experimental import mesh_utils
+    if plan.dcn_size > 1:
+        ici_shape = [plan.axes.get(n, 1) for n in names]
+        dcn_shape = [plan.dcn.get(n, 1) for n in names]
+        if platform == "tpu":
+            from jax.experimental import mesh_utils
 
-        if plan.dcn_size > 1:
-            ici_shape = [plan.axes.get(n, 1) for n in names]
-            dcn_shape = [plan.dcn.get(n, 1) for n in names]
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices
             )
         else:
-            dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+            # emulated slices: same layout contract, no topology to optimize
+            dev_array = _hybrid_flat_mesh(ici_shape, dcn_shape, devices)
+    elif platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
     else:
         dev_array = _cpu_or_flat_mesh(sizes, devices)
     return Mesh(dev_array, names)
@@ -147,7 +170,10 @@ def mesh_from_context(
 
     With no explicit plan, defaults to pure data parallelism over every chip
     in the slice — the moral equivalent of the reference's Horovod ring over
-    all ranks (examples/horovod/tensorflow_mnist.py, SURVEY.md §2.5).
+    all ranks (examples/horovod/tensorflow_mnist.py, SURVEY.md §2.5). For a
+    multi-slice gang (ctx.num_slices > 1) the default is data parallelism
+    with the slice count on the DCN factor of the data axis, so gradient
+    reductions are the only cross-slice traffic.
 
     Fails fast when the gang the controller declared (num_hosts ×
     chips_per_host) disagrees with what XLA sees after rendezvous — the
@@ -165,5 +191,10 @@ def mesh_from_context(
                 f"{jax.device_count()} — rendezvous and placement disagree"
             )
     if plan is None:
-        plan = MeshPlan.data_parallel(jax.device_count())
+        n = jax.device_count()
+        ns = getattr(ctx, "num_slices", 1) if ctx is not None else 1
+        if ns > 1 and n % ns == 0:
+            plan = MeshPlan(axes={AXIS_DATA: n // ns}, dcn={AXIS_DATA: ns})
+        else:
+            plan = MeshPlan.data_parallel(n)
     return build_mesh(plan)

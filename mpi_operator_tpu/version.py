@@ -1,0 +1,42 @@
+"""Build/version stamp.
+
+≙ /root/reference/pkg/version/version.go:21-45 (+ the ldflags wiring in
+Makefile:9-16): Version/GitSHA/Built surfaced through a --version flag and
+importable constants. The ldflags equivalent here is the environment at
+image-build time (Dockerfile can bake TPUJOB_BUILD_* in); at runtime the
+git SHA falls back to the working tree when available.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+VERSION = "2.0.0"
+
+
+def git_sha() -> str:
+    baked = os.environ.get("TPUJOB_BUILD_SHA")
+    if baked:
+        return baked
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True,
+                text=True,
+                timeout=5,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
+def built() -> str:
+    return os.environ.get("TPUJOB_BUILD_DATE", "unknown")
+
+
+def version_string() -> str:
+    return f"tpu-operator {VERSION} (git {git_sha()}, built {built()})"

@@ -6,8 +6,16 @@ batch 64/device, synthetic ImageNet, SGD+momentum, Horovod DP
 Same workload shape here, TPU-native: NHWC bf16 ResNet-101 under a
 global-view jit over all visible chips.
 
+``BENCH_MODEL=llama`` switches to the BASELINE Llama acceptance workload: a
+Llama-3-architecture decoder (models.llama.bench_single_chip) trained with
+AdamW + the real compiled Pallas flash-attention kernel, reporting tokens/s
+and MFU. The reference has no LLM baseline, so vs_baseline there is
+MFU / 0.50 (the BASELINE.md MFU target). The llama run also numerically
+checks the compiled flash kernel against the chunked XLA reference on-chip
+before timing and reports the max error in the JSON.
+
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N/154.2, ...}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 import json
@@ -18,6 +26,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 154.2  # reference README.md:184-199
+TARGET_MFU = 0.50  # BASELINE.md north-star MFU target
 
 # bf16 peak FLOPs/s per chip by device kind (scaling-book table)
 PEAK_FLOPS = {
@@ -30,22 +39,47 @@ PEAK_FLOPS = {
 }
 
 
-def main():
+def _device_info():
     import jax
-    import numpy as np
+
+    devices = jax.devices()
+    kind = getattr(devices[0], "device_kind", devices[0].platform)
+    peak = next(
+        (v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), PEAK_FLOPS["cpu"]
+    )
+    print(f"[bench] {len(devices)} x {kind}", file=sys.stderr)
+    return len(devices), kind, peak
+
+
+def _timed_steps(trainer, state, batch, steps, warmup):
+    import jax
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    print(
+        f"[bench] compile+warmup {time.perf_counter() - t0:.1f}s, "
+        f"loss={float(metrics['loss']):.3f}",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    return time.perf_counter() - t0
+
+
+def bench_resnet():
+    import jax
 
     from mpi_operator_tpu.models import resnet
     from mpi_operator_tpu.ops import Trainer, TrainerConfig
     from mpi_operator_tpu.ops.data import make_global_batch, synthetic_imagenet
     from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 
-    devices = jax.devices()
-    n_chips = len(devices)
-    kind = getattr(devices[0], "device_kind", devices[0].platform)
-    peak = next(
-        (v for k, v in PEAK_FLOPS.items() if kind.startswith(k)), PEAK_FLOPS["cpu"]
-    )
-    print(f"[bench] {n_chips} x {kind}", file=sys.stderr)
+    n_chips, kind, peak = _device_info()
 
     # 128/chip measured best on v5e (MFU .407 vs .392 at 64); the reference
     # ran 64/GPU, but per-chip batch is a tuning knob, not workload shape
@@ -73,21 +107,7 @@ def main():
         next(synthetic_imagenet(global_batch=global_batch, image_size=cfg.image_size)),
     )
 
-    t0 = time.perf_counter()
-    for _ in range(warmup):
-        state, metrics = trainer.train_step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    print(
-        f"[bench] compile+warmup {time.perf_counter() - t0:.1f}s, "
-        f"loss={float(metrics['loss']):.3f}",
-        file=sys.stderr,
-    )
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.train_step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    dt = _timed_steps(trainer, state, batch, steps, warmup)
 
     imgs_per_sec = global_batch * steps / dt
     per_chip = imgs_per_sec / n_chips
@@ -108,6 +128,126 @@ def main():
             }
         )
     )
+
+
+def _check_flash_kernel_on_chip():
+    """Compile and run the Pallas flash kernel on the real device and compare
+    against the chunked XLA reference (same math, independent lowering).
+    Returns max abs error — the on-chip numerical validation BASELINE's llama
+    acceptance path requires."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.kernels.flash_attention import (
+        chunked_reference,
+        flash_attention,
+    )
+
+    key = jax.random.PRNGKey(7)
+    b, t, h, h_kv, d = 2, 512, 8, 4, 64
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, t, h_kv, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, t, h_kv, d), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)  # auto → compiled kernel on TPU
+    ref = chunked_reference(q, k, v, causal=True)
+    err = float(
+        jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    )
+    print(f"[bench] flash kernel on-chip check: max abs err {err:.5f}", file=sys.stderr)
+    if err > 0.05:  # bf16 attention outputs are O(1); 0.05 is far outside rounding
+        raise AssertionError(f"flash kernel mismatch on device: {err}")
+    return err
+
+
+def llama_setup(per_chip_batch: int, seq_len: int):
+    """Build the llama bench workload (shared with profile_llama.py so the
+    profile measures exactly the step the benchmark times). Returns
+    (cfg, trainer, state, batch, global_batch)."""
+    import jax
+
+    from mpi_operator_tpu.models import llama
+    from mpi_operator_tpu.ops import Trainer, TrainerConfig
+    from mpi_operator_tpu.ops.data import make_global_batch, synthetic_tokens
+    from mpi_operator_tpu.runtime import MeshPlan, build_mesh
+
+    n_chips = jax.device_count()
+    global_batch = per_chip_batch * n_chips
+    cfg = (
+        llama.bench_single_chip()
+        if jax.default_backend() == "tpu"
+        else llama.tiny()
+    )
+    mesh = build_mesh(MeshPlan.data_parallel(n_chips))
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    trainer = Trainer(
+        lambda p, b: llama.loss_fn(cfg, p, b, mesh=mesh),
+        llama.logical_axes(cfg),
+        mesh,
+        TrainerConfig(learning_rate=3e-4, optimizer="adamw", grad_clip_norm=1.0),
+    )
+    state = trainer.init_state(params)
+    batch = make_global_batch(
+        mesh,
+        next(
+            synthetic_tokens(
+                global_batch=global_batch, seq_len=seq_len, vocab=cfg.vocab
+            )
+        ),
+    )
+    return cfg, trainer, state, batch, global_batch
+
+
+def bench_llama():
+    import jax
+
+    from mpi_operator_tpu.models import llama
+
+    n_chips, kind, peak = _device_info()
+    on_tpu = jax.default_backend() == "tpu"
+    flash_err = _check_flash_kernel_on_chip() if on_tpu else None
+
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
+
+    cfg, trainer, state, batch, global_batch = llama_setup(
+        per_chip_batch, seq_len
+    )
+
+    dt = _timed_steps(trainer, state, batch, steps, warmup)
+
+    tokens_per_sec = global_batch * seq_len * steps / dt
+    per_chip = tokens_per_sec / n_chips
+    mfu = 3 * llama.flops_per_token(cfg, seq_len) * per_chip / peak
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_throughput_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(mfu / TARGET_MFU, 3),
+                "chips": n_chips,
+                "device": kind,
+                "params": llama.param_count(cfg),
+                "global_batch": global_batch,
+                "seq_len": seq_len,
+                "mfu": round(mfu, 4),
+                "step_ms": round(1000 * dt / steps, 2),
+                "flash_kernel_max_err": flash_err,
+            }
+        )
+    )
+
+
+def main():
+    mode = os.environ.get("BENCH_MODEL", "resnet")
+    if mode == "llama":
+        bench_llama()
+    elif mode == "resnet":
+        bench_resnet()
+    else:
+        raise SystemExit(f"unknown BENCH_MODEL={mode!r} (resnet|llama)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,118 @@
+"""Llama decoder training worker — the BASELINE Llama acceptance config,
+elastic-capable, through the operator path.
+
+≙ the reference's elastic Horovod job
+(/root/reference/examples/horovod/tensorflow-mnist-elastic.yaml:20-27:
+horovodrun --host-discovery-script) re-targeted per BASELINE.md: a
+Llama-3-architecture decoder under data-parallel sharded jit, trained via
+ops.elastic.run_elastic — on membership change every worker checkpoints,
+exits EXIT_RESTART (75), and the controller relaunches the gang at the new
+size; the run resumes from the checkpoint with reshard-on-load.
+
+Config via env so one manifest scales from the CPU e2e test to a TPU slice:
+  LLAMA_CONFIG  tiny | bench | 8b   (default tiny)
+  LLAMA_BATCH   per-chip batch      (default 2)
+  LLAMA_SEQ     sequence length     (default 64)
+  LLAMA_STEPS   total train steps   (default 6)
+  LLAMA_CKPT    checkpoint dir      (default: no elasticity, plain loop)
+  LLAMA_SAVE_EVERY / LLAMA_CHECK_EVERY  elastic cadence (default 2 / 1)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mpi_operator_tpu.runtime import bootstrap
+
+import jax
+
+if bootstrap.context_from_env().accelerator in ("", "cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import json
+import time
+
+from mpi_operator_tpu.models import llama
+from mpi_operator_tpu.ops import Trainer, TrainerConfig
+from mpi_operator_tpu.ops.data import make_global_batch, synthetic_tokens
+from mpi_operator_tpu.ops.elastic import ElasticConfig, run_elastic
+from mpi_operator_tpu.runtime import mesh_from_context
+
+CONFIGS = {
+    "tiny": llama.tiny,
+    "bench": llama.bench_single_chip,
+    "8b": llama.llama3_8b,
+}
+
+
+def main():
+    ctx = bootstrap.initialize()
+    mesh = mesh_from_context(ctx)
+
+    cfg = CONFIGS[os.environ.get("LLAMA_CONFIG", "tiny")]()
+    per_chip = int(os.environ.get("LLAMA_BATCH", "2"))
+    seq_len = int(os.environ.get("LLAMA_SEQ", "64"))
+    steps = int(os.environ.get("LLAMA_STEPS", "6"))
+    ckpt_dir = os.environ.get("LLAMA_CKPT", "")
+
+    trainer = Trainer(
+        lambda p, b: llama.loss_fn(cfg, p, b, mesh=mesh),
+        llama.logical_axes(cfg),
+        mesh,
+        TrainerConfig(learning_rate=3e-4, optimizer="adamw", grad_clip_norm=1.0),
+    )
+    global_batch = per_chip * jax.device_count()
+    batches = map(
+        lambda b: make_global_batch(mesh, b),
+        synthetic_tokens(global_batch=global_batch, seq_len=seq_len, vocab=cfg.vocab),
+    )
+
+    def init_state():
+        return trainer.init_state(llama.init(cfg, jax.random.PRNGKey(0)))
+
+    t0 = time.perf_counter()
+    if ckpt_dir:
+        result = run_elastic(
+            trainer,
+            batches,
+            total_steps=steps,
+            config=ElasticConfig(
+                checkpoint_dir=ckpt_dir,
+                save_interval_steps=int(os.environ.get("LLAMA_SAVE_EVERY", "2")),
+                membership_check_every=int(os.environ.get("LLAMA_CHECK_EVERY", "1")),
+            ),
+            init_state=init_state,
+        )
+        outcome, last_step = result.outcome, result.last_step
+        steps_run = result.steps_run  # exclude checkpoint-restored progress
+        loss = (result.metrics or {}).get("loss")
+    else:
+        state = init_state()
+        for _ in range(steps):
+            state, metrics = trainer.train_step(state, next(batches))
+        jax.block_until_ready(metrics["loss"])
+        outcome, last_step, loss = "done", steps, float(metrics["loss"])
+        steps_run = steps
+
+    dt = time.perf_counter() - t0
+    if ctx.is_coordinator:
+        print(
+            json.dumps(
+                {
+                    "workload": "llama",
+                    "outcome": outcome,
+                    "step": last_step,
+                    "loss": loss,
+                    "tokens_per_sec": round(global_batch * steps_run * seq_len / dt, 1),
+                    "hosts": ctx.num_hosts,
+                }
+            ),
+            flush=True,
+        )
+    if ckpt_dir:
+        raise SystemExit(result.exit_code)
+
+
+if __name__ == "__main__":
+    main()

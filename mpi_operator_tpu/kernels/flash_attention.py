@@ -9,9 +9,13 @@ Layout: [B, H, T, D] (heads-major — the kernel-friendly transpose of the
 model's [B, T, H, D]; the wrapper handles it). bf16 in, f32 accumulate, bf16
 out — MXU-native.
 
-Backward uses recompute-through-XLA via custom_vjp: the forward saves only
-(q, k, v) and the backward re-derives the attention blockwise (checkpointed
-q blocks under lax.map) — neither direction ever materializes [T,T].
+Backward is a pair of Pallas kernels (FlashAttention-2 style): the forward
+additionally emits the log-sum-exp rows, and the backward recomputes
+probabilities blockwise on-chip to produce dq (grid over q tiles) and
+dk/dv (grid over k tiles) — neither direction ever materializes [T,T] nor
+round-trips a score block through HBM. Profiling the Llama train step
+showed the previous recompute-through-XLA backward was the single largest
+cost: ~330 ms/step of HBM-bound score-block traffic on v5e.
 
 Pallas custom calls have no SPMD partitioning rule, so on a sharded mesh the
 kernel must run under shard_map; pass ``mesh`` and the wrapper shards batch
@@ -32,26 +36,29 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     causal: bool, scale: float, t_real: int
 ):
     """One program = one (b, h, q-tile). Refs:
-    q [1,1,BQ,D], k/v [1,1,Tpad,D], o [1,1,BQ,D], m/l [1,1,BQ]. K/V are
+    q [1,1,BQ,D], k/v [1,1,Tpad,D], o [1,1,BQ,D], lse [1,1,BQ]. K/V are
     pre-padded to a block_k multiple (pl.ds clamps OOB starts, so unpadded
     tail tiles would silently re-read earlier rows); t_real masks the pad."""
     qb = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+    # dots run in the input dtype (bf16 in production = full MXU rate; the
+    # f32 cast would halve it) with f32 accumulation; scale folds into the
+    # f32 scores
+    q = q_ref[0, 0]  # [BQ, D]
     bq, d = q.shape
     t = t_real
     n_kb = pl.cdiv(t, block_k)
 
     def body(kb, carry):
         acc, m, l = carry
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
+        ) * scale  # [BQ, BK] f32
         k_idx = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1
         )
@@ -66,7 +73,8 @@ def _fwd_kernel(
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return acc_new, m_new, l_new
 
@@ -78,19 +86,24 @@ def _fwd_kernel(
     l0 = jnp.zeros((bq,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # log-sum-exp rows: the backward's sole softmax residual. Trailing
+    # singleton lane dim keeps the block shape TPU-lowerable ((bq, 1) —
+    # mosaic wants last-two dims (8k, 128k) or equal to the array's).
+    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
 
 
 def _flash_fwd(
     q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int,
     interpret: bool,
 ):
-    """q [B,H,T,D], k/v [B,Hkv,T,D] → (o [B,H,T,D], m,l [B,H,T])."""
+    """q [B,H,T,D], k/v [B,Hkv,T,D] → (o [B,H,T,D], lse [B,H,Tq_pad,1])."""
     b, h, t, d = q.shape
     h_kv = k.shape[1]
     g = h // h_kv
     bq = min(block_q, t)
     bk = min(block_k, t)
-    grid = (b, h, pl.cdiv(t, bq))
+    n_qb = pl.cdiv(t, bq)
+    grid = (b, h, n_qb)
 
     # pad K/V up to a block multiple: pl.ds clamps OOB starts, so a partial
     # tail tile would otherwise alias earlier rows
@@ -103,7 +116,7 @@ def _flash_fwd(
     kernel = functools.partial(
         _fwd_kernel, block_k=bk, causal=causal, scale=scale, t_real=t
     )
-    o = pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -111,11 +124,197 @@ def _flash_fwd(
             pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
             pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, n_qb * bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    block_k: int, causal: bool, scale: float, t_real: int,
+):
+    """dq for one (b, h, q-tile): stream K/V tiles, recompute P on-chip.
+    Refs: q/do/dq [1,1,BQ,D], k/v [1,1,Tpad,D], lse/delta [1,1,BQ,1]."""
+    qb = pl.program_id(2)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    bq, d = q.shape
+    n_kb = pl.cdiv(t_real, block_k)
+    if causal:
+        n_kb = jnp.minimum(n_kb, pl.cdiv((qb + 1) * bq, block_k))
+
+    def body(kb, acc):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1
+        )
+        valid = k_idx < t_real
+        if causal:
+            q_idx = qb * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            valid = jnp.logical_and(valid, q_idx >= k_idx)
+        # p rows are already normalized: lse folds in the softmax denominator
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    acc = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+    block_q: int, causal: bool, scale: float, t_real: int,
+):
+    """dk/dv for one (b, h, k-tile): stream Q/dO tiles, recompute P^T
+    on-chip. GQA: outputs are per *q* head; the wrapper group-sums to kv
+    heads. Refs: k/v/dk/dv [1,1,BK,D], q/do [1,1,Tqpad,D],
+    lse/delta [1,1,Tqpad,1]."""
+    kb = pl.program_id(2)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    bk, d = k.shape
+    t_q = q_ref.shape[2]
+    n_qb = t_q // block_q
+    qb0 = (kb * bk) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BQ, BK]
+        q_idx = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0
+        )
+        k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        valid = jnp.logical_and(q_idx < t_real, k_idx < t_real)
+        if causal:
+            valid = jnp.logical_and(valid, q_idx >= k_idx)
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb0, n_qb, body, (z, z))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(
+    q, k, v, o, lse, do, *, causal: bool, scale: float, block_q: int,
+    block_k: int, interpret: bool,
+):
+    """Pallas flash backward. q/o/do [B,H,T,D], k/v [B,Hkv,T,D],
+    lse [B,H,Tq_pad,1] → (dq, dk, dv) in input shapes/dtypes."""
+    b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    n_qb = pl.cdiv(t, bq)
+    tq_pad = n_qb * bq
+    tk_pad = pl.cdiv(t, bk) * bk
+
+    # delta_i = dO_i · O_i — the rowwise residual term of d(softmax);
+    # trailing singleton matches the lse layout
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B, H, T, 1]
+    if tq_pad != t:
+        pad4 = [(0, 0), (0, 0), (0, tq_pad - t), (0, 0)]
+        delta = jnp.pad(delta, pad4)
+        q_p = jnp.pad(q, pad4)
+        do_p = jnp.pad(do, pad4)
+    else:
+        q_p, do_p = q, do
+    if tk_pad != t:
+        pad4 = [(0, 0), (0, 0), (0, tk_pad - t), (0, 0)]
+        k_p = jnp.pad(k, pad4)
+        v_p = jnp.pad(v, pad4)
+    else:
+        k_p, v_p = k, v
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_k=bk, causal=causal, scale=scale, t_real=t
+        ),
+        grid=(b, h, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
         interpret=interpret,
-    )(q, k, v)
-    return o
+    )(q, k_p, v_p, do, lse, delta)
+
+    # dk/dv per q-head (grid over k tiles); kv grads group-sum below
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale, t_real=t
+        ),
+        grid=(b, h, tk_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, tq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, tq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tk_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tk_pad, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_p, k_p, v_p, do_p, lse, delta)
+
+    dk = dk_h[:, :, :t].reshape(b, h_kv, g, t, d).sum(axis=2).astype(k.dtype)
+    dv = dv_h[:, :, :t].reshape(b, h_kv, g, t, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
 def _block_reference(q_blk, k, v, q_offset, *, causal: bool, scale: float):
@@ -163,35 +362,54 @@ def _dense_reference(q, k, v, *, causal: bool, scale: float):
     return _block_reference(q, k, v, 0, causal=causal, scale=scale)
 
 
+def chunked_reference(q, k, v, *, causal: bool = True, scale=None, block_q: int = 256):
+    """The chunked XLA reference in *model* layout (q [B,T,H,D]) — the
+    independent lowering that on-hardware checks (bench.py's pre-timing
+    gate, tests_tpu/) compare the compiled kernel against."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _chunked_reference(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+    ).transpose(0, 2, 1, 3)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(
+    o, _ = _flash_fwd(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, interpret=interpret,
     )
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o = _flash_fwd(
+    o, lse = _flash_fwd(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, interpret=interpret,
     )
-    return o, (q, k, v)
+    # named so a rematted caller can elect to SAVE these residuals (o is
+    # cheap to keep, recomputing it costs a full kernel pass) — see
+    # models.llama.apply's save_only_these_names policy
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    # Recompute-through-XLA backward over checkpointed q blocks: exact
-    # gradients, O(BQ·T) live memory, never a [T,T] residual.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _chunked_reference(
-            q_, k_, v_, causal=causal, scale=scale, block_q=block_q
-        ),
-        q, k, v,
+    q, k, v, o, lse = res
+    return _flash_bwd(
+        q, k, v, o, lse, do, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(do)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -204,8 +422,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
     mesh=None,
     batch_axes=("data", "fsdp"),
@@ -254,6 +472,10 @@ def flash_attention(
     # stay aligned on every shard
     h_part = head_axis if (tp > 1 and h % tp == 0 and h_kv % tp == 0) else None
     spec = P(b_part, None, h_part, None)
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, so shard_map's vma checker rejects it; the specs above are
+    # the full partitioning contract anyway.
     return jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )(q, k, v)

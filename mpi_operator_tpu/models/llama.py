@@ -51,6 +51,12 @@ class Config:
     # XLA dense elsewhere; "dense"/"flash" force a path (a sharded sequence
     # axis always takes the ring — it's the only exact option there)
     attention_impl: str = "auto"
+    # checkpoint each scan layer: backward stores only the 12-layer stack of
+    # [B,T,D] layer inputs instead of every intra-layer intermediate — the
+    # remat that actually bounds peak HBM for deep stacks (a whole-loss
+    # jax.checkpoint would not: its backward recomputation re-materializes
+    # all layer intermediates at once)
+    remat_layers: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "dense", "flash"):
@@ -70,6 +76,17 @@ class Config:
 
 def llama3_8b() -> Config:
     return Config()
+
+
+def bench_single_chip() -> Config:
+    """Llama-3-architecture decoder (~0.79B params) sized so AdamW training
+    fits one 16 GiB v5e chip: every matmul dim a multiple of 128 (MXU tiles),
+    GQA 4:1, d_ff = 3.5x like the 8B config. The compute-bound MFU
+    demonstration workload for bench.py's llama mode."""
+    return Config(
+        vocab=32_768, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=4,
+        head_dim=128, d_ff=7168, remat_layers=True,
+    )
 
 
 def tiny(vocab: int = 256) -> Config:
@@ -219,6 +236,16 @@ def apply(
         h = constrain(h, ["batch", "seq", "embed"])
         return h, None
 
+    if c.remat_layers:
+        # save the flash kernel's (o, lse) residuals across the remat
+        # boundary: recomputing them in the backward costs a full kernel
+        # pass (~4% of the llama step on v5e) for ~70MB/layer of HBM
+        layer = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse"
+            ),
+        )
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"]["scale"], c.norm_eps)
     logits = x @ params["lm_head"]["w"].astype(dt)

@@ -53,6 +53,13 @@ class ElasticResult:
     state: Any
     last_step: int
     metrics: Optional[Dict[str, float]] = None
+    start_step: int = 0  # step this incarnation resumed from (0 = fresh)
+
+    @property
+    def steps_run(self) -> int:
+        """Steps executed by THIS process (excludes restored progress) —
+        the denominator-matching count for throughput reporting."""
+        return self.last_step - self.start_step
 
     @property
     def exit_code(self) -> int:
@@ -104,7 +111,7 @@ def run_elastic(
     # Track the step host-side: int(state.step) forces a device sync on a
     # jit output, which would serialize dispatch of step N+1 behind compute
     # of step N every iteration. One sync at restore, then a local counter.
-    step = int(state.step)
+    step = start_step = int(state.step)
     metrics = None
     profiler = StepProfiler()  # no-op unless TPUJOB_PROFILE_DIR is set
     try:
@@ -126,6 +133,7 @@ def run_elastic(
                     state,
                     step,
                     {k: float(v) for k, v in (metrics or {}).items()},
+                    start_step=start_step,
                 )
         if mgr.latest_step() != step:
             mgr.save(step, state, force=True)
@@ -134,5 +142,9 @@ def run_elastic(
         profiler.close()
         mgr.close()
     return ElasticResult(
-        "done", state, step, {k: float(v) for k, v in (metrics or {}).items()}
+        "done",
+        state,
+        step,
+        {k: float(v) for k, v in (metrics or {}).items()},
+        start_step=start_step,
     )

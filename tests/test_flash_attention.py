@@ -73,6 +73,31 @@ def test_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_gqa_uneven(causal):
+    # GQA group-summed dk/dv + partial tail tiles through the backward kernels
+    q, k, v = _qkv(jax.random.PRNGKey(7), t=96, h=8, hkv=2)
+
+    def f_flash(q_, k_, v_):
+        return jnp.sum(
+            flash_attention(
+                q_, k_, v_, causal=causal, block_q=64, block_k=64, interpret=True
+            )
+            ** 2
+        )
+
+    def f_dense(q_, k_, v_):
+        return jnp.sum(
+            dense_attention(q_, k_, v_, causal=causal, scale=q.shape[-1] ** -0.5)
+            ** 2
+        )
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
+
+
 def test_jit_compiles():
     q, k, v = _qkv(jax.random.PRNGKey(5), t=64)
     f = jax.jit(lambda *a: flash_attention(*a, causal=True, block_q=32, block_k=32, interpret=True))

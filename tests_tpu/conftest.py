@@ -1,0 +1,16 @@
+"""On-hardware test configuration.
+
+Unlike tests/ (which pins a virtual 8-device CPU mesh), this directory runs
+on whatever accelerator JAX finds — it exists to execute compiled Pallas
+kernels on a real TPU chip. Collected separately on purpose:
+
+    python -m pytest tests_tpu/ -q     # on a TPU host
+
+Every test skips itself off-TPU, so accidentally running this on CPU is
+harmless (but pointless — tests/ already covers the interpret path).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,76 @@
+"""Compiled Pallas flash-attention numerics ON the TPU chip.
+
+tests/test_flash_attention.py validates the kernel bodies under the Pallas
+interpreter; this file is the hardware half of VERDICT's acceptance bar —
+the kernel must have executed as a *compiled* kernel with outputs verified
+against an independent XLA lowering (the chunked reference). bench.py's
+llama mode runs the same check before every timed run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.kernels.flash_attention import (
+    chunked_reference,
+    flash_attention,
+)
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs a real TPU chip"
+)
+
+
+def _qkv(key, b=2, t=1024, h=8, hkv=4, d=128, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), dtype),
+        jax.random.normal(kk, (b, t, hkv, d), dtype),
+        jax.random.normal(kv, (b, t, hkv, d), dtype),
+    )
+
+
+def _ref(q, k, v, causal=True):
+    return chunked_reference(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_compiled_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    got = flash_attention(q, k, v, causal=causal)  # auto → compiled kernel
+    want = _ref(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_gradients_compiled_match_reference():
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+
+    def f_flash(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True).astype(jnp.float32) ** 2)
+
+    def f_ref(q_, k_, v_):
+        return jnp.sum(_ref(q_, k_, v_).astype(jnp.float32) ** 2)
+
+    g1 = jax.jit(jax.grad(f_flash, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(f_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g1, g2):
+        scale = max(1.0, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32) / scale,
+            np.asarray(b, np.float32) / scale,
+            atol=5e-2, rtol=5e-2,
+        )
+
+
+def test_uneven_tail_compiled():
+    # t not a block multiple exercises the padded-tail masking on hardware
+    q, k, v = _qkv(jax.random.PRNGKey(2), t=640 + 96)
+    got = flash_attention(q, k, v, causal=True)
+    want = _ref(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )

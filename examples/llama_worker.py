@@ -15,7 +15,12 @@ Config via env so one manifest scales from the CPU e2e test to a TPU slice:
   LLAMA_SEQ     sequence length     (default 64)
   LLAMA_STEPS   total train steps   (default 6)
   LLAMA_CKPT    checkpoint dir      (default: no elasticity, plain loop)
-  LLAMA_SAVE_EVERY / LLAMA_CHECK_EVERY  elastic cadence (default 2 / 1)
+  LLAMA_SAVE_EVERY / LLAMA_CHECK_EVERY  elastic cadence (default 2 / 10;
+                the membership check is a gang-wide broadcast collective, so
+                its cadence trades rescale latency against per-step sync)
+  LLAMA_STEP_SLEEP  seconds of pacing between steps (default 0) — gives the
+                rescale e2e test a deterministic window to mutate replicas
+                while the tiny-config gang is still mid-training
 """
 
 import os
@@ -63,10 +68,17 @@ def main():
         TrainerConfig(learning_rate=3e-4, optimizer="adamw", grad_clip_norm=1.0),
     )
     global_batch = per_chip * jax.device_count()
-    batches = map(
-        lambda b: make_global_batch(mesh, b),
-        synthetic_tokens(global_batch=global_batch, seq_len=seq_len, vocab=cfg.vocab),
-    )
+    pace = float(os.environ.get("LLAMA_STEP_SLEEP", "0") or 0)
+
+    def batches_iter():
+        for b in synthetic_tokens(
+            global_batch=global_batch, seq_len=seq_len, vocab=cfg.vocab
+        ):
+            if pace:
+                time.sleep(pace)
+            yield make_global_batch(mesh, b)
+
+    batches = batches_iter()
 
     def init_state():
         return trainer.init_state(llama.init(cfg, jax.random.PRNGKey(0)))
@@ -80,7 +92,7 @@ def main():
             config=ElasticConfig(
                 checkpoint_dir=ckpt_dir,
                 save_interval_steps=int(os.environ.get("LLAMA_SAVE_EVERY", "2")),
-                membership_check_every=int(os.environ.get("LLAMA_CHECK_EVERY", "1")),
+                membership_check_every=int(os.environ.get("LLAMA_CHECK_EVERY", "10")),
             ),
             init_state=init_state,
         )

@@ -92,6 +92,11 @@ ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
 
 DEFAULT_COORDINATOR_PORT = 8476
 
+# Deliberately duplicated from ops/elastic.py (EXIT_RESTART): the controller
+# must not import the jax-heavy training stack. tests/test_controller.py
+# asserts the two stay identical.
+EXIT_RESTART = 75
+
 # ConfigMap keys (≙ hostfile / discover_hosts.sh, :1088-1138)
 CONFIG_HOSTFILE = "hostfile"
 CONFIG_DISCOVER_HOSTS = "discover_hosts.sh"
@@ -495,14 +500,35 @@ class TPUJobController:
 
     def _reconcile_workers(self, job: TPUJob, placement: SlicePlacement) -> List[Pod]:
         """Per-index get-or-create + elastic scale-down of indices >= replicas
-        (≙ getOrCreateWorker :817-877, scale-down :833-849)."""
+        (≙ getOrCreateWorker :817-877, scale-down :833-849).
+
+        Under ExitCode policy, a RUNNING over-index pod is left to exit on
+        its own: the elastic protocol has every worker observe the shrunken
+        hostfile and exit EXIT_RESTART at the *same gang-synchronized step*
+        (ops/elastic.py). Killing it here would sever a live collective and
+        crash the survivors with a permanent (non-75) exit code. The
+        reference can kill immediately because Horovod re-forms rings around
+        lost peers; an XLA gang cannot."""
         replicas = job.spec.worker.replicas
+        graceful = job.spec.worker.restart_policy == RestartPolicy.EXIT_CODE
         existing = {p.metadata.name: p for p in self._list_workers(job)}
+        # scale-UP grace, symmetric to the scale-down grace below: a worker
+        # created into a still-running old gang cannot join its rendezvous
+        # (the live coordinator was started with the old process count) and
+        # would crash non-retryably. While any old-size pod is RUNNING,
+        # defer new creations; the drain restart relaunches the full gang.
+        old_gang_live = graceful and any(
+            p.status.phase == PodPhase.RUNNING
+            and p.spec.container.env.get(ENV_NUM_HOSTS) != str(replicas)
+            for p in existing.values()
+        )
         out: List[Pod] = []
         for i in range(replicas):
             name = job.worker_name(i)
             pod = existing.pop(name, None)
             if pod is None:
+                if old_gang_live:
+                    continue
                 pod = self.store.create(self._new_worker(job, i, placement))
             else:
                 self._check_owned(job, pod)
@@ -510,6 +536,8 @@ class TPUJobController:
         # anything left in `existing` has index >= replicas → scale down
         for name, pod in existing.items():
             self._check_owned(job, pod)
+            if graceful and pod.status.phase == PodPhase.RUNNING:
+                continue  # it will exit EXIT_RESTART itself; reap next sync
             self.store.try_delete("Pod", job.namespace, name)
         return out
 
@@ -553,10 +581,34 @@ class TPUJobController:
             cond.ensure_timestamps(job.status)
             return
 
-        # --- failures (≙ :935-983 + restart semantics of SURVEY.md §5.3) ---
+        # --- failures: gang-coherent restart (≙ :935-983, redesigned) ---
+        # The reference restarts per-pod because Horovod re-forms rings
+        # around lost peers. An XLA gang cannot: losing one member makes the
+        # survivors' collectives fail with ordinary (non-retryable) exit
+        # codes. So failure handling is gang-scoped: if ANY pod failed
+        # retryably (evicted, exit>=128, EXIT_RESTART), companion failures
+        # are collateral and the WHOLE gang restarts — but only once no pod
+        # is still running (drain: peers exit via the elastic protocol or
+        # their own collective error; activeDeadlineSeconds backstops a
+        # straggler that never exits). The drain sync executes the restart
+        # exactly once per generation, so backoffLimit counts restart
+        # generations, not per-pod failure observations.
         failed = [p for p in workers if p.status.phase == PodPhase.FAILED]
         if failed:
-            if all(self._pod_retryable(job, p) for p in failed):
+            if any(self._pod_retryable(job, p) for p in failed):
+                if cond.update_job_conditions(
+                    job.status,
+                    ConditionType.RESTARTING,
+                    cond.REASON_RESTARTING,
+                    "worker pod(s) failed retryably; gang will restart",
+                ):
+                    self.recorder.event(
+                        job, WARNING, cond.REASON_RESTARTING, "job restarting"
+                    )
+                cond.ensure_timestamps(job.status)
+                all_pods = self._list_workers(job)  # incl. over-index stragglers
+                if any(p.status.phase == PodPhase.RUNNING for p in all_pods):
+                    return  # draining; the straggler's exit re-enqueues us
                 backoff = job.spec.run_policy.backoff_limit
                 if backoff is not None and job.status.restart_count >= backoff:
                     self._fail_job(
@@ -569,19 +621,11 @@ class TPUJobController:
                     return
                 job.status.restart_count += 1
                 metrics.jobs_restarted.inc()
-                if cond.update_job_conditions(
-                    job.status,
-                    ConditionType.RESTARTING,
-                    cond.REASON_RESTARTING,
-                    f"{len(failed)} worker pod(s) failed retryably; restarting",
-                ):
-                    self.recorder.event(
-                        job, WARNING, cond.REASON_RESTARTING, "job restarting"
-                    )
-                cond.ensure_timestamps(job.status)
-                # delete failed pods; next reconcile recreates them (≙ the
-                # evicted-launcher delete+requeue of syncHandler :506-529)
-                for p in failed:
+                # delete every terminal pod — a succeeded non-coordinator
+                # must re-run too, or the relaunched gang waits on a member
+                # that never comes back; next reconcile recreates the gang
+                # at the (possibly rescaled) size
+                for p in all_pods:
                     self.store.try_delete("Pod", p.metadata.namespace, p.metadata.name)
                 return
             first = failed[0]
@@ -607,15 +651,19 @@ class TPUJobController:
     def _pod_retryable(self, job: TPUJob, pod: Pod) -> bool:
         """Eviction/preemption is always retryable (TPU preemption is routine;
         ≙ the evicted-requeue of syncHandler :506-529). Otherwise the replica
-        restart policy decides; ExitCode retries only system exit codes >= 128
-        (SIGKILL'd / infrastructure), matching kubeflow-common convention."""
+        restart policy decides; ExitCode retries system exit codes >= 128
+        (SIGKILL'd / infrastructure, matching kubeflow-common convention) and
+        EXIT_RESTART (75, EX_TEMPFAIL) — the elastic protocol's own
+        "re-run me at the new gang size" code (ops/elastic.py; ≙ the
+        discover_hosts.sh re-form loop, SURVEY.md §3.5)."""
         if pod.is_evicted():
             return True
         rp = job.spec.worker.restart_policy
         if rp in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE):
             return True
         if rp == RestartPolicy.EXIT_CODE:
-            return pod.status.exit_code is not None and pod.status.exit_code >= 128
+            ec = pod.status.exit_code
+            return ec is not None and (ec >= 128 or ec == EXIT_RESTART)
         return False
 
     def _fail_job(

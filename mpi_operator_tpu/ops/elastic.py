@@ -94,10 +94,26 @@ def run_elastic(
     exists); otherwise the latest checkpoint is restored INTO the current
     mesh layout. Returns "restart" (caller exits EXIT_RESTART) or "done".
     """
-    if current_world is None:
-        import jax
+    import jax
 
+    if current_world is None:
         current_world = jax.process_count()
+
+    def agreed_membership() -> int:
+        """Host 0's membership view, broadcast to the gang. Each host polls
+        its own projected hostfile, and projection timing skews across
+        hosts — if hosts acted on their *local* read they could diverge on
+        which step to exit at, desynchronizing the collectives (the step
+        loop is SPMD: every control-flow decision must be gang-uniform).
+        A one-to-all broadcast runs at a synchronized point of every
+        participant's loop, so the decision is uniform by construction.
+        Single-process: a passthrough."""
+        if jax.process_count() == 1:
+            return membership()
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        return int(multihost_utils.broadcast_one_to_all(np.int32(membership())))
     mgr = CheckpointManager(
         config.checkpoint_dir,
         save_interval_steps=config.save_interval_steps,
@@ -123,7 +139,7 @@ def run_elastic(
                 mgr.save(step, state)
             if (
                 step % config.membership_check_every == 0
-                and membership() != current_world
+                and agreed_membership() != current_world
             ):
                 if mgr.latest_step() != step:
                     mgr.save(step, state, force=True)

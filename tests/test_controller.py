@@ -181,7 +181,11 @@ def test_worker_failed_never_fails_job(f):
 
 
 def test_evicted_worker_restarts(f):
-    """Eviction is retryable (≙ the evicted delete+requeue of :506-529)."""
+    """Eviction is retryable (≙ the evicted delete+requeue of :506-529) —
+    gang-coherent: the restart waits for the survivor to drain (its
+    collectives fail once the peer is gone), then relaunches the WHOLE gang;
+    the survivor's ordinary exit code is collateral, not a permanent
+    failure."""
     job = f.create_job(make_job(name="ev", replicas=2))
     f.run_to_phase(job)
     f.set_pod_phase(job, 1, PodPhase.FAILED, reason="Evicted")
@@ -189,12 +193,17 @@ def test_evicted_worker_restarts(f):
     st = f.job(job).status
     assert conditions.has_condition(st, ConditionType.RESTARTING)
     assert not conditions.is_finished(st)
+    assert st.restart_count == 0  # draining: worker 0 still running
+    f.set_pod_phase(job, 0, PodPhase.FAILED, exit_code=1)  # collective error
+    f.sync(job)
+    st = f.job(job).status
     assert st.restart_count == 1
-    # failed pod deleted; next reconcile recreates it
+    assert not conditions.is_finished(st)
+    # gang deleted; next reconcile recreates it whole
     f.sync(job)
     pods = f.pods(job)
     assert len(pods) == 2
-    assert pods[1].status.phase == PodPhase.PENDING
+    assert all(p.status.phase == PodPhase.PENDING for p in pods)
 
 
 def test_exit_code_retryable_vs_permanent(f):
@@ -205,10 +214,41 @@ def test_exit_code_retryable_vs_permanent(f):
     f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=137)  # SIGKILL → retry
     f.sync(job)
     assert conditions.has_condition(f.job(job).status, ConditionType.RESTARTING)
-    f.sync(job)  # recreate
-    f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=1)  # app error → permanent
+    f.set_pod_phase(job, 0, PodPhase.FAILED, exit_code=1)  # collateral → drain
+    f.sync(job)
+    assert f.job(job).status.restart_count == 1
+    f.sync(job)  # recreate the gang
+    assert all(p.status.phase == PodPhase.PENDING for p in f.pods(job))
+    # the whole gang exiting with plain app errors (no retryable member) is
+    # a permanent failure
+    f.set_pod_phase(job, 0, PodPhase.FAILED, exit_code=1)
+    f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=1)
     f.sync(job)
     assert conditions.is_failed(f.job(job).status)
+
+
+def test_exit_restart_code_is_retryable(f):
+    """EXIT_RESTART (75) — the elastic protocol's own 'relaunch me' code —
+    must be retryable under ExitCode policy, or the elastic loop can never
+    compose with the controller (ops/elastic.py step 3 → 4)."""
+    from mpi_operator_tpu.controller.controller import EXIT_RESTART
+    from mpi_operator_tpu.ops import elastic
+
+    assert EXIT_RESTART == elastic.EXIT_RESTART  # the duplicated contract
+    job = make_job(name="er", replicas=2)
+    job.spec.worker.restart_policy = RestartPolicy.EXIT_CODE
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.FAILED, exit_code=EXIT_RESTART)
+    f.set_pod_phase(job, 1, PodPhase.FAILED, exit_code=EXIT_RESTART)
+    f.sync(job)
+    st = f.job(job).status
+    assert conditions.has_condition(st, ConditionType.RESTARTING)
+    assert not conditions.is_failed(st)
+    f.sync(job)  # recreate both workers
+    pods = f.pods(job)
+    assert len(pods) == 2
+    assert all(p.status.phase == PodPhase.PENDING for p in pods)
 
 
 def test_backoff_limit_exceeded(f):

@@ -81,6 +81,95 @@ def test_restart_policy_relaunches_failed_worker(tmp_path):
     assert sentinel.exists()
 
 
+def test_elastic_rescale_end_to_end(tmp_path):
+    """The composed elastic loop (VERDICT r2 item 2): a live 3-worker llama
+    job is rescaled to 2 by mutating spec.worker.replicas on the stored job;
+    workers observe the projected hostfile shrink, checkpoint, exit
+    EXIT_RESTART (75); the controller relaunches the gang at 2; training
+    resumes from the checkpoint and the job reaches Succeeded.
+    ≙ the reference's discover_hosts.sh → horovodrun re-form loop
+    (mpi_job_controller.go:689-707,1116-1138, SURVEY.md §3.5) — restart-based
+    here because an XLA program is fixed to its mesh."""
+    import json
+    import time
+
+    from mpi_operator_tpu.controller.controller import (
+        ControllerOptions,
+        TPUJobController,
+    )
+    from mpi_operator_tpu.executor import LocalExecutor
+    from mpi_operator_tpu.machinery.events import EventRecorder
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.scheduler import GangScheduler
+
+    ckpt = tmp_path / "ckpt"
+    job = load_job(os.path.join(EXAMPLES, "llama.yaml"))
+    env = job.spec.worker.template.container.env
+    env["LLAMA_CKPT"] = str(ckpt)
+    env["LLAMA_STEPS"] = "120"
+    env["LLAMA_SEQ"] = "16"
+    env["LLAMA_STEP_SLEEP"] = "0.05"  # ~6s of stepping: a wide rescale window
+    assert job.spec.worker.replicas == 3
+    assert job.spec.worker.restart_policy == "ExitCode"
+
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    scheduler = GangScheduler(store, recorder)
+    executor = LocalExecutor(store, workdir=REPO, require_binding=True)
+    store.create(job)
+    controller.run()
+    scheduler.start()
+    executor.start()
+    try:
+        # phase 1: wait until the gang has saved a checkpoint (mid-training)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if ckpt.exists() and any(p.is_dir() for p in ckpt.iterdir()):
+                break
+            cur = store.get("TPUJob", "default", "llama")
+            assert not is_failed(cur.status), cur.status.conditions
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("no checkpoint appeared")
+
+        # phase 2: live rescale 3 -> 2 (what `kubectl scale` would do)
+        cur = store.get("TPUJob", "default", "llama")
+        cur.spec.worker.replicas = 2
+        store.update(cur)
+
+        # phase 3: the loop closes — restart at 2, resume, succeed
+        while time.time() < deadline:
+            cur = store.get("TPUJob", "default", "llama")
+            if is_succeeded(cur.status):
+                break
+            assert not is_failed(cur.status), cur.status.conditions
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("job did not succeed after rescale")
+    finally:
+        executor.stop()
+        scheduler.stop()
+        controller.stop()
+
+    final = store.get("TPUJob", "default", "llama")
+    # the exit-75 relaunch was taken, exactly once per rescale
+    assert final.status.restart_count >= 1
+    # the surviving gang is 2 workers, both accounted for
+    pods = store.list("Pod", "default")
+    assert len(pods) == 2
+    # worker 0's JSON report: ran to the full step count at the new size,
+    # and this incarnation resumed from the checkpoint (steps_run < total)
+    out = executor.logs["default/llama-worker-0"][0]
+    report = json.loads(out.strip().splitlines()[-1])
+    assert report["outcome"] == "done"
+    assert report["step"] == 120
+    assert report["hosts"] == 2
+    # the checkpoint the second incarnation restored from predates the end
+    saved_steps = sorted(int(p.name) for p in ckpt.iterdir() if p.is_dir())
+    assert saved_steps and saved_steps[0] < 120
+
+
 def test_k8s_style_env_list_parses():
     from mpi_operator_tpu.api.types import Container
 

@@ -5,6 +5,7 @@ simulates pod phases because there is no kubelet). Here the LocalExecutor IS
 the kubelet, so the documented smoke test (examples/pi, ≙
 /root/reference/examples/pi/README.md) runs in-suite, gang and all."""
 
+import json
 import os
 import shutil
 import subprocess
@@ -20,6 +21,11 @@ EXAMPLES = os.path.join(REPO, "examples")
 
 def _succeeded(job) -> bool:
     return is_succeeded(job.status)
+
+
+def _last_report(log: str) -> dict:
+    """Parse the worker's final JSON report line from its stdout."""
+    return json.loads(log.strip().splitlines()[-1])
 
 
 def _failed(job) -> bool:
@@ -79,6 +85,85 @@ def test_restart_policy_relaunches_failed_worker(tmp_path):
     final, logs = run_job(job, timeout=90, workdir=REPO)
     assert _succeeded(final), final.status.conditions
     assert sentinel.exists()
+
+
+def test_resnet_example_end_to_end():
+    """The headline benchmark workload crossing the full operator path
+    (≙ the reference's documented recipe,
+    /root/reference/examples/v1/tensorflow-benchmarks.yaml): run
+    examples/resnet.yaml as-written (tiny 2-host CPU gang) and assert the
+    coordinator reports throughput."""
+    job = load_job(os.path.join(EXAMPLES, "resnet.yaml"))
+    final, logs = run_job(job, timeout=240, workdir=REPO)
+    assert _succeeded(final), final.status.conditions
+    report = _last_report(logs["default/resnet-worker-0"][0])
+    assert report["hosts"] == 2
+    assert report["images_per_sec"] > 0
+    # SPMD: worker 1 ran the same program; only the coordinator reports.
+    # (cleanPodPolicy: Running may have reaped worker 1 before its exit —
+    # its logs only exist if it finished first.)
+    w1 = logs.get("default/resnet-worker-1")
+    assert w1 is None or "images_per_sec" not in w1[0]
+
+
+def test_mnist_allreduce_example_end_to_end():
+    """The MXNet-equivalent acceptance config (≙ the reference's
+    examples/mxnet/mxnet_mnist.py Horovod-MXNet DP): explicit parameter
+    broadcast + gradient allreduce, through the full operator path."""
+    job = load_job(os.path.join(EXAMPLES, "mnist_allreduce.yaml"))
+    final, logs = run_job(job, timeout=240, workdir=REPO)
+    assert _succeeded(final), final.status.conditions
+    report = _last_report(logs["default/mnist-allreduce-worker-0"][0])
+    assert report["hosts"] == 2
+    assert report["last_loss"] < report["first_loss"]
+
+
+def test_submit_job_example_two_process(tmp_path):
+    """examples/submit_job.py against a shared sqlite store with the
+    operator running as a SEPARATE process — the reference's
+    SDK-submits-to-apiserver split (/root/reference/sdk/python/examples/
+    tensorflow-mnist.py) as a real two-process deployment."""
+    db = tmp_path / "store.db"
+    # file-backed output: a PIPE would fill and deadlock a chatty operator,
+    # and we want its log attached to any failure
+    op_log = open(tmp_path / "operator.log", "w+")
+    operator = subprocess.Popen(
+        [
+            "python", "-m", "mpi_operator_tpu.opshell",
+            "--store", f"sqlite:{db}",
+            "--executor", "local",
+            "--monitoring-port", "0",
+        ],
+        cwd=REPO,
+        stdout=op_log,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    def operator_log() -> str:
+        op_log.flush()
+        return (tmp_path / "operator.log").read_text()
+
+    try:
+        submit = subprocess.run(
+            ["python", "examples/submit_job.py", f"sqlite:{db}"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        detail = submit.stdout + submit.stderr + "\noperator:\n" + operator_log()
+        assert submit.returncode == 0, detail
+        assert "SUCCEEDED" in submit.stdout, detail
+        assert "created TPUJob" in submit.stdout, detail
+    finally:
+        operator.terminate()
+        try:
+            operator.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            operator.kill()
+            operator.wait()
+        op_log.close()
 
 
 def test_elastic_rescale_end_to_end(tmp_path):

@@ -122,7 +122,9 @@ def test_labels_and_env_keys_are_user_data():
 
 
 def test_repo_examples_pass_strict_schema():
-    for name in ("pi.yaml", "pi_native.yaml", "mnist.yaml"):
+    for name in sorted(os.listdir(os.path.join(REPO, "examples"))):
+        if not name.endswith(".yaml"):
+            continue
         with open(os.path.join(REPO, "examples", name)) as f:
             parse_tpujob(yaml.safe_load(f))
 

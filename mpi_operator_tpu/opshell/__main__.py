@@ -26,7 +26,7 @@ from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
 from mpi_operator_tpu.opshell.election import ElectionConfig, LeaderElector
 from mpi_operator_tpu.opshell.server import OpsServer
-from mpi_operator_tpu.scheduler import GangScheduler
+from mpi_operator_tpu.scheduler import GangScheduler, SliceInventory
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--inventory-chips", type=int, default=None,
                     help="finite chip inventory for gang admission "
                          "(default: unbounded)")
+    ap.add_argument("--inventory-slices", default=None,
+                    help="topology-aware inventory: comma-separated host "
+                         "meshes, one per physical slice (e.g. '4x4,4x4'); "
+                         "gangs admit only into contiguous free blocks")
     ap.add_argument("--store", default="memory",
                     help="'memory' (in-process) or 'sqlite:PATH' "
                          "(shared across processes/replicas)")
@@ -94,7 +98,36 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    scheduler = GangScheduler(store, recorder, chips=args.inventory_chips) if gang else None
+    if args.inventory_slices is not None and not gang:
+        print(
+            "error: --inventory-slices requires gang scheduling "
+            "(remove --no-gang-scheduling)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.inventory_slices is not None and args.inventory_chips is not None:
+        print(
+            "error: --inventory-chips and --inventory-slices are exclusive "
+            "(the topology inventory defines capacity)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        inventory = (
+            SliceInventory.parse(args.inventory_slices)
+            if args.inventory_slices
+            else None
+        )
+    except ValueError as e:
+        print(f"error: --inventory-slices: {e}", file=sys.stderr)
+        return 2
+    scheduler = (
+        GangScheduler(
+            store, recorder, chips=args.inventory_chips, inventory=inventory
+        )
+        if gang
+        else None
+    )
     executor = (
         LocalExecutor(store, require_binding=gang)
         if args.executor == "local"

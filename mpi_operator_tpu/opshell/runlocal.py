@@ -30,7 +30,7 @@ from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobCont
 from mpi_operator_tpu.executor import LocalExecutor
 from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
-from mpi_operator_tpu.scheduler import GangScheduler
+from mpi_operator_tpu.scheduler import GangScheduler, SliceInventory
 
 
 def load_job(path: str) -> TPUJob:
@@ -47,16 +47,23 @@ def run_job(
     timeout: float = 300.0,
     workdir: str | None = None,
     chips: int | None = None,
+    inventory: str | None = None,
 ) -> tuple:
     """Drive one job to completion; returns (final job, worker logs dict).
 
     ``chips`` bounds the gang scheduler's inventory (None = unbounded);
-    either way admission is enforced: pods launch only once the whole gang
-    is bound (scheduler/gang.py)."""
+    ``inventory`` switches to topology-aware admission (a SliceInventory
+    spec like ``"4x4,4x4"``). Either way admission is enforced: pods launch
+    only once the whole gang is bound (scheduler/gang.py)."""
     store = ObjectStore()
     recorder = EventRecorder(store)
     controller = TPUJobController(store, recorder, ControllerOptions())
-    scheduler = GangScheduler(store, recorder, chips=chips)
+    scheduler = GangScheduler(
+        store,
+        recorder,
+        chips=chips,
+        inventory=SliceInventory.parse(inventory) if inventory else None,
+    )
     executor = LocalExecutor(store, workdir=workdir, require_binding=True)
     store.create(job)
     controller.run()
@@ -89,12 +96,22 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--chips", type=int, default=None,
                     help="bound the scheduler's chip inventory")
+    ap.add_argument("--inventory", default=None,
+                    help="topology-aware inventory (host meshes per physical "
+                         "slice, e.g. '4x4,4x4')")
     ap.add_argument("--events", action="store_true", help="print the event log")
     args = ap.parse_args(argv)
 
+    if args.inventory:
+        try:
+            SliceInventory.parse(args.inventory)
+        except ValueError as e:
+            print(f"error: --inventory: {e}", file=sys.stderr)
+            return 2
     job = load_job(args.manifest)
     store_job, logs = run_job(
-        job, timeout=args.timeout, workdir=args.workdir, chips=args.chips
+        job, timeout=args.timeout, workdir=args.workdir, chips=args.chips,
+        inventory=args.inventory,
     )
 
     # worker 0 plays the launcher; its output is the job's output

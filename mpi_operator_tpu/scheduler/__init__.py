@@ -1,3 +1,4 @@
 from mpi_operator_tpu.scheduler.gang import GangScheduler, pod_cost
+from mpi_operator_tpu.scheduler.inventory import PhysicalSlice, SliceInventory
 
-__all__ = ["GangScheduler", "pod_cost"]
+__all__ = ["GangScheduler", "pod_cost", "PhysicalSlice", "SliceInventory"]

@@ -6,8 +6,12 @@ fits (/root/reference/v2/pkg/controller/mpi_job_controller.go:634-656,
 1215-1237). On TPU the gang unit is a slice: an inherently finite, atomic
 resource. This component IS the enforcement:
 
-- **Finite inventory**: a chip budget (``chips=None`` = unbounded). Each
-  worker pod costs its ``TPUJOB_CHIPS_PER_HOST``.
+- **Finite inventory**: a chip budget (``chips=None`` = unbounded), or —
+  the topology-aware mode — a :class:`SliceInventory` of physical slices,
+  where a gang admits only when a *contiguous axis-aligned block* matching
+  its host mesh is free on a physical slice (one distinct slice per job
+  slice). Scattered capacity that merely sums to enough chips does NOT
+  admit: fragmentation is a first-class reason to stay pending.
 - **Atomic admission**: a gang is admitted only when *all* ``min_member``
   pods exist and their total cost fits the free inventory — then every pod
   is bound in one pass. Until then nothing launches; no partial placement.
@@ -33,9 +37,18 @@ import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.controller.placement import (
+    ANNOTATION_HOST_COORD,
+    ANNOTATION_HOST_MESH,
+    ANNOTATION_SLICE_ID,
+)
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import Pod, PodPhase
 from mpi_operator_tpu.machinery.store import NotFound, ObjectStore
+from mpi_operator_tpu.scheduler.inventory import (
+    SliceInventory,
+    parse_node_name,
+)
 
 log = logging.getLogger("tpujob.scheduler")
 
@@ -66,10 +79,12 @@ class GangScheduler:
         recorder: Optional[EventRecorder] = None,
         *,
         chips: Optional[int] = None,
+        inventory: Optional[SliceInventory] = None,
     ):
         self.store = store
         self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
         self.chips = chips
+        self.inventory = inventory  # topology mode; overrides the chip budget
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -117,6 +132,18 @@ class GangScheduler:
             return None
         return self.chips - self.used_chips()
 
+    def occupancy(self) -> Dict[str, set]:
+        """Topology mode: physical-slice host coords held by live bound pods
+        (recomputed from the store each pass — nothing to drift)."""
+        occ: Dict[str, set] = {}
+        for p in self.store.list("Pod"):
+            if not p.spec.node_name or p.is_finished():
+                continue
+            parsed = parse_node_name(p.spec.node_name)
+            if parsed is not None:
+                occ.setdefault(parsed[0], set()).add(parsed[1])
+        return occ
+
     # -- the scheduling pass ------------------------------------------------
 
     def sync(self) -> None:
@@ -147,6 +174,10 @@ class GangScheduler:
                 if not p.spec.node_name and p.status.phase == PodPhase.PENDING
             ]
             if not unbound:
+                continue
+            if self.inventory is not None:
+                if not self._sync_gang_topology(pg, bound, unbound):
+                    break  # strict FIFO, same as the scalar branch below
                 continue
             if bound:
                 # gang already admitted: later members (elastic scale-up)
@@ -189,6 +220,109 @@ class GangScheduler:
                 f"gang admitted: {n} pods, {sum(pod_cost(p) for p in unbound)} chips",
             )
 
+    # -- topology-aware admission -------------------------------------------
+
+    @staticmethod
+    def _pod_geometry(pod: Pod):
+        """(host_mesh, host_coord, slice_id) from the placement annotations
+        controller/placement.py stamped; None when absent (non-topology pod)."""
+        ann = pod.metadata.annotations
+        mesh = ann.get(ANNOTATION_HOST_MESH, "")
+        coord = ann.get(ANNOTATION_HOST_COORD, "")
+        if not mesh or not coord:
+            return None
+        try:
+            return (
+                tuple(int(d) for d in mesh.split("x")),
+                tuple(int(d) for d in coord.split("x")),
+                int(ann.get(ANNOTATION_SLICE_ID, "0")),
+            )
+        except ValueError:
+            return None
+
+    def _sync_gang_topology(self, pg, bound: List[Pod], unbound: List[Pod]) -> bool:
+        """One gang against the slice inventory. Returns False when the gang
+        must keep waiting (caller stops the FIFO pass)."""
+        assert self.inventory is not None
+        occ = self.occupancy()
+        geos = {p.metadata.name: self._pod_geometry(p) for p in unbound}
+        if any(g is None for g in geos.values()):
+            self._warn(pg, "pods carry no placement annotations; cannot admit")
+            return True  # not capacity — skip, don't block the queue
+        if bound:
+            # relaunched/scaled member of an admitted gang: rejoin the
+            # gang's existing block (offset = bound member's abs − its coord)
+            offsets = {}
+            for b in bound:
+                parsed = parse_node_name(b.spec.node_name)
+                geo = self._pod_geometry(b)
+                if parsed is None or geo is None:
+                    continue
+                name, abs_coord = parsed
+                offsets[geo[2]] = (
+                    name,
+                    tuple(a - c for a, c in zip(abs_coord, geo[1])),
+                )
+            ok = True
+            for p in unbound:
+                mesh, coord, sid = geos[p.metadata.name]
+                if sid not in offsets:
+                    ok = False
+                    continue
+                name, off = offsets[sid]
+                node = self.inventory.node_for(name, off, coord)
+                if node is None:
+                    # annotations outgrew the admitted block (e.g. rescale):
+                    # the gang-coherent restart will re-admit; never bind to
+                    # a host outside the physical mesh
+                    ok = False
+                    continue
+                parsed = parse_node_name(node)
+                if parsed and parsed[1] in occ.get(name, set()):
+                    # the freed slot was taken by another gang meanwhile:
+                    # this member cannot rejoin. Warn and skip — holding the
+                    # whole FIFO here would starve unrelated gangs behind a
+                    # non-capacity conflict.
+                    self._warn(
+                        pg, f"pod {p.metadata.name}'s slot {node} is occupied"
+                    )
+                    continue
+                if self._bind(p, node):
+                    occ.setdefault(name, set()).add(parsed[1])
+            if not ok:
+                self._warn(pg, "gang grew past its admitted block; waiting "
+                               "for the gang-coherent restart to re-admit")
+            return True
+        if len(unbound) < pg.spec.min_member:
+            return True  # gang not fully created yet; don't block the queue
+        mesh = next(iter(geos.values()))[0]
+        num_slices = 1 + max(g[2] for g in geos.values())
+        placement = self.inventory.find_placement(mesh, num_slices, occ)
+        if placement is None:
+            self._warn(
+                pg,
+                f"no contiguous {'x'.join(map(str, mesh))} host block free "
+                f"on {num_slices} distinct slice(s) — waiting (fragmentation "
+                f"counts: scattered free hosts cannot carry ICI collectives)",
+            )
+            return False  # capacity/topology: hold FIFO here
+        n = 0
+        for p in unbound:
+            _, coord, sid = geos[p.metadata.name]
+            name, off = placement[sid]
+            if self._bind(p, self.inventory.node_for(name, off, coord)):
+                n += 1
+        self._last_warning.pop(self._pg_key(pg), None)
+        where = ", ".join(
+            s + "+" + "x".join(map(str, o)) for s, o in placement
+        )
+        self.recorder.event(
+            pg, "Normal", EVENT_SCHEDULED,
+            f"gang admitted: {n} pods in {'x'.join(map(str, mesh))} "
+            f"block(s) at {where}",
+        )
+        return True
+
     # -- helpers ------------------------------------------------------------
 
     @staticmethod
@@ -202,7 +336,7 @@ class GangScheduler:
         self._last_warning[key] = message
         self.recorder.event(pg, WARNING, EVENT_UNSCHEDULABLE, message)
 
-    def _bind(self, pod: Pod) -> bool:
+    def _bind(self, pod: Pod, node: str = NODE_NAME) -> bool:
         """Set node_name (scheduler owns this field, like the kube binding
         subresource — force-update is the binding's authority)."""
         try:
@@ -211,7 +345,7 @@ class GangScheduler:
             return False
         if cur.spec.node_name or cur.is_finished():
             return False
-        cur.spec.node_name = NODE_NAME
+        cur.spec.node_name = node
         try:
             self.store.update(cur, force=True)
         except NotFound:

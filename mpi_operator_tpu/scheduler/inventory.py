@@ -1,0 +1,165 @@
+"""Slice-shaped inventory: the topology the gang scheduler admits against.
+
+A scalar chip budget can say "8 chips free" but not "those 8 chips form two
+2x2 corners of different slices" — and on real hardware a 4x2 job cannot run
+on scattered chips: its collectives must ride contiguous ICI (SURVEY.md §7
+"hard parts": ICI-aware placement; the capability bar is the reference's
+Volcano delegation, mpi_job_controller.go:634-656, which has no topology
+model at all).
+
+The model here:
+
+- The cluster is a list of **physical slices**, each a host mesh (e.g. two
+  v5e-16 slices → ``4x4,4x4`` with 4-chip hosts). Hosts, not chips, are the
+  allocation unit — a TPU host's chip block is indivisible.
+- A job's gang needs ``num_slices`` **contiguous, axis-aligned blocks** of
+  shape ``host_mesh`` (from controller/placement.py), each on a distinct
+  physical slice (job slices talk DCN; hosts within a block talk ICI).
+- Admission is an exact-orientation block search per physical slice.
+  Occupancy is recomputed from bound pods every pass (level-triggered — the
+  scheduler carries no state that can drift).
+
+``parse("4x4,4x4")`` builds the inventory; a bound pod's node name is
+``slice<i>/<abs-coord>`` so occupancy round-trips through the store.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+Coord = Tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class PhysicalSlice:
+    name: str
+    host_mesh: Tuple[int, ...]
+
+    @property
+    def num_hosts(self) -> int:
+        n = 1
+        for d in self.host_mesh:
+            n *= d
+        return n
+
+
+def _node_name(slice_name: str, coord: Coord) -> str:
+    return f"{slice_name}/{'x'.join(map(str, coord))}"
+
+
+def parse_node_name(node: str) -> Optional[Tuple[str, Coord]]:
+    """Inverse of the binding's node name; None for foreign names (e.g. the
+    scalar mode's ``local``)."""
+    if "/" not in node:
+        return None
+    name, _, coord = node.partition("/")
+    try:
+        return name, tuple(int(p) for p in coord.split("x"))
+    except ValueError:
+        return None
+
+
+class SliceInventory:
+    """The physical slices a scheduler instance owns."""
+
+    def __init__(self, slices: Sequence[PhysicalSlice]):
+        self.slices = list(slices)
+        by_name = {s.name for s in self.slices}
+        if len(by_name) != len(self.slices):
+            raise ValueError("physical slice names must be unique")
+
+    @staticmethod
+    def parse(spec: str) -> "SliceInventory":
+        """``"4x4,4x4"`` → two 4x4-host slices named slice0, slice1."""
+        slices = []
+        for i, part in enumerate(p.strip() for p in spec.split(",") if p.strip()):
+            try:
+                mesh = tuple(int(d) for d in part.split("x"))
+            except ValueError:
+                raise ValueError(f"bad host mesh {part!r}") from None
+            if not mesh or any(d < 1 for d in mesh):
+                raise ValueError(f"bad host mesh {part!r}")
+            slices.append(PhysicalSlice(name=f"slice{i}", host_mesh=mesh))
+        if not slices:
+            raise ValueError(f"empty inventory spec {spec!r}")
+        return SliceInventory(slices)
+
+    @property
+    def total_hosts(self) -> int:
+        return sum(s.num_hosts for s in self.slices)
+
+    # -- the block search ---------------------------------------------------
+
+    @staticmethod
+    def _free_block_at(
+        occupied: Set[Coord], offset: Coord, shape: Coord
+    ) -> bool:
+        for rel in itertools.product(*(range(d) for d in shape)):
+            if tuple(o + r for o, r in zip(offset, rel)) in occupied:
+                return False
+        return True
+
+    def _find_block(
+        self, phys: PhysicalSlice, occupied: Set[Coord], shape: Coord
+    ) -> Optional[Coord]:
+        """Smallest-offset free axis-aligned block of ``shape`` in ``phys``
+        (exact orientation: ICI axes are not interchangeable)."""
+        if len(shape) != len(phys.host_mesh):
+            return None
+        if any(s > m for s, m in zip(shape, phys.host_mesh)):
+            return None
+        for offset in itertools.product(
+            *(range(m - s + 1) for s, m in zip(shape, phys.host_mesh))
+        ):
+            if self._free_block_at(occupied, offset, shape):
+                return offset
+        return None
+
+    def find_placement(
+        self,
+        host_mesh: Coord,
+        num_slices: int,
+        occupancy: Dict[str, Set[Coord]],
+    ) -> Optional[List[Tuple[str, Coord]]]:
+        """Atomically place ``num_slices`` blocks of ``host_mesh`` on
+        DISTINCT physical slices. Returns [(slice_name, offset)] per job
+        slice, or None when no placement exists (caller keeps the gang
+        pending — fragmentation is a valid reason even when total free
+        hosts would suffice)."""
+        chosen: List[Tuple[str, Coord]] = []
+        used_slices: Set[str] = set()
+        for _ in range(num_slices):
+            found = None
+            for phys in self.slices:
+                if phys.name in used_slices:
+                    continue
+                off = self._find_block(
+                    phys, occupancy.get(phys.name, set()), host_mesh
+                )
+                if off is not None:
+                    found = (phys.name, off)
+                    break
+            if found is None:
+                return None
+            chosen.append(found)
+            used_slices.add(found[0])
+        return chosen
+
+    def node_for(
+        self, slice_name: str, offset: Coord, host_coord: Coord
+    ) -> Optional[str]:
+        """The node name binding a worker at ``host_coord`` within its job
+        block placed at ``offset`` — or None when the host falls outside the
+        physical slice (a rejoining pod whose annotations no longer match
+        the admitted block must not bind to a host that doesn't exist)."""
+        phys = next((s for s in self.slices if s.name == slice_name), None)
+        if phys is None:
+            return None
+        coord = tuple(o + c for o, c in zip(offset, host_coord))
+        if len(coord) != len(phys.host_mesh) or any(
+            c < 0 or c >= m for c, m in zip(coord, phys.host_mesh)
+        ):
+            return None
+        return _node_name(slice_name, coord)

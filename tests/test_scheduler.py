@@ -184,3 +184,150 @@ def test_end_to_end_oversubscribed_job_times_out_pending():
     job.metadata.name = "toolarge"
     with pytest.raises(TimeoutError):
         run_job(job, timeout=3, workdir=REPO, chips=1)
+
+
+# -- topology-aware admission (slice-shaped inventory) -----------------------
+
+from mpi_operator_tpu.controller.placement import (  # noqa: E402
+    ANNOTATION_HOST_COORD,
+    ANNOTATION_HOST_MESH,
+    ANNOTATION_SLICE_ID,
+)
+from mpi_operator_tpu.scheduler.inventory import SliceInventory  # noqa: E402
+
+
+def make_topo_pod(store, job, index, mesh, coord, slice_id=0, ns="default"):
+    return store.create(
+        Pod(
+            metadata=ObjectMeta(
+                name=f"{job}-worker-{index}",
+                namespace=ns,
+                labels={LABEL_JOB_NAME: job},
+                annotations={
+                    ANNOTATION_HOST_MESH: "x".join(map(str, mesh)),
+                    ANNOTATION_HOST_COORD: "x".join(map(str, coord)),
+                    ANNOTATION_SLICE_ID: str(slice_id),
+                },
+            ),
+            spec=PodSpec(container=Container(env={})),
+        )
+    )
+
+
+def make_topo_gang(store, sched, job, mesh, n, slice_ids=None):
+    """A gang of n workers laid out row-major over ``mesh``."""
+    make_gang(store, job, min_member=n)
+    per_slice = n if slice_ids is None else n // (max(slice_ids) + 1)
+    for i in range(n):
+        within = i % per_slice
+        coord = []
+        rem = within
+        for dim in reversed(mesh):
+            coord.append(rem % dim)
+            rem //= dim
+        make_topo_pod(
+            store, job, i, mesh, tuple(reversed(coord)),
+            slice_id=0 if slice_ids is None else slice_ids[i],
+        )
+    sched.sync()
+
+
+def nodes_of(store, job):
+    return sorted(p.spec.node_name for p in bound_pods(store, job))
+
+
+def test_topology_gang_admits_contiguous_block():
+    store = ObjectStore()
+    sched = GangScheduler(store, inventory=SliceInventory.parse("8"))
+    make_topo_gang(store, sched, "a", (2,), 2)
+    assert nodes_of(store, "a") == ["slice0/0", "slice0/1"]
+    make_topo_gang(store, sched, "b", (4,), 4)
+    assert nodes_of(store, "b") == [
+        "slice0/2", "slice0/3", "slice0/4", "slice0/5"
+    ]
+
+
+def test_fragmentation_blocks_admission_despite_total_capacity():
+    """THE topology case a scalar budget cannot express: 4 hosts free, but
+    scattered — a 3-host contiguous gang must stay pending."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    sched = GangScheduler(store, recorder, inventory=SliceInventory.parse("8"))
+    make_topo_gang(store, sched, "a", (2,), 2)   # hosts 0-1
+    make_topo_gang(store, sched, "b", (4,), 4)   # hosts 2-5
+    finish(store, "a")                            # free: {0,1,6,7} — 4 hosts
+    make_topo_gang(store, sched, "c", (3,), 3)
+    assert bound_pods(store, "c") == []           # fragmentation blocks it
+    msgs = [
+        e.message for e in store.list("Event")
+        if e.reason == EVENT_UNSCHEDULABLE and e.involved.name == "c-gang"
+    ]
+    assert msgs and "contiguous" in msgs[-1]
+    finish(store, "b")                            # free: everything
+    sched.sync()
+    assert nodes_of(store, "c") == ["slice0/0", "slice0/1", "slice0/2"]
+
+
+def test_topology_2d_block_search():
+    store = ObjectStore()
+    sched = GangScheduler(store, inventory=SliceInventory.parse("4x4"))
+    make_topo_gang(store, sched, "a", (2, 2), 4)
+    assert nodes_of(store, "a") == [
+        "slice0/0x0", "slice0/0x1", "slice0/1x0", "slice0/1x1"
+    ]
+    make_topo_gang(store, sched, "b", (2, 2), 4)  # next free 2x2: offset 0x2
+    assert nodes_of(store, "b") == [
+        "slice0/0x2", "slice0/0x3", "slice0/1x2", "slice0/1x3"
+    ]
+    make_topo_gang(store, sched, "c", (3, 3), 9)  # no 3x3 block free
+    assert bound_pods(store, "c") == []
+    finish(store, "a")
+    sched.sync()                                  # still no 3x3 (b holds cols 2-3 of rows 0-1)
+    assert bound_pods(store, "c") == []
+    finish(store, "b")
+    sched.sync()
+    assert len(bound_pods(store, "c")) == 9
+
+
+def test_multislice_gang_lands_on_distinct_physical_slices():
+    store = ObjectStore()
+    sched = GangScheduler(store, inventory=SliceInventory.parse("4,4"))
+    make_topo_gang(store, sched, "m", (2,), 4, slice_ids=[0, 0, 1, 1])
+    nodes = nodes_of(store, "m")
+    assert nodes == ["slice0/0", "slice0/1", "slice1/0", "slice1/1"]
+    # a second 2-slice job fits the remaining halves
+    make_topo_gang(store, sched, "n", (2,), 4, slice_ids=[0, 0, 1, 1])
+    assert nodes_of(store, "n") == ["slice0/2", "slice0/3", "slice1/2", "slice1/3"]
+    # a third cannot: no distinct pair of slices has 2 contiguous free
+    make_topo_gang(store, sched, "o", (2,), 4, slice_ids=[0, 0, 1, 1])
+    assert bound_pods(store, "o") == []
+
+
+def test_topology_relaunched_member_rejoins_its_block():
+    """A recreated member of an admitted gang binds back to its own host
+    (offset re-derived from a surviving bound member)."""
+    store = ObjectStore()
+    sched = GangScheduler(store, inventory=SliceInventory.parse("8"))
+    make_topo_gang(store, sched, "r", (3,), 3)
+    assert nodes_of(store, "r") == ["slice0/0", "slice0/1", "slice0/2"]
+    store.try_delete("Pod", "default", "r-worker-1")
+    make_topo_pod(store, "r", 1, (3,), (1,))
+    sched.sync()
+    assert nodes_of(store, "r") == ["slice0/0", "slice0/1", "slice0/2"]
+
+
+def test_topology_rejoin_conflict_does_not_starve_fifo():
+    """A relaunched member whose freed slot was taken by another gang warns
+    and waits — but gangs later in the FIFO still admit (a non-capacity
+    conflict must not become head-of-line blocking)."""
+    store = ObjectStore()
+    sched = GangScheduler(store, inventory=SliceInventory.parse("8"))
+    make_topo_gang(store, sched, "r", (2,), 2)        # hosts 0-1
+    store.try_delete("Pod", "default", "r-worker-1")
+    sched.sync()
+    make_topo_gang(store, sched, "s", (1,), 1)        # takes freed host 1
+    assert nodes_of(store, "s") == ["slice0/1"]
+    make_topo_pod(store, "r", 1, (2,), (1,))          # wants host 1 back
+    make_topo_gang(store, sched, "t", (2,), 2)        # later gang: must admit
+    assert nodes_of(store, "t") == ["slice0/2", "slice0/3"]
+    assert len(bound_pods(store, "r")) == 1           # member still pending

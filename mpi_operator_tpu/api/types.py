@@ -527,6 +527,10 @@ class JobStatus(_Dictable):
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
     restart_count: int = 0
+    # rendezvous port the controller allocated this job (per-job so two
+    # concurrent gangs under one executor never collide on bind; the
+    # reference gets isolation for free from per-pod DNS)
+    coordinator_port: Optional[int] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "JobStatus":
@@ -539,6 +543,7 @@ class JobStatus(_Dictable):
             completion_time=d.get("completion_time"),
             last_reconcile_time=d.get("last_reconcile_time"),
             restart_count=d.get("restart_count", 0),
+            coordinator_port=d.get("coordinator_port"),
         )
 
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -139,6 +140,11 @@ class TPUJobController:
         self._watch_q = None
         # injectable, ≙ updateStatusHandler (:243-244)
         self._write_status = self._default_write_status
+        # in-flight port reservations: two reconcile threads assigning ports
+        # concurrently must not both pick the same one before either status
+        # persists (cleared when the job disappears)
+        self._port_lock = threading.Lock()
+        self._ports_inflight: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # run loop (≙ Run + runWorker + processNextWorkItem :347-438)
@@ -241,6 +247,8 @@ class TPUJobController:
         namespace, name = key.split("/", 1)
         job = self.store.try_get("TPUJob", namespace, name)
         if job is None:
+            with self._port_lock:  # release the port reservation
+                self._ports_inflight.pop(key, None)
             return True  # deleted; nothing to do (≙ :460-467)
         set_defaults(job)  # store returned a deep copy (≙ DeepCopy + Default :470-475)
 
@@ -355,8 +363,52 @@ class TPUJobController:
         )
         return self.store.create(svc)
 
+    # ports probed above options.coordinator_port before wrapping
+    PORT_RANGE = 1024
+
+    def _assign_coordinator_port(self, job: TPUJob) -> int:
+        """Per-job rendezvous port, recorded in status (once assigned it is
+        stable for the job's lifetime — workers compiled against it must
+        find the same coordinator after every gang restart). Hash-placed in
+        [base, base+PORT_RANGE) with linear probing against the ports of
+        other live jobs; the reference needs no analogue because every pod
+        has its own DNS name, whereas one LocalExecutor host shares one
+        loopback interface."""
+        key = job.metadata.key()
+        with self._port_lock:
+            if job.status.coordinator_port:
+                self._ports_inflight[key] = job.status.coordinator_port
+                return job.status.coordinator_port
+            reserved = self._ports_inflight.get(key)
+            if reserved is not None:
+                # a prior attempt whose status write lost a Conflict: the
+                # pods already carry this port, so it must stick
+                job.status.coordinator_port = reserved
+                return reserved
+            used = {
+                j.status.coordinator_port
+                for j in self.store.list("TPUJob")
+                if j.status.coordinator_port
+                and j.metadata.uid != job.metadata.uid
+                and not cond.is_finished(j.status)
+            }
+            used |= {
+                p for k, p in self._ports_inflight.items() if k != key
+            }
+            base = self.options.coordinator_port
+            start = zlib.crc32(key.encode()) % self.PORT_RANGE
+            port = base + start  # all taken: best effort
+            for probe in range(self.PORT_RANGE):
+                cand = base + (start + probe) % self.PORT_RANGE
+                if cand not in used:
+                    port = cand
+                    break
+            self._ports_inflight[key] = port
+            job.status.coordinator_port = port
+            return port
+
     def coordinator_address(self, job: TPUJob) -> str:
-        return f"{job.worker_hostname(0)}:{self.options.coordinator_port}"
+        return f"{job.worker_hostname(0)}:{self._assign_coordinator_port(job)}"
 
     def _config_data(self, job: TPUJob, workers: List[Pod]) -> Dict[str, str]:
         """hostfile + discover_hosts.sh parity (≙ newConfigMap :1088-1113 and

@@ -47,9 +47,12 @@ class Config:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     compute_dtype: Any = jnp.bfloat16
-    # "auto": pallas flash kernel on TPU when the sequence isn't ring-sharded,
-    # XLA dense elsewhere; "dense"/"flash" force a path (a sharded sequence
-    # axis always takes the ring — it's the only exact option there)
+    # "auto": flash_attention whenever the sequence isn't ring-sharded — the
+    # compiled Pallas kernel on TPU, the memory-bounded chunked XLA lowering
+    # on other backends (never the dense [T,T] matrix, which OOMs at
+    # production sequence lengths). "dense" forces the quadratic oracle
+    # (tests/small cases only); "flash" forces the kernel path. A sharded
+    # sequence axis always takes the ring — the only exact option there.
     attention_impl: str = "auto"
     # checkpoint each scan layer: backward stores only the 12-layer stack of
     # [B,T,D] layer inputs instead of every intra-layer intermediate — the
@@ -208,24 +211,20 @@ def apply(
             and AXIS_SEQ in mesh.axis_names
             and mesh.shape[AXIS_SEQ] > 1
         )
-        use_flash = c.attention_impl == "flash" or (
-            c.attention_impl == "auto" and jax.default_backend() == "tpu"
-        )
         if seq_sharded:
             # ring attention is the only exact option over a sharded sequence
             attn = ring_attention(q, k, v, mesh, causal=True)
-        elif use_flash:
+        elif c.attention_impl == "dense":
+            attn = dense_attention(q, k, v, causal=True, scale=c.head_dim**-0.5)
+        else:
             from mpi_operator_tpu.kernels import flash_attention
 
-            # mesh passed through: the pallas call must run under shard_map
-            # on sharded inputs (it is not SPMD-partitionable)
+            # auto/flash: the kernel on TPU, chunked XLA elsewhere. mesh
+            # passed through: the pallas call must run under shard_map on
+            # sharded inputs (it is not SPMD-partitionable)
             attn = flash_attention(
                 q, k, v, causal=True, scale=c.head_dim**-0.5, mesh=mesh
             )
-        elif mesh is not None:
-            attn = ring_attention(q, k, v, mesh, causal=True)
-        else:
-            attn = dense_attention(q, k, v, causal=True, scale=c.head_dim**-0.5)
         attn = attn.reshape(b, t, c.q_dim)
         h = h + attn @ lp["wo"]["w"].astype(dt)
         h = constrain(h, ["batch", "seq", "embed"])

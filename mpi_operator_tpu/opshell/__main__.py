@@ -115,7 +115,7 @@ def main(argv=None) -> int:
     try:
         inventory = (
             SliceInventory.parse(args.inventory_slices)
-            if args.inventory_slices
+            if args.inventory_slices is not None
             else None
         )
     except ValueError as e:

@@ -47,23 +47,20 @@ def run_job(
     timeout: float = 300.0,
     workdir: str | None = None,
     chips: int | None = None,
-    inventory: str | None = None,
+    inventory: "str | SliceInventory | None" = None,
 ) -> tuple:
     """Drive one job to completion; returns (final job, worker logs dict).
 
     ``chips`` bounds the gang scheduler's inventory (None = unbounded);
-    ``inventory`` switches to topology-aware admission (a SliceInventory
-    spec like ``"4x4,4x4"``). Either way admission is enforced: pods launch
-    only once the whole gang is bound (scheduler/gang.py)."""
+    ``inventory`` switches to topology-aware admission (a SliceInventory,
+    or a spec string like ``"4x4,4x4"``). Either way admission is enforced:
+    pods launch only once the whole gang is bound (scheduler/gang.py)."""
+    if isinstance(inventory, str):
+        inventory = SliceInventory.parse(inventory)
     store = ObjectStore()
     recorder = EventRecorder(store)
     controller = TPUJobController(store, recorder, ControllerOptions())
-    scheduler = GangScheduler(
-        store,
-        recorder,
-        chips=chips,
-        inventory=SliceInventory.parse(inventory) if inventory else None,
-    )
+    scheduler = GangScheduler(store, recorder, chips=chips, inventory=inventory)
     executor = LocalExecutor(store, workdir=workdir, require_binding=True)
     store.create(job)
     controller.run()
@@ -102,16 +99,17 @@ def main(argv=None) -> int:
     ap.add_argument("--events", action="store_true", help="print the event log")
     args = ap.parse_args(argv)
 
-    if args.inventory:
+    inventory = None
+    if args.inventory is not None:
         try:
-            SliceInventory.parse(args.inventory)
+            inventory = SliceInventory.parse(args.inventory)
         except ValueError as e:
             print(f"error: --inventory: {e}", file=sys.stderr)
             return 2
     job = load_job(args.manifest)
     store_job, logs = run_job(
         job, timeout=args.timeout, workdir=args.workdir, chips=args.chips,
-        inventory=args.inventory,
+        inventory=inventory,
     )
 
     # worker 0 plays the launcher; its output is the job's output

@@ -30,6 +30,11 @@ from mpi_operator_tpu.runtime.topology import AXIS_SEQ
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
                   # for fully-masked blocks
 
+# longest sequence for which the dense fallback may materialize [T, T]
+# scores; past this the chunked lowering (kernels/flash_attention.py) is the
+# only memory-sane non-ring path
+DENSE_FALLBACK_MAX_T = 1024
+
 
 def _scores(q, k, scale):
     """Attention scores with GQA grouping: q [B,Tq,H,D], k [B,Tk,Hkv,D] with
@@ -153,6 +158,15 @@ def ring_attention(
     )
     if seq_part is None:
         # No sequence axis in this mesh: single-shard attention, no ring.
+        # Above the threshold the dense [T,T] score matrix is a production
+        # OOM (8B-class sequence lengths), so route to the memory-bounded
+        # chunked lowering; dense stays the small-case/test oracle.
+        if q.shape[1] > DENSE_FALLBACK_MAX_T:
+            from mpi_operator_tpu.kernels.flash_attention import (
+                chunked_reference,
+            )
+
+            return chunked_reference(q, k, v, causal=causal, scale=scale)
         return dense_attention(q, k, v, causal=causal, scale=scale)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

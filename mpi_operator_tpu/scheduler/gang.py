@@ -159,6 +159,7 @@ class GangScheduler:
                 by_gang[(p.metadata.namespace, job)].append(p)
 
         free = self.free_chips()  # None = unbounded
+        occ = None  # topology occupancy, computed once on first use
         groups = sorted(
             self.store.list("PodGroup"),
             key=lambda g: (g.metadata.creation_timestamp or 0, g.metadata.name),
@@ -176,7 +177,9 @@ class GangScheduler:
             if not unbound:
                 continue
             if self.inventory is not None:
-                if not self._sync_gang_topology(pg, bound, unbound):
+                if occ is None:
+                    occ = self.occupancy()
+                if not self._sync_gang_topology(pg, bound, unbound, occ):
                     break  # strict FIFO, same as the scalar branch below
                 continue
             if bound:
@@ -240,11 +243,13 @@ class GangScheduler:
         except ValueError:
             return None
 
-    def _sync_gang_topology(self, pg, bound: List[Pod], unbound: List[Pod]) -> bool:
-        """One gang against the slice inventory. Returns False when the gang
-        must keep waiting (caller stops the FIFO pass)."""
+    def _sync_gang_topology(
+        self, pg, bound: List[Pod], unbound: List[Pod], occ: Dict[str, set]
+    ) -> bool:
+        """One gang against the slice inventory (``occ`` is the pass-wide
+        occupancy, updated in place as binds land). Returns False when the
+        gang must keep waiting for capacity (caller stops the FIFO pass)."""
         assert self.inventory is not None
-        occ = self.occupancy()
         geos = {p.metadata.name: self._pod_geometry(p) for p in unbound}
         if any(g is None for g in geos.values()):
             self._warn(pg, "pods carry no placement annotations; cannot admit")
@@ -299,6 +304,16 @@ class GangScheduler:
         num_slices = 1 + max(g[2] for g in geos.values())
         placement = self.inventory.find_placement(mesh, num_slices, occ)
         if placement is None:
+            if self.inventory.find_placement(mesh, num_slices, {}) is None:
+                # can NEVER fit (wrong dimensionality / bigger than every
+                # physical slice): a spec problem, not a capacity wait —
+                # skip so it doesn't starve the gangs behind it forever
+                self._warn(
+                    pg,
+                    f"host mesh {'x'.join(map(str, mesh))} x{num_slices} "
+                    f"slice(s) can never fit this inventory — not admitting",
+                )
+                return True
             self._warn(
                 pg,
                 f"no contiguous {'x'.join(map(str, mesh))} host block free "
@@ -310,8 +325,12 @@ class GangScheduler:
         for p in unbound:
             _, coord, sid = geos[p.metadata.name]
             name, off = placement[sid]
-            if self._bind(p, self.inventory.node_for(name, off, coord)):
+            node = self.inventory.node_for(name, off, coord)
+            if node is not None and self._bind(p, node):
                 n += 1
+                parsed = parse_node_name(node)
+                if parsed:
+                    occ.setdefault(parsed[0], set()).add(parsed[1])
         self._last_warning.pop(self._pg_key(pg), None)
         where = ", ".join(
             s + "+" + "x".join(map(str, o)) for s, o in placement

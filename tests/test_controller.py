@@ -79,7 +79,9 @@ def test_creates_all_dependents(f):
     cm = f.store.get("ConfigMap", "default", "pi-config")
     assert "pi-worker-0.pi-worker slots=1" in cm.data["hostfile"]
     assert "pi-worker-1.pi-worker slots=1" in cm.data["hostfile"]
-    assert cm.data["coordinator"] == "pi-worker-0.pi-worker:8476"
+    port = f.job(job).status.coordinator_port
+    assert port is not None
+    assert cm.data["coordinator"] == f"pi-worker-0.pi-worker:{port}"
     pg = f.store.get("PodGroup", "default", "pi")
     assert pg.spec.min_member == 2  # workers, no +1: launcher-less
     pods = f.pods(job)
@@ -99,7 +101,8 @@ def test_golden_worker_pod(f):
     assert pod.spec.subdomain == "train-worker"
     assert pod.metadata.labels[LABEL_REPLICA_INDEX] == "1"
     env = pod.spec.container.env
-    assert env[ENV_COORDINATOR] == "train-worker-0.train-worker:8476"
+    port = f.job(job).status.coordinator_port
+    assert env[ENV_COORDINATOR] == f"train-worker-0.train-worker:{port}"
     assert env[ENV_NUM_HOSTS] == "2"
     assert env[ENV_HOST_ID] == "1"
     assert env[ENV_HOST_COORD] == "1"
@@ -412,3 +415,20 @@ def test_pod_priority_class_empty_by_default(f):
     job = f.create_job(make_job(name="pc", replicas=1))
     f.sync(job)
     assert f.pods(job)[0].spec.priority_class == ""
+
+
+def test_per_job_coordinator_ports(f):
+    """Concurrent jobs get distinct rendezvous ports, recorded in status and
+    stable across reconciles (two gangs under one executor share loopback —
+    a single fixed port would collide on bind)."""
+    a = f.create_job(make_job(name="porta", replicas=1))
+    b = f.create_job(make_job(name="portb", replicas=1))
+    f.sync(a)
+    f.sync(b)
+    pa = f.job(a).status.coordinator_port
+    pb = f.job(b).status.coordinator_port
+    assert pa and pb and pa != pb
+    f.sync(a)
+    assert f.job(a).status.coordinator_port == pa  # stable
+    pod = f.pods(a)[0]
+    assert pod.spec.container.env["TPUJOB_COORDINATOR_ADDRESS"].endswith(f":{pa}")

@@ -166,6 +166,60 @@ def test_submit_job_example_two_process(tmp_path):
         op_log.close()
 
 
+def test_two_concurrent_jobs_one_executor():
+    """Two gangs under one LocalExecutor share a loopback interface — the
+    per-job coordinator ports (job.status.coordinator_port) keep their
+    rendezvous from colliding on bind; both jobs must succeed."""
+    import time
+
+    from mpi_operator_tpu.controller.controller import (
+        ControllerOptions,
+        TPUJobController,
+    )
+    from mpi_operator_tpu.executor import LocalExecutor
+    from mpi_operator_tpu.machinery.events import EventRecorder
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.scheduler import GangScheduler
+
+    jobs = []
+    for name in ("pi-a", "pi-b"):
+        j = load_job(os.path.join(EXAMPLES, "pi.yaml"))
+        j.metadata.name = name
+        j.spec.worker.template.container.command = [
+            "python", "examples/pi_worker.py", "20000",
+        ]
+        jobs.append(j)
+
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    scheduler = GangScheduler(store, recorder)
+    executor = LocalExecutor(store, workdir=REPO, require_binding=True)
+    for j in jobs:
+        store.create(j)
+    controller.run()
+    scheduler.start()
+    executor.start()
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            finals = [store.get("TPUJob", "default", j.metadata.name) for j in jobs]
+            assert not any(is_failed(x.status) for x in finals), [
+                x.status.conditions for x in finals
+            ]
+            if all(is_succeeded(x.status) for x in finals):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("concurrent jobs did not both succeed")
+    finally:
+        executor.stop()
+        scheduler.stop()
+        controller.stop()
+    ports = {x.status.coordinator_port for x in finals}
+    assert len(ports) == 2 and None not in ports
+
+
 def test_elastic_rescale_end_to_end(tmp_path):
     """The composed elastic loop (VERDICT r2 item 2): a live 3-worker llama
     job is rescaled to 2 by mutating spec.worker.replicas on the stored job;

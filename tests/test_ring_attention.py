@@ -89,3 +89,29 @@ def test_bfloat16_inputs(seq_mesh):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
     )
+
+
+def test_no_seq_axis_long_sequence_uses_chunked_fallback():
+    """Above DENSE_FALLBACK_MAX_T the no-ring fallback must route through
+    the memory-bounded chunked lowering and stay exact (dense is the oracle
+    only — at production lengths the [T,T] matrix is an OOM)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mpi_operator_tpu.parallel.ring_attention import (
+        DENSE_FALLBACK_MAX_T,
+        dense_attention,
+        ring_attention,
+    )
+
+    t = DENSE_FALLBACK_MAX_T + 512
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (1, t, 2, 16), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, t, 1, 16), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, t, 1, 16), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))  # no sequence axis
+    got = ring_attention(q, k, v, mesh, causal=True)
+    want = dense_attention(q, k, v, causal=True, scale=16**-0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)

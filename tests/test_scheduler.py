@@ -331,3 +331,20 @@ def test_topology_rejoin_conflict_does_not_starve_fifo():
     make_topo_gang(store, sched, "t", (2,), 2)        # later gang: must admit
     assert nodes_of(store, "t") == ["slice0/2", "slice0/3"]
     assert len(bound_pods(store, "r")) == 1           # member still pending
+
+
+def test_impossible_topology_gang_does_not_starve_fifo():
+    """A gang whose host mesh can never fit the inventory (wrong rank) is a
+    spec problem, not a capacity wait — gangs behind it must still admit."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    sched = GangScheduler(store, recorder, inventory=SliceInventory.parse("8"))
+    make_topo_gang(store, sched, "bad", (2, 2), 4)    # 2-D mesh, 1-D slices
+    assert bound_pods(store, "bad") == []
+    make_topo_gang(store, sched, "good", (2,), 2)
+    assert len(bound_pods(store, "good")) == 2
+    msgs = [
+        e.message for e in store.list("Event")
+        if e.reason == EVENT_UNSCHEDULABLE and e.involved.name == "bad-gang"
+    ]
+    assert msgs and "never fit" in msgs[-1]

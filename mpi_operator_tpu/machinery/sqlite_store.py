@@ -58,15 +58,38 @@ CREATE TABLE IF NOT EXISTS log (
     kind TEXT NOT NULL,
     data TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS watch_cursors (
+    id TEXT PRIMARY KEY,
+    last_rv INTEGER NOT NULL,
+    updated REAL NOT NULL
+);
 """
 
 
 class SqliteStore:
     """Drop-in ObjectStore over a sqlite file; safe across processes."""
 
-    def __init__(self, path: str, *, poll_interval: float = 0.05):
+    def __init__(
+        self,
+        path: str,
+        *,
+        poll_interval: float = 0.05,
+        log_retention_rows: int = 4096,
+        cursor_stale_after: float = 60.0,
+    ):
         self.path = os.path.abspath(path)
         self.poll_interval = poll_interval
+        # retention: the log table is append-only and would otherwise grow
+        # (and slow the 50ms poll scan) without bound on a busy operator.
+        # Rows are trimmed once every live watcher (this process or another
+        # one, tracked in watch_cursors) has consumed them; a cursor whose
+        # heartbeat is older than ``cursor_stale_after`` belongs to a dead
+        # process and no longer holds rows. ``log_retention_rows`` is the
+        # floor kept regardless, so brand-new watchers never race the trim.
+        self.log_retention_rows = log_retention_rows
+        self.cursor_stale_after = cursor_stale_after
+        self._cursor_id = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self._last_trim = 0.0
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(
             self.path, check_same_thread=False, timeout=30.0
@@ -253,6 +276,27 @@ class SqliteStore:
                         (self._last_seen_rv,),
                     ).fetchall()
                     watchers = list(self._watchers)
+                if (
+                    rows
+                    and self._last_seen_rv > 0
+                    and rows[0][0] > self._last_seen_rv + 1
+                ):
+                    # rvs are contiguous AUTOINCREMENT: a gap means this
+                    # poller stalled past cursor_stale_after and the rows it
+                    # needed were trimmed (≙ a kube watch 'resourceVersion
+                    # too old'). Recover by relisting: synthesize MODIFIED
+                    # for every live object so level-triggered consumers
+                    # reconverge. Boundary: DELETED events inside the gap
+                    # are unrecoverable per-watcher (no per-watcher cache to
+                    # diff) — controller reads self-heal, but an executor
+                    # could keep a process for a pod deleted during a >60s
+                    # stall.
+                    self._relist_to(watchers)
+                    # the relist already reflects these rows' effects; jump
+                    # past them (replaying would emit stale versions AFTER
+                    # the fresh relist state)
+                    self._last_seen_rv = rows[-1][0]
+                    rows = []
                 for rv, etype, kind, data in rows:
                     self._last_seen_rv = rv
                     try:
@@ -262,13 +306,72 @@ class SqliteStore:
                     for want, wq in watchers:
                         if want is None or want == kind:
                             wq.put(WatchEvent(etype, kind, obj.deepcopy()))
+                self._heartbeat_and_trim()
             except sqlite3.Error:
                 pass  # transient lock contention; retry next tick
             self._stop.wait(self.poll_interval)
+
+    def _relist_to(self, watchers) -> None:
+        """Watch-gap recovery: emit a MODIFIED event per live object (the
+        informer relist) to the given watchers."""
+        with self._lock:
+            rows = self._conn.execute("SELECT kind, data FROM objects").fetchall()
+        for kind, data in rows:
+            try:
+                obj = self._load(kind, data)
+            except Exception:
+                continue
+            for want, wq in watchers:
+                if want is None or want == kind:
+                    wq.put(WatchEvent(MODIFIED, kind, obj.deepcopy()))
+
+    # -- log retention -------------------------------------------------------
+
+    _TRIM_EVERY = 5.0  # seconds between retention passes
+
+    def _heartbeat_and_trim(self) -> None:
+        """Advertise this process's watch progress and trim log rows every
+        live watcher has consumed (see __init__ docstring)."""
+        now = time.time()
+        if now - self._last_trim < self._TRIM_EVERY:
+            return
+        self._last_trim = now
+        with self._lock, self._conn:
+            cur = self._conn.cursor()
+            cur.execute(
+                "INSERT INTO watch_cursors (id, last_rv, updated) "
+                "VALUES (?, ?, ?) ON CONFLICT(id) DO UPDATE SET "
+                "last_rv=excluded.last_rv, updated=excluded.updated",
+                (self._cursor_id, self._last_seen_rv, now),
+            )
+            live = cur.execute(
+                "SELECT MIN(last_rv) FROM watch_cursors WHERE updated > ?",
+                (now - self.cursor_stale_after,),
+            ).fetchone()[0]
+            cur.execute(
+                "DELETE FROM watch_cursors WHERE updated <= ?",
+                (now - self.cursor_stale_after,),
+            )
+            max_rv = cur.execute("SELECT MAX(rv) FROM log").fetchone()[0] or 0
+            # keep the retention floor AND anything an active watcher still
+            # needs — whichever bound is lower wins
+            horizon = max_rv - self.log_retention_rows
+            if live is not None:
+                horizon = min(horizon, live)
+            if horizon > 0:
+                cur.execute("DELETE FROM log WHERE rv <= ?", (horizon,))
 
     def close(self) -> None:
         self._stop.set()
         if self._poller is not None:
             self._poller.join(timeout=2.0)
         with self._lock:
+            try:
+                with self._conn:
+                    self._conn.execute(
+                        "DELETE FROM watch_cursors WHERE id=?",
+                        (self._cursor_id,),
+                    )
+            except sqlite3.Error:
+                pass  # closing is best-effort; stale expiry reclaims it
             self._conn.close()

@@ -143,3 +143,51 @@ def test_check_manifest_returns_normalized_form():
     assert errors == []
     assert norm["api_version"] == "tpujob.dev/v1"
     assert norm["spec"]["worker"]["replicas"] == 2
+
+
+def test_crd_artifact_in_sync():
+    """deploy/tpujob-crd.yaml is generated; drift from the dataclasses must
+    fail CI the same way tpujob-schema.json drift does."""
+    from mpi_operator_tpu.api.gen_schema import crd_manifest
+
+    with open(os.path.join(REPO, "deploy", "tpujob-crd.yaml")) as f:
+        on_disk = yaml.safe_load(f)
+    assert on_disk == crd_manifest()
+
+
+def test_crd_schema_is_structural():
+    """k8s structural-schema constraints the generator must uphold: typed
+    everywhere, no boolean additionalProperties:false."""
+    from mpi_operator_tpu.api.gen_schema import crd_manifest
+
+    def walk(node):
+        if isinstance(node, dict):
+            assert node.get("additionalProperties") is not False
+            if "properties" in node:
+                assert node.get("type") == "object"
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    version = crd_manifest()["spec"]["versions"][0]
+    walk(version["schema"]["openAPIV3Schema"])
+    assert version["subresources"] == {"status": {}}
+
+
+def test_kustomize_overlays_parse_and_target_real_objects():
+    base = os.path.join(REPO, "deploy")
+    with open(os.path.join(base, "kustomization.yaml")) as f:
+        k = yaml.safe_load(f)
+    for res in k["resources"]:
+        assert os.path.exists(os.path.join(base, res)), res
+    for overlay in ("dev", "standalone"):
+        path = os.path.join(base, "overlays", overlay, "kustomization.yaml")
+        with open(path) as f:
+            o = yaml.safe_load(f)
+        assert o["resources"] == ["../.."]
+        for patch in o.get("patches", []):
+            assert patch["target"]["kind"] == "Deployment"
+            ops = yaml.safe_load(patch["patch"])
+            assert isinstance(ops, list) and all("op" in p for p in ops)

@@ -195,3 +195,84 @@ def test_leader_election_across_store_handles(tmp_path):
     eb.stop()
     for s in (a, b):
         s.close()
+
+
+def _log_rows(store):
+    return store._conn.execute("SELECT COUNT(*) FROM log").fetchone()[0]
+
+
+def test_log_retention_trims_consumed_rows(tmp_path):
+    """The append-only log is trimmed once every live watcher has consumed
+    it (bounded store file + bounded 50ms poll scan on long-lived
+    operators); the retention floor is kept regardless."""
+    s = SqliteStore(str(tmp_path / "r.db"), poll_interval=0.01,
+                    log_retention_rows=10)
+    s._last_trim = float("inf")  # deterministic: only the manual trim below
+    q = s.watch(None)
+    for i in range(100):
+        s.create(Pod(metadata=ObjectMeta(name=f"p{i}")))
+    for _ in range(100):  # watcher must observe every event despite trims
+        q.get(timeout=5)
+    s._last_trim = 0.0
+    s._heartbeat_and_trim()
+    assert _log_rows(s) <= 11  # retention floor (+ the fencepost row)
+    s.close()
+
+
+def test_log_retention_respects_live_foreign_cursor(tmp_path):
+    """Rows an ACTIVE cursor (another process) still needs survive the trim;
+    a stale cursor (dead process) does not hold rows forever."""
+    s = SqliteStore(str(tmp_path / "f.db"), poll_interval=0.01,
+                    log_retention_rows=5, cursor_stale_after=60)
+    s._last_trim = float("inf")  # deterministic: only the manual trims below
+    q = s.watch(None)
+    for i in range(50):
+        s.create(Pod(metadata=ObjectMeta(name=f"p{i}")))
+    for _ in range(50):
+        q.get(timeout=5)
+    with s._conn:  # a live foreign process parked at rv=3
+        s._conn.execute(
+            "INSERT INTO watch_cursors (id, last_rv, updated) VALUES (?,?,?)",
+            ("foreign-live", 3, time.time()),
+        )
+    s._last_trim = 0.0
+    s._heartbeat_and_trim()
+    assert _log_rows(s) >= 47  # rows 4..50 held for the slow live watcher
+    with s._conn:  # now it dies: heartbeat goes stale
+        s._conn.execute(
+            "UPDATE watch_cursors SET updated=? WHERE id=?",
+            (time.time() - 120, "foreign-live"),
+        )
+    s._last_trim = 0.0
+    s._heartbeat_and_trim()
+    assert _log_rows(s) <= 6  # stale cursor expired; floor applies again
+    s.close()
+
+
+def test_watch_gap_triggers_relist(tmp_path):
+    """A poller that stalled past the trim horizon detects the rv gap
+    (AUTOINCREMENT is contiguous) and recovers by relisting live objects —
+    the kube 'resourceVersion too old' → relist contract, instead of
+    silently skipping lost events."""
+    s = SqliteStore(str(tmp_path / "g.db"), poll_interval=0.01)
+    s._last_trim = float("inf")
+    q = s.watch(None)
+    for i in range(3):
+        s.create(Pod(metadata=ObjectMeta(name=f"p{i}")))
+    for _ in range(3):
+        q.get(timeout=5)
+    with s._conn:  # trim everything, as if another process expired us
+        s._conn.execute("DELETE FROM log")
+    s._last_seen_rv = 1  # simulate: we were parked before the trimmed rows
+    s.create(Pod(metadata=ObjectMeta(name="p3")))
+    seen = set()
+    import queue as _q
+    deadline = time.time() + 5
+    while time.time() < deadline and len(seen) < 4:
+        try:
+            ev = q.get(timeout=0.5)
+        except _q.Empty:
+            continue
+        seen.add(ev.obj.metadata.name)
+    assert seen == {"p0", "p1", "p2", "p3"}  # relist covered the gap
+    s.close()

@@ -200,3 +200,48 @@ def test_remat_matches_no_remat(dp_mesh):
     s1, m1 = tr1.train_step(state1, batch)
     s2, m2 = tr2.train_step(state2, batch)
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+
+def test_multi_step_matches_single_steps():
+    """multi_step(n) (one lax.scan dispatch) must be step-for-step identical
+    to n train_step calls on the same batch."""
+    import jax
+    import numpy as np
+
+    from mpi_operator_tpu.models import mnist
+    from mpi_operator_tpu.ops import Trainer, TrainerConfig
+    from mpi_operator_tpu.runtime import MeshPlan, build_mesh
+
+    cfg = mnist.Config()
+    mesh = build_mesh(MeshPlan.data_parallel(8))
+    batch = {
+        "image": np.zeros((8, 28, 28, 1), np.float32),
+        "label": np.arange(8, dtype=np.int32) % 10,
+    }
+
+    def make():
+        t = Trainer(
+            lambda p, b: mnist.loss_fn(cfg, p, b),
+            mnist.logical_axes(cfg),
+            mesh,
+            TrainerConfig(learning_rate=1e-2),
+            donate=False,
+        )
+        return t, t.init_state(mnist.init(cfg, jax.random.PRNGKey(0)))
+
+    t1, s1 = make()
+    for _ in range(3):
+        s1, m1 = t1.train_step(s1, batch)
+    t2, s2 = make()
+    s2, m2 = t2.multi_step(s2, batch, 3)
+    assert int(s2.step) == 3
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m2["loss"]), rtol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        s1.params,
+        s2.params,
+    )

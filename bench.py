@@ -186,11 +186,12 @@ def llama_setup(per_chip_batch: int, seq_len: int):
 
     n_chips = jax.device_count()
     global_batch = per_chip_batch * n_chips
-    cfg = (
-        llama.bench_single_chip()
-        if jax.default_backend() == "tpu"
-        else llama.tiny()
-    )
+    if jax.default_backend() != "tpu":
+        cfg = llama.tiny()
+    elif seq_len > 8192:
+        cfg = llama.bench_long_context()  # smaller vocab: activations win
+    else:
+        cfg = llama.bench_single_chip()
     mesh = build_mesh(MeshPlan.data_parallel(n_chips))
     params = llama.init(cfg, jax.random.PRNGKey(0))
     trainer = Trainer(

@@ -1,21 +1,25 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
-Blockwise online-softmax attention: Q tiles stream against K/V tiles held in
-VMEM, the [T, T] score matrix never exists, and each (batch, head, q-tile)
-program owns one output tile. GQA-aware: the kv head for a q head is derived
-in the BlockSpec index maps (no K/V expansion in HBM).
+Blockwise online-softmax attention: every kernel streams fixed-size Q and
+K/V tiles through a 4-D grid, so VMEM use is O(block·head_dim) regardless
+of sequence length — the [T, T] score matrix never exists, no full-sequence
+array is ever VMEM-resident (the first kernel generation held whole K/V per
+program and capped out near T≈8k against the 16 MB scoped-VMEM limit), and
+T is bounded only by HBM. GQA-aware: the kv head for a q head is derived in
+the BlockSpec index maps (no K/V expansion in HBM).
 
 Layout: [B, H, T, D] (heads-major — the kernel-friendly transpose of the
-model's [B, T, H, D]; the wrapper handles it). bf16 in, f32 accumulate, bf16
-out — MXU-native.
+model's [B, T, H, D]; the wrapper handles it). bf16 operands on the MXU,
+f32 accumulation in VMEM scratch that persists across the innermost grid
+dimension; outputs are written on that dimension's final step.
 
-Backward is a pair of Pallas kernels (FlashAttention-2 style): the forward
-additionally emits the log-sum-exp rows, and the backward recomputes
-probabilities blockwise on-chip to produce dq (grid over q tiles) and
-dk/dv (grid over k tiles) — neither direction ever materializes [T,T] nor
-round-trips a score block through HBM. Profiling the Llama train step
-showed the previous recompute-through-XLA backward was the single largest
-cost: ~330 ms/step of HBM-bound score-block traffic on v5e.
+Backward is FlashAttention-2-style: the forward additionally emits the
+log-sum-exp rows, and the backward recomputes probabilities blockwise
+on-chip to produce dq (grid over q tiles × streamed K/V) and dk/dv (grid
+over k tiles × streamed Q) — neither direction round-trips a score block
+through HBM. Profiling the Llama train step showed the previous
+recompute-through-XLA backward was the single largest cost: ~330 ms/step
+of HBM-bound score-block traffic on v5e.
 
 Pallas custom calls have no SPMD partitioning rule, so on a sharded mesh the
 kernel must run under shard_map; pass ``mesh`` and the wrapper shards batch
@@ -35,61 +39,75 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-    causal: bool, scale: float, t_real: int
-):
-    """One program = one (b, h, q-tile). Refs:
-    q [1,1,BQ,D], k/v [1,1,Tpad,D], o [1,1,BQ,D], lse [1,1,BQ]. K/V are
-    pre-padded to a block_k multiple (pl.ds clamps OOB starts, so unpadded
-    tail tiles would silently re-read earlier rows); t_real masks the pad."""
-    qb = pl.program_id(2)
-    # dots run in the input dtype (bf16 in production = full MXU rate; the
-    # f32 cast would halve it) with f32 accumulation; scale folds into the
-    # f32 scores
-    q = q_ref[0, 0]  # [BQ, D]
-    bq, d = q.shape
-    t = t_real
-    n_kb = pl.cdiv(t, block_k)
+def _pad_t(x, t_pad: int):
+    """Zero-pad dim 2 (sequence) of [B,H,T,D]-like arrays up to t_pad."""
+    t = x.shape[2]
+    if t == t_pad:
+        return x
+    return jnp.pad(x, [(0, 0), (0, 0), (0, t_pad - t)] + [(0, 0)] * (x.ndim - 3))
 
-    def body(kb, carry):
-        acc, m, l = carry
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+    block_q: int, block_k: int, n_kb: int, causal: bool, scale: float,
+    t_real: int,
+):
+    """One grid step folds one (q-tile, k-tile) pair. Grid (b, h, qi, ki),
+    ki innermost: the f32 scratch (acc, m, l) carries the online softmax
+    across a q-tile's k sweep; o/lse are written on the sweep's last step.
+    Refs: q/o [1,1,BQ,D], k/v [1,1,BK,D], lse [1,1,BQ,1]."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: tiles strictly above the diagonal contribute nothing; skip
+    # their compute (their DMAs still happen — the grid is static)
+    diag_open = (
+        (ki * block_k < (qi + 1) * block_q) if causal else True
+    )
+
+    @pl.when(diag_open)
+    def _fold():
+        q = q_ref[0, 0]  # input dtype: full-rate MXU, f32 accumulate
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK] f32
-        k_idx = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
         )
-        # tail K tiles are padded past t — padded keys must not attend
-        valid = k_idx < t
+        valid = k_idx < t_real  # edge tiles read past t: mask them
         if causal:
-            q_idx = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             valid = jnp.logical_and(valid, q_idx >= k_idx)
         s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_prev * corr + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
 
-    if causal:
-        # skip key tiles strictly above the diagonal for this q tile
-        n_kb = jnp.minimum(n_kb, pl.cdiv((qb + 1) * bq, block_k))
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # log-sum-exp rows: the backward's sole softmax residual. Trailing
-    # singleton lane dim keeps the block shape TPU-lowerable ((bq, 1) —
-    # mosaic wants last-two dims (8k, 128k) or equal to the array's).
-    lse_ref[0, 0] = (m + jnp.log(l))[:, None]
+    @pl.when(ki == n_kb - 1)
+    def _emit():
+        l = l_ref[:, 0]
+        # fully-masked rows (q padding) have l == 0; emit 0, not NaN
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(safe[:, None]))
 
 
 def _flash_fwd(
@@ -103,120 +121,145 @@ def _flash_fwd(
     bq = min(block_q, t)
     bk = min(block_k, t)
     n_qb = pl.cdiv(t, bq)
-    grid = (b, h, n_qb)
+    n_kb = pl.cdiv(t, bk)
+    grid = (b, h, n_qb, n_kb)
 
-    # pad K/V up to a block multiple: pl.ds clamps OOB starts, so a partial
-    # tail tile would otherwise alias earlier rows
-    t_pad = ((t + bk - 1) // bk) * bk
-    if t_pad != t:
-        pad = [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
+    # zero-pad to block multiples: an edge tile's OOB region is otherwise
+    # undefined memory, and 0·NaN = NaN leaks through masked weights in the
+    # PV product (zero weights do NOT neutralize NaN operands). Padding is
+    # a no-op at production sizes; the score mask (t_real) keeps padded
+    # keys from attending.
+    q = _pad_t(q, n_qb * bq)
+    k = _pad_t(k, n_kb * bk)
+    v = _pad_t(v, n_kb * bk)
 
     kernel = functools.partial(
-        _fwd_kernel, block_k=bk, causal=causal, scale=scale, t_real=t
+        _fwd_kernel, block_q=bq, block_k=bk, n_kb=n_kb, causal=causal,
+        scale=scale, t_real=t,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
-            pl.BlockSpec((1, 1, t_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, n_qb * bq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, n_qb * bq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o[:, :, :t], lse
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-    block_k: int, causal: bool, scale: float, t_real: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
+    block_q: int, block_k: int, n_kb: int, causal: bool, scale: float,
+    t_real: int,
 ):
-    """dq for one (b, h, q-tile): stream K/V tiles, recompute P on-chip.
-    Refs: q/do/dq [1,1,BQ,D], k/v [1,1,Tpad,D], lse/delta [1,1,BQ,1]."""
-    qb = pl.program_id(2)
-    q = q_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
-    bq, d = q.shape
-    n_kb = pl.cdiv(t_real, block_k)
-    if causal:
-        n_kb = jnp.minimum(n_kb, pl.cdiv((qb + 1) * bq, block_k))
+    """dq: grid (b, h, qi, ki) streams K/V tiles past each q tile,
+    recomputing P on-chip from the saved LSE. Refs: q/do/dq [1,1,BQ,D],
+    k/v [1,1,BK,D], lse/delta [1,1,BQ,1]."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
 
-    def body(kb, acc):
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    diag_open = (
+        (ki * block_k < (qi + 1) * block_q) if causal else True
+    )
+
+    @pl.when(diag_open)
+    def _fold():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        k_idx = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
         )
         valid = k_idx < t_real
         if causal:
-            q_idx = qb * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
             )
             valid = jnp.logical_and(valid, q_idx >= k_idx)
-        # p rows are already normalized: lse folds in the softmax denominator
+        # p rows are already normalized: lse folds in the denominator
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = (p * (dp - delta[:, None])).astype(k.dtype)
-        return acc + jax.lax.dot_general(
+        acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    acc = jax.lax.fori_loop(0, n_kb, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = (acc * scale).astype(dq_ref.dtype)
+    @pl.when(ki == n_kb - 1)
+    def _emit():
+        dq_ref[0, 0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-    block_q: int, causal: bool, scale: float, t_real: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref, *,
+    block_q: int, block_k: int, n_qb: int, causal: bool, scale: float,
+    t_real: int,
 ):
-    """dk/dv for one (b, h, k-tile): stream Q/dO tiles, recompute P^T
-    on-chip. GQA: outputs are per *q* head; the wrapper group-sums to kv
-    heads. Refs: k/v/dk/dv [1,1,BK,D], q/do [1,1,Tqpad,D],
-    lse/delta [1,1,Tqpad,1]."""
-    kb = pl.program_id(2)
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    bk, d = k.shape
-    t_q = q_ref.shape[2]
-    n_qb = t_q // block_q
-    qb0 = (kb * bk) // block_q if causal else 0
+    """dk/dv: grid (b, h, ki, qi) streams Q/dO tiles past each k tile. GQA:
+    outputs are per *q* head; the wrapper group-sums to kv heads. Refs:
+    k/v/dk/dv [1,1,BK,D], q/do [1,1,BQ,D], lse/delta [1,1,BQ,1]."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q), 0]
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q), 0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    diag_open = (
+        ((qi + 1) * block_q > ki * block_k) if causal else True
+    )
+
+    @pl.when(diag_open)
+    def _fold():
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK]
-        q_idx = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, bk), 0
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
         )
-        k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         valid = jnp.logical_and(q_idx < t_real, k_idx < t_real)
         if causal:
             valid = jnp.logical_and(valid, q_idx >= k_idx)
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
-        dv_acc = dv_acc + jax.lax.dot_general(
+        dv_acc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -224,15 +267,14 @@ def _bwd_dkv_kernel(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = (p * (dp - delta[:, None])).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(
+        dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_acc, dv_acc
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb0, n_qb, body, (z, z))
-    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_qb - 1)
+    def _emit():
+        dk_ref[0, 0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(
@@ -247,73 +289,90 @@ def _flash_bwd(
     bq = min(block_q, t)
     bk = min(block_k, t)
     n_qb = pl.cdiv(t, bq)
-    tq_pad = n_qb * bq
-    tk_pad = pl.cdiv(t, bk) * bk
+    n_kb = pl.cdiv(t, bk)
 
     # delta_i = dO_i · O_i — the rowwise residual term of d(softmax);
-    # trailing singleton matches the lse layout
+    # trailing singleton matches the lse layout. Everything zero-padded to
+    # block multiples (see _flash_fwd: undefined OOB tile memory leaks NaN
+    # through masked products).
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, T, 1]
-    if tq_pad != t:
-        pad4 = [(0, 0), (0, 0), (0, tq_pad - t), (0, 0)]
-        delta = jnp.pad(delta, pad4)
-        q_p = jnp.pad(q, pad4)
-        do_p = jnp.pad(do, pad4)
-    else:
-        q_p, do_p = q, do
-    if tk_pad != t:
-        pad4 = [(0, 0), (0, 0), (0, tk_pad - t), (0, 0)]
-        k_p = jnp.pad(k, pad4)
-        v_p = jnp.pad(v, pad4)
-    else:
-        k_p, v_p = k, v
+    delta = _pad_t(delta, n_qb * bq)
+    q_p = _pad_t(q, n_qb * bq)
+    do_p = _pad_t(do, n_qb * bq)
+    k_p = _pad_t(k, n_kb * bk)
+    v_p = _pad_t(v, n_kb * bk)
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=bk, causal=causal, scale=scale, t_real=t
+            _bwd_dq_kernel, block_q=bq, block_k=bk, n_kb=n_kb,
+            causal=causal, scale=scale, t_real=t,
         ),
-        grid=(b, h, n_qb),
+        grid=(b, h, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
-            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi // g, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, n_qb * bq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k_p, v_p, do, lse, delta)
+    )(q_p, k_p, v_p, do_p, lse, delta)[:, :, :t]
 
-    # dk/dv per q-head (grid over k tiles); kv grads group-sum below
+    # dk/dv per q-head (grid over k tiles, q innermost); kv grads group-sum
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale, t_real=t
+            _bwd_dkv_kernel, block_q=bq, block_k=bk, n_qb=n_qb,
+            causal=causal, scale=scale, t_real=t,
         ),
-        grid=(b, h, tk_pad // bk),
+        grid=(b, h, n_kb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, tq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, tq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         ],
+        # partials in the input dtype (f32 accumulation stays in scratch):
+        # the per-q-head [B,H,T,D] pair is the backward's largest transient,
+        # and the group-sum result is cast to k.dtype regardless
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tk_pad, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, tk_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_kb * bk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, n_kb * bk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q_p, k_p, v_p, do_p, lse, delta)
 
-    dk = dk_h[:, :, :t].reshape(b, h_kv, g, t, d).sum(axis=2).astype(k.dtype)
-    dv = dv_h[:, :, :t].reshape(b, h_kv, g, t, d).sum(axis=2).astype(v.dtype)
+    dk = (
+        dk_h[:, :, :t]
+        .reshape(b, h_kv, g, t, d)
+        .astype(jnp.float32)
+        .sum(axis=2)
+        .astype(k.dtype)
+    )
+    dv = (
+        dv_h[:, :, :t]
+        .reshape(b, h_kv, g, t, d)
+        .astype(jnp.float32)
+        .sum(axis=2)
+        .astype(v.dtype)
+    )
     return dq, dk, dv
 
 
@@ -339,13 +398,13 @@ def _block_reference(q_blk, k, v, q_offset, *, causal: bool, scale: float):
 def _chunked_reference(q, k, v, *, causal: bool, scale: float, block_q: int):
     """Memory-bounded XLA attention: lax.map over checkpointed q blocks, so
     its vjp stores only block inputs and recomputes scores blockwise —
-    backward memory stays O(BQ·T) instead of [T,T]. This is the function the
-    flash kernel's custom_vjp differentiates."""
+    backward memory stays O(BQ·T) instead of [T,T]. The non-TPU fallback
+    and the independent lowering the on-chip checks compare against."""
     b, h, t, d = q.shape
     bq = min(block_q, t)
     n = -(-t // bq)
     t_pad = n * bq
-    q_p = jnp.pad(q, [(0, 0), (0, 0), (0, t_pad - t), (0, 0)]) if t_pad != t else q
+    q_p = _pad_t(q, t_pad)
     qr = q_p.reshape(b, h, n, bq, d).transpose(2, 0, 1, 3, 4)  # [n,B,H,BQ,D]
     offsets = jnp.arange(n) * bq
 
@@ -422,8 +481,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
     mesh=None,
     batch_axes=("data", "fsdp"),
@@ -437,7 +496,7 @@ def flash_attention(
     runs the real kernel on TPU and the exact chunked XLA reference on any
     other backend — never the Pallas interpreter; pass ``interpret=True``
     explicitly to exercise the kernel body off-TPU (kernel tests do).
-    Differentiable (blockwise recompute backward)."""
+    Differentiable (Pallas flash backward)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     # interpret=None means "auto": the real kernel on TPU; elsewhere the

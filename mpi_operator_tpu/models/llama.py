@@ -92,6 +92,14 @@ def bench_single_chip() -> Config:
     )
 
 
+def bench_long_context() -> Config:
+    """The bench_single_chip architecture with a 16k vocab: the embed +
+    lm_head state (params + AdamW moments + grads, ~1 GB f32) is what
+    doesn't fit next to 16k-token activations on a 16 GiB chip. Used by
+    bench.py's llama mode above 8k sequence."""
+    return dataclasses.replace(bench_single_chip(), vocab=16_384)
+
+
 def tiny(vocab: int = 256) -> Config:
     """Test-scale config with the same architecture (GQA ratio included)."""
     return Config(
@@ -178,8 +186,11 @@ def apply(
     *,
     mesh=None,
     rules=None,
+    return_features=False,
 ) -> jnp.ndarray:
-    """tokens [B,T] int32 → logits [B,T,vocab] f32.
+    """tokens [B,T] int32 → logits [B,T,vocab] f32 (or the final-norm
+    features [B,T,d_model] with ``return_features`` — the long-context
+    loss applies the lm_head blockwise instead).
 
     With a mesh that has a ``sequence`` axis, attention runs as ring
     attention over ICI; otherwise dense causal attention. All other ops are
@@ -247,6 +258,8 @@ def apply(
         )
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"]["scale"], c.norm_eps)
+    if return_features:
+        return x
     logits = x @ params["lm_head"]["w"].astype(dt)
     return logits.astype(jnp.float32)
 
@@ -258,15 +271,57 @@ def loss_fn(
     *,
     mesh=None,
     rules=None,
+    ce_chunk: int = 2048,
 ) -> jnp.ndarray:
     """Next-token cross-entropy. batch = {"tokens": [B,T]}; position t
-    predicts token t+1; the final position is dropped."""
+    predicts token t+1; the final position is dropped.
+
+    Above ``ce_chunk`` positions the loss is computed blockwise over the
+    sequence (checkpointed lax.map): the [B,T,vocab] f32 logits plus their
+    log-softmax are each >2 GB at 16k×32k-vocab — materializing them is
+    what OOMs long-context training, not the attention. Chunking keeps CE
+    memory at O(B·chunk·vocab) with exact results."""
     tokens = batch["tokens"]
-    logits = apply(config, params, tokens, mesh=mesh, rules=rules)
-    targets = tokens[:, 1:]
-    lp = jax.nn.log_softmax(logits[:, :-1])
-    ll = jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    t = tokens.shape[1]
+    if t - 1 <= ce_chunk:
+        logits = apply(config, params, tokens, mesh=mesh, rules=rules)
+        targets = tokens[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1])
+        ll = jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    feats = apply(
+        config, params, tokens, mesh=mesh, rules=rules, return_features=True
+    )  # [B, T, D] compute dtype
+    head = params["lm_head"]["w"]
+    # shift targets by roll instead of slicing feats[:-1]/tokens[1:]:
+    # keeping T intact aligns chunk boundaries with the (typically
+    # power-of-two) sequence length so no repad is needed; the final
+    # position is masked out below
+    b, t_full = tokens.shape
+    y = jnp.roll(tokens, -1, axis=1)
+    n = t_full - 1  # real prediction positions
+    n_chunks = -(-t_full // ce_chunk)
+    pad = n_chunks * ce_chunk - t_full
+    x = feats
+    if pad:
+        x = jnp.pad(x, [(0, 0), (0, pad), (0, 0)])
+        y = jnp.pad(y, [(0, 0), (0, pad)])
+    xr = x.reshape(b, n_chunks, ce_chunk, -1).transpose(1, 0, 2, 3)
+    yr = y.reshape(b, n_chunks, ce_chunk).transpose(1, 0, 2)
+    valid = (
+        jnp.arange(n_chunks * ce_chunk).reshape(n_chunks, 1, ce_chunk) < n
+    )
+
+    @jax.checkpoint
+    def chunk_nll(xc, yc, vc):
+        logits = (xc @ head.astype(xc.dtype)).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(lp, yc[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(vc, ll, 0.0))
+
+    totals = lax.map(lambda args: chunk_nll(*args), (xr, yr, valid))
+    return -jnp.sum(totals) / (b * n)
 
 
 def param_count(config: Config) -> int:

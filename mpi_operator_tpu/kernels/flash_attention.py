@@ -4,7 +4,7 @@ Blockwise online-softmax attention: every kernel streams fixed-size Q and
 K/V tiles through a 4-D grid, so VMEM use is O(block·head_dim) regardless
 of sequence length — the [T, T] score matrix never exists, no full-sequence
 array is ever VMEM-resident (the first kernel generation held whole K/V per
-program and capped out near T≈8k against the 16 MB scoped-VMEM limit), and
+program and died at T≈16k against the 16 MB scoped-VMEM limit), and
 T is bounded only by HBM. GQA-aware: the kv head for a q head is derived in
 the BlockSpec index maps (no K/V expansion in HBM).
 

@@ -106,6 +106,16 @@ def test_resnet_example_end_to_end():
     assert w1 is None or "images_per_sec" not in w1[0]
 
 
+def test_mnist_example_end_to_end():
+    """The Trainer-idiom MNIST DP config (≙ the reference's Horovod TF
+    MNIST, examples/horovod/tensorflow-mnist.yaml) through the operator."""
+    job = load_job(os.path.join(EXAMPLES, "mnist.yaml"))
+    final, logs = run_job(job, timeout=240, workdir=REPO)
+    assert _succeeded(final), final.status.conditions
+    out = logs["default/mnist-worker-0"][0]
+    assert "loss" in out and "2 hosts" in out
+
+
 def test_mnist_allreduce_example_end_to_end():
     """The MXNet-equivalent acceptance config (≙ the reference's
     examples/mxnet/mxnet_mnist.py Horovod-MXNet DP): explicit parameter

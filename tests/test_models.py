@@ -182,12 +182,6 @@ def test_llama_chunked_ce_matches_dense():
     """Long-context loss: blockwise lm_head + CE (ce_chunk) must match the
     dense path exactly in value and to bf16 accumulation noise in grads —
     at 16k×32k-vocab the dense [B,T,V] f32 logits are a >2GB OOM."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from mpi_operator_tpu.models import llama
-
     cfg = llama.tiny()
     params = llama.init(cfg, jax.random.PRNGKey(0))
     batch = {

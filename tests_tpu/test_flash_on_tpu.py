@@ -74,3 +74,18 @@ def test_uneven_tail_compiled():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         atol=3e-2, rtol=3e-2,
     )
+
+
+def test_long_context_16k_trains():
+    """The streamed kernels' raison d'être: fwd+bwd compile and run at a
+    sequence length (16k) that the VMEM-resident kernel generation could
+    not reach on this chip."""
+    t = 16384
+    q, k, v = _qkv(jax.random.PRNGKey(3), b=1, t=t, h=8, hkv=4, d=128)
+
+    def f(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, causal=True).astype(jnp.float32))
+
+    grads = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))

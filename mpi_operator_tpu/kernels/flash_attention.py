@@ -487,8 +487,12 @@ def flash_attention(
     mesh=None,
     batch_axes=("data", "fsdp"),
     head_axis: str = "tensor",
+    layout: str = "bthd",
 ):
-    """Flash attention in model layout q [B,T,H,D], k/v [B,T,Hkv,D].
+    """Flash attention in model layout q [B,T,H,D], k/v [B,T,Hkv,D] — or,
+    with ``layout="bhtd"``, directly in the kernel's heads-major layout
+    (a caller that PRODUCES q/k/v heads-major skips the [B,T,H,D]↔[B,H,T,D]
+    copies the wrapper otherwise pays on every call, ~3% of the llama step).
 
     With ``mesh``, runs under shard_map (batch over ``batch_axes``, heads
     over ``head_axis`` when divisible) — required for sharded inputs, since
@@ -497,6 +501,9 @@ def flash_attention(
     other backend — never the Pallas interpreter; pass ``interpret=True``
     explicitly to exercise the kernel body off-TPU (kernel tests do).
     Differentiable (Pallas flash backward)."""
+    if layout not in ("bthd", "bhtd"):
+        raise ValueError(f"layout={layout!r}; expected bthd|bhtd")
+    heads_major = layout == "bhtd"
     if scale is None:
         scale = q.shape[-1] ** -0.5
     # interpret=None means "auto": the real kernel on TPU; elsewhere the
@@ -508,16 +515,19 @@ def flash_attention(
         interpret = jax.default_backend() != "tpu"
 
     def local(q_, k_, v_):
-        qt = q_.transpose(0, 2, 1, 3)
-        kt = k_.transpose(0, 2, 1, 3)
-        vt = v_.transpose(0, 2, 1, 3)
+        if heads_major:
+            qt, kt, vt = q_, k_, v_
+        else:
+            qt = q_.transpose(0, 2, 1, 3)
+            kt = k_.transpose(0, 2, 1, 3)
+            vt = v_.transpose(0, 2, 1, 3)
         if use_kernel:
             o = _flash(qt, kt, vt, causal, scale, block_q, block_k, interpret)
         else:
             o = _chunked_reference(
                 qt, kt, vt, causal=causal, scale=scale, block_q=block_q
             )
-        return o.transpose(0, 2, 1, 3)
+        return o if heads_major else o.transpose(0, 2, 1, 3)
 
     if mesh is None:
         return local(q, k, v)
@@ -525,12 +535,17 @@ def flash_attention(
     from jax.sharding import PartitionSpec as P
 
     b_part = tuple(a for a in batch_axes if a in mesh.axis_names) or None
-    h, h_kv = q.shape[2], k.shape[2]
+    h_dim = 1 if heads_major else 2
+    h, h_kv = q.shape[h_dim], k.shape[h_dim]
     tp = mesh.shape.get(head_axis, 1) if head_axis in mesh.axis_names else 1
     # heads shard only when BOTH head counts divide: the GQA grouping must
     # stay aligned on every shard
     h_part = head_axis if (tp > 1 and h % tp == 0 and h_kv % tp == 0) else None
-    spec = P(b_part, None, h_part, None)
+    spec = (
+        P(b_part, h_part, None, None)
+        if heads_major
+        else P(b_part, None, h_part, None)
+    )
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, so shard_map's vma checker rejects it; the specs above are
     # the full partitioning contract anyway.

@@ -165,18 +165,32 @@ def _rmsnorm(x, scale, eps):
     return (y * scale).astype(x.dtype)
 
 
-def _rope(x, theta):
-    """x [B,T,H,Dh] with global positions 0..T-1 (arrays are global-view;
-    sequence sharding is XLA's problem, not RoPE's)."""
-    b, t, h, dh = x.shape
+def _rope_tables(t, dh, theta, dtype):
+    """cos/sin rotation tables [T, Dh/2] for global positions 0..T-1
+    (arrays are global-view; sequence sharding is XLA's problem, not
+    RoPE's). Shared by both layout variants so the math can never drift."""
     half = dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
-    cos, sin = jnp.cos(ang), jnp.sin(ang)
-    x1, x2 = x[..., :half], x[..., half:]
-    cos = cos[None, :, None, :].astype(x.dtype)
-    sin = sin[None, :, None, :].astype(x.dtype)
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def _rotate(x, cos, sin):
+    x1, x2 = x[..., : x.shape[-1] // 2], x[..., x.shape[-1] // 2 :]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _rope(x, theta):
+    """RoPE, model layout: x [B,T,H,Dh], positions along axis 1."""
+    cos, sin = _rope_tables(x.shape[1], x.shape[-1], theta, x.dtype)
+    return _rotate(x, cos[None, :, None, :], sin[None, :, None, :])
+
+
+def _rope_bhtd(x, theta):
+    """RoPE, kernel heads-major layout: x [B,H,T,Dh], positions along
+    axis 2 (same tables, different broadcast)."""
+    cos, sin = _rope_tables(x.shape[2], x.shape[-1], theta, x.dtype)
+    return _rotate(x, cos[None, None], sin[None, None])
 
 
 def apply(
@@ -210,11 +224,6 @@ def apply(
         h = carry
         y = _rmsnorm(h, lp["attn_norm"]["scale"], c.norm_eps)
         b, t, _ = y.shape
-        q = (y @ lp["wq"]["w"].astype(dt)).reshape(b, t, c.n_heads, c.head_dim)
-        k = (y @ lp["wk"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
-        v = (y @ lp["wv"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
-        q = _rope(q, c.rope_theta)
-        k = _rope(k, c.rope_theta)
         # K/V stay at n_kv_heads: every attention path is GQA-aware, so the
         # ring never carries expanded K/V
         seq_sharded = (
@@ -222,22 +231,43 @@ def apply(
             and AXIS_SEQ in mesh.axis_names
             and mesh.shape[AXIS_SEQ] > 1
         )
-        if seq_sharded:
-            # ring attention is the only exact option over a sharded sequence
-            attn = ring_attention(q, k, v, mesh, causal=True)
-        elif c.attention_impl == "dense":
-            attn = dense_attention(q, k, v, causal=True, scale=c.head_dim**-0.5)
-        else:
+        use_flash = not seq_sharded and c.attention_impl != "dense"
+        if use_flash:
             from mpi_operator_tpu.kernels import flash_attention
 
-            # auto/flash: the kernel on TPU, chunked XLA elsewhere. mesh
-            # passed through: the pallas call must run under shard_map on
-            # sharded inputs (it is not SPMD-partitionable)
+            # heads-major end to end: project straight into the kernel's
+            # [B,H,T,Dh] layout via einsum (the transpose folds into the
+            # matmul) and fold the attention output into wo the same way —
+            # no standalone [B,T,H,D]↔[B,H,T,D] copies around the kernel.
+            # auto/flash: the kernel on TPU, chunked XLA elsewhere; mesh
+            # passed through (the pallas call is not SPMD-partitionable).
+            wq3 = lp["wq"]["w"].astype(dt).reshape(-1, c.n_heads, c.head_dim)
+            wk3 = lp["wk"]["w"].astype(dt).reshape(-1, c.n_kv_heads, c.head_dim)
+            wv3 = lp["wv"]["w"].astype(dt).reshape(-1, c.n_kv_heads, c.head_dim)
+            q = _rope_bhtd(jnp.einsum("btd,dhx->bhtx", y, wq3), c.rope_theta)
+            k = _rope_bhtd(jnp.einsum("btd,dhx->bhtx", y, wk3), c.rope_theta)
+            v = jnp.einsum("btd,dhx->bhtx", y, wv3)
             attn = flash_attention(
-                q, k, v, causal=True, scale=c.head_dim**-0.5, mesh=mesh
+                q, k, v, causal=True, scale=c.head_dim**-0.5, mesh=mesh,
+                layout="bhtd",
             )
-        attn = attn.reshape(b, t, c.q_dim)
-        h = h + attn @ lp["wo"]["w"].astype(dt)
+            wo3 = lp["wo"]["w"].astype(dt).reshape(c.n_heads, c.head_dim, -1)
+            h = h + jnp.einsum("bhtx,hxd->btd", attn, wo3)
+        else:
+            q = (y @ lp["wq"]["w"].astype(dt)).reshape(b, t, c.n_heads, c.head_dim)
+            k = (y @ lp["wk"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+            v = (y @ lp["wv"]["w"].astype(dt)).reshape(b, t, c.n_kv_heads, c.head_dim)
+            q = _rope(q, c.rope_theta)
+            k = _rope(k, c.rope_theta)
+            if seq_sharded:
+                # ring attention: the only exact option over a sharded sequence
+                attn = ring_attention(q, k, v, mesh, causal=True)
+            else:
+                attn = dense_attention(
+                    q, k, v, causal=True, scale=c.head_dim**-0.5
+                )
+            attn = attn.reshape(b, t, c.q_dim)
+            h = h + attn @ lp["wo"]["w"].astype(dt)
         h = constrain(h, ["batch", "seq", "embed"])
         y = _rmsnorm(h, lp["mlp_norm"]["scale"], c.norm_eps)
         gate = jax.nn.silu(y @ lp["w_gate"]["w"].astype(dt))

@@ -114,3 +114,40 @@ def test_auto_mode_falls_back_off_tpu():
     got = flash_attention(q, k, v, causal=True)
     want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_bhtd_layout_matches_bthd():
+    # heads-major inputs skip the wrapper transposes but must be numerically
+    # identical to the model-layout path
+    q, k, v = _qkv(jax.random.PRNGKey(8), h=8, hkv=2)
+    want = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True)
+    got = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=True, block_q=32, block_k=32, interpret=True, layout="bhtd",
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.transpose(0, 2, 1, 3)), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_bhtd_layout_sharded_mesh_with_tensor_axis():
+    # the heads-major PartitionSpec puts the head axis in position 1 — a
+    # wrong spec would shard the sequence dim and break GQA numerics
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "tensor"))
+    q, k, v = _qkv(jax.random.PRNGKey(9), b=2, h=8, hkv=4)
+    want = dense_attention(q, k, v, causal=True, scale=q.shape[-1] ** -0.5)
+    got = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=True, block_q=32, block_k=32, interpret=True,
+        mesh=mesh, layout="bhtd",
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.transpose(0, 2, 1, 3)), np.asarray(want), atol=2e-5, rtol=2e-5
+    )

@@ -21,6 +21,10 @@ Config via env so one manifest scales from the CPU e2e test to a TPU slice:
   LLAMA_STEP_SLEEP  seconds of pacing between steps (default 0) — gives the
                 rescale e2e test a deterministic window to mutate replicas
                 while the tiny-config gang is still mid-training
+  LLAMA_MESH    parallelism spec, e.g. "fsdp=2" or "fsdp=4,tensor=2"
+                (default: pure DP over all chips). LLAMA_MESH_DCN adds
+                slice counts for multi-slice gangs ("data=2"). This is how
+                the manifest chooses FSDP/TP/SP without code changes.
 """
 
 import os
@@ -42,7 +46,7 @@ from mpi_operator_tpu.models import llama
 from mpi_operator_tpu.ops import Trainer, TrainerConfig
 from mpi_operator_tpu.ops.data import make_global_batch, synthetic_tokens
 from mpi_operator_tpu.ops.elastic import ElasticConfig, run_elastic
-from mpi_operator_tpu.runtime import mesh_from_context
+from mpi_operator_tpu.runtime import MeshPlan, mesh_from_context
 
 CONFIGS = {
     "tiny": llama.tiny,
@@ -53,7 +57,12 @@ CONFIGS = {
 
 def main():
     ctx = bootstrap.initialize()
-    mesh = mesh_from_context(ctx)
+    mesh_spec = os.environ.get("LLAMA_MESH", "")
+    dcn_spec = os.environ.get("LLAMA_MESH_DCN", "")
+    if dcn_spec and not mesh_spec:
+        raise SystemExit("LLAMA_MESH_DCN requires LLAMA_MESH to be set")
+    plan = MeshPlan.parse(mesh_spec, dcn_spec) if mesh_spec else None
+    mesh = mesh_from_context(ctx, plan)
 
     cfg = CONFIGS[os.environ.get("LLAMA_CONFIG", "tiny")]()
     per_chip = int(os.environ.get("LLAMA_BATCH", "2"))

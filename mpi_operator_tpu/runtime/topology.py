@@ -93,6 +93,34 @@ class MeshPlan:
     def data_parallel(n: int) -> "MeshPlan":
         return MeshPlan(axes={AXIS_DATA: n})
 
+    @staticmethod
+    def parse(spec: str, dcn: str = "") -> "MeshPlan":
+        """Parse a parallelism spec from a job manifest / env var —
+        ``"fsdp=4,tensor=2"`` (ICI axes) plus an optional DCN spec like
+        ``"data=2"`` (slice counts on leading axes). This is how a TPUJob
+        chooses non-DP parallelism without code: the worker passes the
+        parsed plan to mesh_from_context (e.g. examples/llama_worker.py's
+        LLAMA_MESH)."""
+
+        def parse_axes(s: str) -> Dict[str, int]:
+            out: Dict[str, int] = {}
+            for part in (p.strip() for p in s.split(",") if p.strip()):
+                name, _, size = part.partition("=")
+                name = name.strip()
+                if name in out:
+                    raise ValueError(f"duplicate mesh axis {name!r} in {s!r}")
+                try:
+                    out[name] = int(size)
+                except ValueError:
+                    raise ValueError(
+                        f"bad mesh spec entry {part!r}; expected axis=N"
+                    ) from None
+                if out[name] < 1:
+                    raise ValueError(f"bad mesh axis size in {part!r}")
+            return out
+
+        return MeshPlan(axes=parse_axes(spec), dcn=parse_axes(dcn))
+
 
 def _cpu_or_flat_mesh(shape: Sequence[int], devices) -> np.ndarray:
     return np.asarray(devices).reshape(tuple(shape))

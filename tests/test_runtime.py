@@ -125,3 +125,22 @@ def test_mesh_from_context_defaults_to_pure_dp():
     mesh = mesh_from_context(ctx)
     assert mesh.axis_names == (AXIS_DATA,)
     assert mesh.devices.size == jax.device_count()
+
+
+def test_mesh_plan_parse():
+    from mpi_operator_tpu.runtime.topology import MeshPlan
+
+    plan = MeshPlan.parse("fsdp=4,tensor=2")
+    assert plan.axes == {"fsdp": 4, "tensor": 2} and plan.dcn == {}
+    plan = MeshPlan.parse("data=2", dcn="data=2")
+    assert plan.dcn == {"data": 2} and plan.total_devices == 4
+    import pytest
+
+    with pytest.raises(ValueError):
+        MeshPlan.parse("fsdp=banana")
+    with pytest.raises(ValueError):
+        MeshPlan.parse("warp=2")  # not in the axis vocabulary
+    with pytest.raises(ValueError):
+        MeshPlan.parse("fsdp=0")
+    with pytest.raises(ValueError):
+        MeshPlan.parse("fsdp=2,fsdp=4")  # duplicate axis is a typo

@@ -57,8 +57,8 @@ CONFIGS = {
 
 def main():
     ctx = bootstrap.initialize()
-    mesh_spec = os.environ.get("LLAMA_MESH", "")
-    dcn_spec = os.environ.get("LLAMA_MESH_DCN", "")
+    mesh_spec = os.environ.get("LLAMA_MESH", "").strip()
+    dcn_spec = os.environ.get("LLAMA_MESH_DCN", "").strip()
     if dcn_spec and not mesh_spec:
         raise SystemExit("LLAMA_MESH_DCN requires LLAMA_MESH to be set")
     plan = MeshPlan.parse(mesh_spec, dcn_spec) if mesh_spec else None
@@ -127,6 +127,10 @@ def main():
                     "loss": loss,
                     "tokens_per_sec": round(global_batch * steps_run * seq_len / dt, 1),
                     "hosts": ctx.num_hosts,
+                    "backend": jax.default_backend(),
+                    "mesh": ",".join(
+                        f"{a}={s}" for a, s in mesh.shape.items() if s > 1
+                    ),
                 }
             ),
             flush=True,

@@ -354,6 +354,7 @@ def test_llama_fsdp_mesh_through_operator():
     assert _succeeded(final), final.status.conditions
     report = _last_report(logs["default/llama-fsdp-worker-0"][0])
     assert report["outcome"] == "done" and report["hosts"] == 2
+    assert report["mesh"] == "fsdp=2"  # the manifest's plan, not default DP
 
 
 def test_k8s_style_env_list_parses():

@@ -1,0 +1,47 @@
+"""The operator path driving a REAL TPU workload.
+
+tests/test_e2e.py proves the control plane with CPU gangs; this proves the
+missing link on hardware — a TPUJob manifest declaring a v5e slice, run
+through controller → gang scheduler → local executor, whose worker process
+trains on the actual chip (the executor only pins a CPU device count for
+cpu-family pods; a v5e pod inherits the host's real accelerator).
+≙ the reference's documented on-cluster smoke flow (`kubectl create -f
+examples/pi/pi.yaml` on a GPU cluster, examples/pi/README.md)."""
+
+import json
+import os
+
+import pytest
+
+from mpi_operator_tpu.api.conditions import is_succeeded
+from mpi_operator_tpu.opshell.runlocal import load_job, run_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpu_available() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU chip")
+def test_llama_job_trains_on_real_tpu():
+    job = load_job(os.path.join(REPO, "examples", "llama.yaml"))
+    job.metadata.name = "llama-tpu"
+    job.spec.worker.replicas = 1
+    job.spec.slice.accelerator = "v5e"
+    job.spec.slice.chips_per_host = 1  # v5e-1 sub-host slice
+    job.spec.slots_per_worker = 1
+    env = job.spec.worker.template.container.env
+    env.pop("LLAMA_CKPT", None)
+    env["LLAMA_CONFIG"] = "tiny"
+    env["LLAMA_STEPS"] = "3"
+    env["LLAMA_SEQ"] = "128"
+    final, logs = run_job(job, timeout=300, workdir=REPO)
+    assert is_succeeded(final.status), final.status.conditions
+    out, _ = logs["default/llama-tpu-worker-0"]
+    report = json.loads(out.strip().splitlines()[-1])
+    assert report["outcome"] == "done" and report["step"] == 3
+    # the worker really ran on the chip, not a CPU fallback
+    assert report["backend"] == "tpu"

@@ -137,13 +137,23 @@ def _flash_fwd(
         _fwd_kernel, block_q=bq, block_k=bk, n_kb=n_kb, causal=causal,
         scale=scale, t_real=t,
     )
+
+    # causal: a k tile strictly above the diagonal is skipped by the kernel
+    # (pl.when) — clamping its block index to the last USED tile makes the
+    # index map repeat, so pallas elides the DMA too. ~2x less K/V traffic
+    # at long T (the causally-dead half of the rectangle grid).
+    def kv_index(bi, hi, qi, ki):
+        if causal:
+            ki = jnp.minimum(ki, ((qi + 1) * bq + bk - 1) // bk - 1)
+        return (bi, hi // g, ki, 0)
+
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -304,6 +314,18 @@ def _flash_bwd(
     k_p = _pad_t(k, n_kb * bk)
     v_p = _pad_t(v, n_kb * bk)
 
+    # causally-skipped tiles: clamp the index map so the DMA is elided too
+    # (see the same trick in _flash_fwd)
+    def kv_index(bi, hi, qi, ki):
+        if causal:
+            ki = jnp.minimum(ki, ((qi + 1) * bq + bk - 1) // bk - 1)
+        return (bi, hi // g, ki, 0)
+
+    def q_index_dkv(bi, hi, ki, qi):
+        if causal:
+            qi = jnp.maximum(qi, (ki * bk) // bq)
+        return (bi, hi, qi, 0)
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_q=bq, block_k=bk, n_kb=n_kb,
@@ -312,8 +334,8 @@ def _flash_bwd(
         grid=(b, h, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, ki: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -334,12 +356,12 @@ def _flash_bwd(
         ),
         grid=(b, h, n_kb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), q_index_dkv),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi // g, ki, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), q_index_dkv),
+            pl.BlockSpec((1, 1, bq, 1), q_index_dkv),
+            pl.BlockSpec((1, 1, bq, 1), q_index_dkv),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),

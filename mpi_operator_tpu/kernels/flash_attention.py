@@ -47,6 +47,28 @@ def _pad_t(x, t_pad: int):
     return jnp.pad(x, [(0, 0), (0, 0), (0, t_pad - t)] + [(0, 0)] * (x.ndim - 3))
 
 
+# Causal tile-skip algebra, shared by the kernels' pl.when predicates and
+# the BlockSpec index-map clamps (a clamped index repeats on skipped grid
+# steps, so pallas elides the dead tiles' DMAs). The two sides MUST agree:
+# a tile is computed iff ki * bk < (qi + 1) * bq ("diag open").
+
+
+def _causal_open(qi, ki, bq: int, bk: int):
+    """True iff k tile ki intersects the causal (lower-triangular) region
+    of q tile qi — the kernels' compute-skip predicate."""
+    return ki * bk < (qi + 1) * bq
+
+
+def _causal_last_k_tile(qi, bq: int, bk: int):
+    """Largest ki with _causal_open(qi, ki): ceil((qi+1)*bq / bk) - 1."""
+    return ((qi + 1) * bq + bk - 1) // bk - 1
+
+
+def _causal_first_q_tile(ki, bq: int, bk: int):
+    """Smallest qi with _causal_open(qi, ki): (ki*bk) // bq."""
+    return (ki * bk) // bq
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     block_q: int, block_k: int, n_kb: int, causal: bool, scale: float,
@@ -66,10 +88,8 @@ def _fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # causal: tiles strictly above the diagonal contribute nothing; skip
-    # their compute (their DMAs still happen — the grid is static)
-    diag_open = (
-        (ki * block_k < (qi + 1) * block_q) if causal else True
-    )
+    # their compute (the matching index-map clamp elides their DMAs too)
+    diag_open = _causal_open(qi, ki, block_q, block_k) if causal else True
 
     @pl.when(diag_open)
     def _fold():
@@ -144,7 +164,7 @@ def _flash_fwd(
     # at long T (the causally-dead half of the rectangle grid).
     def kv_index(bi, hi, qi, ki):
         if causal:
-            ki = jnp.minimum(ki, ((qi + 1) * bq + bk - 1) // bk - 1)
+            ki = jnp.minimum(ki, _causal_last_k_tile(qi, bq, bk))
         return (bi, hi // g, ki, 0)
 
     o, lse = pl.pallas_call(
@@ -188,9 +208,7 @@ def _bwd_dq_kernel(
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    diag_open = (
-        (ki * block_k < (qi + 1) * block_q) if causal else True
-    )
+    diag_open = _causal_open(qi, ki, block_q, block_k) if causal else True
 
     @pl.when(diag_open)
     def _fold():
@@ -244,9 +262,7 @@ def _bwd_dkv_kernel(
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    diag_open = (
-        ((qi + 1) * block_q > ki * block_k) if causal else True
-    )
+    diag_open = _causal_open(qi, ki, block_q, block_k) if causal else True
 
     @pl.when(diag_open)
     def _fold():
@@ -318,12 +334,12 @@ def _flash_bwd(
     # (see the same trick in _flash_fwd)
     def kv_index(bi, hi, qi, ki):
         if causal:
-            ki = jnp.minimum(ki, ((qi + 1) * bq + bk - 1) // bk - 1)
+            ki = jnp.minimum(ki, _causal_last_k_tile(qi, bq, bk))
         return (bi, hi // g, ki, 0)
 
     def q_index_dkv(bi, hi, ki, qi):
         if causal:
-            qi = jnp.maximum(qi, (ki * bk) // bq)
+            qi = jnp.maximum(qi, _causal_first_q_tile(ki, bq, bk))
         return (bi, hi, qi, 0)
 
     dq = pl.pallas_call(

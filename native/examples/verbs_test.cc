@@ -1,0 +1,57 @@
+/* Exercises every tpucoll verb across a real gang and self-checks results.
+ *
+ * ≙ the MPI verb surface the reference stack exposes to workloads
+ * (Allreduce/Reduce/Bcast/Allgather/Barrier — SURVEY.md §5.8's capability
+ * table); prints VERBS OK on every rank iff all checks pass. Run under the
+ * gang launcher (tests/test_native.py) with the TPUJOB_* rendezvous env.
+ */
+#include <cmath>
+#include <cstdio>
+
+#include "tpucoll.h"
+
+static int fail(const char *what, int rank) {
+  fprintf(stderr, "verbs_test rank %d: %s failed\n", rank, what);
+  return 1;
+}
+
+int main() {
+  tpucoll_ctx *ctx = nullptr;
+  if (tpucoll_init(&ctx) != 0) return 1;
+  const int rank = tpucoll_rank(ctx);
+  const int size = tpucoll_size(ctx);
+
+  /* allreduce: sum of ranks, twice over (vector of 2) */
+  double ar[2] = {static_cast<double>(rank), static_cast<double>(2 * rank)};
+  if (tpucoll_allreduce_sum_f64(ctx, ar, 2) != 0) return fail("allreduce", rank);
+  const double rank_sum = size * (size - 1) / 2.0;
+  if (ar[0] != rank_sum || ar[1] != 2 * rank_sum)
+    return fail("allreduce value", rank);
+
+  /* reduce to root: only rank 0 sees the sum */
+  double rr = 1.0;
+  if (tpucoll_reduce_sum_f64(ctx, &rr, 1) != 0) return fail("reduce", rank);
+  if (rank == 0 && rr != static_cast<double>(size))
+    return fail("reduce value", rank);
+  if (rank != 0 && rr != 1.0) return fail("reduce non-root unchanged", rank);
+
+  /* broadcast: rank 0's value wins everywhere */
+  double bc = rank == 0 ? 42.5 : -1.0;
+  if (tpucoll_broadcast_f64(ctx, &bc, 1) != 0) return fail("broadcast", rank);
+  if (bc != 42.5) return fail("broadcast value", rank);
+
+  /* allgather: rank-ordered concatenation on every host */
+  double mine[2] = {static_cast<double>(rank), static_cast<double>(rank) + 0.5};
+  double all[2 * 64];
+  if (size > 64) return fail("gang too large for test buffer", rank);
+  if (tpucoll_allgather_f64(ctx, mine, 2, all) != 0)
+    return fail("allgather", rank);
+  for (int r = 0; r < size; ++r)
+    if (all[2 * r] != r || all[2 * r + 1] != r + 0.5)
+      return fail("allgather value", rank);
+
+  if (tpucoll_barrier(ctx) != 0) return fail("barrier", rank);
+  if (tpucoll_finalize(ctx) != 0) return fail("finalize", rank);
+  printf("VERBS OK rank %d/%d\n", rank, size);
+  return 0;
+}

@@ -45,6 +45,17 @@ int tpucoll_reduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n);
 /* All hosts block until every host arrives (≙ MPI_Barrier). */
 int tpucoll_barrier(tpucoll_ctx *ctx);
 
+/* Host 0's n doubles overwrite buf on every host (≙ MPI_Bcast /
+ * hvd.broadcast_global_variables — the initial-weights sync verb). */
+int tpucoll_broadcast_f64(tpucoll_ctx *ctx, double *buf, size_t n);
+
+/* Every host contributes n doubles from send; recv (capacity n * size)
+ * holds the rank-ordered concatenation on every host (≙ MPI_Allgather —
+ * the discover-hosts/metric-collection verb). send == recv is allowed only
+ * when size == 1. */
+int tpucoll_allgather_f64(tpucoll_ctx *ctx, const double *send, size_t n,
+                          double *recv);
+
 /* Collective teardown; frees ctx. */
 int tpucoll_finalize(tpucoll_ctx *ctx);
 

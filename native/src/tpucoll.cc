@@ -35,6 +35,8 @@ constexpr uint8_t kOpAllreduce = 1;
 constexpr uint8_t kOpReduceRoot = 2;
 constexpr uint8_t kOpBarrier = 3;
 constexpr uint8_t kOpFinalize = 4;
+constexpr uint8_t kOpBroadcast = 5;
+constexpr uint8_t kOpAllgather = 6;
 constexpr int kConnectTimeoutMs = 30000;
 constexpr int kConnectRetryMs = 100;
 // This library carries host-side control traffic (scalars, barriers);
@@ -130,6 +132,33 @@ void serve(tpucoll_ctx *ctx) {
       for (int r = 0; r < n; ++r) write_full(ctx->peers[r], &ack, 1);
       return;
     }
+    if (first.op == kOpBroadcast) {
+      // rank 0's payload wins; everyone receives it back
+      for (int r = 0; r < n; ++r) {
+        uint8_t ack = 1;
+        if (!write_full(ctx->peers[r], &ack, 1)) return;
+        if (first.count > 0 &&
+            !write_full(ctx->peers[r], payloads[0].data(), first.count * 8))
+          return;
+      }
+      continue;
+    }
+    if (first.op == kOpAllgather) {
+      // rank-ordered concatenation to everyone (count per rank is uniform,
+      // enforced by the mismatch check above)
+      acc.clear();
+      acc.reserve(first.count * static_cast<uint64_t>(n));
+      for (int r = 0; r < n; ++r)
+        acc.insert(acc.end(), payloads[r].begin(), payloads[r].end());
+      for (int r = 0; r < n; ++r) {
+        uint8_t ack = 1;
+        if (!write_full(ctx->peers[r], &ack, 1)) return;
+        if (!acc.empty() &&
+            !write_full(ctx->peers[r], acc.data(), acc.size() * 8))
+          return;
+      }
+      continue;
+    }
     acc.assign(first.count, 0.0);
     for (int r = 0; r < n; ++r)
       for (uint64_t i = 0; i < first.count; ++i) acc[i] += payloads[r][i];
@@ -165,18 +194,22 @@ void destroy_ctx(tpucoll_ctx *ctx) {
   delete ctx;
 }
 
-int round_trip(tpucoll_ctx *ctx, uint8_t op, double *buf, size_t n,
-               bool expect_data) {
+/* One collective round on the client side: send (op, count, payload), read
+ * the ack, and read the response into recv (recv_n doubles) when the
+ * coordinator sends one. THE single copy of the wire protocol — every verb
+ * goes through here so the framing can never fork. */
+int round_trip(tpucoll_ctx *ctx, uint8_t op, const double *send, size_t n,
+               double *recv, size_t recv_n) {
   if (ctx->size == 1) return 0;  // single host: every collective is identity
   uint64_t count = n;
   if (!write_full(ctx->sock, &op, 1) || !write_full(ctx->sock, &count, 8))
     return -EIO;
-  if (n > 0 && !write_full(ctx->sock, buf, n * 8)) return -EIO;
+  if (n > 0 && !write_full(ctx->sock, send, n * 8)) return -EIO;
   uint8_t has_data = 0;
   if (!read_full(ctx->sock, &has_data, 1)) return -EIO;
   if (has_data) {
-    if (!expect_data && has_data) return -EPROTO;
-    if (!read_full(ctx->sock, buf, n * 8)) return -EIO;
+    if (recv_n == 0) return -EPROTO;
+    if (!read_full(ctx->sock, recv, recv_n * 8)) return -EIO;
   }
   return 0;
 }
@@ -310,19 +343,34 @@ int tpucoll_rank(const tpucoll_ctx *ctx) { return ctx->rank; }
 int tpucoll_size(const tpucoll_ctx *ctx) { return ctx->size; }
 
 int tpucoll_allreduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
-  return round_trip(ctx, kOpAllreduce, buf, n, true);
+  return round_trip(ctx, kOpAllreduce, buf, n, buf, n);
 }
 
 int tpucoll_reduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
-  return round_trip(ctx, kOpReduceRoot, buf, n, ctx->rank == 0);
+  return round_trip(ctx, kOpReduceRoot, buf, n, buf,
+                    ctx->rank == 0 ? n : 0);
 }
 
 int tpucoll_barrier(tpucoll_ctx *ctx) {
-  return round_trip(ctx, kOpBarrier, nullptr, 0, false);
+  return round_trip(ctx, kOpBarrier, nullptr, 0, nullptr, 0);
+}
+
+int tpucoll_broadcast_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
+  return round_trip(ctx, kOpBroadcast, buf, n, buf, n);
+}
+
+int tpucoll_allgather_f64(tpucoll_ctx *ctx, const double *send, size_t n,
+                          double *recv) {
+  if (ctx->size == 1) {
+    if (recv != send) memcpy(recv, send, n * 8);
+    return 0;
+  }
+  return round_trip(ctx, kOpAllgather, send, n, recv,
+                    n * static_cast<size_t>(ctx->size));
 }
 
 int tpucoll_finalize(tpucoll_ctx *ctx) {
-  int rc = round_trip(ctx, kOpFinalize, nullptr, 0, false);
+  int rc = round_trip(ctx, kOpFinalize, nullptr, 0, nullptr, 0);
   if (ctx->sock >= 0) close(ctx->sock);
   if (ctx->server.joinable()) ctx->server.join();
   if (ctx->listen_fd >= 0) close(ctx->listen_fd);

@@ -42,6 +42,19 @@ def _load() -> ctypes.CDLL:
             ctypes.c_size_t,
         ]
         fn.restype = ctypes.c_int
+    lib.tpucoll_broadcast_f64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_size_t,
+    ]
+    lib.tpucoll_broadcast_f64.restype = ctypes.c_int
+    lib.tpucoll_allgather_f64.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.tpucoll_allgather_f64.restype = ctypes.c_int
     for fn in (lib.tpucoll_barrier, lib.tpucoll_finalize):
         fn.argtypes = [ctypes.c_void_p]
         fn.restype = ctypes.c_int
@@ -84,6 +97,24 @@ class HostCollectives:
         if rc != 0:
             raise RuntimeError(f"reduce failed: {rc}")
         return list(arr)
+
+    def broadcast(self, values: Sequence[float]) -> list:
+        """Host 0's values win everywhere (≙ hvd.broadcast_parameters)."""
+        arr = self._buf(values)
+        rc = self._lib.tpucoll_broadcast_f64(self._ctx, arr, len(values))
+        if rc != 0:
+            raise RuntimeError(f"broadcast failed: {rc}")
+        return list(arr)
+
+    def allgather(self, values: Sequence[float]) -> list:
+        """Rank-ordered concatenation of every host's values (uniform length
+        per host, ≙ MPI_Allgather)."""
+        arr = self._buf(values)
+        out = (ctypes.c_double * (len(values) * self.size))()
+        rc = self._lib.tpucoll_allgather_f64(self._ctx, arr, len(values), out)
+        if rc != 0:
+            raise RuntimeError(f"allgather failed: {rc}")
+        return list(out)
 
     def barrier(self) -> None:
         rc = self._lib.tpucoll_barrier(self._ctx)

@@ -208,8 +208,12 @@ int round_trip(tpucoll_ctx *ctx, uint8_t op, const double *send, size_t n,
   uint8_t has_data = 0;
   if (!read_full(ctx->sock, &has_data, 1)) return -EIO;
   if (has_data) {
-    if (recv_n == 0) return -EPROTO;
-    if (!read_full(ctx->sock, recv, recv_n * 8)) return -EIO;
+    // recv == nullptr means "this verb expects no response" (barrier,
+    // finalize, non-root reduce); a zero-length response (recv set,
+    // recv_n == 0) is legal — the coordinator acks data-bearing ops even
+    // at count 0 and just sends no payload.
+    if (recv == nullptr) return -EPROTO;
+    if (recv_n > 0 && !read_full(ctx->sock, recv, recv_n * 8)) return -EIO;
   }
   return 0;
 }
@@ -347,8 +351,10 @@ int tpucoll_allreduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
 }
 
 int tpucoll_reduce_sum_f64(tpucoll_ctx *ctx, double *buf, size_t n) {
-  return round_trip(ctx, kOpReduceRoot, buf, n, buf,
-                    ctx->rank == 0 ? n : 0);
+  // non-root expects no response at all (recv = nullptr keeps the
+  // unexpected-data guard armed)
+  return round_trip(ctx, kOpReduceRoot, buf, n,
+                    ctx->rank == 0 ? buf : nullptr, ctx->rank == 0 ? n : 0);
 }
 
 int tpucoll_barrier(tpucoll_ctx *ctx) {

@@ -12,4 +12,7 @@ with HostCollectives() as hc:
     rooted = hc.reduce_sum([r])
     if hc.rank == 0:
         print("ROOT_REDUCE", rooted[0])
+    print("BROADCAST", hc.broadcast([42.5 if hc.rank == 0 else -1.0]))
+    print("ALLGATHER", hc.allgather([r, r + 0.5]))
+    print("EMPTY", hc.allreduce_sum([]), hc.broadcast([]), hc.allgather([]))
     hc.barrier()

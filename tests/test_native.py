@@ -79,6 +79,8 @@ def test_python_binding_single_host():
         assert hc.size == 1 and hc.rank == 0
         # single-host collectives are identities
         assert hc.allreduce_sum([1.5, 2.5]) == [1.5, 2.5]
+        assert hc.broadcast([7.0]) == [7.0]
+        assert hc.allgather([1.0, 2.0]) == [1.0, 2.0]
         hc.barrier()
 
 
@@ -89,6 +91,9 @@ def test_python_binding_gang():
     # every host sees the allreduced sum 0+1+2=3 and rank-sum 3.0
     for out in outs:
         assert "ALLREDUCE [3.0, 30.0]" in out
+        assert "BROADCAST [42.5]" in out  # host 0's value won everywhere
+        assert "ALLGATHER [0.0, 0.5, 1.0, 1.5, 2.0, 2.5]" in out
+        assert "EMPTY [] [] []" in out  # zero-length collectives are legal
     assert "ROOT_REDUCE 3.0" in outs[0]
 
 

@@ -198,7 +198,12 @@ def llama_setup(per_chip_batch: int, seq_len: int):
         lambda p, b: llama.loss_fn(cfg, p, b, mesh=mesh),
         llama.logical_axes(cfg),
         mesh,
-        TrainerConfig(learning_rate=3e-4, optimizer="adamw", grad_clip_norm=1.0),
+        TrainerConfig(
+            learning_rate=3e-4,
+            optimizer="adamw",
+            grad_clip_norm=1.0,
+            adam_mu_bf16=os.environ.get("BENCH_MU_BF16", "1") != "0",
+        ),
     )
     state = trainer.init_state(params)
     batch = make_global_batch(

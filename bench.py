@@ -173,6 +173,12 @@ def _check_flash_kernel_on_chip():
     return err
 
 
+def _mu_bf16() -> bool:
+    """bf16 first-moment AdamW, the llama-bench default (BENCH_MU_BF16=0
+    opts out). Read in one place: the batch default is coupled to it."""
+    return os.environ.get("BENCH_MU_BF16", "1") != "0"
+
+
 def llama_setup(per_chip_batch: int, seq_len: int):
     """Build the llama bench workload (shared with profile_llama.py so the
     profile measures exactly the step the benchmark times). Returns
@@ -202,7 +208,7 @@ def llama_setup(per_chip_batch: int, seq_len: int):
             learning_rate=3e-4,
             optimizer="adamw",
             grad_clip_norm=1.0,
-            adam_mu_bf16=os.environ.get("BENCH_MU_BF16", "1") != "0",
+            adam_mu_bf16=_mu_bf16(),
         ),
     )
     state = trainer.init_state(params)
@@ -226,7 +232,12 @@ def bench_llama():
     on_tpu = jax.default_backend() == "tpu"
     flash_err = _check_flash_kernel_on_chip() if on_tpu else None
 
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "8"))
+    # defaults are coupled: batch 10 only fits the 16 GiB chip because bf16
+    # moments free ~1.6 GB — an f32-moment run (BENCH_MU_BF16=0) drops back
+    # to the batch-8 baseline unless BENCH_BATCH overrides
+    per_chip_batch = int(
+        os.environ.get("BENCH_BATCH", "10" if _mu_bf16() else "8")
+    )
     seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))

@@ -179,6 +179,15 @@ def _mu_bf16() -> bool:
     return os.environ.get("BENCH_MU_BF16", "1") != "0"
 
 
+def llama_per_chip_batch() -> int:
+    """BENCH_BATCH with its coupled default: batch 10 only fits the 16 GiB
+    chip because bf16 moments free ~1.6 GB — an f32-moment run
+    (BENCH_MU_BF16=0) drops back to the batch-8 baseline unless BENCH_BATCH
+    overrides. One definition, shared with profile_llama.py so the profile
+    measures exactly the step the benchmark times."""
+    return int(os.environ.get("BENCH_BATCH", "10" if _mu_bf16() else "8"))
+
+
 def llama_setup(per_chip_batch: int, seq_len: int):
     """Build the llama bench workload (shared with profile_llama.py so the
     profile measures exactly the step the benchmark times). Returns
@@ -232,12 +241,7 @@ def bench_llama():
     on_tpu = jax.default_backend() == "tpu"
     flash_err = _check_flash_kernel_on_chip() if on_tpu else None
 
-    # defaults are coupled: batch 10 only fits the 16 GiB chip because bf16
-    # moments free ~1.6 GB — an f32-moment run (BENCH_MU_BF16=0) drops back
-    # to the batch-8 baseline unless BENCH_BATCH overrides
-    per_chip_batch = int(
-        os.environ.get("BENCH_BATCH", "10" if _mu_bf16() else "8")
-    )
+    per_chip_batch = llama_per_chip_batch()
     seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))

@@ -8,11 +8,15 @@ client, poll status). Here the client talks to any store backend:
   python examples/submit_job.py sqlite:/tmp/s.db # against a shared store
                                                  # (an operator replica must
                                                  # be running on it)
+  python examples/submit_job.py http://host:8475 # against a store server
+                                                 # (multi-node: operator may
+                                                 # be on a different machine)
 
-With a sqlite path this is a true two-process deployment: the operator
-(`python -m mpi_operator_tpu.opshell --store sqlite:... --executor local`)
-reconciles in its own process; this script only creates the job and watches
-status — exactly the reference's SDK-submits-to-apiserver split.
+With a sqlite path or store-server URL this is a true multi-process
+deployment: the operator (`python -m mpi_operator_tpu.opshell --store ...
+--executor local`) reconciles in its own process; this script only creates
+the job and watches status — exactly the reference's
+SDK-submits-to-apiserver split.
 """
 
 import os
@@ -50,10 +54,12 @@ MANIFEST = {
 
 
 def main() -> int:
-    if len(sys.argv) > 1 and sys.argv[1].startswith("sqlite:"):
-        from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+    if len(sys.argv) > 1:
+        # one spec→backend dispatch for the whole framework (sqlite:PATH or
+        # http://HOST:PORT; an operator replica must be running on it)
+        from mpi_operator_tpu.opshell.__main__ import build_store
 
-        store = SqliteStore(sys.argv[1][len("sqlite:"):])
+        store = build_store(sys.argv[1])
         stack = None
     else:
         # self-contained demo: run the whole operator stack in-process

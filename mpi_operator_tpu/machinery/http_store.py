@@ -1,0 +1,573 @@
+"""HTTP store: the multi-node store backend (the etcd/apiserver seam).
+
+The deployment matrix (deploy/README.md) had one unfilled row: multi-node.
+SqliteStore is honest about its scope — one node, writers serialized by the
+file lock. The reference's multi-node story is the kube-apiserver + etcd
+pair every component talks to over the network
+(/root/reference/manifests/base/deployment.yaml; the clientsets of
+v2/pkg/client/). This module is that pair for this framework:
+
+- ``StoreServer`` wraps ANY backing store (ObjectStore for in-memory,
+  SqliteStore for durability) and serves the duck-typed store surface over
+  HTTP — the one process that owns the data, like etcd.
+- ``HttpStoreClient`` implements the *same* create/get/update/delete/list/
+  watch surface over the wire, so operator replicas, CLIs, and executors on
+  **other nodes** plug in unchanged (`--store http://host:8475`). Components
+  never see the backend — the same duck-typing contract as
+  machinery/store.py and machinery/sqlite_store.py.
+
+Watch semantics match the file-backed store: the server keeps a bounded
+in-memory event log with contiguous sequence numbers; clients long-poll
+``/v1/watch?after=N``. A client that falls behind the retention window gets
+a relist (every live object as MODIFIED) — the kube "resourceVersion too
+old" → relist contract, same recovery path as SqliteStore._relist_to.
+
+Run standalone (the etcd-equivalent process):
+
+  python -m mpi_operator_tpu.machinery.http_store \\
+      --store sqlite:/var/lib/tpujob/store.db --listen 0.0.0.0:8475
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery.serialize import decode, encode
+from mpi_operator_tpu.machinery.store import (
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    WatchEvent,
+)
+
+_ERROR_CLASSES = {
+    "NotFound": NotFound,
+    "AlreadyExists": AlreadyExists,
+    "Conflict": Conflict,
+}
+
+
+def parse_listen(spec: str) -> Tuple[str, int]:
+    """'HOST:PORT', ':PORT', '[v6]:PORT', or bare 'PORT' → (host, port).
+    Shared by every listen-address flag (--listen, --serve-store)."""
+    host, sep, port = spec.rpartition(":")
+    if not sep:
+        host, port = "", spec
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    try:
+        return host or "127.0.0.1", int(port)
+    except ValueError:
+        raise ValueError(
+            f"invalid listen address {spec!r}; expected HOST:PORT"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _RegistrationBarrier:
+    """Sentinel pushed through the drain queue at watch registration: the
+    backing store enqueues events in commit order, so once the drain thread
+    reaches the sentinel, every event committed before registration is in
+    the log and the head snapshot handed to the client excludes none of
+    them (the async drain would otherwise assign them post-snapshot seqs
+    and replay them). With a SqliteStore backing, writes from *other*
+    processes reach the backing's watch queue only at its poll cadence —
+    those may still replay within one poll interval; consumers are
+    level-triggered, so replay is benign (same argument as relist)."""
+
+    def __init__(self):
+        self.reached = threading.Event()
+
+
+class _EventLog:
+    """Bounded event log with contiguous seqs and blocking reads.
+
+    ≙ etcd's revision-indexed watch window: readers cursor by seq; a reader
+    whose cursor fell off the retained window must relist.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._cond = threading.Condition()
+        self._events: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        self._next_seq = 1
+
+    @property
+    def head(self) -> int:
+        """Seq of the newest appended event (0 if none)."""
+        with self._cond:
+            return self._next_seq - 1
+
+    def append(self, etype: str, kind: str, data: Dict[str, Any]) -> None:
+        with self._cond:
+            self._events.append((self._next_seq, etype, kind, data))
+            self._next_seq += 1
+            if len(self._events) > self.capacity:
+                del self._events[: len(self._events) - self.capacity]
+            self._cond.notify_all()
+
+    def read_after(
+        self, after: int, timeout: float
+    ) -> Tuple[Optional[List[Tuple[int, str, str, Dict[str, Any]]]], int]:
+        """Events with seq > after, blocking up to ``timeout`` for the first.
+
+        Returns (events, head). events is None when ``after`` predates the
+        retained window (caller must relist).
+        """
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                head = self._next_seq - 1
+                if after > head:
+                    # cursor from a previous server incarnation (the seq
+                    # space reset on restart): the client can't know what it
+                    # missed → relist
+                    return None, head
+                oldest_retained = self._next_seq - len(self._events)
+                if after + 1 < oldest_retained and after < head:
+                    return None, head  # gap: relist required
+                out = [e for e in self._events if e[0] > after]
+                if out:
+                    return out, self._next_seq - 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], self._next_seq - 1
+                self._cond.wait(remaining)
+
+
+class StoreServer:
+    """Serves a backing store's surface over HTTP (the etcd-equivalent)."""
+
+    def __init__(self, backing: Any, host: str = "127.0.0.1", port: int = 0,
+                 *, log_capacity: int = 4096):
+        self.backing = backing
+        # the seq space is per-incarnation; clients echo this id so a
+        # restarted server (fresh seqs) can't be confused with the old one
+        # even after the new log catches up past a stale cursor
+        self.instance = uuid.uuid4().hex
+        self._log = _EventLog(capacity=log_capacity)
+        self._watch_q = backing.watch(None)
+        self._drain = threading.Thread(
+            target=self._drain_loop, name="http-store-drain", daemon=True
+        )
+        self._stop = threading.Event()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _dispatch(self, method: str) -> None:
+                try:
+                    code, payload = server._handle(
+                        method, self.path, self._body() if method in ("POST", "PUT") else {}
+                    )
+                    self._send(code, payload)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # surface, don't kill the thread
+                    try:
+                        self._send(500, {"error": "Internal", "message": str(e)})
+                    except Exception:
+                        pass
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._serve = threading.Thread(
+            target=self._httpd.serve_forever, name="http-store-serve", daemon=True
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "StoreServer":
+        self._drain.start()
+        self._serve.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.backing.stop_watch(self._watch_q)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if isinstance(ev, _RegistrationBarrier):
+                ev.reached.set()
+                continue
+            self._log.append(ev.type, ev.kind, encode(ev.obj))
+
+    # -- request handling ---------------------------------------------------
+
+    def _handle(
+        self, method: str, path: str, body: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        parsed = urllib.parse.urlparse(path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        try:
+            if parts == ["healthz"]:
+                return 200, {"ok": True}
+            if parts == ["v1", "watch"] and method == "GET":
+                return self._handle_watch(qs)
+            if parts[:2] == ["v1", "objects"]:
+                return self._handle_objects(method, parts[2:], qs, body)
+            return 404, {"error": "NotFound", "message": f"no route {parsed.path}"}
+        except NotFound as e:
+            return 404, {"error": "NotFound", "message": str(e)}
+        except AlreadyExists as e:
+            return 409, {"error": "AlreadyExists", "message": str(e)}
+        except Conflict as e:
+            return 409, {"error": "Conflict", "message": str(e)}
+        except KeyError as e:  # unknown kind from serialize registry
+            return 400, {"error": "BadRequest", "message": str(e)}
+
+    def _handle_objects(
+        self,
+        method: str,
+        rest: List[str],
+        qs: Dict[str, List[str]],
+        body: Dict[str, Any],
+    ) -> Tuple[int, Dict[str, Any]]:
+        if method == "POST" and not rest:
+            obj = decode(body["kind"], body["object"])
+            created = self.backing.create(obj)
+            return 200, {"object": encode(created)}
+        if method == "GET" and len(rest) == 1:
+            kind = rest[0]
+            namespace = qs.get("namespace", [None])[0]
+            selector = None
+            if "selector" in qs:
+                selector = dict(
+                    pair.split("=", 1) for pair in qs["selector"][0].split(",") if pair
+                )
+            objs = self.backing.list(kind, namespace, selector)
+            return 200, {"objects": [encode(o) for o in objs]}
+        if len(rest) == 3:
+            kind, namespace, name = rest
+            if method == "GET":
+                return 200, {"object": encode(self.backing.get(kind, namespace, name))}
+            if method == "PUT":
+                obj = decode(kind, body["object"])
+                force = qs.get("force", ["0"])[0] == "1"
+                return 200, {"object": encode(self.backing.update(obj, force=force))}
+            if method == "DELETE":
+                return 200, {"object": encode(self.backing.delete(kind, namespace, name))}
+        return 404, {"error": "NotFound", "message": "bad objects route"}
+
+    def _handle_watch(self, qs: Dict[str, List[str]]) -> Tuple[int, Dict[str, Any]]:
+        after = int(qs.get("after", ["-1"])[0])
+        timeout = min(float(qs.get("timeout", ["25"])[0]), 55.0)
+        client_instance = qs.get("instance", [self.instance])[0]
+        if after < 0:
+            # registration: hand the current head so the client sees only
+            # post-registration events (ObjectStore watch semantics); the
+            # barrier makes sure already-committed events are in the log
+            # before the head is read (see _RegistrationBarrier)
+            barrier = _RegistrationBarrier()
+            self._watch_q.put(barrier)
+            barrier.reached.wait(timeout=5.0)
+            return 200, {
+                "events": [], "next": self._log.head,
+                "instance": self.instance,
+            }
+        if client_instance != self.instance:
+            # cursor from a previous incarnation: its seqs mean nothing in
+            # this log (even if numerically <= head) → relist
+            return 200, self._relist_payload()
+        events, head = self._log.read_after(after, timeout)
+        if events is None:
+            # cursor fell off the window → relist (kube 'rv too old')
+            return 200, self._relist_payload()
+        return 200, {
+            "events": [
+                {"seq": s, "type": t, "kind": k, "object": d}
+                for (s, t, k, d) in events
+            ],
+            "next": head,
+            "instance": self.instance,
+        }
+
+    def _relist_payload(self) -> Dict[str, Any]:
+        # capture the cursor BEFORE listing: an event appended during the
+        # list then replays after the relist (benign for level-triggered
+        # consumers) instead of being skipped (lost update) — the same
+        # ordering SqliteStore._poll_loop uses for its gap recovery
+        head = self._log.head
+        objs = []
+        for kind in _all_kinds():
+            objs.extend(encode(o) for o in self.backing.list(kind))
+        return {"relist": objs, "next": head, "instance": self.instance}
+
+
+def _all_kinds() -> List[str]:
+    from mpi_operator_tpu.machinery.serialize import KIND_CLASSES
+
+    return list(KIND_CLASSES)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class HttpStoreClient:
+    """Drop-in store over the network; same duck-typed surface.
+
+    One background long-poll thread serves every local watcher (the same
+    single-poller pattern as SqliteStore). ≙ the generated clientset +
+    shared informer factory pair of the reference
+    (v2/pkg/client/, mpi_job_controller.go:300-339).
+    """
+
+    def __init__(self, url: str, *, timeout: float = 10.0,
+                 watch_poll_timeout: float = 25.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.watch_poll_timeout = watch_poll_timeout
+        self._lock = threading.RLock()
+        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        self._poller: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- transport ----------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                pass
+            cls = _ERROR_CLASSES.get(payload.get("error", ""))
+            if cls is not None:
+                raise cls(payload.get("message", str(e))) from None
+            raise
+
+    # -- CRUD (same contracts as ObjectStore) -------------------------------
+
+    def create(self, obj: Any) -> Any:
+        r = self._request(
+            "POST", "/v1/objects", {"kind": obj.kind, "object": encode(obj)}
+        )
+        return decode(obj.kind, r["object"])
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        r = self._request("GET", f"/v1/objects/{kind}/{namespace}/{name}")
+        return decode(kind, r["object"])
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def update(self, obj: Any, force: bool = False) -> Any:
+        m = obj.metadata
+        r = self._request(
+            "PUT",
+            f"/v1/objects/{obj.kind}/{m.namespace}/{m.name}"
+            + ("?force=1" if force else ""),
+            {"object": encode(obj)},
+        )
+        return decode(obj.kind, r["object"])
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        r = self._request("DELETE", f"/v1/objects/{kind}/{namespace}/{name}")
+        return decode(kind, r["object"])
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        qs = {}
+        if namespace is not None:
+            qs["namespace"] = namespace
+        if selector:
+            qs["selector"] = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+        path = f"/v1/objects/{kind}"
+        if qs:
+            path += "?" + urllib.parse.urlencode(qs)
+        r = self._request("GET", path)
+        return [decode(kind, d) for d in r["objects"]]
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            if self._poller is None:
+                # register with the server BEFORE adding the local queue: if
+                # the request fails, the caller retries with nothing leaked
+                # (an early-appended queue would collect events forever)
+                r = self._request("GET", "/v1/watch?after=-1")
+                self._cursor = r["next"]
+                self._instance = r.get("instance", "")
+                self._poller = threading.Thread(
+                    target=self._poll_loop, name="http-store-watch", daemon=True
+                )
+                self._poller.start()
+            self._watchers.append((kind, q))
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                r = self._request(
+                    "GET",
+                    f"/v1/watch?after={self._cursor}"
+                    f"&timeout={self.watch_poll_timeout}"
+                    f"&instance={self._instance}",
+                    timeout=self.watch_poll_timeout + self.timeout,
+                )
+            except Exception:
+                # server briefly unreachable (restart, network): informer
+                # backoff-and-retry, cursor preserved; the echoed instance
+                # id makes the restarted server relist us regardless of
+                # where its new seq space has advanced to
+                if self._stop.wait(0.5):
+                    return
+                continue
+            self._instance = r.get("instance", self._instance)
+            with self._lock:
+                watchers = list(self._watchers)
+            if "relist" in r:
+                for d in r["relist"]:
+                    self._fan_out(watchers, MODIFIED, d)
+                self._cursor = r["next"]
+                continue
+            for ev in r["events"]:
+                self._cursor = ev["seq"]
+                self._fan_out(watchers, ev["type"], ev["object"], ev["kind"])
+
+    @staticmethod
+    def _fan_out(watchers, etype: str, data: Dict[str, Any],
+                 kind: Optional[str] = None) -> None:
+        kind = kind or data.get("kind")
+        try:
+            obj = decode(kind, data)
+        except KeyError:
+            return  # kind from a newer server version
+        for want, wq in watchers:
+            if want is None or want == kind:
+                wq.put(WatchEvent(etype, kind, obj.deepcopy()))
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# standalone entry point (the etcd-equivalent process)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-store", description="Serve a TPUJob object store over HTTP."
+    )
+    ap.add_argument("--store", default="memory",
+                    help="'memory' or 'sqlite:PATH' backing store")
+    ap.add_argument("--listen", default="127.0.0.1:8475",
+                    help="host:port to bind")
+    args = ap.parse_args(argv)
+    from mpi_operator_tpu.opshell.__main__ import build_store
+
+    backing = build_store(args.store)
+    try:
+        host, port = parse_listen(args.listen)
+    except ValueError as e:
+        raise SystemExit(f"error: --listen: {e}")
+    server = StoreServer(backing, host, port).start()
+    print(f"store serving on {server.url}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -22,7 +22,11 @@ from jax import lax
 
 Params = Dict[str, Any]
 
-STAGE_BLOCKS = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3)}
+STAGE_BLOCKS = {
+    "resnet26": (2, 2, 2, 2),  # test-scale: same bottleneck topology
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -49,8 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "meshes, one per physical slice (e.g. '4x4,4x4'); "
                          "gangs admit only into contiguous free blocks")
     ap.add_argument("--store", default="memory",
-                    help="'memory' (in-process) or 'sqlite:PATH' "
-                         "(shared across processes/replicas)")
+                    help="'memory' (in-process), 'sqlite:PATH' (shared "
+                         "across processes on one node), or 'http://HOST:PORT' "
+                         "(a store server — shared across nodes)")
+    ap.add_argument("--serve-store", default=None, metavar="HOST:PORT",
+                    help="additionally serve this operator's backing store "
+                         "over HTTP so other nodes can use --store http://...")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
@@ -64,6 +68,10 @@ def build_store(spec: str):
         from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
 
         return SqliteStore(spec[len("sqlite:"):])
+    if spec.startswith("http://") or spec.startswith("https://"):
+        from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+
+        return HttpStoreClient(spec)
     raise SystemExit(f"error: unknown --store {spec!r}")
 
 
@@ -79,6 +87,25 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     store = build_store(args.store)
+    store_server = None
+    if args.serve_store:
+        from mpi_operator_tpu.machinery.http_store import (
+            HttpStoreClient,
+            StoreServer,
+            parse_listen,
+        )
+
+        if isinstance(store, HttpStoreClient):
+            print("error: --serve-store cannot re-serve a remote --store http://",
+                  file=sys.stderr)
+            return 2
+        try:
+            host, port = parse_listen(args.serve_store)
+        except ValueError as e:
+            print(f"error: --serve-store: {e}", file=sys.stderr)
+            return 2
+        store_server = StoreServer(store, host, port).start()
+        logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)
     controller = TPUJobController(
         store,
@@ -173,6 +200,8 @@ def main(argv=None) -> int:
     t = threading.Thread(target=elector.run, daemon=True)
     t.start()
     stop.wait()
+    if store_server is not None:
+        store_server.stop()
     ops.stop()
     return 0
 

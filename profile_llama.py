@@ -19,13 +19,11 @@ import json
 
 import jax
 
-from bench import _mu_bf16, llama_setup
+from bench import llama_per_chip_batch, llama_setup
 
 
 def main():
-    # same coupled default as bench.py so the profile measures the exact
-    # step the benchmark times
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "10" if _mu_bf16() else "8"))
+    per_chip_batch = llama_per_chip_batch()
     seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
     _, trainer, state, batch, _ = llama_setup(per_chip_batch, seq_len)
 

@@ -94,7 +94,7 @@ def test_resnet_example_end_to_end():
     examples/resnet.yaml as-written (tiny 2-host CPU gang) and assert the
     coordinator reports throughput."""
     job = load_job(os.path.join(EXAMPLES, "resnet.yaml"))
-    final, logs = run_job(job, timeout=240, workdir=REPO)
+    final, logs = run_job(job, timeout=360, workdir=REPO)
     assert _succeeded(final), final.status.conditions
     report = _last_report(logs["default/resnet-worker-0"][0])
     assert report["hosts"] == 2

@@ -161,10 +161,6 @@ class StoreServer:
         # even after the new log catches up past a stale cursor
         self.instance = uuid.uuid4().hex
         self._log = _EventLog(capacity=log_capacity)
-        self._watch_q = backing.watch(None)
-        self._drain = threading.Thread(
-            target=self._drain_loop, name="http-store-drain", daemon=True
-        )
         self._stop = threading.Event()
         server = self
 
@@ -212,9 +208,16 @@ class StoreServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
+        # bind first — it is the only fallible step; registering the backing
+        # watch before a failed bind would leak a never-drained queue that
+        # the backing store fills forever (retry-on-EADDRINUSE loops)
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
+        self._watch_q = backing.watch(None)
+        self._drain = threading.Thread(
+            target=self._drain_loop, name="http-store-drain", daemon=True
+        )
         self._serve = threading.Thread(
             target=self._httpd.serve_forever, name="http-store-serve", daemon=True
         )
@@ -288,9 +291,9 @@ class StoreServer:
             namespace = qs.get("namespace", [None])[0]
             selector = None
             if "selector" in qs:
-                selector = dict(
-                    pair.split("=", 1) for pair in qs["selector"][0].split(",") if pair
-                )
+                # JSON on the wire: label values may contain ','/'=' and the
+                # duck-typed list() contract must match the other backends
+                selector = json.loads(qs["selector"][0])
             objs = self.backing.list(kind, namespace, selector)
             return 200, {"objects": [encode(o) for o in objs]}
         if len(rest) == 3:
@@ -458,7 +461,7 @@ class HttpStoreClient:
         if namespace is not None:
             qs["namespace"] = namespace
         if selector:
-            qs["selector"] = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+            qs["selector"] = json.dumps(selector, sort_keys=True)
         path = f"/v1/objects/{kind}"
         if qs:
             path += "?" + urllib.parse.urlencode(qs)
@@ -506,17 +509,24 @@ class HttpStoreClient:
                 if self._stop.wait(0.5):
                     return
                 continue
-            self._instance = r.get("instance", self._instance)
-            with self._lock:
-                watchers = list(self._watchers)
-            if "relist" in r:
-                for d in r["relist"]:
-                    self._fan_out(watchers, MODIFIED, d)
-                self._cursor = r["next"]
-                continue
-            for ev in r["events"]:
-                self._cursor = ev["seq"]
-                self._fan_out(watchers, ev["type"], ev["object"], ev["kind"])
+            try:
+                self._instance = r.get("instance", self._instance)
+                with self._lock:
+                    watchers = list(self._watchers)
+                if "relist" in r:
+                    for d in r["relist"]:
+                        self._fan_out(watchers, MODIFIED, d)
+                    self._cursor = r["next"]
+                    continue
+                for ev in r["events"]:
+                    self._cursor = ev["seq"]
+                    self._fan_out(watchers, ev["type"], ev["object"], ev["kind"])
+            except Exception:
+                # malformed response (proxy interposing, version skew): a
+                # dead poll thread would silently stall every watcher
+                # forever — back off and retry instead, same as unreachable
+                if self._stop.wait(0.5):
+                    return
 
     @staticmethod
     def _fan_out(watchers, etype: str, data: Dict[str, Any],

@@ -102,6 +102,10 @@ def test_label_selector_list(client):
     assert len(client.list("Pod", "default", selector={"job": "x"})) == 2
     assert len(client.list("Pod")) == 3
     assert client.list("Pod", namespace="elsewhere") == []
+    # values with ','/'=' must filter identically to the other backends
+    client.create(Pod(metadata=ObjectMeta(name="odd", labels={"note": "a,b=c"})))
+    got = client.list("Pod", "default", selector={"note": "a,b=c"})
+    assert [p.metadata.name for p in got] == ["odd"]
 
 
 def test_two_clients_share_state_and_watches(server):

@@ -182,12 +182,49 @@ def test_kustomize_overlays_parse_and_target_real_objects():
         k = yaml.safe_load(f)
     for res in k["resources"]:
         assert os.path.exists(os.path.join(base, res)), res
-    for overlay in ("dev", "standalone"):
+    for overlay in ("dev", "standalone", "cluster"):
         path = os.path.join(base, "overlays", overlay, "kustomization.yaml")
         with open(path) as f:
             o = yaml.safe_load(f)
-        assert o["resources"] == ["../.."]
+        assert o["resources"][0] == "../.."
+        for extra in o["resources"][1:]:  # overlay-local resource files
+            assert os.path.exists(
+                os.path.join(base, "overlays", overlay, extra)
+            ), extra
         for patch in o.get("patches", []):
-            assert patch["target"]["kind"] == "Deployment"
+            assert patch["target"]["kind"] in (
+                "Deployment", "PersistentVolumeClaim",
+            )
             ops = yaml.safe_load(patch["patch"])
-            assert isinstance(ops, list) and all("op" in p for p in ops)
+            if isinstance(ops, dict):  # strategic-merge (e.g. $patch: delete)
+                assert ops.get("$patch") == "delete"
+            else:
+                assert isinstance(ops, list) and all("op" in p for p in ops)
+
+
+def test_cluster_overlay_store_wiring_is_coherent():
+    """The cluster overlay's store server, its Service, and the operator's
+    --store URL must agree on name and port (a drifted port would deploy an
+    operator that can never reach its store)."""
+    base = os.path.join(REPO, "deploy", "overlays", "cluster")
+    with open(os.path.join(base, "store.yaml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    by_kind = {d["kind"]: d for d in docs}
+    dep, svc = by_kind["Deployment"], by_kind["Service"]
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    listen = [a for a in container["args"] if a.startswith("--listen=")][0]
+    listen_port = int(listen.rsplit(":", 1)[1])
+    assert svc["spec"]["ports"][0]["targetPort"] == listen_port
+    svc_port = svc["spec"]["ports"][0]["port"]
+    with open(os.path.join(base, "kustomization.yaml")) as f:
+        k = yaml.safe_load(f)
+    dep_patch = [p for p in k["patches"]
+                 if p["target"]["kind"] == "Deployment"][0]
+    ops = yaml.safe_load(dep_patch["patch"])
+    store_url = [p["value"] for p in ops
+                 if p["op"] == "replace" and p["value"].startswith("--store=")][0]
+    assert store_url == f"--store=http://{svc['metadata']['name']}:{svc_port}"
+    # the PVC the base mounts is deleted; the store's own PVC exists
+    assert by_kind["PersistentVolumeClaim"]["spec"]["accessModes"] == [
+        "ReadWriteOnce"
+    ]

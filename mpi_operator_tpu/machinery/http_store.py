@@ -293,7 +293,14 @@ class StoreServer:
             if "selector" in qs:
                 # JSON on the wire: label values may contain ','/'=' and the
                 # duck-typed list() contract must match the other backends
-                selector = json.loads(qs["selector"][0])
+                try:
+                    selector = json.loads(qs["selector"][0])
+                except json.JSONDecodeError:
+                    return 400, {
+                        "error": "BadRequest",
+                        "message": "selector must be a JSON object "
+                                   "(version-skewed client?)",
+                    }
             objs = self.backing.list(kind, namespace, selector)
             return 200, {"objects": [encode(o) for o in objs]}
         if len(rest) == 3:
@@ -510,13 +517,17 @@ class HttpStoreClient:
                     return
                 continue
             try:
-                self._instance = r.get("instance", self._instance)
                 with self._lock:
                     watchers = list(self._watchers)
                 if "relist" in r:
                     for d in r["relist"]:
                         self._fan_out(watchers, MODIFIED, d)
+                    # cursor and instance move together, only after the
+                    # relist fully lands: adopting the new instance id with
+                    # the old cursor would satisfy the server's instance
+                    # check and silently skip everything before the cursor
                     self._cursor = r["next"]
+                    self._instance = r.get("instance", self._instance)
                     continue
                 for ev in r["events"]:
                     self._cursor = ev["seq"]
@@ -534,8 +545,9 @@ class HttpStoreClient:
         kind = kind or data.get("kind")
         try:
             obj = decode(kind, data)
-        except KeyError:
-            return  # kind from a newer server version
+        except Exception:
+            return  # unknown kind / skewed shape from a newer server —
+            # skip the object rather than abort the whole batch
         for want, wq in watchers:
             if want is None or want == kind:
                 wq.put(WatchEvent(etype, kind, obj.deepcopy()))

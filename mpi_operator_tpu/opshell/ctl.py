@@ -1,0 +1,292 @@
+"""tpujobctl: the kubectl-equivalent operations CLI.
+
+The reference's entire day-2 surface is kubectl against the CRD —
+`kubectl create -f pi.yaml`, `kubectl get mpijobs`, `kubectl describe
+mpijob pi`, `kubectl delete mpijob pi` (/root/reference/examples/pi/
+README.md; the Events section of describe is the audit log the controller
+writes, SURVEY.md §5.5). This framework owns its store, so it ships the
+equivalent verbs against any backend:
+
+  python -m mpi_operator_tpu.opshell.ctl --store sqlite:/var/lib/tpujob/store.db get
+  python -m mpi_operator_tpu.opshell.ctl --store http://store:8475 create -f job.yaml
+  python -m mpi_operator_tpu.opshell.ctl --store ... describe myjob
+  python -m mpi_operator_tpu.opshell.ctl --store ... watch myjob
+
+Verbs: create (strict-schema admission), get (table or -o json), describe
+(spec summary + per-replica status + pods + the Event audit trail), delete,
+events, watch (stream condition transitions until the job finishes).
+Worker logs are intentionally NOT a verb here: stdout/stderr live with the
+executor that ran the pods (executor/local.py keeps them in-process; a real
+cluster keeps them on the node) — the describe output names the pods to
+look up. Pods' spec.node_name says where.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, List, Optional
+
+from mpi_operator_tpu.api.client import TPUJobClient, ValidationRejected
+from mpi_operator_tpu.api.conditions import (
+    is_failed,
+    is_finished,
+    is_succeeded,
+)
+from mpi_operator_tpu.api.schema import ManifestError
+from mpi_operator_tpu.machinery.store import AlreadyExists, NotFound
+
+
+def job_state(job: Any) -> str:
+    """One-word state column, precedence mirroring the condition machine
+    (api/conditions.py; ≙ the STATE kubectl prints from status)."""
+    s = job.status
+    if is_succeeded(s):
+        return "Succeeded"
+    if is_failed(s):
+        return "Failed"
+    for cond in s.conditions:
+        if cond.type == "Restarting" and cond.status:
+            return "Restarting"
+        if cond.type == "Suspended" and cond.status:
+            return "Suspended"
+    for cond in s.conditions:
+        if cond.type == "Running" and cond.status:
+            return "Running"
+    if s.conditions:
+        return "Created"
+    return "Pending"
+
+
+def _age(ts: Optional[float]) -> str:
+    if not ts:
+        return "-"
+    d = max(0, int(time.time() - ts))
+    if d < 120:
+        return f"{d}s"
+    if d < 7200:
+        return f"{d // 60}m"
+    return f"{d // 3600}h"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*header)]
+    out += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(out)
+
+
+def cmd_create(client: TPUJobClient, args) -> int:
+    import yaml
+
+    try:
+        with open(args.filename) as f:
+            doc = yaml.safe_load(f)
+    except (OSError, yaml.YAMLError) as e:
+        print(f"error: {args.filename}: {e}", file=sys.stderr)
+        return 1
+    try:
+        job = client.create(doc)
+    except (ManifestError, ValidationRejected, AlreadyExists) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"tpujob.tpujob.dev/{job.metadata.name} created")
+    return 0
+
+
+def cmd_get(client: TPUJobClient, args) -> int:
+    if args.name:
+        try:
+            jobs = [client.get(args.name)]
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    else:
+        jobs = client.list()
+    if args.output == "json":
+        docs = [j.to_dict() for j in jobs]
+        print(json.dumps(docs[0] if args.name else docs, indent=2))
+        return 0
+    if not jobs:
+        print("No tpujobs found.")
+        return 0
+    rows = [
+        [
+            j.metadata.name,
+            j.spec.worker.replicas if j.spec.worker else 0,
+            job_state(j),
+            _age(j.metadata.creation_timestamp),
+        ]
+        for j in jobs
+    ]
+    print(_table(rows, ["NAME", "WORKERS", "STATE", "AGE"]))
+    return 0
+
+
+def cmd_delete(client: TPUJobClient, args) -> int:
+    try:
+        client.delete(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"tpujob.tpujob.dev/{args.name} deleted")
+    return 0
+
+
+def _job_events(store, job) -> List[Any]:
+    evs = [
+        e
+        for e in store.list("Event", job.metadata.namespace)
+        if e.involved.kind == "TPUJob" and e.involved.name == job.metadata.name
+    ]
+    evs.sort(key=lambda e: e.timestamp)
+    return evs
+
+
+def cmd_events(client: TPUJobClient, args) -> int:
+    try:
+        job = client.get(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    evs = _job_events(client.store, job)
+    if not evs:
+        print("No events.")
+        return 0
+    rows = [[_age(e.timestamp), e.type, e.reason, e.message] for e in evs]
+    print(_table(rows, ["AGE", "TYPE", "REASON", "MESSAGE"]))
+    return 0
+
+
+def cmd_describe(client: TPUJobClient, args) -> int:
+    try:
+        job = client.get(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    m, s = job.metadata, job.spec
+    lines = [
+        f"Name:       {m.name}",
+        f"Namespace:  {m.namespace}",
+        f"UID:        {m.uid}",
+        f"Created:    {_age(m.creation_timestamp)} ago",
+        f"State:      {job_state(job)}",
+    ]
+    if s.slice:
+        topo = f", topology {s.slice.topology}" if s.slice.topology else ""
+        lines.append(
+            f"Slice:      {s.slice.accelerator}"
+            f" x{s.slice.chips_per_host}/host{topo}"
+        )
+    if s.worker:
+        lines.append(f"Workers:    {s.worker.replicas}")
+    for rtype, rs in sorted(job.status.replica_statuses.items()):
+        lines.append(
+            f"Replicas[{rtype}]: active={rs.active} "
+            f"succeeded={rs.succeeded} failed={rs.failed}"
+        )
+    lines.append("Conditions:")
+    for c in job.status.conditions:
+        lines.append(
+            f"  {c.type:<12} {str(bool(c.status)):<6} {c.reason} — {c.message}"
+        )
+    pods = client.store.list(
+        "Pod", m.namespace, selector={"tpujob.dev/job-name": m.name}
+    )
+    if pods:
+        lines.append("Pods:")
+        for p in sorted(pods, key=lambda p: p.metadata.name):
+            where = f" on {p.spec.node_name}" if p.spec.node_name else ""
+            lines.append(
+                f"  {p.metadata.name:<28} {p.status.phase}{where}"
+            )
+    evs = _job_events(client.store, job)
+    lines.append("Events:")
+    for e in evs or []:
+        lines.append(f"  {_age(e.timestamp):<5} {e.type:<8} {e.reason:<22} {e.message}")
+    if not evs:
+        lines.append("  <none>")
+    print("\n".join(lines))
+    return 0
+
+
+def cmd_watch(client: TPUJobClient, args) -> int:
+    """Stream state transitions until the job finishes (≙ kubectl get -w)."""
+    try:
+        job = client.get(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    last = None
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        try:
+            job = client.get(args.name)
+        except NotFound:
+            print(f"{args.name}  <deleted>")
+            return 0
+        state = job_state(job)
+        if state != last:
+            print(f"{job.metadata.name}  {state}")
+            last = state
+        if is_finished(job.status):
+            return 0 if is_succeeded(job.status) else 1
+        time.sleep(0.2)
+    print(f"error: timed out after {args.timeout}s", file=sys.stderr)
+    return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpujobctl", description=__doc__)
+    # required, no 'memory' default: a client CLI on a private in-process
+    # store would print success and affect nothing
+    ap.add_argument("--store", required=True,
+                    help="'sqlite:PATH' or 'http://HOST:PORT' (the shared "
+                         "store an operator is running on)")
+    ap.add_argument("-n", "--namespace", default="default")
+    sub = ap.add_subparsers(dest="verb", required=True)
+    p = sub.add_parser("create", help="submit a TPUJob manifest")
+    p.add_argument("-f", "--filename", required=True)
+    p = sub.add_parser("get", help="list jobs, or one job")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-o", "--output", choices=["table", "json"], default="table")
+    p = sub.add_parser("describe", help="job detail: spec, conditions, pods, events")
+    p.add_argument("name")
+    p = sub.add_parser("delete", help="delete a job")
+    p.add_argument("name")
+    p = sub.add_parser("events", help="the job's event audit trail")
+    p.add_argument("name")
+    p = sub.add_parser("watch", help="stream state transitions until finished")
+    p.add_argument("name")
+    p.add_argument("--timeout", type=float, default=600.0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from mpi_operator_tpu.opshell.__main__ import build_store
+
+    store = build_store(args.store)
+    client = TPUJobClient(store, namespace=args.namespace)
+    try:
+        return {
+            "create": cmd_create,
+            "get": cmd_get,
+            "describe": cmd_describe,
+            "delete": cmd_delete,
+            "events": cmd_events,
+            "watch": cmd_watch,
+        }[args.verb](client, args)
+    finally:
+        close = getattr(store, "close", None)
+        if close is not None:
+            close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

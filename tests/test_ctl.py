@@ -1,0 +1,126 @@
+"""tpujobctl: the kubectl-equivalent CLI (opshell/ctl.py).
+
+≙ the reference's documented day-2 flow (/root/reference/examples/pi/
+README.md): create -f, get, describe (with the Events audit trail),
+delete — here against the framework's own store backends. The fixture runs
+a real operator stack (controller + gang scheduler + local executor) on a
+shared sqlite store; every CLI invocation is a separate store handle, the
+same process split as a real deployment.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.conditions import is_finished
+from mpi_operator_tpu.controller.controller import (
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.executor import LocalExecutor
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+from mpi_operator_tpu.opshell import ctl
+from mpi_operator_tpu.scheduler import GangScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PI_YAML = os.path.join(REPO, "examples", "pi.yaml")
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """Operator stack on a shared sqlite store; yields the store spec."""
+    path = str(tmp_path / "ctl.db")
+    store = SqliteStore(path, poll_interval=0.02)
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    scheduler = GangScheduler(store, recorder)
+    executor = LocalExecutor(store, workdir=REPO, require_binding=True)
+    controller.run()
+    scheduler.start()
+    executor.start()
+    yield f"sqlite:{path}"
+    executor.stop()
+    scheduler.stop()
+    controller.stop()
+    store.close()
+
+
+def run_ctl(store_spec, *argv):
+    return ctl.main(["--store", store_spec, *argv])
+
+
+def test_create_watch_get_describe_events_delete(stack, capsys):
+    """The full kubectl-style session against a running operator."""
+    assert run_ctl(stack, "create", "-f", PI_YAML) == 0
+    assert "created" in capsys.readouterr().out
+
+    # watch streams transitions and exits 0 on success
+    assert run_ctl(stack, "watch", "pi", "--timeout", "120") == 0
+    out = capsys.readouterr().out
+    assert "Succeeded" in out
+
+    assert run_ctl(stack, "get") == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "pi" in out and "Succeeded" in out
+
+    assert run_ctl(stack, "get", "pi", "-o", "json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["metadata"]["name"] == "pi"
+    assert doc["kind"] == "TPUJob"
+
+    assert run_ctl(stack, "describe", "pi") == 0
+    out = capsys.readouterr().out
+    assert "State:      Succeeded" in out
+    assert "Conditions:" in out and "Events:" in out
+    assert "TPUJobCreated" in out  # the audit trail is populated
+
+    assert run_ctl(stack, "events", "pi") == 0
+    out = capsys.readouterr().out
+    assert "TPUJobSucceeded" in out
+
+    assert run_ctl(stack, "delete", "pi") == 0
+    assert "deleted" in capsys.readouterr().out
+    assert run_ctl(stack, "get", "pi") == 1  # gone
+
+
+def test_errors_and_admission(stack, tmp_path, capsys):
+    # unknown job
+    assert run_ctl(stack, "describe", "nope") == 1
+    assert "error" in capsys.readouterr().err
+    # strict schema: typo'd field rejected at create
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "apiVersion: tpujob.dev/v1\nkind: TPUJob\n"
+        "metadata: {name: bad}\n"
+        "spec:\n  worker:\n    replicaz: 2\n"
+    )
+    assert run_ctl(stack, "create", "-f", str(bad)) == 1
+    assert "error" in capsys.readouterr().err
+    # missing manifest file: clean error, not a traceback
+    assert run_ctl(stack, "create", "-f", str(tmp_path / "nope.yaml")) == 1
+    assert "error" in capsys.readouterr().err
+    # duplicate create (rerunning the README command): clean error
+    assert run_ctl(stack, "create", "-f", PI_YAML) == 0
+    capsys.readouterr()
+    assert run_ctl(stack, "create", "-f", PI_YAML) == 1
+    assert "already exists" in capsys.readouterr().err
+
+
+def test_job_state_precedence():
+    """STATE column precedence mirrors the condition machine."""
+    from mpi_operator_tpu.api.types import Condition, JobStatus, TPUJob
+
+    job = TPUJob()
+    assert ctl.job_state(job) == "Pending"
+    job.status = JobStatus(conditions=[Condition(type="Created", status=True)])
+    assert ctl.job_state(job) == "Created"
+    job.status.conditions.append(Condition(type="Running", status=True))
+    assert ctl.job_state(job) == "Running"
+    job.status.conditions.append(Condition(type="Restarting", status=True))
+    assert ctl.job_state(job) == "Restarting"
+    job.status.conditions.append(Condition(type="Succeeded", status=True))
+    assert ctl.job_state(job) == "Succeeded"

@@ -26,6 +26,7 @@ import os
 import subprocess
 import tempfile
 import threading
+import uuid
 from typing import Dict, Optional
 
 from mpi_operator_tpu.machinery.objects import Pod, PodPhase
@@ -56,6 +57,7 @@ class LocalExecutor:
         extra_env: Optional[Dict[str, str]] = None,
         workdir: Optional[str] = None,
         require_binding: bool = False,
+        logs_dir: Optional[str] = None,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
@@ -66,6 +68,10 @@ class LocalExecutor:
         self.workdir = workdir
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
         self.logs: Dict[str, tuple] = {}  # pod key → (stdout, stderr)
+        # kubelet log dir: pod stdout/stderr stream to files here while the
+        # pod runs; the stdout path is stamped into pod.status.log_path so
+        # `ctl logs` (any process on this node) can read it
+        self.logs_dir = logs_dir or tempfile.mkdtemp(prefix="tpujob-logs-")
         self._config_root = tempfile.mkdtemp(prefix="tpujob-config-")
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -192,31 +198,64 @@ class LocalExecutor:
                 env["XLA_FLAGS"] = pin_host_device_count(
                     env.get("XLA_FLAGS", ""), chips
                 )
+            # stream to files (kubelet log dir) instead of pipes: logs
+            # survive the executor process and are readable mid-run by
+            # `ctl logs`; stdout and stderr stay separate so callers can
+            # parse structured stdout (e.g. the bench JSON line) unmixed.
+            # The path is unique per incarnation: a restarted same-name pod
+            # must not truncate the file an old reaper is about to read
+            # (pod.status.log_path always names the current incarnation)
+            os.makedirs(self.logs_dir, exist_ok=True)
+            base = os.path.join(
+                self.logs_dir,
+                f"{pod.metadata.namespace}-{pod.metadata.name}"
+                f"-{uuid.uuid4().hex[:8]}",
+            )
+            log_path = base + ".log"
+            handles = []
             try:
+                f_out = open(log_path, "w")
+                handles.append(f_out)
+                f_err = open(base + ".err", "w")
+                handles.append(f_err)
                 proc = subprocess.Popen(
                     argv,
                     env=env,
                     cwd=self.workdir,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
+                    stdout=f_out,
+                    stderr=f_err,
                     text=True,
                 )
             except OSError as e:
                 log.warning("pod %s failed to start: %s", key, e)
                 self._set_phase(pod, PodPhase.FAILED, reason=f"StartError: {e}")
                 return
+            finally:
+                # the child holds the fds now (or the spawn failed): either
+                # way these handles are done
+                for f in handles:
+                    f.close()
             self._procs[key] = proc
-        self._set_phase(pod, PodPhase.RUNNING, ip="127.0.0.1")
+        self._set_phase(pod, PodPhase.RUNNING, ip="127.0.0.1", log_path=log_path)
         t = threading.Thread(
-            target=self._reap, args=(pod, proc), name=f"reap-{key}", daemon=True
+            target=self._reap, args=(pod, proc, base), name=f"reap-{key}",
+            daemon=True,
         )
         t.start()
         # prune finished reap threads so per-pod state doesn't accumulate
         self._threads = [th for th in self._threads if th.is_alive()]
         self._threads.append(t)
 
-    def _reap(self, pod: Pod, proc: subprocess.Popen) -> None:
-        out, err = proc.communicate()
+    def _reap(self, pod: Pod, proc: subprocess.Popen, base: str) -> None:
+        proc.wait()
+        out = err = ""
+        try:
+            with open(base + ".log") as f:
+                out = f.read()
+            with open(base + ".err") as f:
+                err = f.read()
+        except OSError:
+            pass  # log files are best-effort; phase/exit code still land
         self.logs[self._pod_key(pod)] = (out, err)
         if proc.returncode == 0:
             self._set_phase(pod, PodPhase.SUCCEEDED, exit_code=0)
@@ -239,6 +278,7 @@ class LocalExecutor:
         ip: str = "",
         message: str = "",
         exit_code: Optional[int] = None,
+        log_path: str = "",
     ) -> None:
         # re-read (controller may have updated the pod since); force-update
         # status like a kubelet (status is the executor's to own)
@@ -255,6 +295,8 @@ class LocalExecutor:
             cur.status.pod_ip = ip
         if exit_code is not None:
             cur.status.exit_code = exit_code
+        if log_path:
+            cur.status.log_path = log_path
         try:
             self.store.update(cur, force=True)
         except NotFound:

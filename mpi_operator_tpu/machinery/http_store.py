@@ -296,6 +296,8 @@ class StoreServer:
                 try:
                     selector = json.loads(qs["selector"][0])
                 except json.JSONDecodeError:
+                    selector = None
+                if not isinstance(selector, dict):
                     return 400, {
                         "error": "BadRequest",
                         "message": "selector must be a JSON object "

@@ -52,6 +52,10 @@ class PodStatus(_Dictable):
     pod_ip: str = ""
     host_ip: str = ""
     start_time: Optional[float] = None
+    # where the executor streams this pod's stdout (stderr sits next to it
+    # with a .err suffix) — the kubelet-log-dir equivalent that `ctl logs`
+    # reads; the path is local to the node named in spec.node_name
+    log_path: str = ""
 
 
 @dataclass

@@ -48,13 +48,14 @@ def _load() -> ctypes.CDLL:
         ctypes.c_size_t,
     ]
     lib.tpucoll_broadcast_f64.restype = ctypes.c_int
-    lib.tpucoll_allgather_f64.argtypes = [
-        ctypes.c_void_p,
-        ctypes.POINTER(ctypes.c_double),
-        ctypes.c_size_t,
-        ctypes.POINTER(ctypes.c_double),
-    ]
-    lib.tpucoll_allgather_f64.restype = ctypes.c_int
+    for fn in (lib.tpucoll_allgather_f64, lib.tpucoll_reduce_scatter_sum_f64):
+        fn.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        fn.restype = ctypes.c_int
     for fn in (lib.tpucoll_barrier, lib.tpucoll_finalize):
         fn.argtypes = [ctypes.c_void_p]
         fn.restype = ctypes.c_int
@@ -114,6 +115,24 @@ class HostCollectives:
         rc = self._lib.tpucoll_allgather_f64(self._ctx, arr, len(values), out)
         if rc != 0:
             raise RuntimeError(f"allgather failed: {rc}")
+        return list(out)
+
+    def reduce_scatter_sum(self, values: Sequence[float]) -> list:
+        """Elementwise sum scattered by rank: this host gets chunk ``rank``
+        of the summed vector (len(values) must be a multiple of the gang
+        size; ≙ MPI_Reduce_scatter_block — the sharded-gradient verb)."""
+        if len(values) % max(1, self.size) != 0:
+            raise ValueError(
+                f"reduce_scatter length {len(values)} not divisible by "
+                f"gang size {self.size}"
+            )
+        arr = self._buf(values)
+        out = (ctypes.c_double * (len(values) // max(1, self.size)))()
+        rc = self._lib.tpucoll_reduce_scatter_sum_f64(
+            self._ctx, arr, len(values), out
+        )
+        if rc != 0:
+            raise RuntimeError(f"reduce_scatter failed: {rc}")
         return list(out)
 
     def barrier(self) -> None:

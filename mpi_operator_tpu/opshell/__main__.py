@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-gang-scheduling", action="store_true")
     ap.add_argument("--executor", choices=["none", "local"], default="none",
                     help="'local' runs worker pods as OS processes")
+    ap.add_argument("--logs-dir", default=None,
+                    help="directory for pod stdout/stderr files (default: a "
+                         "temp dir; paths land in pod.status for `ctl logs`)")
     ap.add_argument("--coordinator-port", type=int, default=8476)
     ap.add_argument("--inventory-chips", type=int, default=None,
                     help="finite chip inventory for gang admission "
@@ -156,7 +159,7 @@ def main(argv=None) -> int:
         else None
     )
     executor = (
-        LocalExecutor(store, require_binding=gang)
+        LocalExecutor(store, require_binding=gang, logs_dir=args.logs_dir)
         if args.executor == "local"
         else None
     )

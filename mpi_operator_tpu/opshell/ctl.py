@@ -14,11 +14,10 @@ equivalent verbs against any backend:
 
 Verbs: create (strict-schema admission), get (table or -o json), describe
 (spec summary + per-replica status + pods + the Event audit trail), delete,
-events, watch (stream condition transitions until the job finishes).
-Worker logs are intentionally NOT a verb here: stdout/stderr live with the
-executor that ran the pods (executor/local.py keeps them in-process; a real
-cluster keeps them on the node) — the describe output names the pods to
-look up. Pods' spec.node_name says where.
+events, logs (a pod's stdout/stderr from the executor's log dir — the path
+is stamped in pod.status.log_path and is local to the node in
+spec.node_name), watch (stream condition transitions until the job
+finishes).
 """
 
 from __future__ import annotations
@@ -215,6 +214,45 @@ def cmd_describe(client: TPUJobClient, args) -> int:
     return 0
 
 
+def cmd_logs(client: TPUJobClient, args) -> int:
+    """≙ `kubectl logs pi-launcher` (the reference README's way to read the
+    job's output). Accepts a pod name, or a job name (coordinator pod —
+    worker 0 — by convention, since only it prints in SPMD workloads).
+    Reads the file the executor stamped into pod.status.log_path; that path
+    is local to the node in spec.node_name."""
+    pod = client.store.try_get("Pod", client.namespace, args.name)
+    if pod is None:
+        pods = client.store.list(
+            "Pod", client.namespace, selector={"tpujob.dev/job-name": args.name}
+        )
+        if not pods:
+            print(f"error: no pod or job named {args.name!r}", file=sys.stderr)
+            return 1
+        pod = sorted(pods, key=lambda p: p.metadata.name)[0]
+    path = pod.status.log_path
+    if not path:
+        print(
+            f"error: pod {pod.metadata.name} has no logs recorded "
+            f"(phase {pod.status.phase})",
+            file=sys.stderr,
+        )
+        return 1
+    if args.stderr:
+        path = path[: -len(".log")] + ".err" if path.endswith(".log") else path
+    try:
+        with open(path) as f:
+            sys.stdout.write(f.read())
+    except OSError as e:
+        where = pod.spec.node_name or "the executor's node"
+        print(
+            f"error: cannot read {path} here ({e.strerror}); "
+            f"the pod ran on {where}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_watch(client: TPUJobClient, args) -> int:
     """Stream state transitions until the job finishes (≙ kubectl get -w)."""
     try:
@@ -261,6 +299,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("name")
     p = sub.add_parser("events", help="the job's event audit trail")
     p.add_argument("name")
+    p = sub.add_parser("logs", help="print a pod's stdout (pod name, or job "
+                                    "name for its coordinator pod)")
+    p.add_argument("name")
+    p.add_argument("--stderr", action="store_true",
+                   help="print the stderr stream instead")
     p = sub.add_parser("watch", help="stream state transitions until finished")
     p.add_argument("name")
     p.add_argument("--timeout", type=float, default=600.0)
@@ -269,6 +312,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.store == "memory":
+        # build_store would hand back a private in-process store: every verb
+        # would "succeed" against state nobody else can see
+        print("error: --store memory is not usable from a client CLI; "
+              "point at a shared store (sqlite:PATH or http://HOST:PORT)",
+              file=sys.stderr)
+        return 2
     from mpi_operator_tpu.opshell.__main__ import build_store
 
     store = build_store(args.store)
@@ -280,6 +330,7 @@ def main(argv=None) -> int:
             "describe": cmd_describe,
             "delete": cmd_delete,
             "events": cmd_events,
+            "logs": cmd_logs,
             "watch": cmd_watch,
         }[args.verb](client, args)
     finally:

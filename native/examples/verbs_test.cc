@@ -50,6 +50,17 @@ int main() {
     if (all[2 * r] != r || all[2 * r + 1] != r + 0.5)
       return fail("allgather value", rank);
 
+  /* reduce_scatter: each rank sends send[i] = i + rank over 2*size slots;
+   * the summed vector is size*i + rank_sum, and rank r keeps slots
+   * [2r, 2r+2) */
+  double rs_in[2 * 64], rs_out[2];
+  for (int i = 0; i < 2 * size; ++i) rs_in[i] = i + rank;
+  if (tpucoll_reduce_scatter_sum_f64(ctx, rs_in, 2 * size, rs_out) != 0)
+    return fail("reduce_scatter", rank);
+  for (int j = 0; j < 2; ++j)
+    if (rs_out[j] != size * (2 * rank + j) + rank_sum)
+      return fail("reduce_scatter value", rank);
+
   if (tpucoll_barrier(ctx) != 0) return fail("barrier", rank);
   if (tpucoll_finalize(ctx) != 0) return fail("finalize", rank);
   printf("VERBS OK rank %d/%d\n", rank, size);

@@ -56,6 +56,13 @@ int tpucoll_broadcast_f64(tpucoll_ctx *ctx, double *buf, size_t n);
 int tpucoll_allgather_f64(tpucoll_ctx *ctx, const double *send, size_t n,
                           double *recv);
 
+/* Every host contributes n_total doubles (n_total must be a multiple of the
+ * gang size); the elementwise sum is scattered: host r receives chunk r
+ * (n_total / size doubles) into recv (≙ MPI_Reduce_scatter_block — the
+ * sharded-gradient verb whose ICI analogue is XLA reduce_scatter). */
+int tpucoll_reduce_scatter_sum_f64(tpucoll_ctx *ctx, const double *send,
+                                   size_t n_total, double *recv);
+
 /* Collective teardown; frees ctx. */
 int tpucoll_finalize(tpucoll_ctx *ctx);
 

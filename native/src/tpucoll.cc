@@ -37,6 +37,7 @@ constexpr uint8_t kOpBarrier = 3;
 constexpr uint8_t kOpFinalize = 4;
 constexpr uint8_t kOpBroadcast = 5;
 constexpr uint8_t kOpAllgather = 6;
+constexpr uint8_t kOpReduceScatter = 7;
 constexpr int kConnectTimeoutMs = 30000;
 constexpr int kConnectRetryMs = 100;
 // This library carries host-side control traffic (scalars, barriers);
@@ -162,6 +163,23 @@ void serve(tpucoll_ctx *ctx) {
     acc.assign(first.count, 0.0);
     for (int r = 0; r < n; ++r)
       for (uint64_t i = 0; i < first.count; ++i) acc[i] += payloads[r][i];
+    if (first.op == kOpReduceScatter) {
+      if (first.count % static_cast<uint64_t>(n) != 0) {
+        fprintf(stderr,
+                "tpucoll: reduce_scatter count %llu not divisible by gang "
+                "size %d\n", (unsigned long long)first.count, n);
+        return;
+      }
+      const uint64_t chunk = first.count / static_cast<uint64_t>(n);
+      for (int r = 0; r < n; ++r) {
+        uint8_t ack = 1;
+        if (!write_full(ctx->peers[r], &ack, 1)) return;
+        if (chunk > 0 &&
+            !write_full(ctx->peers[r], acc.data() + r * chunk, chunk * 8))
+          return;
+      }
+      continue;
+    }
     for (int r = 0; r < n; ++r) {
       bool wants_data =
           first.op == kOpAllreduce || (first.op == kOpReduceRoot && r == 0);
@@ -373,6 +391,17 @@ int tpucoll_allgather_f64(tpucoll_ctx *ctx, const double *send, size_t n,
   }
   return round_trip(ctx, kOpAllgather, send, n, recv,
                     n * static_cast<size_t>(ctx->size));
+}
+
+int tpucoll_reduce_scatter_sum_f64(tpucoll_ctx *ctx, const double *send,
+                                   size_t n_total, double *recv) {
+  if (n_total % static_cast<size_t>(ctx->size) != 0) return -EINVAL;
+  if (ctx->size == 1) {
+    if (recv != send) memcpy(recv, send, n_total * 8);
+    return 0;
+  }
+  return round_trip(ctx, kOpReduceScatter, send, n_total, recv,
+                    n_total / static_cast<size_t>(ctx->size));
 }
 
 int tpucoll_finalize(tpucoll_ctx *ctx) {

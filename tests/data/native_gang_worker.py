@@ -14,5 +14,8 @@ with HostCollectives() as hc:
         print("ROOT_REDUCE", rooted[0])
     print("BROADCAST", hc.broadcast([42.5 if hc.rank == 0 else -1.0]))
     print("ALLGATHER", hc.allgather([r, r + 0.5]))
-    print("EMPTY", hc.allreduce_sum([]), hc.broadcast([]), hc.allgather([]))
+    print("REDUCE_SCATTER", hc.reduce_scatter_sum(
+        [float(i) + r for i in range(hc.size)]))
+    print("EMPTY", hc.allreduce_sum([]), hc.broadcast([]), hc.allgather([]),
+          hc.reduce_scatter_sum([]))
     hc.barrier()

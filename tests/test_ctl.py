@@ -82,6 +82,16 @@ def test_create_watch_get_describe_events_delete(stack, capsys):
     out = capsys.readouterr().out
     assert "TPUJobSucceeded" in out
 
+    # logs: job name resolves to the coordinator pod (≙ kubectl logs
+    # pi-launcher, the reference README's way to read the result)
+    assert run_ctl(stack, "logs", "pi") == 0
+    assert "pi is approximately 3.1" in capsys.readouterr().out
+    # ...and a pod name works directly
+    assert run_ctl(stack, "logs", "pi-worker-1") == 0
+    capsys.readouterr()
+    assert run_ctl(stack, "logs", "no-such-thing") == 1
+    assert "error" in capsys.readouterr().err
+
     assert run_ctl(stack, "delete", "pi") == 0
     assert "deleted" in capsys.readouterr().out
     assert run_ctl(stack, "get", "pi") == 1  # gone
@@ -108,6 +118,12 @@ def test_errors_and_admission(stack, tmp_path, capsys):
     capsys.readouterr()
     assert run_ctl(stack, "create", "-f", PI_YAML) == 1
     assert "already exists" in capsys.readouterr().err
+
+
+def test_memory_store_rejected(capsys):
+    """A client CLI on a private in-process store would silently no-op."""
+    assert ctl.main(["--store", "memory", "get"]) == 2
+    assert "not usable" in capsys.readouterr().err
 
 
 def test_job_state_precedence():

@@ -14,6 +14,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.parse
 
 import pytest
 
@@ -244,6 +245,21 @@ def test_stale_instance_relists_even_when_seqs_overlap():
         assert [e["seq"] for e in r["events"]] == [3, 4, 5]
     finally:
         srv.stop()
+
+
+def test_non_object_selector_is_bad_request(server):
+    """Any malformed selector (non-JSON or JSON-but-not-an-object) is a 400
+    BadRequest, not an opaque 500 (version-skew diagnosability)."""
+    import urllib.error
+    import urllib.request
+
+    for raw in ("not-json", "123", '"str"', "[1,2]"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{server.url}/v1/objects/Pod?selector={urllib.parse.quote(raw)}",
+                timeout=5,
+            )
+        assert ei.value.code == 400
 
 
 def test_failed_watch_registration_leaks_no_queue():

@@ -81,6 +81,7 @@ def test_python_binding_single_host():
         assert hc.allreduce_sum([1.5, 2.5]) == [1.5, 2.5]
         assert hc.broadcast([7.0]) == [7.0]
         assert hc.allgather([1.0, 2.0]) == [1.0, 2.0]
+        assert hc.reduce_scatter_sum([1.0, 2.0]) == [1.0, 2.0]
         hc.barrier()
 
 
@@ -89,11 +90,14 @@ def test_python_binding_gang():
     script = os.path.join(REPO, "tests", "data", "native_gang_worker.py")
     outs = _run_gang([sys.executable, script], size=3)
     # every host sees the allreduced sum 0+1+2=3 and rank-sum 3.0
-    for out in outs:
+    for r, out in enumerate(outs):
         assert "ALLREDUCE [3.0, 30.0]" in out
         assert "BROADCAST [42.5]" in out  # host 0's value won everywhere
         assert "ALLGATHER [0.0, 0.5, 1.0, 1.5, 2.0, 2.5]" in out
-        assert "EMPTY [] [] []" in out  # zero-length collectives are legal
+        # each rank sends [r, 1+r, 2+r]; summed = [3, 6, 9]; rank r keeps
+        # chunk r of the scatter
+        assert f"REDUCE_SCATTER [{3.0 * (r + 1)}]" in out
+        assert "EMPTY [] [] [] []" in out  # zero-length collectives are legal
     assert "ROOT_REDUCE 3.0" in outs[0]
 
 

@@ -128,22 +128,17 @@ def test_mnist_allreduce_example_end_to_end():
     assert report["last_loss"] < report["first_loss"]
 
 
-def test_submit_job_example_two_process(tmp_path):
-    """examples/submit_job.py against a shared sqlite store with the
-    operator running as a SEPARATE process — the reference's
-    SDK-submits-to-apiserver split (/root/reference/sdk/python/examples/
-    tensorflow-mnist.py) as a real two-process deployment."""
-    db = tmp_path / "store.db"
-    # file-backed output: a PIPE would fill and deadlock a chatty operator,
-    # and we want its log attached to any failure
+import contextlib
+
+
+@contextlib.contextmanager
+def _running_operator(tmp_path, *flags):
+    """Run the operator CLI as a separate process; yields a callable that
+    returns its accumulated log (attached to assertion failures). File-backed
+    output: a PIPE would fill and deadlock a chatty operator."""
     op_log = open(tmp_path / "operator.log", "w+")
     operator = subprocess.Popen(
-        [
-            "python", "-m", "mpi_operator_tpu.opshell",
-            "--store", f"sqlite:{db}",
-            "--executor", "local",
-            "--monitoring-port", "0",
-        ],
+        ["python", "-m", "mpi_operator_tpu.opshell", *flags],
         cwd=REPO,
         stdout=op_log,
         stderr=subprocess.STDOUT,
@@ -155,6 +150,27 @@ def test_submit_job_example_two_process(tmp_path):
         return (tmp_path / "operator.log").read_text()
 
     try:
+        yield operator_log
+    finally:
+        operator.terminate()
+        try:
+            operator.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            operator.kill()
+            operator.wait()
+        op_log.close()
+
+
+def test_submit_job_example_two_process(tmp_path):
+    """examples/submit_job.py against a shared sqlite store with the
+    operator running as a SEPARATE process — the reference's
+    SDK-submits-to-apiserver split (/root/reference/sdk/python/examples/
+    tensorflow-mnist.py) as a real two-process deployment."""
+    db = tmp_path / "store.db"
+    with _running_operator(
+        tmp_path, "--store", f"sqlite:{db}", "--executor", "local",
+        "--monitoring-port", "0",
+    ) as operator_log:
         submit = subprocess.run(
             ["python", "examples/submit_job.py", f"sqlite:{db}"],
             cwd=REPO,
@@ -166,14 +182,58 @@ def test_submit_job_example_two_process(tmp_path):
         assert submit.returncode == 0, detail
         assert "SUCCEEDED" in submit.stdout, detail
         assert "created TPUJob" in submit.stdout, detail
-    finally:
-        operator.terminate()
-        try:
-            operator.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            operator.kill()
-            operator.wait()
-        op_log.close()
+
+
+def test_serve_store_multinode_end_to_end(tmp_path):
+    """The README's multi-node flow as a real process split: the operator
+    co-hosts its store over HTTP (--serve-store, ≙ apiserver+etcd in one
+    pod), and a separate process submits with the SDK over the network and
+    reads worker logs with `ctl logs` — no shared filesystem between the
+    client and the store."""
+    import time
+    import urllib.request
+
+    from mpi_operator_tpu.runtime.emulation import free_port
+
+    port = free_port()
+    with _running_operator(
+        tmp_path, "--store", f"sqlite:{tmp_path / 'store.db'}",
+        "--serve-store", f"127.0.0.1:{port}",
+        "--executor", "local", "--monitoring-port", "0",
+    ) as operator_log:
+        # wait for the served store to come up before submitting (the
+        # client has no connect-retry on the first request)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                )
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            raise TimeoutError("store endpoint never came up:\n" + operator_log())
+        submit = subprocess.run(
+            ["python", "examples/submit_job.py", f"http://127.0.0.1:{port}"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        detail = (submit.stdout + submit.stderr + "\noperator:\n"
+                  + operator_log())
+        assert submit.returncode == 0, detail
+        assert "SUCCEEDED" in submit.stdout, detail
+        # day-2 verb over the same wire: read the coordinator's output
+        logs = subprocess.run(
+            ["python", "-m", "mpi_operator_tpu.opshell.ctl",
+             "--store", f"http://127.0.0.1:{port}", "logs", "pi-sdk"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert logs.returncode == 0, logs.stdout + logs.stderr + detail
+        assert "pi is approximately 3.1" in logs.stdout
+
 
 
 def test_two_concurrent_jobs_one_executor():

@@ -64,6 +64,15 @@ class TPUJobClient:
             raise ValidationRejected(errors)
         return self.store.create(job)
 
+    def update(self, job: TPUJob) -> TPUJob:
+        """Admission-validated spec update (scale, suspend, …): the same
+        defaulted-copy validation as ``create``, then an optimistic store
+        update (Conflict propagates; re-get and retry)."""
+        errors = validate_tpujob(set_defaults(job.deepcopy()))
+        if errors:
+            raise ValidationRejected(errors)
+        return self.store.update(job)
+
     # -- read ---------------------------------------------------------------
 
     def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:

@@ -16,8 +16,9 @@ Verbs: create (strict-schema admission), get (table or -o json), describe
 (spec summary + per-replica status + pods + the Event audit trail), delete,
 events, logs (a pod's stdout/stderr from the executor's log dir — the path
 is stamped in pod.status.log_path and is local to the node in
-spec.node_name), watch (stream condition transitions until the job
-finishes).
+spec.node_name), scale (live worker-replica change — the elastic entry
+point), suspend/resume (runPolicy.suspend), watch (stream condition
+transitions until the job finishes).
 """
 
 from __future__ import annotations
@@ -35,7 +36,7 @@ from mpi_operator_tpu.api.conditions import (
     is_succeeded,
 )
 from mpi_operator_tpu.api.schema import ManifestError
-from mpi_operator_tpu.machinery.store import AlreadyExists, NotFound
+from mpi_operator_tpu.machinery.store import AlreadyExists, Conflict, NotFound
 
 
 def job_state(job: Any) -> str:
@@ -214,6 +215,70 @@ def cmd_describe(client: TPUJobClient, args) -> int:
     return 0
 
 
+def _mutate_spec(client: TPUJobClient, name: str, mutate, done_msg: str) -> int:
+    """Optimistic read-mutate-update with conflict retry + backoff
+    (≙ kubectl's RetryOnConflict: the controller may be writing status
+    concurrently). Admission validation lives in TPUJobClient.update — one
+    admission path for create and mutate."""
+    for attempt in range(5):
+        try:
+            job = client.get(name)
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        mutate(job)
+        try:
+            client.update(job)
+        except ValidationRejected as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        except Conflict:
+            time.sleep(0.05 * (attempt + 1))
+            continue  # re-read and re-apply
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(done_msg)
+        return 0
+    print(f"error: persistent update conflict on {name}", file=sys.stderr)
+    return 1
+
+
+def cmd_scale(client: TPUJobClient, args) -> int:
+    """≙ kubectl scale — the elastic entry point: the controller observes
+    the replica change on the live job, republishes the projected host
+    list, and drives a gang-coherent restart at the new size."""
+
+    def mutate(job):
+        job.spec.worker.replicas = args.replicas
+
+    return _mutate_spec(
+        client, args.name, mutate,
+        f"tpujob.tpujob.dev/{args.name} scaled to {args.replicas} workers",
+    )
+
+
+def cmd_suspend(client: TPUJobClient, args) -> int:
+    """≙ kubectl patch runPolicy.suspend=true (implemented here, unlike the
+    reference's declared-only RunPolicy — SURVEY.md §2.2)."""
+
+    def mutate(job):
+        job.spec.run_policy.suspend = True
+
+    return _mutate_spec(
+        client, args.name, mutate, f"tpujob.tpujob.dev/{args.name} suspended"
+    )
+
+
+def cmd_resume(client: TPUJobClient, args) -> int:
+    def mutate(job):
+        job.spec.run_policy.suspend = False
+
+    return _mutate_spec(
+        client, args.name, mutate, f"tpujob.tpujob.dev/{args.name} resumed"
+    )
+
+
 def cmd_logs(client: TPUJobClient, args) -> int:
     """≙ `kubectl logs pi-launcher` (the reference README's way to read the
     job's output). Accepts a pod name, or a job name (coordinator pod —
@@ -299,6 +364,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("name")
     p = sub.add_parser("events", help="the job's event audit trail")
     p.add_argument("name")
+    p = sub.add_parser("scale", help="change worker replicas on a live job "
+                                     "(the elastic entry point)")
+    p.add_argument("name")
+    p.add_argument("--replicas", type=int, required=True)
+    p = sub.add_parser("suspend", help="set runPolicy.suspend: the gang is "
+                                       "drained, the job holds")
+    p.add_argument("name")
+    p = sub.add_parser("resume", help="clear runPolicy.suspend")
+    p.add_argument("name")
     p = sub.add_parser("logs", help="print a pod's stdout (pod name, or job "
                                     "name for its coordinator pod)")
     p.add_argument("name")
@@ -331,6 +405,9 @@ def main(argv=None) -> int:
             "delete": cmd_delete,
             "events": cmd_events,
             "logs": cmd_logs,
+            "scale": cmd_scale,
+            "suspend": cmd_suspend,
+            "resume": cmd_resume,
             "watch": cmd_watch,
         }[args.verb](client, args)
     finally:

@@ -5,6 +5,7 @@ simulates pod phases because there is no kubelet). Here the LocalExecutor IS
 the kubelet, so the documented smoke test (examples/pi, ≙
 /root/reference/examples/pi/README.md) runs in-suite, gang and all."""
 
+import contextlib
 import json
 import os
 import shutil
@@ -126,9 +127,6 @@ def test_mnist_allreduce_example_end_to_end():
     report = _last_report(logs["default/mnist-allreduce-worker-0"][0])
     assert report["hosts"] == 2
     assert report["last_loss"] < report["first_loss"]
-
-
-import contextlib
 
 
 @contextlib.contextmanager

@@ -57,6 +57,19 @@ _ERROR_CLASSES = {
     "Conflict": Conflict,
 }
 
+# Store objects are manifests and status records — O(KB). The cap keeps an
+# untrusted peer from driving a multi-GB allocation through Content-Length
+# (same posture as tpucoll.cc's kMaxCount on the native wire).
+_MAX_BODY_BYTES = 8 << 20
+
+
+class _BodyTooLarge(Exception):
+    """Content-Length rejected: too large, negative, or non-numeric."""
+
+    def __init__(self, size):
+        self.size = size
+        super().__init__(f"body {size} bytes")
+
 
 def parse_listen(spec: str) -> Tuple[str, int]:
     """'HOST:PORT', ':PORT', '[v6]:PORT', or bare 'PORT' → (host, port).
@@ -179,7 +192,16 @@ class StoreServer:
                 self.wfile.write(body)
 
             def _body(self) -> Dict[str, Any]:
-                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.headers.get("Content-Length", "0")
+                try:
+                    n = int(raw)
+                except ValueError:
+                    n = -1  # malformed header → same reject path
+                if n < 0 or n > _MAX_BODY_BYTES:
+                    # same posture as tpucoll's kMaxCount: a peer must not
+                    # drive an arbitrary allocation (or an
+                    # rfile.read(-1)-to-EOF stall) through a length field
+                    raise _BodyTooLarge(raw)
                 return json.loads(self.rfile.read(n)) if n else {}
 
             def _dispatch(self, method: str) -> None:
@@ -188,6 +210,17 @@ class StoreServer:
                         method, self.path, self._body() if method in ("POST", "PUT") else {}
                     )
                     self._send(code, payload)
+                except _BodyTooLarge as e:
+                    # the unread body would desync keep-alive framing: close
+                    self.close_connection = True
+                    try:
+                        self._send(413, {
+                            "error": "BadRequest",
+                            "message": f"Content-Length {e.size!r} rejected "
+                                       f"(cap {_MAX_BODY_BYTES} bytes)",
+                        })
+                    except Exception:
+                        pass
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # surface, don't kill the thread

@@ -247,6 +247,29 @@ def test_stale_instance_relists_even_when_seqs_overlap():
         srv.stop()
 
 
+def test_oversized_body_is_rejected_not_allocated(server):
+    """A Content-Length past the 8 MiB cap gets 413 before the server reads
+    (or allocates) the body — tpucoll's kMaxCount posture on the HTTP wire."""
+    import urllib.error
+    import urllib.request
+
+    for bad_length in (str(64 << 20), "-1", "10abc"):
+        req = urllib.request.Request(
+            f"{server.url}/v1/objects",
+            data=b"x",  # tiny actual body; the declared length is the attack
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "Content-Length": bad_length},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 413, bad_length
+    # the server is still healthy afterwards
+    c = HttpStoreClient(server.url)
+    c.create(Pod(metadata=ObjectMeta(name="after-413")))
+    assert c.get("Pod", "default", "after-413").metadata.name == "after-413"
+
+
 def test_non_object_selector_is_bad_request(server):
     """Any malformed selector (non-JSON or JSON-but-not-an-object) is a 400
     BadRequest, not an opaque 500 (version-skew diagnosability)."""

@@ -31,6 +31,7 @@ Run standalone (the etcd-equivalent process):
 from __future__ import annotations
 
 import argparse
+import hmac
 import json
 import queue
 import threading
@@ -48,6 +49,7 @@ from mpi_operator_tpu.machinery.store import (
     AlreadyExists,
     Conflict,
     NotFound,
+    Unauthorized,
     WatchEvent,
 )
 
@@ -55,6 +57,7 @@ _ERROR_CLASSES = {
     "NotFound": NotFound,
     "AlreadyExists": AlreadyExists,
     "Conflict": Conflict,
+    "Unauthorized": Unauthorized,
 }
 
 # Store objects are manifests and status records — O(KB). The cap keeps an
@@ -69,6 +72,23 @@ class _BodyTooLarge(Exception):
     def __init__(self, size):
         self.size = size
         super().__init__(f"body {size} bytes")
+
+
+def read_token_file(path: Optional[str]) -> Optional[str]:
+    """Load a shared bearer token from a file (whitespace-stripped; empty
+    file = no token). File-sourced so the secret never sits on a command
+    line (≙ a mounted Secret, not a flag value visible in `ps`)."""
+    if not path:
+        return None
+    with open(path) as f:
+        tok = f.read().strip()
+    return tok or None
+
+
+def _quote(part: str) -> str:
+    """Path-segment-safe encoding for object names: Node names carry '/'
+    (slice0/0x0) and must survive the /v1/objects/{kind}/{ns}/{name} route."""
+    return urllib.parse.quote(part, safe="")
 
 
 def parse_listen(spec: str) -> Tuple[str, int]:
@@ -167,8 +187,15 @@ class StoreServer:
     """Serves a backing store's surface over HTTP (the etcd-equivalent)."""
 
     def __init__(self, backing: Any, host: str = "127.0.0.1", port: int = 0,
-                 *, log_capacity: int = 4096):
+                 *, log_capacity: int = 4096, token: Optional[str] = None,
+                 auth_reads: bool = False):
         self.backing = backing
+        # shared bearer token (≙ the authn half of kube-apiserver's
+        # protection on this seam — see deploy/README.md trust boundary):
+        # required on every mutating route when set; reads too with
+        # auth_reads (watch included — watches carry full object payloads)
+        self.token = token
+        self.auth_reads = auth_reads
         # the seq space is per-incarnation; clients echo this id so a
         # restarted server (fresh seqs) can't be confused with the old one
         # even after the new log catches up past a stale cursor
@@ -204,8 +231,34 @@ class StoreServer:
                     raise _BodyTooLarge(raw)
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _authorized(self, method: str) -> bool:
+                if server.token is None:
+                    return True
+                if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
+                    # liveness probes carry no headers; /healthz leaks
+                    # nothing, so it stays open even under --auth-reads
+                    return True
+                if method == "GET" and not server.auth_reads:
+                    return True
+                header = self.headers.get("Authorization", "")
+                scheme, _, presented = header.partition(" ")
+                return scheme == "Bearer" and hmac.compare_digest(
+                    presented.strip(), server.token
+                )
+
             def _dispatch(self, method: str) -> None:
                 try:
+                    if not self._authorized(method):
+                        # drain the body first: an unread body would desync
+                        # keep-alive framing (same concern as _BodyTooLarge)
+                        if method in ("POST", "PUT"):
+                            self._body()
+                        self._send(401, {
+                            "error": "Unauthorized",
+                            "message": "missing or invalid bearer token "
+                                       "(server runs with --token-file)",
+                        })
+                        return
                     code, payload = server._handle(
                         method, self.path, self._body() if method in ("POST", "PUT") else {}
                     )
@@ -270,7 +323,8 @@ class StoreServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        host = f"[{self.host}]" if ":" in self.host else self.host
+        return f"http://{host}:{self.port}"
 
     def _drain_loop(self) -> None:
         while not self._stop.is_set():
@@ -290,7 +344,9 @@ class StoreServer:
     ) -> Tuple[int, Dict[str, Any]]:
         parsed = urllib.parse.urlparse(path)
         qs = urllib.parse.parse_qs(parsed.query)
-        parts = [p for p in parsed.path.split("/") if p]
+        # unquote AFTER splitting: %2F inside an object name must not create
+        # path segments (Node names are slice0/0x0)
+        parts = [urllib.parse.unquote(p) for p in parsed.path.split("/") if p]
         try:
             if parts == ["healthz"]:
                 return 200, {"ok": True}
@@ -351,8 +407,13 @@ class StoreServer:
         return 404, {"error": "NotFound", "message": "bad objects route"}
 
     def _handle_watch(self, qs: Dict[str, List[str]]) -> Tuple[int, Dict[str, Any]]:
-        after = int(qs.get("after", ["-1"])[0])
-        timeout = min(float(qs.get("timeout", ["25"])[0]), 55.0)
+        try:
+            after = int(qs.get("after", ["-1"])[0])
+            timeout = min(float(qs.get("timeout", ["25"])[0]), 55.0)
+        except ValueError as e:
+            # malformed query from a skewed client: a 400, not an opaque 500
+            # (same posture as the selector parameter above)
+            return 400, {"error": "BadRequest", "message": f"bad watch param: {e}"}
         client_instance = qs.get("instance", [self.instance])[0]
         if after < 0:
             # registration: hand the current head so the client sees only
@@ -416,8 +477,10 @@ class HttpStoreClient:
     """
 
     def __init__(self, url: str, *, timeout: float = 10.0,
-                 watch_poll_timeout: float = 25.0):
+                 watch_poll_timeout: float = 25.0,
+                 token: Optional[str] = None):
         self.url = url.rstrip("/")
+        self.token = token
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
         self._lock = threading.RLock()
@@ -435,11 +498,11 @@ class HttpStoreClient:
         timeout: Optional[float] = None,
     ) -> Dict[str, Any]:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            self.url + path,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            self.url + path, data=data, method=method, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
@@ -464,7 +527,9 @@ class HttpStoreClient:
         return decode(obj.kind, r["object"])
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
-        r = self._request("GET", f"/v1/objects/{kind}/{namespace}/{name}")
+        r = self._request(
+            "GET", f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
+        )
         return decode(kind, r["object"])
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -477,14 +542,16 @@ class HttpStoreClient:
         m = obj.metadata
         r = self._request(
             "PUT",
-            f"/v1/objects/{obj.kind}/{m.namespace}/{m.name}"
+            f"/v1/objects/{obj.kind}/{_quote(m.namespace)}/{_quote(m.name)}"
             + ("?force=1" if force else ""),
             {"object": encode(obj)},
         )
         return decode(obj.kind, r["object"])
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
-        r = self._request("DELETE", f"/v1/objects/{kind}/{namespace}/{name}")
+        r = self._request(
+            "DELETE", f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
+        )
         return decode(kind, r["object"])
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -606,6 +673,11 @@ def main(argv=None) -> int:
                     help="'memory' or 'sqlite:PATH' backing store")
     ap.add_argument("--listen", default="127.0.0.1:8475",
                     help="host:port to bind")
+    ap.add_argument("--token-file", default=None,
+                    help="file holding the shared bearer token; when set, "
+                         "every mutating request must present it")
+    ap.add_argument("--auth-reads", action="store_true",
+                    help="require the token on reads/watches too")
     args = ap.parse_args(argv)
     from mpi_operator_tpu.opshell.__main__ import build_store
 
@@ -614,7 +686,15 @@ def main(argv=None) -> int:
         host, port = parse_listen(args.listen)
     except ValueError as e:
         raise SystemExit(f"error: --listen: {e}")
-    server = StoreServer(backing, host, port).start()
+    try:
+        token = read_token_file(args.token_file)
+    except OSError as e:
+        raise SystemExit(f"error: --token-file: {e}")
+    if args.auth_reads and token is None:
+        raise SystemExit("error: --auth-reads requires --token-file")
+    server = StoreServer(
+        backing, host, port, token=token, auth_reads=args.auth_reads
+    ).start()
     print(f"store serving on {server.url}", flush=True)
     try:
         threading.Event().wait()

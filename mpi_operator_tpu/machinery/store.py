@@ -37,6 +37,12 @@ class Conflict(RuntimeError):
     pass
 
 
+class Unauthorized(PermissionError):
+    """A store request was rejected for a missing/wrong bearer token
+    (HTTP backend only; ≙ kube-apiserver authn rejecting a client,
+    /root/reference/manifests/base/cluster-role.yaml being the authz side)."""
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"

@@ -483,7 +483,6 @@ class TPUJobController:
             ),
             spec=PodGroupSpec(
                 min_member=desired,
-                queue=sp.queue if sp else "",
                 priority_class=sp.priority_class if sp else "",
             ),
         )
@@ -639,28 +638,38 @@ class TPUJobController:
         # survivors' collectives fail with ordinary (non-retryable) exit
         # codes. So failure handling is gang-scoped: if ANY pod failed
         # retryably (evicted, exit>=128, EXIT_RESTART), companion failures
-        # are collateral and the WHOLE gang restarts — but only once no pod
-        # is still running (drain: peers exit via the elastic protocol or
-        # their own collective error; activeDeadlineSeconds backstops a
-        # straggler that never exits). The drain sync executes the restart
-        # exactly once per generation, so backoffLimit counts restart
-        # generations, not per-pod failure observations.
+        # are collateral and the WHOLE gang restarts — but the fail-vs-
+        # restart VERDICT waits until no pod is still running (drain: peers
+        # exit via the elastic protocol or their own collective error;
+        # activeDeadlineSeconds backstops a straggler that never exits).
+        # The drain sync executes the restart exactly once per generation,
+        # so backoffLimit counts restart generations, not per-pod failure
+        # observations.
         failed = [p for p in workers if p.status.phase == PodPhase.FAILED]
         if failed:
-            if any(self._pod_retryable(job, p) for p in failed):
-                if cond.update_job_conditions(
-                    job.status,
-                    ConditionType.RESTARTING,
-                    cond.REASON_RESTARTING,
-                    "worker pod(s) failed retryably; gang will restart",
-                ):
-                    self.recorder.event(
-                        job, WARNING, cond.REASON_RESTARTING, "job restarting"
-                    )
-                cond.ensure_timestamps(job.status)
-                all_pods = self._list_workers(job)  # incl. over-index stragglers
-                if any(p.status.phase == PodPhase.RUNNING for p in all_pods):
-                    return  # draining; the straggler's exit re-enqueues us
+            retryable = any(self._pod_retryable(job, p) for p in failed)
+            all_pods = self._list_workers(job)  # incl. over-index stragglers
+            if retryable and cond.update_job_conditions(
+                job.status,
+                ConditionType.RESTARTING,
+                cond.REASON_RESTARTING,
+                "worker pod(s) failed retryably; gang will restart",
+            ):
+                self.recorder.event(
+                    job, WARNING, cond.REASON_RESTARTING, "job restarting"
+                )
+            cond.ensure_timestamps(job.status)
+            if any(p.status.phase == PodPhase.RUNNING for p in all_pods):
+                # drain before the VERDICT, not just before the restart: a
+                # companion's ordinary crash often lands before the root
+                # cause is recorded (a lost node's pods are only marked
+                # Evicted after the heartbeat grace window — NodeMonitor),
+                # so deciding fail-vs-restart now would misread collateral
+                # rc=1 exits as a permanent app failure. Survivors exit on
+                # their own (collective error / elastic protocol);
+                # activeDeadlineSeconds backstops a straggler.
+                return
+            if retryable:
                 backoff = job.spec.run_policy.backoff_limit
                 if backoff is not None and job.status.restart_count >= backoff:
                     self._fail_job(

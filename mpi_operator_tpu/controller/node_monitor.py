@@ -1,0 +1,117 @@
+"""NodeMonitor: node-liveness controller (the kube node controller role).
+
+The reference never detects worker loss itself — kubernetes' node controller
+notices a kubelet stop posting status, marks the Node NotReady, and evicts
+its pods; the MPIJob controller then sees Failed/Evicted workers and applies
+its restart policy (/root/reference/v2/pkg/controller/mpi_job_controller.go
+:506-529 evicted-requeue; SURVEY.md §5.3). This module is that missing first
+half for this framework:
+
+- node agents (executor/agent.py) heartbeat their Node objects;
+- the monitor (run on the elected leader, opshell/__main__.py) scans them:
+  a node silent past the grace window is marked NotReady and every live pod
+  bound to it is force-failed with reason ``Evicted`` — which
+  controller/controller.py already treats as retryable, driving the
+  gang-coherent restart onto the remaining live nodes.
+
+Nodes with ``last_heartbeat == 0`` are static (manually registered) and are
+never evicted by the monitor.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, PodPhase
+from mpi_operator_tpu.machinery.store import NotFound
+
+log = logging.getLogger("tpujob.nodemonitor")
+
+EVENT_NODE_LOST = "NodeLost"
+
+
+class NodeMonitor:
+    def __init__(
+        self,
+        store,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        grace: float = 6.0,
+        interval: float = 1.0,
+    ):
+        self.store = store
+        self.recorder = recorder or EventRecorder(
+            store, component="tpujob-node-monitor"
+        )
+        self.grace = grace
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="node-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync()
+            except Exception:
+                log.exception("node monitor sync failed")  # next tick retries
+
+    def sync(self) -> None:
+        now = time.time()
+        for node in self.store.list("Node", NODE_NAMESPACE):
+            hb = node.status.last_heartbeat
+            if not hb:
+                continue  # static node: no heartbeat contract
+            if now - hb <= self.grace:
+                continue
+            if node.status.ready:
+                try:
+                    cur = self.store.get("Node", NODE_NAMESPACE, node.metadata.name)
+                    cur.status.ready = False
+                    self.store.update(cur, force=True)
+                except NotFound:
+                    continue
+                self.recorder.event(
+                    node, WARNING, EVENT_NODE_LOST,
+                    f"node {node.metadata.name} stopped heartbeating "
+                    f"({now - hb:.1f}s > {self.grace:.1f}s grace)",
+                )
+                log.warning("node %s lost; evicting its pods", node.metadata.name)
+            self._evict_pods(node.metadata.name)
+
+    def _evict_pods(self, node_name: str) -> None:
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name != node_name or pod.is_finished():
+                continue
+            try:
+                cur = self.store.get(
+                    "Pod", pod.metadata.namespace, pod.metadata.name
+                )
+            except NotFound:
+                continue
+            if cur.is_finished():
+                continue
+            cur.status.phase = PodPhase.FAILED
+            cur.status.ready = False
+            cur.status.reason = "Evicted"
+            cur.status.message = f"node {node_name} lost (heartbeat timeout)"
+            try:
+                self.store.update(cur, force=True)
+            except NotFound:
+                continue
+            self.recorder.event(
+                cur, WARNING, EVENT_NODE_LOST,
+                f"evicted: node {node_name} stopped heartbeating",
+            )

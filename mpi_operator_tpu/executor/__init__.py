@@ -10,4 +10,15 @@ mirror — runs end-to-end in-suite with zero cluster.
 
 from mpi_operator_tpu.executor.local import LocalExecutor
 
-__all__ = ["LocalExecutor"]
+
+def __getattr__(name):
+    # NodeAgent lazily: importing it pulls in the agent's HTTP server bits,
+    # which pure-LocalExecutor users (worker images) never need
+    if name == "NodeAgent":
+        from mpi_operator_tpu.executor.agent import NodeAgent
+
+        return NodeAgent
+    raise AttributeError(name)
+
+
+__all__ = ["LocalExecutor", "NodeAgent"]

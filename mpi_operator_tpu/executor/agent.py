@@ -1,0 +1,312 @@
+"""NodeAgent: the per-node execution agent (the kubelet role).
+
+The reference's controller only *creates* pods
+(/root/reference/v2/pkg/controller/mpi_job_controller.go:817-877,1246-1296);
+kubernetes' kubelet — one per node — is what actually runs an MPIJob's
+workers on N machines and feeds their status back. This process is that
+component for this framework:
+
+- connects to the shared store (normally ``--store http://...``, the
+  etcd/apiserver seam of machinery/http_store.py),
+- **claims only pods whose ``spec.node_name`` matches its identity**
+  (the binding the gang scheduler wrote), runs them through the
+  LocalExecutor process machinery, and mirrors phases back,
+- registers itself as a :class:`Node` object and **heartbeats** it, so the
+  leader's NodeMonitor can evict pods off a dead node (≙ the node
+  controller's pod eviction),
+- serves its pods' log files over HTTP and stamps *URLs* (not local paths)
+  into ``pod.status.log_path``, so ``ctl logs`` works from any node
+  (≙ ``kubectl logs`` riding the kubelet API),
+- resolves coordinator addresses through the store: worker-0's pod →
+  its bound node → that node's advertised address (the headless-service
+  DNS role).
+
+Deployed as the DaemonSet-shaped second deployment of
+deploy/overlays/cluster (one per execution node):
+
+  python -m mpi_operator_tpu.executor.agent \\
+      --store http://store:8475 --token-file /etc/tpujob/token \\
+      --node-name slice0/0x0 --advertise 10.0.0.7
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from mpi_operator_tpu.executor.local import LocalExecutor
+from mpi_operator_tpu.machinery.objects import (
+    NODE_NAMESPACE,
+    Node,
+    Pod,
+    PodPhase,
+)
+from mpi_operator_tpu.machinery.store import NotFound
+
+log = logging.getLogger("tpujob.agent")
+
+
+class LogServer:
+    """Serves the agent's log directory read-only over HTTP.
+
+    GET /logs/<file> streams one pod log (basenames only — the executor
+    names files uniquely per pod incarnation; traversal is rejected).
+    """
+
+    def __init__(self, logs_dir: str, host: str = "0.0.0.0", port: int = 0):
+        self.logs_dir = logs_dir
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                prefix = "/logs/"
+                name = self.path[len(prefix):] if self.path.startswith(prefix) else ""
+                # basenames only: no separators, no traversal
+                if not name or "/" in name or "\\" in name or ".." in name:
+                    self.send_error(404)
+                    return
+                path = os.path.join(server.logs_dir, name)
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="agent-logs", daemon=True
+        )
+
+    def start(self) -> "LogServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class NodeAgent:
+    """One node's claim-run-report loop + Node heartbeat."""
+
+    def __init__(
+        self,
+        store,
+        node_name: str,
+        *,
+        advertise: str = "127.0.0.1",
+        capacity_chips: Optional[int] = None,
+        logs_dir: Optional[str] = None,
+        log_port: int = 0,
+        workdir: Optional[str] = None,
+        heartbeat_interval: float = 2.0,
+    ):
+        self.store = store
+        self.node_name = node_name
+        self.advertise = advertise
+        self.capacity_chips = capacity_chips
+        self.heartbeat_interval = heartbeat_interval
+        self.logs_dir = logs_dir or tempfile.mkdtemp(prefix="tpujob-agent-logs-")
+        self.log_server = LogServer(self.logs_dir, port=log_port)
+        self.executor = LocalExecutor(
+            store,
+            require_binding=True,
+            node_name=node_name,
+            logs_dir=self.logs_dir,
+            workdir=workdir,
+            log_url_base=None,  # filled at start (needs the bound log port)
+        )
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- node object ---------------------------------------------------------
+
+    def _node_template(self) -> Node:
+        node = Node()
+        node.metadata.namespace = NODE_NAMESPACE
+        node.metadata.name = self.node_name
+        node.status.address = self.advertise
+        node.status.log_url = f"http://{self.advertise}:{self.log_server.port}/logs"
+        node.status.capacity_chips = self.capacity_chips
+        node.status.ready = True
+        node.status.last_heartbeat = time.time()
+        return node
+
+    def _register(self) -> None:
+        tmpl = self._node_template()
+        try:
+            cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
+        except NotFound:
+            self.store.create(tmpl)
+            return
+        cur.status = tmpl.status
+        self.store.update(cur, force=True)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._register()  # create-or-refresh: survives node deletion
+            except Exception:
+                # store briefly unreachable: keep trying — the monitor's
+                # grace period absorbs short gaps
+                log.warning("heartbeat failed; retrying", exc_info=True)
+
+    def _evict_orphans(self) -> None:
+        """A restarted agent lost its child processes: any pod the store
+        still shows RUNNING on this node has no process behind it — mark it
+        evicted so the controller's gang-coherent restart recovers it
+        (the kubelet-restart reconciliation)."""
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name != self.node_name:
+                continue
+            if pod.status.phase != PodPhase.RUNNING:
+                continue
+            self._evict(pod, "node agent restarted; process lost")
+
+    def _evict(self, pod: Pod, message: str) -> None:
+        try:
+            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            return
+        cur.status.phase = PodPhase.FAILED
+        cur.status.ready = False
+        cur.status.reason = "Evicted"
+        cur.status.message = message
+        try:
+            self.store.update(cur, force=True)
+        except NotFound:
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "NodeAgent":
+        self.log_server.start()
+        self.executor.log_url_base = (
+            f"http://{self.advertise}:{self.log_server.port}/logs"
+        )
+        self._register()
+        self._evict_orphans()
+        self.executor.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+        log.info(
+            "node agent %s up (advertise %s, logs :%d)",
+            self.node_name, self.advertise, self.log_server.port,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.executor.stop()
+        try:
+            cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
+            cur.status.ready = False
+            self.store.update(cur, force=True)
+        except Exception:
+            pass  # best-effort drain mark; the monitor catches it anyway
+        self.log_server.stop()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpu-node-agent", description=__doc__)
+    ap.add_argument("--store", required=True,
+                    help="the shared store ('http://HOST:PORT' across nodes; "
+                         "'sqlite:PATH' for same-host testing)")
+    ap.add_argument("--token-file", default=None,
+                    help="bearer token file for an authenticated http store")
+    ap.add_argument("--node-name", required=True,
+                    help="this node's identity — must match what the "
+                         "scheduler binds (inventory mode: e.g. slice0/0x0)")
+    ap.add_argument("--advertise", default="127.0.0.1",
+                    help="address other nodes reach this node at "
+                         "(coordinator rendezvous + log fetch)")
+    ap.add_argument("--chips", type=int, default=None,
+                    help="chip capacity for scalar-mode node scheduling "
+                         "(default: unbounded)")
+    ap.add_argument("--logs-dir", default=None)
+    ap.add_argument("--log-port", type=int, default=0,
+                    help="port for the log endpoint (default: ephemeral)")
+    ap.add_argument("--heartbeat", type=float, default=2.0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    from mpi_operator_tpu.machinery.http_store import read_token_file
+    from mpi_operator_tpu.opshell.__main__ import build_store
+
+    if args.store == "memory":
+        print("error: --store memory is private to one process; an agent "
+              "needs the shared store the scheduler binds into",
+              file=sys.stderr)
+        return 2
+    try:
+        token = read_token_file(args.token_file)
+    except OSError as e:
+        print(f"error: --token-file: {e}", file=sys.stderr)
+        return 2
+    store = build_store(args.store, token=token)
+    agent = NodeAgent(
+        store,
+        args.node_name,
+        advertise=args.advertise,
+        capacity_chips=args.chips,
+        logs_dir=args.logs_dir,
+        log_port=args.log_port,
+        workdir=args.workdir,
+        heartbeat_interval=args.heartbeat,
+    ).start()
+    print(f"node agent {args.node_name} running "
+          f"(logs http://{args.advertise}:{agent.log_server.port}/logs)",
+          flush=True)
+    stop = threading.Event()
+
+    def on_signal(sig, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop.wait()
+    agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

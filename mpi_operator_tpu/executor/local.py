@@ -29,7 +29,7 @@ import threading
 import uuid
 from typing import Dict, Optional
 
-from mpi_operator_tpu.machinery.objects import Pod, PodPhase
+from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
 from mpi_operator_tpu.machinery.store import (
     ADDED,
     DELETED,
@@ -40,6 +40,22 @@ from mpi_operator_tpu.machinery.store import (
 from mpi_operator_tpu.runtime.emulation import pin_host_device_count
 
 log = logging.getLogger("tpujob.executor")
+
+
+def _die_with_parent() -> None:
+    """Child-side pre-exec hook: SIGKILL this process when the executor
+    dies (PR_SET_PDEATHSIG). An executor crash therefore behaves like a
+    node crash — no orphan workers silently holding ports/collectives —
+    which is exactly what the NodeAgent's restart reconciliation and the
+    NodeMonitor's eviction already assume. Linux-only; a no-op elsewhere."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, _signal.SIGKILL)  # PR_SET_PDEATHSIG = 1
+    except Exception:
+        pass
 
 ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
 ENV_CONFIG_DIR = "TPUJOB_CONFIG_DIR"
@@ -58,12 +74,22 @@ class LocalExecutor:
         workdir: Optional[str] = None,
         require_binding: bool = False,
         logs_dir: Optional[str] = None,
+        node_name: Optional[str] = None,
+        log_url_base: Optional[str] = None,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
         # kubelet semantics: with a scheduler in play, only bound pods run
         # (spec.node_name set by scheduler/gang.py's atomic admission)
         self.require_binding = require_binding
+        # node identity (executor/agent.py): claim ONLY pods bound to this
+        # node — the per-node kubelet role; None = run every bound pod
+        # (single-node LocalExecutor behavior)
+        self.node_name = node_name
+        # when set, pod.status.log_path gets f"{base}/<file>" instead of a
+        # local filesystem path, so `ctl logs` works cross-node through the
+        # agent's log endpoint
+        self.log_url_base = log_url_base.rstrip("/") if log_url_base else None
         self.extra_env = dict(extra_env or {})
         self.workdir = workdir
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
@@ -166,6 +192,8 @@ class LocalExecutor:
             return
         if self.require_binding and not pod.spec.node_name:
             return  # waiting for gang admission; binding event re-triggers
+        if self.node_name is not None and pod.spec.node_name != self.node_name:
+            return  # bound to another node — its agent claims it
         key = self._pod_key(pod)
         with self._lock:
             if key in self._procs:
@@ -179,8 +207,11 @@ class LocalExecutor:
             env.update(self.extra_env)
             env.update(container.env)
             if self.loopback_rewrite and ENV_COORDINATOR in env:
-                _, _, port = env[ENV_COORDINATOR].rpartition(":")
-                env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+                addr = env[ENV_COORDINATOR]
+                _, _, port = addr.rpartition(":")
+                env[ENV_COORDINATOR] = (
+                    f"{self._resolve_coordinator_host(pod, addr)}:{port}"
+                )
             # The executor owns the device inventory (≙ kubelet device
             # plugin): for cpu-family pods, pin the emulated chip count to
             # the pod's declared chips_per_host, overriding any inherited
@@ -225,6 +256,7 @@ class LocalExecutor:
                     stdout=f_out,
                     stderr=f_err,
                     text=True,
+                    preexec_fn=_die_with_parent,
                 )
             except OSError as e:
                 log.warning("pod %s failed to start: %s", key, e)
@@ -236,7 +268,10 @@ class LocalExecutor:
                 for f in handles:
                     f.close()
             self._procs[key] = proc
-        self._set_phase(pod, PodPhase.RUNNING, ip="127.0.0.1", log_path=log_path)
+        stamped = log_path
+        if self.log_url_base:
+            stamped = f"{self.log_url_base}/{os.path.basename(log_path)}"
+        self._set_phase(pod, PodPhase.RUNNING, ip="127.0.0.1", log_path=stamped)
         t = threading.Thread(
             target=self._reap, args=(pod, proc, base), name=f"reap-{key}",
             daemon=True,
@@ -245,6 +280,26 @@ class LocalExecutor:
         # prune finished reap threads so per-pod state doesn't accumulate
         self._threads = [th for th in self._threads if th.is_alive()]
         self._threads.append(t)
+
+    def _resolve_coordinator_host(self, pod: Pod, addr: str) -> str:
+        """The DNS role: ``<job>-worker-0.<subdomain>`` only resolves inside
+        a cluster's headless service. Single-node executors rewrite to
+        loopback (ports disambiguate jobs). A node agent resolves through
+        the store instead: coordinator pod → its bound node → that node's
+        advertised address (binding precedes launch under gang admission,
+        so the lookup is race-free)."""
+        if self.node_name is None:
+            return "127.0.0.1"
+        host, _, _ = addr.rpartition(":")
+        coord_pod_name = host.split(".", 1)[0]
+        coord = self.store.try_get(
+            "Pod", pod.metadata.namespace, coord_pod_name
+        )
+        if coord is not None and coord.spec.node_name:
+            node = self.store.try_get("Node", NODE_NAMESPACE, coord.spec.node_name)
+            if node is not None and node.status.address:
+                return node.status.address
+        return "127.0.0.1"
 
     def _reap(self, pod: Pod, proc: subprocess.Popen, base: str) -> None:
         proc.wait()

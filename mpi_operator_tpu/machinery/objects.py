@@ -97,7 +97,8 @@ class ConfigMap(_Dictable):
 @dataclass
 class PodGroupSpec(_Dictable):
     min_member: int = 0
-    queue: str = ""
+    # priority class name or integer string; resolved by the scheduler
+    # (scheduler/gang.py resolve_priority_class) to order pending gangs
     priority_class: str = ""
 
 
@@ -110,6 +111,40 @@ class PodGroup(_Dictable):
     kind: str = "PodGroup"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+
+
+# Nodes are cluster-scoped in kubernetes; this store is namespaced, so they
+# live under one well-known pseudo-namespace
+NODE_NAMESPACE = "nodes"
+
+
+@dataclass
+class NodeStatus(_Dictable):
+    # where this node can be reached (coordinator rendezvous resolution —
+    # the headless-service-DNS role the reference gets from kube DNS,
+    # ≙ newWorkersService :1141-1171 giving workers stable resolvable names)
+    address: str = ""
+    # base URL of the node agent's log endpoint; the agent stamps
+    # f"{log_url}/<file>" into pod.status.log_path so `ctl logs` reads
+    # cross-node (≙ `kubectl logs` riding the kubelet API)
+    log_url: str = ""
+    last_heartbeat: float = 0.0
+    ready: bool = False
+    # chips this node can host (None = unbounded); the scalar-mode gang
+    # scheduler admits against the sum over live nodes
+    capacity_chips: Optional[int] = None
+
+
+@dataclass
+class Node(_Dictable):
+    """A registered execution node (the kubelet's Node object). Node agents
+    (executor/agent.py) self-register and heartbeat; the NodeMonitor marks
+    stale nodes NotReady and evicts their pods (≙ the node controller's
+    pod eviction that the reference leans on for worker-loss recovery)."""
+
+    kind: str = "Node"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
 
 
 @dataclass
@@ -135,4 +170,4 @@ class Event(_Dictable):
     timestamp: float = 0.0
 
 
-KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event")
+KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event", "Node")

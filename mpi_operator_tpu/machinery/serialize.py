@@ -62,6 +62,7 @@ KIND_CLASSES: Dict[str, Type] = {
     "ConfigMap": mo.ConfigMap,
     "PodGroup": mo.PodGroup,
     "Event": mo.Event,
+    "Node": mo.Node,
 }
 
 

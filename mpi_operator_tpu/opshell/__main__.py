@@ -21,6 +21,7 @@ import sys
 import threading
 
 from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobController
+from mpi_operator_tpu.controller.node_monitor import NodeMonitor
 from mpi_operator_tpu.executor import LocalExecutor
 from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
@@ -58,13 +59,20 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--serve-store", default=None, metavar="HOST:PORT",
                     help="additionally serve this operator's backing store "
                          "over HTTP so other nodes can use --store http://...")
+    ap.add_argument("--token-file", default=None,
+                    help="shared bearer token file: required from peers when "
+                         "serving (--serve-store), presented when connecting "
+                         "to a remote --store http://...")
+    ap.add_argument("--node-grace", type=float, default=6.0,
+                    help="seconds without a node-agent heartbeat before its "
+                         "pods are evicted (the node-controller grace)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
     return ap
 
 
-def build_store(spec: str):
+def build_store(spec: str, token: str = None):
     if spec == "memory":
         return ObjectStore()
     if spec.startswith("sqlite:"):
@@ -74,7 +82,7 @@ def build_store(spec: str):
     if spec.startswith("http://") or spec.startswith("https://"):
         from mpi_operator_tpu.machinery.http_store import HttpStoreClient
 
-        return HttpStoreClient(spec)
+        return HttpStoreClient(spec, token=token)
     raise SystemExit(f"error: unknown --store {spec!r}")
 
 
@@ -89,7 +97,14 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    store = build_store(args.store)
+    from mpi_operator_tpu.machinery.http_store import read_token_file
+
+    try:
+        token = read_token_file(args.token_file)
+    except OSError as e:
+        print(f"error: --token-file: {e}", file=sys.stderr)
+        return 2
+    store = build_store(args.store, token=token)
     store_server = None
     if args.serve_store:
         from mpi_operator_tpu.machinery.http_store import (
@@ -107,7 +122,7 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"error: --serve-store: {e}", file=sys.stderr)
             return 2
-        store_server = StoreServer(store, host, port).start()
+        store_server = StoreServer(store, host, port, token=token).start()
         logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)
     controller = TPUJobController(
@@ -153,7 +168,8 @@ def main(argv=None) -> int:
         return 2
     scheduler = (
         GangScheduler(
-            store, recorder, chips=args.inventory_chips, inventory=inventory
+            store, recorder, chips=args.inventory_chips, inventory=inventory,
+            node_grace=args.node_grace,
         )
         if gang
         else None
@@ -163,6 +179,9 @@ def main(argv=None) -> int:
         if args.executor == "local"
         else None
     )
+    # the node-controller role (leader-only): evicts pods off nodes whose
+    # agents stop heartbeating, so gang restarts land on live nodes
+    monitor = NodeMonitor(store, recorder, grace=args.node_grace)
 
     stop = threading.Event()
 
@@ -172,6 +191,7 @@ def main(argv=None) -> int:
             scheduler.start()
         if executor:
             executor.start()
+        monitor.start()
 
     def on_stopped():
         # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
@@ -181,6 +201,7 @@ def main(argv=None) -> int:
             scheduler.stop()
         if executor:
             executor.stop()
+        monitor.stop()
         stop.set()
 
     elector = LeaderElector(

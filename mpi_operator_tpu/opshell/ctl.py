@@ -115,10 +115,20 @@ def cmd_get(client: TPUJobClient, args) -> int:
     if not jobs:
         print("No tpujobs found.")
         return 0
+    from mpi_operator_tpu.api.defaults import set_defaults
+
+    def _workers(j) -> int:
+        # stored specs are deliberately un-defaulted: render the effective
+        # replica count the controller will run with, not 'None'
+        if j.spec.worker and j.spec.worker.replicas is not None:
+            return j.spec.worker.replicas
+        d = set_defaults(j.deepcopy())
+        return d.spec.worker.replicas if d.spec.worker else 0
+
     rows = [
         [
             j.metadata.name,
-            j.spec.worker.replicas if j.spec.worker else 0,
+            _workers(j),
             job_state(j),
             _age(j.metadata.creation_timestamp),
         ]
@@ -304,6 +314,24 @@ def cmd_logs(client: TPUJobClient, args) -> int:
         return 1
     if args.stderr:
         path = path[: -len(".log")] + ".err" if path.endswith(".log") else path
+    if path.startswith("http://") or path.startswith("https://"):
+        # a node agent stamped a URL: fetch from its log endpoint — the
+        # `kubectl logs`-through-the-kubelet-API path, works from any node
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(path, timeout=10) as r:
+                sys.stdout.write(r.read().decode(errors="replace"))
+        except (urllib.error.URLError, OSError) as e:
+            where = pod.spec.node_name or "its node"
+            print(
+                f"error: cannot fetch {path} ({e}); the pod ran on {where} "
+                f"— is its node agent still up?",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     try:
         with open(path) as f:
             sys.stdout.write(f.read())
@@ -351,6 +379,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--store", required=True,
                     help="'sqlite:PATH' or 'http://HOST:PORT' (the shared "
                          "store an operator is running on)")
+    ap.add_argument("--token-file", default=None,
+                    help="bearer token file for an authenticated http store")
     ap.add_argument("-n", "--namespace", default="default")
     sub = ap.add_subparsers(dest="verb", required=True)
     p = sub.add_parser("create", help="submit a TPUJob manifest")
@@ -393,9 +423,15 @@ def main(argv=None) -> int:
               "point at a shared store (sqlite:PATH or http://HOST:PORT)",
               file=sys.stderr)
         return 2
+    from mpi_operator_tpu.machinery.http_store import read_token_file
     from mpi_operator_tpu.opshell.__main__ import build_store
 
-    store = build_store(args.store)
+    try:
+        token = read_token_file(args.token_file)
+    except OSError as e:
+        print(f"error: --token-file: {e}", file=sys.stderr)
+        return 2
+    store = build_store(args.store, token=token)
     client = TPUJobClient(store, namespace=args.namespace)
     try:
         return {

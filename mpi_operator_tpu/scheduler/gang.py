@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -43,7 +44,7 @@ from mpi_operator_tpu.controller.placement import (
     ANNOTATION_SLICE_ID,
 )
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
-from mpi_operator_tpu.machinery.objects import Pod, PodPhase
+from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
 from mpi_operator_tpu.machinery.store import NotFound, ObjectStore
 from mpi_operator_tpu.scheduler.inventory import (
     SliceInventory,
@@ -59,6 +60,30 @@ EVENT_UNSCHEDULABLE = "Unschedulable"
 EVENT_SCHEDULED = "Scheduled"
 
 NODE_NAME = "local"  # single-host emulation: binding == admission
+
+# Built-in priority classes (≙ the PriorityClass objects a k8s cluster would
+# define; the reference stamps the name onto a Volcano PodGroup and relies on
+# Volcano to resolve it — mpi_job_controller.go:1215-1237). Bare integer
+# strings are accepted too; unknown names admit at 0 with a warning event at
+# admission time (validation rejects them up front).
+PRIORITY_CLASSES = {
+    "": 0,
+    "low": -100,
+    "default": 0,
+    "high": 100,
+    "critical": 1000,
+}
+
+
+def resolve_priority_class(name: str) -> Optional[int]:
+    """Priority value for a class name or integer literal; None if unknown
+    (api/validation.py uses this to reject bad specs at admission)."""
+    if name in PRIORITY_CLASSES:
+        return PRIORITY_CLASSES[name]
+    try:
+        return int(name)
+    except ValueError:
+        return None
 
 
 def pod_cost(pod: Pod) -> int:
@@ -80,16 +105,31 @@ class GangScheduler:
         *,
         chips: Optional[int] = None,
         inventory: Optional[SliceInventory] = None,
+        node_grace: float = 6.0,
+        starvation_grace: float = 300.0,
     ):
         self.store = store
         self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
         self.chips = chips
         self.inventory = inventory  # topology mode; overrides the chip budget
+        # scalar mode with registered Nodes: a node whose agent heartbeat is
+        # older than this is not a binding target (matches the NodeMonitor)
+        self.node_grace = node_grace
+        # starvation guard for priority ordering: a gang pending longer than
+        # this jumps to the head of the queue (FIFO among the aged), so a
+        # stream of high-priority jobs cannot starve a low-priority one
+        # forever
+        self.starvation_grace = starvation_grace
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch_q = None
         self._last_warning: Dict[str, str] = {}  # pg key → message (dedupe)
+        # pg key → when it last became pending (has unbound pods); drives
+        # the starvation guard. PodGroups outlive gang restarts, so aging
+        # must measure time-PENDING, not object age — a long-running job
+        # that restarts is not thereby starved.
+        self._pending_since: Dict[str, float] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -160,10 +200,52 @@ class GangScheduler:
 
         free = self.free_chips()  # None = unbounded
         occ = None  # topology occupancy, computed once on first use
-        groups = sorted(
-            self.store.list("PodGroup"),
-            key=lambda g: (g.metadata.creation_timestamp or 0, g.metadata.name),
-        )
+        # scalar mode turns node-aware the moment agents register Nodes:
+        # binding targets become live nodes (≙ kubelets posting NodeStatus)
+        # instead of the single-process 'local' sentinel
+        nodes: Optional[List] = None
+        node_used: Dict[str, int] = {}
+        if self.inventory is None:
+            all_nodes = self.store.list("Node", NODE_NAMESPACE)
+            if all_nodes:
+                nodes = self._live_nodes(all_nodes)
+                node_used = self._node_used(pods)
+        # (priority desc, FIFO) with an aging guard: aged gangs go first in
+        # plain FIFO order — the queue the reference delegates to Volcano's
+        # priorityClassName handling (mpi_job_controller.go:1215-1237),
+        # implemented here because admission IS this component
+        now = time.time()
+        all_groups = self.store.list("PodGroup")
+        keys = set()
+        for pg in all_groups:
+            key = self._pg_key(pg)
+            keys.add(key)
+            job = pg.metadata.labels.get(LABEL_JOB_NAME, pg.metadata.name)
+            members = by_gang.get((pg.metadata.namespace, job), [])
+            if any(
+                not p.spec.node_name
+                and p.status.phase == PodPhase.PENDING
+                and not p.is_finished()
+                for p in members
+            ):
+                self._pending_since.setdefault(key, now)
+            else:
+                self._pending_since.pop(key, None)
+        for stale in set(self._pending_since) - keys:
+            self._pending_since.pop(stale, None)  # deleted gangs don't leak
+
+        def order(pg):
+            key = self._pg_key(pg)
+            ts = pg.metadata.creation_timestamp or 0
+            pri = resolve_priority_class(pg.spec.priority_class)
+            if pri is None:
+                pri = 0  # validation rejects these; stored legacy admits at 0
+            since = self._pending_since.get(key, now)
+            if now - since > self.starvation_grace:
+                return (0, 0, since, pg.metadata.name)
+            return (1, -pri, ts, pg.metadata.name)
+
+        groups = sorted(all_groups, key=order)
         for pg in groups:
             job = pg.metadata.labels.get(LABEL_JOB_NAME, pg.metadata.name)
             members = by_gang.get((pg.metadata.namespace, job), [])
@@ -179,12 +261,13 @@ class GangScheduler:
             if self.inventory is not None:
                 if occ is None:
                     occ = self.occupancy()
+                    self._occlude_dead_nodes(occ)
                 if not self._sync_gang_topology(pg, bound, unbound, occ):
                     break  # strict FIFO, same as the scalar branch below
                 continue
             if bound:
-                # gang already admitted: later members (elastic scale-up)
-                # bind individually as capacity allows
+                # gang already admitted: later members (elastic scale-up /
+                # evicted-member relaunch) bind individually as capacity allows
                 for p in unbound:
                     cost = pod_cost(p)
                     if free is not None and cost > free:
@@ -194,8 +277,20 @@ class GangScheduler:
                             f"chips, {free} free",
                         )
                         break
-                    if self._bind(p) and free is not None:
-                        free -= cost
+                    target = NODE_NAME
+                    if nodes is not None:
+                        target = self._pick_node(nodes, node_used, cost)
+                        if target is None:
+                            self._warn(
+                                pg,
+                                f"scale-up pod {p.metadata.name} needs {cost} "
+                                f"chips but no live node has room",
+                            )
+                            break
+                    if self._bind(p, target):
+                        if free is not None:
+                            free -= cost
+                        node_used[target] = node_used.get(target, 0) + cost
                 continue
             # fresh gang: all-or-nothing
             if len(unbound) < pg.spec.min_member:
@@ -211,12 +306,24 @@ class GangScheduler:
                 # strict FIFO: do not backfill later gangs past this one —
                 # a stream of small jobs could otherwise starve a large one
                 break
+            assignment = None
+            if nodes is not None:
+                assignment = self._assign_gang(nodes, node_used, unbound)
+                if assignment is None:
+                    self._warn(
+                        pg,
+                        f"gang needs {total} chips ({len(unbound)} pods) but "
+                        f"no placement fits the {len(nodes)} live node(s)",
+                    )
+                    break  # capacity: hold the FIFO, same as the budget path
             n = 0
             for p in unbound:
-                if self._bind(p):
+                target = assignment[p.metadata.name] if assignment else NODE_NAME
+                if self._bind(p, target):
                     n += 1
                     if free is not None:
                         free -= pod_cost(p)
+                    node_used[target] = node_used.get(target, 0) + pod_cost(p)
             self._last_warning.pop(self._pg_key(pg), None)
             self.recorder.event(
                 pg, "Normal", EVENT_SCHEDULED,
@@ -341,6 +448,81 @@ class GangScheduler:
             f"block(s) at {where}",
         )
         return True
+
+    def _occlude_dead_nodes(self, occ: Dict[str, set]) -> None:
+        """Inventory mode with registered agents: mark the host slot of any
+        registered-but-not-live Node as occupied, so the block search routes
+        around dead hardware. Without this, a gang evicted off a dead node
+        would be re-placed onto the same free-looking slot and bounce
+        through evict/restart until backoffLimit kills the job. Hosts with
+        no registered agent stay schedulable (pure-inventory deployments
+        carry no Node objects at all)."""
+        all_nodes = self.store.list("Node", NODE_NAMESPACE)
+        if not all_nodes:
+            return
+        live = {n.metadata.name for n in self._live_nodes(all_nodes)}
+        for n in all_nodes:
+            if n.metadata.name in live:
+                continue
+            parsed = parse_node_name(n.metadata.name)
+            if parsed is not None:
+                occ.setdefault(parsed[0], set()).add(parsed[1])
+
+    # -- scalar node mode ---------------------------------------------------
+
+    def _live_nodes(self, all_nodes: List) -> List:
+        """Ready nodes with a fresh heartbeat (or static: heartbeat 0),
+        name-sorted for deterministic spread."""
+        now = time.time()
+        out = []
+        for n in all_nodes:
+            if not n.status.ready:
+                continue
+            hb = n.status.last_heartbeat
+            if hb and now - hb > self.node_grace:
+                continue
+            out.append(n)
+        return sorted(out, key=lambda n: n.metadata.name)
+
+    @staticmethod
+    def _node_used(pods: List[Pod]) -> Dict[str, int]:
+        used: Dict[str, int] = defaultdict(int)
+        for p in pods:
+            if p.spec.node_name and not p.is_finished():
+                used[p.spec.node_name] += pod_cost(p)
+        return used
+
+    @staticmethod
+    def _pick_node(nodes: List, used: Dict[str, int], cost: int) -> Optional[str]:
+        """Least-loaded live node with room (spread; name order breaks ties)."""
+        best = None
+        best_load = None
+        for n in nodes:
+            cap = n.status.capacity_chips
+            u = used.get(n.metadata.name, 0)
+            if cap is not None and u + cost > cap:
+                continue
+            if best is None or u < best_load:
+                best, best_load = n.metadata.name, u
+        return best
+
+    def _assign_gang(
+        self, nodes: List, used: Dict[str, int], unbound: List[Pod]
+    ) -> Optional[Dict[str, str]]:
+        """All-or-nothing pod→node assignment for a fresh gang: greedy
+        least-loaded spread simulated on a scratch copy, committed only when
+        every member fits (gang semantics — no partial placement). Pods are
+        taken in name order so worker 0 lands deterministically."""
+        scratch = dict(used)
+        out: Dict[str, str] = {}
+        for p in sorted(unbound, key=lambda p: p.metadata.name):
+            cost = pod_cost(p)
+            target = self._pick_node(nodes, scratch, cost)
+            if target is None:
+                return None
+            scratch[target] = scratch.get(target, 0) + cost
+            out[p.metadata.name] = target
+        return out
 
     # -- helpers ------------------------------------------------------------
 

@@ -1,0 +1,49 @@
+"""Gang-coupled test workload: a stand-in for an SPMD program's collective
+liveness coupling, without JAX import cost.
+
+Worker 0 serves a TCP socket one step off the rendezvous port; its peer
+holds the connection open with heartbeat bytes. Losing the peer mid-run
+surfaces as EOF and worker 0 exits 1 — the same shape as an XLA collective
+erroring when a gang member dies. Node-loss tests use this to exercise the
+drain → gang-restart path with realistic failure ordering.
+"""
+
+import os
+import socket
+import sys
+import time
+
+addr = os.environ["TPUJOB_COORDINATOR_ADDRESS"]
+host, _, port = addr.rpartition(":")
+port = int(port) + 1  # sidecar port next to the rendezvous port
+host_id = int(os.environ["TPUJOB_HOST_ID"])
+hold = float(os.environ.get("HOLD_SECONDS", "5"))
+
+if host_id == 0:
+    srv = socket.create_server((host, port))
+    srv.settimeout(60)
+    conn, _ = srv.accept()
+    conn.settimeout(60)
+    deadline = time.time() + hold
+    while time.time() < deadline:
+        b = conn.recv(1)
+        if not b:
+            print("peer lost: collective failed", flush=True)
+            sys.exit(1)
+    print("survived", flush=True)
+else:
+    for _ in range(300):
+        try:
+            conn = socket.create_connection((host, port), timeout=2)
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        sys.exit(2)
+    try:
+        deadline = time.time() + hold + 5.0  # outlive the coordinator's window
+        while time.time() < deadline:
+            conn.send(b"x")
+            time.sleep(0.1)
+    except OSError:
+        pass  # coordinator finished first: our job is done

@@ -326,7 +326,9 @@ def _run_elastic_rescale(tmp_path, *, name, from_replicas, to_replicas):
     executor.start()
     try:
         # phase 1: wait until the gang has saved a checkpoint (mid-training)
-        deadline = time.time() + 240
+        # (one deadline spans checkpoint-wait AND rescale-converge: two
+        # llama compile generations; 240s flakes under concurrent load)
+        deadline = time.time() + 420
         while time.time() < deadline:
             if ckpt.exists() and any(p.is_dir() for p in ckpt.iterdir()):
                 break

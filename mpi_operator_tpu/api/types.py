@@ -400,17 +400,28 @@ class ElasticPolicy(_Dictable):
 @dataclass
 class SchedulingPolicy(_Dictable):
     """Gang-scheduling knobs. ≙ common.SchedulingPolicy consumed by newPodGroup
-    (reference v2/pkg/controller/mpi_job_controller.go:1215-1237)."""
+    (reference v2/pkg/controller/mpi_job_controller.go:1215-1237).
+
+    ``priority_class`` orders pending gangs in the scheduler: a built-in
+    class name (low | default | high | critical) or a bare integer string
+    (higher admits first; default 0). Unlike the reference — which stamps
+    the field onto a Volcano PodGroup and hopes an external scheduler
+    honors it — admission here implements the ordering itself
+    (scheduler/gang.py), with an aging guard so a starved low-priority
+    gang eventually reaches the head. The reference's ``queue`` field
+    (a Volcano capacity-pool name) is deliberately NOT carried: this
+    framework's capacity model is the slice inventory / node capacities,
+    and a declared-but-unenforced knob would be exactly the silent-config
+    pattern this API refuses elsewhere (cf. RunPolicy, implemented here
+    though declared-only in the reference)."""
 
     min_available: Optional[int] = None
-    queue: str = ""
     priority_class: str = ""
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "SchedulingPolicy":
         return SchedulingPolicy(
             min_available=d.get("min_available"),
-            queue=d.get("queue", ""),
             priority_class=d.get("priority_class", ""),
         )
 

@@ -96,6 +96,19 @@ def validate_tpujob(job: TPUJob) -> List[str]:
             f"spec.worker.restart_policy: unsupported value {rp!r}, "
             f"expected one of {list(RestartPolicy.ALL_VALUES)}"
         )
+    sp = spec.run_policy.scheduling_policy
+    if sp is not None and sp.priority_class:
+        from mpi_operator_tpu.scheduler.gang import (
+            PRIORITY_CLASSES,
+            resolve_priority_class,
+        )
+
+        if resolve_priority_class(sp.priority_class) is None:
+            errs.append(
+                f"spec.run_policy.scheduling_policy.priority_class: unknown "
+                f"class {sp.priority_class!r}; expected one of "
+                f"{sorted(k for k in PRIORITY_CLASSES if k)} or an integer"
+            )
     acc = spec.slice.accelerator
     if acc and acc not in KNOWN_ACCELERATORS:
         # ≙ the MPIImplementation enum check (validation.go:69-79): reject

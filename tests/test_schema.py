@@ -222,7 +222,8 @@ def test_cluster_overlay_store_wiring_is_coherent():
                  if p["target"]["kind"] == "Deployment"][0]
     ops = yaml.safe_load(dep_patch["patch"])
     store_url = [p["value"] for p in ops
-                 if p["op"] == "replace" and p["value"].startswith("--store=")][0]
+                 if p["op"] == "replace" and isinstance(p["value"], str)
+                 and p["value"].startswith("--store=")][0]
     assert store_url == f"--store=http://{svc['metadata']['name']}:{svc_port}"
     # the PVC the base mounts is deleted; the store's own PVC exists
     assert by_kind["PersistentVolumeClaim"]["spec"]["accessModes"] == [

@@ -29,7 +29,10 @@ from mpi_operator_tpu.parallel.ring_attention import (
     dense_attention,
     ring_attention,
 )
-from mpi_operator_tpu.parallel.sharding import with_logical_constraint
+from mpi_operator_tpu.parallel.sharding import (
+    with_logical_constraint,
+    with_logical_constraint_fwd,
+)
 from mpi_operator_tpu.runtime.topology import AXIS_SEQ
 
 Params = Dict[str, Any]
@@ -217,8 +220,22 @@ def apply(
             return x
         return with_logical_constraint(x, axes, rules=rules, mesh=mesh)
 
-    x = params["embed"]["w"].astype(dt)[tokens]
-    x = constrain(x, ["batch", "seq", "embed"])
+    def constrain_fwd(x, axes):
+        # forward-only at activation boundaries: the cotangent arrives
+        # sharded by the weight layout (d_model over fsdp); forcing the
+        # batch-sharded primal spec onto it makes the partitioner fall back
+        # to replicate-then-repartition (involuntary full remat)
+        if mesh is None:
+            return x
+        return with_logical_constraint_fwd(x, axes, rules=rules, mesh=mesh)
+
+    # gather from a table laid out for lookup: vocab stays tensor-sharded
+    # (XLA's TP-embedding gather + psum), the embed dim is gathered over
+    # fsdp VOLUNTARILY here — otherwise the partitioner reshards the gather
+    # output [.,.,fsdp] → [batch-sharded] by full rematerialization
+    emb = constrain(params["embed"]["w"].astype(dt), ["vocab", None])
+    x = emb[tokens]
+    x = constrain_fwd(x, ["batch", "seq", "embed"])
 
     def layer(carry, lp):
         h = carry
@@ -268,12 +285,12 @@ def apply(
                 )
             attn = attn.reshape(b, t, c.q_dim)
             h = h + attn @ lp["wo"]["w"].astype(dt)
-        h = constrain(h, ["batch", "seq", "embed"])
+        h = constrain_fwd(h, ["batch", "seq", "embed"])
         y = _rmsnorm(h, lp["mlp_norm"]["scale"], c.norm_eps)
         gate = jax.nn.silu(y @ lp["w_gate"]["w"].astype(dt))
         up = y @ lp["w_up"]["w"].astype(dt)
         h = h + (gate * up) @ lp["w_down"]["w"].astype(dt)
-        h = constrain(h, ["batch", "seq", "embed"])
+        h = constrain_fwd(h, ["batch", "seq", "embed"])
         return h, None
 
     if c.remat_layers:

@@ -129,3 +129,36 @@ def with_logical_constraint(
     if isinstance(mesh, Mesh):
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     return jax.lax.with_sharding_constraint(x, spec)
+
+
+def with_logical_constraint_fwd(
+    x,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Rules] = None,
+    mesh: Optional[Mesh] = None,
+):
+    """Forward-only logical constraint: the primal is annotated, the
+    cotangent passes through UNconstrained.
+
+    ``with_sharding_constraint`` transposes to the same constraint on the
+    cotangent — but activation gradients often arrive sharded by the
+    *weight* layout (e.g. d_model sharded over fsdp out of a ZeRO matmul
+    backward) while the primal constraint shards the *batch* dim over
+    fsdp. Forcing that transition makes the SPMD partitioner fall back to
+    "replicate then repartition" ([SPMD] Involuntary full
+    rematerialization). Leaving the backward free lets XLA keep the
+    natural cotangent sharding and pick the cheap collective itself."""
+    import jax
+
+    @jax.custom_vjp
+    def _constrained(y):
+        return with_logical_constraint(y, logical_axes, rules=rules, mesh=mesh)
+
+    def _fwd(y):
+        return _constrained(y), None
+
+    def _bwd(_, g):
+        return (g,)
+
+    _constrained.defvjp(_fwd, _bwd)
+    return _constrained(x)

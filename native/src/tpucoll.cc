@@ -91,11 +91,26 @@ struct tpucoll_ctx {
 
 namespace {
 
+/* Close every coordinator-side peer socket so ranks blocked in read_full
+ * see EOF and fail fast. Without this, a protocol error observed on one
+ * rank (e.g. a version-skewed client sending a non-divisible
+ * reduce_scatter) would leave every other rank hanging forever in its
+ * blocking read. destroy_ctx skips the -1s. */
+void close_peers(tpucoll_ctx *ctx) {
+  for (int &fd : ctx->peers) {
+    if (fd >= 0) {
+      shutdown(fd, SHUT_RDWR);
+      close(fd);
+      fd = -1;
+    }
+  }
+}
+
 /* Coordinator loop: one round = one matching request from every rank.
  * Answers allreduce with the sum to all; reduce-root with the sum to rank 0
  * and an empty ack to others; barrier with an ack. Exits after a full round
  * of finalize. */
-void serve(tpucoll_ctx *ctx) {
+void serve_rounds(tpucoll_ctx *ctx) {
   const int n = ctx->size;
   std::vector<double> acc;
   for (;;) {
@@ -190,6 +205,13 @@ void serve(tpucoll_ctx *ctx) {
         return;
     }
   }
+}
+
+/* Every exit from the round loop — clean finalize or any error — closes
+ * the peer sockets, so no rank can stay blocked on a wedged gang. */
+void serve(tpucoll_ctx *ctx) {
+  serve_rounds(ctx);
+  close_peers(ctx);
 }
 
 /* Tear down a ctx whose init failed partway. Order matters: close the

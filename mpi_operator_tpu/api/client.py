@@ -114,18 +114,52 @@ class TPUJobClient:
         until: Callable[[Any], bool],
         timeout: float = 300.0,
         namespace: Optional[str] = None,
-        poll: float = 0.1,
     ) -> TPUJob:
-        """Block until ``until(job.status)`` holds; raises TimeoutError.
-        Polling (not watch-based) so it works identically on every backend."""
+        """Block until ``until(job.status)`` holds; raises TimeoutError
+        (NotFound if the job is deleted mid-wait).
+
+        Watch-based on every backend (≙ kubectl wait riding the watch API):
+        the store's watch queue delivers changes — long-poll over HTTP,
+        poll-free in-process — instead of a get round-trip per tick. The
+        watch registers BEFORE the initial read so no transition between
+        them is lost; relist recovery re-delivers as MODIFIED, which a
+        level-triggered predicate absorbs."""
+        from mpi_operator_tpu.machinery.store import DELETED, NotFound
+
         ns = namespace or self.namespace
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        q = self.store.watch(self.KIND)
+        try:
             job = self.store.get(self.KIND, ns, name)
             if until(job.status):
                 return job
-            time.sleep(poll)
-        raise TimeoutError(f"TPUJob {ns}/{name} did not reach the desired state")
+            deadline = time.time() + timeout
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"TPUJob {ns}/{name} did not reach the desired state"
+                    )
+                try:
+                    ev = q.get(timeout=min(remaining, 1.0))
+                except queue.Empty:
+                    # idle resync (≙ the informer's periodic relist): relist
+                    # recovery after a watch gap only re-delivers LIVE
+                    # objects, so a deletion that fell inside the gap would
+                    # otherwise never surface. One level-triggered read per
+                    # idle second bounds that — NotFound propagates.
+                    job = self.store.get(self.KIND, ns, name)
+                    if until(job.status):
+                        return job
+                    continue
+                m = ev.obj.metadata
+                if m.name != name or m.namespace != ns:
+                    continue
+                if ev.type == DELETED:
+                    raise NotFound(f"TPUJob {ns}/{name} deleted while waiting")
+                if until(ev.obj.status):
+                    return ev.obj
+        finally:
+            self.store.stop_watch(q)
 
 
 __all__ = ["TPUJobClient", "ValidationRejected", "ManifestError"]

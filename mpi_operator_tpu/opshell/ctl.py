@@ -18,7 +18,8 @@ events, logs (a pod's stdout/stderr from the executor's log dir — the path
 is stamped in pod.status.log_path and is local to the node in
 spec.node_name), scale (live worker-replica change — the elastic entry
 point), suspend/resume (runPolicy.suspend), watch (stream condition
-transitions until the job finishes).
+transitions until the job finishes, riding the store watch protocol),
+nodes (the registered agent fleet, ≙ kubectl get nodes).
 """
 
 from __future__ import annotations
@@ -346,30 +347,100 @@ def cmd_logs(client: TPUJobClient, args) -> int:
     return 0
 
 
+def cmd_nodes(client: TPUJobClient, args) -> int:
+    """≙ `kubectl get nodes`: the execution plane at a glance — agent
+    registrations, readiness, heartbeat age, capacity, and how many live
+    pods each node is running."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+    nodes = sorted(
+        client.store.list("Node", NODE_NAMESPACE), key=lambda n: n.metadata.name
+    )
+    if not nodes:
+        print("No nodes registered (single-node deployments run without "
+              "agents; see executor/agent.py).")
+        return 0
+    pods = client.store.list("Pod")
+    load = {}
+    for p in pods:
+        if p.spec.node_name and not p.is_finished():
+            load[p.spec.node_name] = load.get(p.spec.node_name, 0) + 1
+    now = time.time()
+    rows = []
+    for n in nodes:
+        hb = n.status.last_heartbeat
+        rows.append([
+            n.metadata.name,
+            "Ready" if n.status.ready else "NotReady",
+            "static" if not hb else f"{max(0, now - hb):.1f}s",
+            n.status.capacity_chips if n.status.capacity_chips is not None else "-",
+            load.get(n.metadata.name, 0),
+            n.status.address or "-",
+        ])
+    print(_table(rows, ["NAME", "STATUS", "HEARTBEAT", "CHIPS", "PODS", "ADDRESS"]))
+    return 0
+
+
 def cmd_watch(client: TPUJobClient, args) -> int:
-    """Stream state transitions until the job finishes (≙ kubectl get -w)."""
+    """Stream state transitions until the job finishes (≙ kubectl get -w —
+    which rides the watch API, so this does too: the store's watch queue
+    delivers changes instead of a get round-trip every 200ms)."""
+    import queue
+
+    from mpi_operator_tpu.machinery.store import DELETED
+
+    q = client.store.watch("TPUJob")  # register BEFORE the initial read
     try:
-        job = client.get(args.name)
-    except NotFound as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    last = None
-    deadline = time.time() + args.timeout
-    while time.time() < deadline:
         try:
             job = client.get(args.name)
-        except NotFound:
-            print(f"{args.name}  <deleted>")
-            return 0
-        state = job_state(job)
-        if state != last:
-            print(f"{job.metadata.name}  {state}")
-            last = state
-        if is_finished(job.status):
-            return 0 if is_succeeded(job.status) else 1
-        time.sleep(0.2)
-    print(f"error: timed out after {args.timeout}s", file=sys.stderr)
-    return 1
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        last = None
+        deadline = time.time() + args.timeout
+
+        def emit(job) -> Optional[int]:
+            nonlocal last
+            state = job_state(job)
+            if state != last:
+                print(f"{job.metadata.name}  {state}", flush=True)
+                last = state
+            if is_finished(job.status):
+                return 0 if is_succeeded(job.status) else 1
+            return None
+
+        rc = emit(job)
+        if rc is not None:
+            return rc
+        while time.time() < deadline:
+            try:
+                ev = q.get(timeout=max(0.01, min(deadline - time.time(), 1.0)))
+            except queue.Empty:
+                # idle resync: a deletion inside a watch/relist gap emits no
+                # DELETED event (relists re-deliver live objects only), so
+                # level-check once per idle second
+                try:
+                    job = client.get(args.name)
+                except NotFound:
+                    print(f"{args.name}  <deleted>")
+                    return 0
+                rc = emit(job)
+                if rc is not None:
+                    return rc
+                continue
+            m = ev.obj.metadata
+            if m.name != args.name or m.namespace != client.namespace:
+                continue
+            if ev.type == DELETED:
+                print(f"{args.name}  <deleted>")
+                return 0
+            rc = emit(ev.obj)
+            if rc is not None:
+                return rc
+        print(f"error: timed out after {args.timeout}s", file=sys.stderr)
+        return 1
+    finally:
+        client.store.stop_watch(q)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -411,6 +482,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("watch", help="stream state transitions until finished")
     p.add_argument("name")
     p.add_argument("--timeout", type=float, default=600.0)
+    sub.add_parser("nodes", help="list registered execution nodes "
+                                 "(the agent fleet; like kubectl get nodes)")
     return ap
 
 
@@ -445,6 +518,7 @@ def main(argv=None) -> int:
             "suspend": cmd_suspend,
             "resume": cmd_resume,
             "watch": cmd_watch,
+            "nodes": cmd_nodes,
         }[args.verb](client, args)
     finally:
         close = getattr(store, "close", None)

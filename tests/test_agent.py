@@ -15,6 +15,8 @@ These tests pin the new execution plane end to end:
 """
 
 import os
+
+import pytest
 import time
 import urllib.request
 
@@ -184,6 +186,7 @@ def test_monitor_spares_fresh_and_static_nodes():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_two_agents_execute_one_pod_each_with_log_urls(tmp_path):
     from mpi_operator_tpu.api.client import TPUJobClient
     from mpi_operator_tpu.api.conditions import is_finished, is_succeeded
@@ -341,6 +344,7 @@ def _wait_nodes_registered(store, names, timeout=60):
     raise TimeoutError(f"nodes {names} never registered (have {have})")
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_multinode_agents_run_pi_end_to_end(tmp_path):
     """The round-3 hole, closed: a store-serving operator that executes
     nothing itself + two separate agent processes. The 2-worker pi job's
@@ -382,6 +386,7 @@ def test_multinode_agents_run_pi_end_to_end(tmp_path):
         _reap(procs)
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_agent_death_evicts_and_gang_restarts_on_survivor(tmp_path):
     """Kill one agent mid-job: the leader's NodeMonitor notices the silent
     heartbeat, evicts the dead node's pod (reason=Evicted — retryable), the
@@ -442,3 +447,26 @@ def test_agent_death_evicts_and_gang_restarts_on_survivor(tmp_path):
         assert node_b.status.ready is False
     finally:
         _reap(procs)
+
+
+def test_ctl_nodes_lists_the_agent_fleet(tmp_path, capsys):
+    """`ctl nodes` ≙ `kubectl get nodes`: the execution plane at a glance."""
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.opshell.ctl import cmd_nodes
+
+    store = ObjectStore()
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", ready=False)
+    pod = _bound_running_pod(store, "j", "node-a")
+    assert pod is not None
+    client = TPUJobClient(store)
+
+    class A:
+        pass
+
+    assert cmd_nodes(client, A()) == 0
+    out = capsys.readouterr().out
+    assert "node-a" in out and "Ready" in out
+    assert "node-b" in out and "NotReady" in out
+    lines = [ln for ln in out.splitlines() if ln.startswith("node-a")]
+    assert lines and " 4 " in lines[0] and " 1 " in lines[0]  # chips, pods

@@ -12,6 +12,9 @@ from mpi_operator_tpu.ops.data import make_global_batch
 from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_FSDP
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 def _setup(mesh):
     cfg = mnist.Config(hidden=32)

@@ -74,6 +74,7 @@ def test_create_accepts_typed_object():
     assert created.metadata.name == "typed"
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_submit_through_full_stack_and_wait():
     """The SDK round trip of the reference example: create → controller
     reconciles → executor runs → wait() observes Succeeded."""

@@ -13,6 +13,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_operator_tpu.parallel import collectives as c
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 AXIS = "data"
 
 

@@ -53,6 +53,7 @@ def run_ctl(store_spec, *argv):
     return ctl.main(["--store", store_spec, *argv])
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_create_watch_get_describe_events_delete(stack, capsys):
     """The full kubectl-style session against a running operator."""
     assert run_ctl(stack, "create", "-f", PI_YAML) == 0
@@ -120,6 +121,7 @@ def test_errors_and_admission(stack, tmp_path, capsys):
     assert "already exists" in capsys.readouterr().err
 
 
+@pytest.mark.slow  # full stack / subprocess e2e
 def test_suspend_scale_resume_lifecycle(stack, tmp_path, capsys):
     """kubectl-style day-2 mutation verbs on a live job: a job created
     suspended holds with no pods; `scale` changes the gang size while held

@@ -16,6 +16,9 @@ import pytest
 from mpi_operator_tpu.api.conditions import is_failed, is_succeeded
 from mpi_operator_tpu.opshell.runlocal import load_job, run_job
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 

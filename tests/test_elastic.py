@@ -22,6 +22,9 @@ from mpi_operator_tpu.ops.elastic import EXIT_RESTART, declared_world_size
 from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_FSDP
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 def _trainer(mesh):
     cfg = mnist.Config(hidden=32)

@@ -12,6 +12,9 @@ import pytest
 from mpi_operator_tpu.kernels import flash_attention
 from mpi_operator_tpu.parallel.ring_attention import dense_attention
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 def _qkv(key, b=2, t=128, h=4, hkv=None, d=16, dtype=jnp.float32):
     hkv = h if hkv is None else hkv

@@ -13,6 +13,9 @@ from mpi_operator_tpu.models import llama, mnist, resnet
 from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_SEQ
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 # ---------- mnist ----------
 

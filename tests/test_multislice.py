@@ -33,6 +33,9 @@ from mpi_operator_tpu.runtime.topology import (
     mesh_from_context,
 )
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 def test_meshplan_dcn_arithmetic():
     plan = MeshPlan(axes={AXIS_DATA: 2, AXIS_FSDP: 2}, dcn={AXIS_DATA: 2})

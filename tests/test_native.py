@@ -15,9 +15,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "native")
 PI = os.path.join(NATIVE, "build", "pi")
 
-pytestmark = pytest.mark.skipif(
-    shutil.which("g++") is None, reason="no C++ toolchain"
-)
+pytestmark = [
+    pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain"),
+    # slow tier: compiles the native lib + runs real process gangs
+    pytest.mark.slow,
+]
 
 
 @pytest.fixture(scope="module", autouse=True)

@@ -14,6 +14,9 @@ from mpi_operator_tpu.parallel.pipeline import run_pipeline
 from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_EXPERT, AXIS_PIPE
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 # ---------- pipeline ----------
 

@@ -15,6 +15,11 @@ from mpi_operator_tpu.parallel.sharding import (
 from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 from mpi_operator_tpu.runtime.topology import AXIS_DATA, AXIS_TENSOR
 
+import pytest
+
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 def test_logical_spec_basic():
     assert logical_spec(["batch", "seq", "embed"]) == P(

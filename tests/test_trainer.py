@@ -20,6 +20,9 @@ from mpi_operator_tpu.runtime.topology import (
     AXIS_TENSOR,
 )
 
+# slow tier: XLA compiles / subprocess gangs (see pytest.ini)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def dp_mesh():

@@ -26,8 +26,9 @@ import time
 from typing import Optional
 
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
-from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, PodPhase
+from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, evict_pod
 from mpi_operator_tpu.machinery.store import NotFound
+from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.nodemonitor")
 
@@ -88,6 +89,7 @@ class NodeMonitor:
                     f"node {node.metadata.name} stopped heartbeating "
                     f"({now - hb:.1f}s > {self.grace:.1f}s grace)",
                 )
+                metrics.nodes_lost.inc()
                 log.warning("node %s lost; evicting its pods", node.metadata.name)
             self._evict_pods(node.metadata.name)
 
@@ -95,23 +97,12 @@ class NodeMonitor:
         for pod in self.store.list("Pod"):
             if pod.spec.node_name != node_name or pod.is_finished():
                 continue
-            try:
-                cur = self.store.get(
-                    "Pod", pod.metadata.namespace, pod.metadata.name
-                )
-            except NotFound:
+            if not evict_pod(
+                self.store, pod, f"node {node_name} lost (heartbeat timeout)"
+            ):
                 continue
-            if cur.is_finished():
-                continue
-            cur.status.phase = PodPhase.FAILED
-            cur.status.ready = False
-            cur.status.reason = "Evicted"
-            cur.status.message = f"node {node_name} lost (heartbeat timeout)"
-            try:
-                self.store.update(cur, force=True)
-            except NotFound:
-                continue
+            metrics.pods_evicted.inc()
             self.recorder.event(
-                cur, WARNING, EVENT_NODE_LOST,
+                pod, WARNING, EVENT_NODE_LOST,
                 f"evicted: node {node_name} stopped heartbeating",
             )

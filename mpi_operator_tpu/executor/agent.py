@@ -46,8 +46,8 @@ from mpi_operator_tpu.executor.local import LocalExecutor
 from mpi_operator_tpu.machinery.objects import (
     NODE_NAMESPACE,
     Node,
-    Pod,
     PodPhase,
+    evict_pod,
 )
 from mpi_operator_tpu.machinery.store import NotFound
 
@@ -162,14 +162,28 @@ class NodeAgent:
         return node
 
     def _register(self) -> None:
+        from mpi_operator_tpu.machinery.store import Conflict
+
         tmpl = self._node_template()
-        try:
-            cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
-        except NotFound:
-            self.store.create(tmpl)
-            return
-        cur.status = tmpl.status
-        self.store.update(cur, force=True)
+        for _ in range(5):
+            try:
+                cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
+            except NotFound:
+                self.store.create(tmpl)
+                return
+            # the cordon flag belongs to the operator (`ctl cordon/drain`),
+            # not to this agent: a heartbeat must never un-cordon the node.
+            # Optimistic update (NOT force): a cordon committed between our
+            # read and write raises Conflict and we re-read — a forced write
+            # would silently resurrect the stale uncordoned copy.
+            tmpl.status.unschedulable = cur.status.unschedulable
+            cur.status = tmpl.status
+            try:
+                self.store.update(cur)
+                return
+            except Conflict:
+                continue
+        log.warning("heartbeat lost a conflict race 5x; next beat retries")
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
@@ -190,21 +204,7 @@ class NodeAgent:
                 continue
             if pod.status.phase != PodPhase.RUNNING:
                 continue
-            self._evict(pod, "node agent restarted; process lost")
-
-    def _evict(self, pod: Pod, message: str) -> None:
-        try:
-            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
-        except NotFound:
-            return
-        cur.status.phase = PodPhase.FAILED
-        cur.status.ready = False
-        cur.status.reason = "Evicted"
-        cur.status.message = message
-        try:
-            self.store.update(cur, force=True)
-        except NotFound:
-            pass
+            evict_pod(self.store, pod, "node agent restarted; process lost")
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -341,6 +341,13 @@ class LocalExecutor:
             cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
         except NotFound:
             return
+        if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
+            # same name, different incarnation: a gang restart deleted and
+            # recreated the pod while this update was in flight (e.g. the
+            # reaper of a process _forget just killed, rc=-9). Stamping the
+            # old incarnation's exit onto the fresh PENDING pod would fail
+            # the restarted job with its predecessor's corpse.
+            return
         cur.status.phase = phase
         cur.status.ready = phase == PodPhase.RUNNING
         cur.status.reason = reason

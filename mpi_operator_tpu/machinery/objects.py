@@ -130,6 +130,11 @@ class NodeStatus(_Dictable):
     log_url: str = ""
     last_heartbeat: float = 0.0
     ready: bool = False
+    # cordon flag (≙ kubectl cordon / node.spec.unschedulable): set by
+    # `ctl cordon/drain`, PRESERVED across agent heartbeats, cleared by
+    # `ctl uncordon`. A cordoned node keeps running its pods (drain evicts
+    # them) but receives no new bindings.
+    unschedulable: bool = False
     # chips this node can host (None = unbounded); the scalar-mode gang
     # scheduler admits against the sum over live nodes
     capacity_chips: Optional[int] = None
@@ -168,6 +173,30 @@ class Event(_Dictable):
     reason: str = ""
     message: str = ""
     timestamp: float = 0.0
+
+
+def evict_pod(store, pod: "Pod", message: str) -> bool:
+    """Mark a pod Evicted — THE eviction primitive (reason=Evicted is what
+    controller._pod_retryable treats as always-retryable, driving the
+    gang-coherent restart). Shared by the node monitor (lost nodes),
+    `ctl drain`, and the agent's restart reconciliation so the semantics
+    can never fork. Returns False when the pod is already gone/finished.
+    Callers own their own events/metrics."""
+    try:
+        cur = store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+    except KeyError:  # NotFound subclasses KeyError; machinery stays low-dep
+        return False
+    if cur.is_finished():
+        return False
+    cur.status.phase = PodPhase.FAILED
+    cur.status.ready = False
+    cur.status.reason = "Evicted"
+    cur.status.message = message
+    try:
+        store.update(cur, force=True)
+    except KeyError:
+        return False
+    return True
 
 
 KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event", "Node")

@@ -19,7 +19,8 @@ is stamped in pod.status.log_path and is local to the node in
 spec.node_name), scale (live worker-replica change — the elastic entry
 point), suspend/resume (runPolicy.suspend), watch (stream condition
 transitions until the job finishes, riding the store watch protocol),
-nodes (the registered agent fleet, ≙ kubectl get nodes).
+nodes (the registered agent fleet, ≙ kubectl get nodes), cordon/uncordon/
+drain (node lifecycle: hold new bindings; evict for maintenance).
 """
 
 from __future__ import annotations
@@ -369,15 +370,86 @@ def cmd_nodes(client: TPUJobClient, args) -> int:
     rows = []
     for n in nodes:
         hb = n.status.last_heartbeat
+        status = "Ready" if n.status.ready else "NotReady"
+        if n.status.unschedulable:
+            status += ",SchedulingDisabled"  # ≙ kubectl's cordon rendering
         rows.append([
             n.metadata.name,
-            "Ready" if n.status.ready else "NotReady",
+            status,
             "static" if not hb else f"{max(0, now - hb):.1f}s",
             n.status.capacity_chips if n.status.capacity_chips is not None else "-",
             load.get(n.metadata.name, 0),
             n.status.address or "-",
         ])
     print(_table(rows, ["NAME", "STATUS", "HEARTBEAT", "CHIPS", "PODS", "ADDRESS"]))
+    return 0
+
+
+def _mutate_node(client: TPUJobClient, name: str, mutate) -> Optional[Any]:
+    """Optimistic read-mutate-update on a Node (no force: a concurrent agent
+    heartbeat must not be clobbered — retry instead)."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+    for attempt in range(10):
+        node = client.store.try_get("Node", NODE_NAMESPACE, name)
+        if node is None:
+            print(f"error: no node named {name!r} (see `ctl nodes`)",
+                  file=sys.stderr)
+            return None
+        mutate(node)
+        try:
+            return client.store.update(node)
+        except Conflict:
+            time.sleep(0.05 * (attempt + 1))
+        except NotFound:
+            print(f"error: node {name!r} was deleted", file=sys.stderr)
+            return None
+    print(f"error: persistent update conflict on node {name}", file=sys.stderr)
+    return None
+
+
+def cmd_cordon(client: TPUJobClient, args) -> int:
+    """≙ kubectl cordon: mark the node unschedulable. Running pods stay;
+    new gangs bind elsewhere. The flag survives agent heartbeats and is
+    cleared only by uncordon."""
+
+    def mutate(node):
+        node.status.unschedulable = True
+
+    if _mutate_node(client, args.name, mutate) is None:
+        return 1
+    print(f"node/{args.name} cordoned")
+    return 0
+
+
+def cmd_uncordon(client: TPUJobClient, args) -> int:
+    def mutate(node):
+        node.status.unschedulable = False
+
+    if _mutate_node(client, args.name, mutate) is None:
+        return 1
+    print(f"node/{args.name} uncordoned")
+    return 0
+
+
+def cmd_drain(client: TPUJobClient, args) -> int:
+    """≙ kubectl drain: cordon, then evict every live pod on the node.
+    Evictions are retryable (reason=Evicted), so affected gangs restart on
+    the remaining schedulable nodes; the drained agent keeps heartbeating
+    and can be uncordoned later."""
+    from mpi_operator_tpu.machinery.objects import evict_pod
+
+    if cmd_cordon(client, args) != 0:
+        return 1
+    evicted = []
+    for pod in client.store.list("Pod"):
+        if pod.spec.node_name != args.name or pod.is_finished():
+            continue
+        if evict_pod(client.store, pod, f"node {args.name} drained"):
+            evicted.append(f"{pod.metadata.namespace}/{pod.metadata.name}")
+    for name in evicted:
+        print(f"evicted pod {name}")
+    print(f"node/{args.name} drained ({len(evicted)} pod(s) evicted)")
     return 0
 
 
@@ -484,6 +556,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=600.0)
     sub.add_parser("nodes", help="list registered execution nodes "
                                  "(the agent fleet; like kubectl get nodes)")
+    p = sub.add_parser("cordon", help="mark a node unschedulable "
+                                      "(running pods stay)")
+    p.add_argument("name")
+    p = sub.add_parser("uncordon", help="clear a node's cordon flag")
+    p.add_argument("name")
+    p = sub.add_parser("drain", help="cordon a node and evict its pods "
+                                     "(gangs restart on schedulable nodes)")
+    p.add_argument("name")
     return ap
 
 
@@ -519,6 +599,9 @@ def main(argv=None) -> int:
             "resume": cmd_resume,
             "watch": cmd_watch,
             "nodes": cmd_nodes,
+            "cordon": cmd_cordon,
+            "uncordon": cmd_uncordon,
+            "drain": cmd_drain,
         }[args.verb](client, args)
     finally:
         close = getattr(store, "close", None)

@@ -95,3 +95,13 @@ job_info = REGISTRY.gauge(
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader", "1 when this replica holds the leader lease"
 )
+nodes_lost = REGISTRY.counter(
+    "tpu_operator_nodes_lost_total",
+    "Counts nodes whose agent stopped heartbeating past the grace window",
+)
+pods_evicted = REGISTRY.counter(
+    "tpu_operator_pods_evicted_total",
+    "Counts pods the node monitor evicted off nodes that stopped "
+    "heartbeating (ctl drain evictions happen client-side and are not "
+    "counted here)",
+)

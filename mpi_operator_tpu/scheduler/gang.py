@@ -476,8 +476,8 @@ class GangScheduler:
         now = time.time()
         out = []
         for n in all_nodes:
-            if not n.status.ready:
-                continue
+            if not n.status.ready or n.status.unschedulable:
+                continue  # dead/drained OR cordoned: not a binding target
             hb = n.status.last_heartbeat
             if hb and now - hb > self.node_grace:
                 continue

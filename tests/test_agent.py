@@ -470,3 +470,131 @@ def test_ctl_nodes_lists_the_agent_fleet(tmp_path, capsys):
     assert "node-b" in out and "NotReady" in out
     lines = [ln for ln in out.splitlines() if ln.startswith("node-a")]
     assert lines and " 4 " in lines[0] and " 1 " in lines[0]  # chips, pods
+
+
+# ---------------------------------------------------------------------------
+# node lifecycle verbs: cordon / uncordon / drain (≙ kubectl)
+# ---------------------------------------------------------------------------
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_cordoned_node_receives_no_bindings_until_uncordoned(capsys):
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.opshell.ctl import cmd_cordon, cmd_nodes, cmd_uncordon
+
+    store = ObjectStore()
+    sched = GangScheduler(store)
+    make_node(store, "node-a")
+    client = TPUJobClient(store)
+    assert cmd_cordon(client, _Args(name="node-a")) == 0
+    make_gang(store, "j", min_member=1)
+    make_pod(store, "j", 0)
+    sched.sync()
+    assert bound_pods(store, "j") == []  # cordoned: zero schedulable targets
+    assert cmd_nodes(client, _Args()) == 0
+    assert "SchedulingDisabled" in capsys.readouterr().out
+    assert cmd_uncordon(client, _Args(name="node-a")) == 0
+    sched.sync()
+    assert [p.spec.node_name for p in bound_pods(store, "j")] == ["node-a"]
+
+
+def test_heartbeat_preserves_cordon_flag(tmp_path):
+    """An agent's heartbeat rewrites its Node status; the cordon flag is the
+    operator's and must survive every beat."""
+    from mpi_operator_tpu.executor.agent import NodeAgent
+
+    store = ObjectStore()
+    agent = NodeAgent(store, "node-a", logs_dir=str(tmp_path))
+    agent.log_server.start()
+    agent.executor.log_url_base = "http://x/logs"
+    agent._register()
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    node.status.unschedulable = True
+    store.update(node, force=True)
+    agent._register()  # the heartbeat body
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert node.status.unschedulable is True
+    assert node.status.ready is True
+    agent.log_server.stop()
+
+
+def test_drain_evicts_pods_and_gang_lands_on_other_node():
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.opshell.ctl import cmd_drain
+
+    store = ObjectStore()
+    sched = GangScheduler(store)
+    make_node(store, "node-a")
+    make_node(store, "node-b")
+    make_gang(store, "j", min_member=2)
+    for i in range(2):
+        make_pod(store, "j", i)
+    sched.sync()
+    bound = {p.metadata.name: p.spec.node_name for p in bound_pods(store, "j")}
+    assert set(bound.values()) == {"node-a", "node-b"}
+    for p in store.list("Pod"):
+        p.status.phase = PodPhase.RUNNING
+        store.update(p, force=True)
+    client = TPUJobClient(store)
+    assert cmd_drain(client, _Args(name="node-b")) == 0
+    drained = store.get("Pod", "default", "j-worker-1")
+    assert drained.is_evicted()  # → the controller's gang restart path
+    # after the controller recreates the gang, rebinding avoids node-b:
+    # simulate the recreate and resync
+    for p in store.list("Pod"):
+        store.delete("Pod", p.metadata.namespace, p.metadata.name)
+    for i in range(2):
+        make_pod(store, "j", i)
+    sched.sync()
+    assert all(
+        p.spec.node_name == "node-a" for p in bound_pods(store, "j")
+    ), [(p.metadata.name, p.spec.node_name) for p in bound_pods(store, "j")]
+
+
+def test_monitor_bumps_node_metrics():
+    from mpi_operator_tpu.opshell import metrics
+
+    store = ObjectStore()
+    make_node(store, "gone", hb=time.time() - 60)
+    _bound_running_pod(store, "j", "gone")
+    lost0 = metrics.nodes_lost.get()
+    evicted0 = metrics.pods_evicted.get()
+    NodeMonitor(store, grace=5.0).sync()
+    assert metrics.nodes_lost.get() == lost0 + 1
+    assert metrics.pods_evicted.get() == evicted0 + 1
+
+
+def test_reaper_cannot_stamp_a_recreated_pod(tmp_path):
+    """Incarnation guard: a gang restart deletes and recreates a same-name
+    pod while the old process's reaper is still in flight; the reaper's
+    exit status (rc=-9 from the _forget kill) must not land on the fresh
+    incarnation — that would fail the restarted job with its predecessor's
+    corpse (found live via `ctl drain`)."""
+    from mpi_operator_tpu.api.types import Container, ObjectMeta
+    from mpi_operator_tpu.executor.local import LocalExecutor
+    from mpi_operator_tpu.machinery.objects import Pod, PodSpec
+
+    store = ObjectStore()
+    old = store.create(Pod(
+        metadata=ObjectMeta(name="w-0", namespace="default"),
+        spec=PodSpec(container=Container()),
+    ))
+    ex = LocalExecutor(store, logs_dir=str(tmp_path))
+    # the restart: delete + recreate same-name (new uid)
+    store.delete("Pod", "default", "w-0")
+    fresh = store.create(Pod(
+        metadata=ObjectMeta(name="w-0", namespace="default"),
+        spec=PodSpec(container=Container()),
+    ))
+    assert fresh.metadata.uid != old.metadata.uid
+    # the in-flight reaper stamps the OLD incarnation's failure
+    ex._set_phase(old, PodPhase.FAILED, reason="ExitCode-9", exit_code=-9)
+    cur = store.get("Pod", "default", "w-0")
+    assert cur.status.phase == PodPhase.PENDING  # untouched
+    # and the fresh incarnation's own updates still land
+    ex._set_phase(fresh, PodPhase.RUNNING, ip="127.0.0.1")
+    assert store.get("Pod", "default", "w-0").status.phase == PodPhase.RUNNING

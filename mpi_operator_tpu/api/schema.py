@@ -26,20 +26,10 @@ import typing
 from typing import Any, Dict, List, Tuple, Type
 
 from mpi_operator_tpu.api.types import (
-    Condition,
     Container,
-    ElasticPolicy,
-    JobStatus,
     ObjectMeta,
-    OwnerReference,
     PodTemplate,
-    ReplicaSpec,
-    ReplicaStatus,
-    RunPolicy,
-    SchedulingPolicy,
-    SliceSpec,
     TPUJob,
-    TPUJobSpec,
 )
 
 

@@ -13,7 +13,7 @@ type reuses the api ObjectMeta so ownership/adoption logic is uniform.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from mpi_operator_tpu.api.types import Container, ObjectMeta, _Dictable
 

@@ -19,7 +19,7 @@ Llama-3-8B-class data-parallel + long-context workload. TPU-native design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

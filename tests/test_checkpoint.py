@@ -2,7 +2,6 @@
 (SURVEY.md §5.4) and the backbone of elastic recovery here."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -15,7 +15,7 @@ import pytest
 
 from mpi_operator_tpu.api import ConditionType, conditions
 from mpi_operator_tpu.api.types import RestartPolicy
-from mpi_operator_tpu.controller import ControllerOptions, TPUJobController
+from mpi_operator_tpu.controller import TPUJobController
 from mpi_operator_tpu.controller.controller import (
     ENV_COORDINATOR,
     ENV_HOST_COORD,

@@ -10,12 +10,10 @@ same process split as a real deployment.
 
 import json
 import os
-import threading
 import time
 
 import pytest
 
-from mpi_operator_tpu.api.conditions import is_finished
 from mpi_operator_tpu.controller.controller import (
     ControllerOptions,
     TPUJobController,

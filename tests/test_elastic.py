@@ -12,7 +12,6 @@ import pytest
 from mpi_operator_tpu.models import mnist
 from mpi_operator_tpu.ops import (
     ElasticConfig,
-    ElasticResult,
     Trainer,
     TrainerConfig,
     run_elastic,

@@ -7,7 +7,6 @@ propagate across handles, and two electors over the same file elect exactly
 one leader with takeover on release.
 """
 
-import json
 import os
 import subprocess
 import sys

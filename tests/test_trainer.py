@@ -5,7 +5,6 @@ the things Horovod promises (grads averaged across the gang ≡ large-batch
 step; params stay in sync) fall out of the global-view compilation."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -59,6 +59,8 @@ class LogServer:
 
     GET /logs/<file> streams one pod log (basenames only — the executor
     names files uniquely per pod incarnation; traversal is rejected).
+    ``?offset=N`` returns only bytes from N (the `ctl logs --follow`
+    incremental-fetch contract, ≙ the kubelet's follow streaming).
     """
 
     def __init__(self, logs_dir: str, host: str = "0.0.0.0", port: int = 0):
@@ -80,15 +82,27 @@ class LogServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                import urllib.parse as _up
+
+                parsed = _up.urlparse(self.path)
                 prefix = "/logs/"
-                name = self.path[len(prefix):] if self.path.startswith(prefix) else ""
+                name = (parsed.path[len(prefix):]
+                        if parsed.path.startswith(prefix) else "")
                 # basenames only: no separators, no traversal
                 if not name or "/" in name or "\\" in name or ".." in name:
                     self.send_error(404)
                     return
+                try:
+                    offset = max(
+                        0, int(_up.parse_qs(parsed.query).get("offset", ["0"])[0])
+                    )
+                except ValueError:
+                    self.send_error(400)
+                    return
                 path = os.path.join(server.logs_dir, name)
                 try:
                     with open(path, "rb") as f:
+                        f.seek(offset)
                         data = f.read()
                 except OSError:
                     self.send_error(404)

@@ -316,35 +316,15 @@ def cmd_logs(client: TPUJobClient, args) -> int:
         return 1
     if args.stderr:
         path = path[: -len(".log")] + ".err" if path.endswith(".log") else path
-    if path.startswith("http://") or path.startswith("https://"):
-        # a node agent stamped a URL: fetch from its log endpoint — the
-        # `kubectl logs`-through-the-kubelet-API path, works from any node
-        import urllib.error
-        import urllib.request
-
-        try:
-            with urllib.request.urlopen(path, timeout=10) as r:
-                sys.stdout.write(r.read().decode(errors="replace"))
-        except (urllib.error.URLError, OSError) as e:
-            where = pod.spec.node_name or "its node"
-            print(
-                f"error: cannot fetch {path} ({e}); the pod ran on {where} "
-                f"— is its node agent still up?",
-                file=sys.stderr,
-            )
-            return 1
-        return 0
+    if getattr(args, "follow", False):
+        return _follow_logs(client, pod, path)
     try:
-        with open(path) as f:
-            sys.stdout.write(f.read())
+        chunk = _read_log_from(path, 0)
     except OSError as e:
-        where = pod.spec.node_name or "the executor's node"
-        print(
-            f"error: cannot read {path} here ({e.strerror}); "
-            f"the pod ran on {where}",
-            file=sys.stderr,
-        )
+        print(_log_read_diagnostic(pod, path, e), file=sys.stderr)
         return 1
+    sys.stdout.buffer.write(chunk)
+    sys.stdout.flush()
     return 0
 
 
@@ -453,6 +433,93 @@ def cmd_drain(client: TPUJobClient, args) -> int:
     return 0
 
 
+def _read_log_from(path: str, offset: int) -> bytes:
+    """Bytes from ``offset`` — local file seek, or the agent log endpoint's
+    ``?offset=`` contract. Raises OSError on any read/fetch failure (THE one
+    copy of the http-vs-local branching; cmd_logs and _follow_logs both ride
+    it so the two paths can never diverge)."""
+    if path.startswith("http://") or path.startswith("https://"):
+        import urllib.error
+        import urllib.request
+
+        url = path if offset == 0 else (
+            f"{path}{'&' if '?' in path else '?'}offset={offset}"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.read()
+        except urllib.error.URLError as e:
+            raise OSError(str(e)) from None
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read()
+
+
+def _log_read_diagnostic(pod, path: str, err: Exception) -> str:
+    where = pod.spec.node_name or "its node"
+    if path.startswith("http://") or path.startswith("https://"):
+        return (f"error: cannot fetch {path} ({err}); the pod ran on "
+                f"{where} — is its node agent still up?")
+    return (f"error: cannot read {path} here ({err}); the pod ran on "
+            f"{where} — with agents, log paths are served as URLs")
+
+
+def _follow_logs(client: TPUJobClient, pod, path: str) -> int:
+    """≙ `kubectl logs -f`: stream the pod's output as it is written, exit
+    when the pod finishes (0 on success; 130 on Ctrl-C like kubectl).
+    Incremental byte-offset fetches — a log streamer's poll cadence, like
+    the kubelet's follow mode. On observing a terminal phase the tail is
+    fetched ONCE more (output flushed between our read and the phase check
+    must not be dropped); persistent read failures surface as an error
+    instead of an eternally silent stream."""
+    import codecs
+
+    decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+    offset = 0
+    uid = pod.metadata.uid
+    failures = 0
+
+    def emit(chunk: bytes) -> None:
+        nonlocal offset
+        offset += len(chunk)
+        sys.stdout.write(decoder.decode(chunk))
+        sys.stdout.flush()
+
+    try:
+        while True:
+            try:
+                chunk = _read_log_from(path, offset)
+                failures = 0
+            except OSError as e:
+                chunk = b""
+                failures += 1
+                if failures >= 10:  # ~5s of consecutive failures: not a blip
+                    print(_log_read_diagnostic(pod, path, e), file=sys.stderr)
+                    return 1
+            if chunk:
+                emit(chunk)
+            cur = client.store.try_get(
+                "Pod", pod.metadata.namespace, pod.metadata.name
+            )
+            if cur is None:
+                return 0  # pod deleted: the stream is over
+            if cur.metadata.uid != uid:
+                print("\n(pod was restarted; re-run logs for the new "
+                      "incarnation)", file=sys.stderr)
+                return 1
+            if cur.is_finished() and not chunk:
+                try:
+                    tail = _read_log_from(path, offset)
+                except OSError:
+                    tail = b""
+                if tail:
+                    emit(tail)
+                return 0 if cur.status.phase == "Succeeded" else 1
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 130
+
+
 def cmd_watch(client: TPUJobClient, args) -> int:
     """Stream state transitions until the job finishes (≙ kubectl get -w —
     which rides the watch API, so this does too: the store's watch queue
@@ -551,6 +618,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("name")
     p.add_argument("--stderr", action="store_true",
                    help="print the stderr stream instead")
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="stream the log as it is written, until the pod "
+                        "finishes (like kubectl logs -f)")
     p = sub.add_parser("watch", help="stream state transitions until finished")
     p.add_argument("name")
     p.add_argument("--timeout", type=float, default=600.0)

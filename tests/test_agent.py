@@ -598,3 +598,49 @@ def test_reaper_cannot_stamp_a_recreated_pod(tmp_path):
     # and the fresh incarnation's own updates still land
     ex._set_phase(fresh, PodPhase.RUNNING, ip="127.0.0.1")
     assert store.get("Pod", "default", "w-0").status.phase == PodPhase.RUNNING
+
+
+def test_logs_follow_streams_incrementally(tmp_path, capsys):
+    """`ctl logs --follow` ≙ kubectl logs -f: incremental byte-offset
+    fetches from the agent's log endpoint, exiting when the pod finishes."""
+    import threading
+
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.api.types import Container, ObjectMeta
+    from mpi_operator_tpu.executor.agent import LogServer
+    from mpi_operator_tpu.machinery.objects import Pod, PodSpec
+    from mpi_operator_tpu.opshell.ctl import _follow_logs
+
+    store = ObjectStore()
+    logf = tmp_path / "w.log"
+    logf.write_text("first line\n")
+    srv = LogServer(str(tmp_path), host="127.0.0.1").start()
+    try:
+        pod = store.create(Pod(
+            metadata=ObjectMeta(name="w-0", namespace="default"),
+            spec=PodSpec(container=Container()),
+        ))
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.log_path = f"http://127.0.0.1:{srv.port}/logs/w.log"
+        store.update(pod, force=True)
+
+        def finish_later():
+            time.sleep(1.2)
+            with open(logf, "a") as f:
+                f.write("second line\n")
+            cur = store.get("Pod", "default", "w-0")
+            cur.status.phase = PodPhase.SUCCEEDED
+            store.update(cur, force=True)
+
+        t = threading.Thread(target=finish_later)
+        t.start()
+        client = TPUJobClient(store)
+        rc = _follow_logs(client, pod, pod.status.log_path)
+        t.join()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "first line" in out and "second line" in out
+        # incremental: the second fetch must not replay the first line
+        assert out.count("first line") == 1
+    finally:
+        srv.stop()

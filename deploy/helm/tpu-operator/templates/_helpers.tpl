@@ -1,0 +1,7 @@
+{{- define "tpu-operator.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "tpu-operator.storeURL" -}}
+http://tpu-store:{{ .Values.store.port }}
+{{- end -}}

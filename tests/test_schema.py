@@ -229,3 +229,34 @@ def test_cluster_overlay_store_wiring_is_coherent():
     assert by_kind["PersistentVolumeClaim"]["spec"]["accessModes"] == [
         "ReadWriteOnce"
     ]
+
+
+def test_helm_chart_mirrors_cluster_overlay():
+    """The helm chart (≙ reference hack/helm/mpi-operator) must stay
+    coherent with the cluster overlay: same store service name/port in the
+    templates as the overlay wires, balanced template actions, and every
+    tier (store/operator/agent) present."""
+    import re
+
+    base = os.path.join(REPO, "deploy", "helm", "tpu-operator")
+    chart = yaml.safe_load(open(os.path.join(base, "Chart.yaml")))
+    assert chart["name"] == "tpu-operator"
+    values = yaml.safe_load(open(os.path.join(base, "values.yaml")))
+    assert values["store"]["port"] == 8475  # matches overlay store.yaml
+    tiers = set()
+    for fn in os.listdir(os.path.join(base, "templates")):
+        s = open(os.path.join(base, "templates", fn)).read()
+        opens = len(re.findall(r"\{\{-? *(?:if|with|range|define)\b", s))
+        ends = len(re.findall(r"\{\{-? *end\b", s))
+        assert opens == ends, (fn, opens, ends)
+        for kind in ("Deployment", "DaemonSet", "Service", "Secret"):
+            if f"kind: {kind}" in s:
+                tiers.add(kind)
+        if "storeURL" in s or "tpu-store:" in s:
+            tiers.add("store-wiring")
+    assert {"Deployment", "DaemonSet", "Service", "Secret",
+            "store-wiring"} <= tiers
+    # the agent tier must claim by node identity, like the overlay
+    agent = open(os.path.join(base, "templates", "agent.yaml")).read()
+    assert "--node-name=$(NODE_NAME)" in agent
+    assert "--token-file" in agent

@@ -3,5 +3,9 @@
 {{- end -}}
 
 {{- define "tpu-operator.storeURL" -}}
+{{- if .Values.store.url -}}
+{{ .Values.store.url }}
+{{- else -}}
 http://tpu-store:{{ .Values.store.port }}
+{{- end -}}
 {{- end -}}

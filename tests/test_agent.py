@@ -644,3 +644,57 @@ def test_logs_follow_streams_incrementally(tmp_path, capsys):
         assert out.count("first line") == 1
     finally:
         srv.stop()
+
+
+@pytest.mark.slow  # full stack / subprocess e2e
+def test_inventory_identity_agents_end_to_end(tmp_path):
+    """Topology mode with real agents: the operator admits against a
+    slice-shaped inventory (--inventory-slices), agents register under the
+    inventory's node identities (slice0/0 — the '/' exercising URL quoting
+    through store, scheduler, and agent claim), and a 2-worker SPMD job
+    runs one pod per slice host."""
+    import subprocess
+    import sys
+
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+    from mpi_operator_tpu.runtime.emulation import free_port
+
+    port = free_port()
+    procs = []
+    procs.append(_spawn(tmp_path, "operator", [
+        sys.executable, "-m", "mpi_operator_tpu.opshell",
+        "--store", f"sqlite:{tmp_path / 'store.db'}",
+        "--serve-store", f"127.0.0.1:{port}",
+        "--inventory-slices", "2",
+        "--monitoring-port", "0",
+    ]))
+    _wait_http(f"http://127.0.0.1:{port}/healthz")
+    for i in (0, 1):
+        (tmp_path / f"logs-{i}").mkdir()
+        procs.append(_spawn(tmp_path, f"agent-{i}", [
+            sys.executable, "-m", "mpi_operator_tpu.executor.agent",
+            "--store", f"http://127.0.0.1:{port}",
+            "--node-name", f"slice0/{i}",
+            "--logs-dir", str(tmp_path / f"logs-{i}"),
+            "--workdir", REPO,
+        ]))
+    try:
+        store = HttpStoreClient(f"http://127.0.0.1:{port}")
+        _wait_nodes_registered(store, ["slice0/0", "slice0/1"])
+        submit = subprocess.run(
+            [sys.executable, "examples/submit_job.py", f"http://127.0.0.1:{port}"],
+            cwd=REPO, capture_output=True, text=True, timeout=240,
+        )
+        detail = (submit.stdout + submit.stderr + "\n"
+                  + _proc_logs(tmp_path, ["operator", "agent-0", "agent-1"]))
+        assert submit.returncode == 0, detail
+        assert "SUCCEEDED" in submit.stdout, detail
+        # one pod per slice host, claimed by node identity
+        for i in (0, 1):
+            files = [f for f in os.listdir(tmp_path / f"logs-{i}")
+                     if f.endswith(".log")]
+            assert len(files) == 1, (i, files, detail)
+        pods = store.list("Pod", "default", selector={LABEL_JOB_NAME: "pi-sdk"})
+        assert {p.spec.node_name for p in pods} <= {"slice0/0", "slice0/1"}
+    finally:
+        _reap(procs)

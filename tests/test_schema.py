@@ -254,8 +254,20 @@ def test_helm_chart_mirrors_cluster_overlay():
                 tiers.add(kind)
         if "storeURL" in s or "tpu-store:" in s:
             tiers.add("store-wiring")
-    assert {"Deployment", "DaemonSet", "Service", "Secret",
-            "store-wiring"} <= tiers
+    for fn in os.listdir(os.path.join(base, "templates")):
+        s = open(os.path.join(base, "templates", fn)).read()
+        for kind in ("Namespace", "ServiceAccount", "NetworkPolicy"):
+            if f"kind: {kind}" in s:
+                tiers.add(kind)
+    assert {"Deployment", "DaemonSet", "Service", "Secret", "Namespace",
+            "ServiceAccount", "NetworkPolicy", "store-wiring"} <= tiers
+    # no dead knobs: every top-level values key must be referenced somewhere
+    templates = "".join(
+        open(os.path.join(base, "templates", fn)).read()
+        for fn in os.listdir(os.path.join(base, "templates"))
+    )
+    for key in values:
+        assert f".Values.{key}" in templates, f"dead values key {key!r}"
     # the agent tier must claim by node identity, like the overlay
     agent = open(os.path.join(base, "templates", "agent.yaml")).read()
     assert "--node-name=$(NODE_NAME)" in agent

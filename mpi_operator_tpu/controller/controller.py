@@ -138,6 +138,9 @@ class TPUJobController:
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._watch_q = None
+        # (job uid, restart generation) pairs already warned about a
+        # non-retryable drain-wait — the event fires once per generation
+        self._drain_noted: set = set()
         # injectable, ≙ updateStatusHandler (:243-244)
         self._write_status = self._default_write_status
         # in-flight port reservations: two reconcile threads assigning ports
@@ -668,6 +671,8 @@ class TPUJobController:
                 # rc=1 exits as a permanent app failure. Survivors exit on
                 # their own (collective error / elastic protocol);
                 # activeDeadlineSeconds backstops a straggler.
+                if not retryable:
+                    self._note_drain_wait(job, failed)
                 return
             if retryable:
                 backoff = job.spec.run_policy.backoff_limit
@@ -726,6 +731,27 @@ class TPUJobController:
             ec = pod.status.exit_code
             return ec is not None and (ec >= 128 or ec == EXIT_RESTART)
         return False
+
+    def _note_drain_wait(self, job: TPUJob, failed: List[Pod]) -> None:
+        """Non-retryable failure observed while peers still run: the verdict
+        waits for drain (a late node-loss eviction can still flip it to a
+        restart). Say so ONCE per generation in the event trail — without
+        activeDeadlineSeconds, a survivor that never exits would otherwise
+        leave the job hanging with no visible explanation."""
+        key = (job.metadata.uid, job.status.restart_count)
+        if key in self._drain_noted:
+            return
+        if len(self._drain_noted) > 1024:
+            self._drain_noted.clear()  # bounded; a re-note is benign
+        self._drain_noted.add(key)
+        first = failed[0]
+        self.recorder.event(
+            job, WARNING, "TPUJobDraining",
+            f"worker pod {first.metadata.name} failed "
+            f"({first.status.reason or 'Error'}); waiting for the remaining "
+            f"workers to drain before the fail-vs-restart verdict — set "
+            f"runPolicy.activeDeadlineSeconds to bound this wait",
+        )
 
     def _fail_job(
         self, job: TPUJob, workers: List[Pod], reason: str, message: str

@@ -71,19 +71,16 @@ class NodeMonitor:
 
     def sync(self) -> None:
         now = time.time()
+        stale = []
         for node in self.store.list("Node", NODE_NAMESPACE):
             hb = node.status.last_heartbeat
             if not hb:
                 continue  # static node: no heartbeat contract
             if now - hb <= self.grace:
                 continue
+            stale.append(node.metadata.name)
             if node.status.ready:
-                try:
-                    cur = self.store.get("Node", NODE_NAMESPACE, node.metadata.name)
-                    cur.status.ready = False
-                    self.store.update(cur, force=True)
-                except NotFound:
-                    continue
+                self._mark_not_ready(node.metadata.name)
                 self.recorder.event(
                     node, WARNING, EVENT_NODE_LOST,
                     f"node {node.metadata.name} stopped heartbeating "
@@ -91,12 +88,37 @@ class NodeMonitor:
                 )
                 metrics.nodes_lost.inc()
                 log.warning("node %s lost; evicting its pods", node.metadata.name)
-            self._evict_pods(node.metadata.name)
+        if stale:
+            # ONE pod list per tick regardless of dead-node count (two
+            # permanently dead nodes must not mean 2 full list round-trips
+            # per second forever); level-triggered so a pod re-bound to a
+            # still-dead node is caught on the next tick
+            self._evict_pods(set(stale))
 
-    def _evict_pods(self, node_name: str) -> None:
-        for pod in self.store.list("Pod"):
-            if pod.spec.node_name != node_name or pod.is_finished():
+    def _mark_not_ready(self, name: str) -> None:
+        """Optimistic (non-force) update with retry: a concurrent `ctl
+        cordon` or a just-landed revival heartbeat must raise Conflict and
+        be re-read, not be silently clobbered by a stale forced copy."""
+        from mpi_operator_tpu.machinery.store import Conflict
+
+        for _ in range(5):
+            try:
+                cur = self.store.get("Node", NODE_NAMESPACE, name)
+            except NotFound:
+                return
+            cur.status.ready = False
+            try:
+                self.store.update(cur)
+                return
+            except Conflict:
                 continue
+        log.warning("node %s: lost the not-ready update race 5x", name)
+
+    def _evict_pods(self, stale_nodes: set) -> None:
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name not in stale_nodes or pod.is_finished():
+                continue
+            node_name = pod.spec.node_name
             if not evict_pod(
                 self.store, pod, f"node {node_name} lost (heartbeat timeout)"
             ):

@@ -53,6 +53,9 @@ from mpi_operator_tpu.machinery.store import NotFound
 
 log = logging.getLogger("tpujob.agent")
 
+# largest single /logs response (clients loop on ?offset= for the rest)
+MAX_LOG_CHUNK = 8 << 20
+
 
 class LogServer:
     """Serves the agent's log directory read-only over HTTP.
@@ -103,7 +106,11 @@ class LogServer:
                 try:
                     with open(path, "rb") as f:
                         f.seek(offset)
-                        data = f.read()
+                        # bounded per response: a multi-GB training log must
+                        # not be materialized in the agent's RAM (an OOM here
+                        # would PDEATHSIG-kill every worker on the node);
+                        # clients loop on ?offset= until an empty read
+                        data = f.read(MAX_LOG_CHUNK)
                 except OSError:
                     self.send_error(404)
                     return
@@ -180,6 +187,11 @@ class NodeAgent:
 
         tmpl = self._node_template()
         for _ in range(5):
+            if self._stop.is_set():
+                # stop() force-marks ready=False; a beat retrying past that
+                # would resurrect a Ready record for a dead agent and make
+                # the monitor burn the full grace window
+                return
             try:
                 cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
             except NotFound:

@@ -147,12 +147,19 @@ class LocalExecutor:
                 ev = self._watch_q.get(timeout=0.2)
             except Exception:
                 continue
-            if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):
-                self._project_config(ev.obj)
-            elif ev.kind == "Pod" and ev.type in (ADDED, MODIFIED):
-                self._maybe_launch(ev.obj)
-            elif ev.kind == "Pod" and ev.type == DELETED:
-                self._forget(ev.obj)
+            try:
+                if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):
+                    self._project_config(ev.obj)
+                elif ev.kind == "Pod" and ev.type in (ADDED, MODIFIED):
+                    self._kill_if_evicted(ev.obj)
+                    self._maybe_launch(ev.obj)
+                elif ev.kind == "Pod" and ev.type == DELETED:
+                    self._forget(ev.obj)
+            except Exception:
+                # this thread is the PDEATHSIG parent of every pod process:
+                # if it dies, the kernel SIGKILLs all of them. A bad event
+                # must never take down the node's workload.
+                log.exception("executor event handling failed; continuing")
 
     def _pod_key(self, pod: Pod) -> str:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -175,6 +182,24 @@ class LocalExecutor:
             with open(tmp, "w") as f:
                 f.write(content)
             os.replace(tmp, os.path.join(d, fname))  # atomic swap, no torn reads
+
+    def _kill_if_evicted(self, pod: Pod) -> None:
+        """Eviction means KILL, not just a status mark (kubelet semantics):
+        `ctl drain` / the NodeMonitor force a pod to Failed while its
+        process may still be alive here — left running it would keep the
+        gang's collectives healthy and the drain would never converge. The
+        reaper still runs but terminal status is write-once (_set_phase),
+        so the Evicted marker — the retryable signal — survives the
+        SIGKILL's rc=-9."""
+        if not pod.is_finished():
+            return
+        key = self._pod_key(pod)
+        with self._lock:
+            proc = self._procs.get(key)
+        if proc is not None and proc.poll() is None:
+            log.info("pod %s externally finished (%s); killing its process",
+                     key, pod.status.reason or pod.status.phase)
+            proc.kill()
 
     def _forget(self, pod: Pod) -> None:
         """Pod deleted (controller restart path / cleanup policy): kill any
@@ -347,6 +372,12 @@ class LocalExecutor:
             # reaper of a process _forget just killed, rc=-9). Stamping the
             # old incarnation's exit onto the fresh PENDING pod would fail
             # the restarted job with its predecessor's corpse.
+            return
+        if cur.is_finished():
+            # terminal status is WRITE-ONCE: an external eviction (drain /
+            # node monitor) must not be overwritten by the reaper of the
+            # process we then killed (its rc=-9 would erase the Evicted
+            # reason — the signal that makes the failure retryable)
             return
         cur.status.phase = phase
         cur.status.ready = phase == PodPhase.RUNNING

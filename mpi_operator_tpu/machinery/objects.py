@@ -186,6 +186,12 @@ def evict_pod(store, pod: "Pod", message: str) -> bool:
         cur = store.get("Pod", pod.metadata.namespace, pod.metadata.name)
     except KeyError:  # NotFound subclasses KeyError; machinery stays low-dep
         return False
+    if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
+        # same name, different incarnation: a gang restart recreated the
+        # pod since the caller observed it — evicting the fresh one would
+        # fail a pod that was never on the dead/drained node (the same
+        # guard executor._set_phase applies)
+        return False
     if cur.is_finished():
         return False
     cur.status.phase = PodPhase.FAILED

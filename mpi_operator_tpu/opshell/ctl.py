@@ -38,7 +38,12 @@ from mpi_operator_tpu.api.conditions import (
     is_succeeded,
 )
 from mpi_operator_tpu.api.schema import ManifestError
-from mpi_operator_tpu.machinery.store import AlreadyExists, Conflict, NotFound
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    Unauthorized,
+)
 
 
 def job_state(job: Any) -> str:
@@ -319,11 +324,18 @@ def cmd_logs(client: TPUJobClient, args) -> int:
     if getattr(args, "follow", False):
         return _follow_logs(client, pod, path)
     try:
-        chunk = _read_log_from(path, 0)
+        offset = 0
+        while True:
+            chunk = _read_log_from(path, offset)
+            if not chunk:
+                break
+            sys.stdout.buffer.write(chunk)
+            offset += len(chunk)
+            if not path.startswith(("http://", "https://")):
+                break  # a local read() already returned the whole file
     except OSError as e:
         print(_log_read_diagnostic(pod, path, e), file=sys.stderr)
         return 1
-    sys.stdout.buffer.write(chunk)
     sys.stdout.flush()
     return 0
 
@@ -673,6 +685,12 @@ def main(argv=None) -> int:
             "uncordon": cmd_uncordon,
             "drain": cmd_drain,
         }[args.verb](client, args)
+    except Unauthorized as e:
+        # a wrong/missing token must read as a CLI error with the server's
+        # hint, not a PermissionError traceback
+        print(f"error: {e} (pass --token-file for an authenticated store)",
+              file=sys.stderr)
+        return 2
     finally:
         close = getattr(store, "close", None)
         if close is not None:

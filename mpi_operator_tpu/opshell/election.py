@@ -11,6 +11,7 @@ store backend) elect exactly one active reconciler.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -25,6 +26,8 @@ from mpi_operator_tpu.machinery.store import (
     ObjectStore,
 )
 from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.election")
 
 LOCK_NAME = "tpu-operator-leader-lock"
 KEY_HOLDER = "holderIdentity"
@@ -71,7 +74,14 @@ class LeaderElector:
 
     def _try_acquire_or_renew(self) -> bool:
         now = time.time()
-        cur = self._read()
+        try:
+            cur = self._read()
+        except Exception:
+            # a transient store error (e.g. sqlite contention under load)
+            # is a failed ATTEMPT, not a dead elector — the renew_deadline
+            # window absorbs it
+            log.warning("lease read failed; retrying", exc_info=True)
+            return False
         if cur is None:
             cm = ConfigMap()
             cm.metadata.name = LOCK_NAME
@@ -81,6 +91,9 @@ class LeaderElector:
                 self.store.create(cm)
                 return True
             except AlreadyExists:
+                return False
+            except Exception:
+                log.warning("lease create failed; retrying", exc_info=True)
                 return False
         holder = cur.data.get(KEY_HOLDER, "")
         renew = float(cur.data.get(KEY_RENEW, "0"))
@@ -92,6 +105,9 @@ class LeaderElector:
             self.store.update(cur)  # optimistic: resource_version guards races
             return True
         except (Conflict, NotFound):
+            return False
+        except Exception:
+            log.warning("lease renew failed; retrying", exc_info=True)
             return False
 
     # -- loop --------------------------------------------------------------
@@ -118,7 +134,13 @@ class LeaderElector:
             if self._try_acquire_or_renew():
                 last_renew = time.time()
             elif time.time() - last_renew > cfg.renew_deadline:
-                break  # lease lost (≙ OnStoppedLeading → klog.Fatalf)
+                # ≙ OnStoppedLeading → klog.Fatalf: this is fatal for every
+                # pod this replica executes — it must never be silent
+                log.warning(
+                    "leader lease lost (no successful renew for %.1fs); "
+                    "stopping all components", time.time() - last_renew,
+                )
+                break
         self.is_leader = False
         metrics.is_leader.set(0)
         self.on_stopped()

@@ -147,16 +147,26 @@ class GangScheduler:
             self.store.stop_watch(self._watch_q)
 
     def _run(self) -> None:
+        last_sync = time.monotonic()
         while not self._stop.is_set():
+            need_sync = False
             try:
                 ev = self._watch_q.get(timeout=0.2)
+                # Node events matter since node-mode binding: an uncordon,
+                # a new agent registration, or a returning heartbeat must
+                # wake pending gangs
+                need_sync = ev.kind in ("Pod", "PodGroup", "Node")
             except Exception:
+                pass
+            # periodic resync: a node going STALE emits no event at all —
+            # it is the absence of heartbeats — yet flips binding decisions
+            if not need_sync and time.monotonic() - last_sync < 2.0:
                 continue
-            if ev.kind in ("Pod", "PodGroup"):
-                try:
-                    self.sync()
-                except Exception:  # keep the loop alive; next event resyncs
-                    log.exception("scheduler sync failed")
+            try:
+                self.sync()
+                last_sync = time.monotonic()
+            except Exception:  # keep the loop alive; next event resyncs
+                log.exception("scheduler sync failed")
 
     # -- accounting ---------------------------------------------------------
 

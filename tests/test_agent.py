@@ -698,3 +698,97 @@ def test_inventory_identity_agents_end_to_end(tmp_path):
         assert {p.spec.node_name for p in pods} <= {"slice0/0", "slice0/1"}
     finally:
         _reap(procs)
+
+
+def test_eviction_kills_the_running_process_and_keeps_the_marker(tmp_path):
+    """Eviction means KILL (kubelet semantics): drain/monitor force a pod
+    Failed while its process lives; the executor must kill it or the gang's
+    collectives stay healthy and the drain never converges — and the
+    reaper's rc=-9 must NOT overwrite the Evicted reason (terminal status
+    is write-once), or the failure stops being retryable."""
+    from mpi_operator_tpu.api.types import Container, ObjectMeta
+    from mpi_operator_tpu.executor.local import LocalExecutor
+    from mpi_operator_tpu.machinery.objects import Pod, PodSpec, evict_pod
+
+    store = ObjectStore()
+    ex = LocalExecutor(store, logs_dir=str(tmp_path))
+    ex.start()
+    try:
+        store.create(Pod(
+            metadata=ObjectMeta(name="w-0", namespace="default"),
+            spec=PodSpec(container=Container(
+                command=["python", "-c", "import time; time.sleep(60)"],
+            )),
+        ))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if store.get("Pod", "default", "w-0").status.phase == PodPhase.RUNNING:
+                break
+            time.sleep(0.05)
+        proc = ex._procs["default/w-0"]
+        assert proc.poll() is None
+        assert evict_pod(store, store.get("Pod", "default", "w-0"),
+                         "node drained")
+        deadline = time.time() + 10
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(0.05)
+        assert proc.poll() is not None, "evicted pod's process must be killed"
+        time.sleep(0.5)  # give the reaper a chance to (wrongly) overwrite
+        cur = store.get("Pod", "default", "w-0")
+        assert cur.is_evicted(), (cur.status.reason, cur.status.exit_code)
+    finally:
+        ex.stop()
+
+
+def test_log_server_chunks_large_files(tmp_path):
+    """/logs responses are bounded (an unbounded read of a multi-GB log
+    would OOM the agent and PDEATHSIG every worker on the node); clients
+    loop on ?offset= — which cmd_logs does."""
+    import urllib.request
+
+    from mpi_operator_tpu.executor import agent as agent_mod
+
+    big = tmp_path / "big.log"
+    big.write_bytes(b"x" * (agent_mod.MAX_LOG_CHUNK + 1234))
+    srv = agent_mod.LogServer(str(tmp_path), host="127.0.0.1").start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/logs/big.log", timeout=5
+        ) as r:
+            first = r.read()
+        assert len(first) == agent_mod.MAX_LOG_CHUNK
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/logs/big.log?offset={len(first)}",
+            timeout=5,
+        ) as r:
+            rest = r.read()
+        assert len(rest) == 1234
+    finally:
+        srv.stop()
+
+
+def test_scheduler_wakes_on_node_events():
+    """An uncordon / new registration / returning heartbeat emits only Node
+    events; pending gangs must re-sync without waiting for unrelated pod
+    churn (and a periodic resync covers nodes going silently stale)."""
+    store = ObjectStore()
+    sched = GangScheduler(store)
+    # node FIRST (agents register before jobs arrive): otherwise a sync
+    # between pod- and node-creation would fall back to scalar 'local' mode
+    make_node(store, "node-a", ready=False)  # registered but not ready
+    sched.start()
+    try:
+        make_gang(store, "j", min_member=1)
+        make_pod(store, "j", 0)
+        time.sleep(0.5)
+        assert bound_pods(store, "j") == []
+        node = store.get("Node", NODE_NAMESPACE, "node-a")
+        node.status.ready = True
+        node.status.last_heartbeat = time.time()
+        store.update(node, force=True)  # ONLY a Node event
+        deadline = time.time() + 20  # generous: suite load can starve threads
+        while time.time() < deadline and not bound_pods(store, "j"):
+            time.sleep(0.1)
+        assert [p.spec.node_name for p in bound_pods(store, "j")] == ["node-a"]
+    finally:
+        sched.stop()

@@ -27,7 +27,7 @@ from typing import Optional
 
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, evict_pod
-from mpi_operator_tpu.machinery.store import NotFound
+from mpi_operator_tpu.machinery.store import optimistic_update
 from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.nodemonitor")
@@ -99,20 +99,14 @@ class NodeMonitor:
         """Optimistic (non-force) update with retry: a concurrent `ctl
         cordon` or a just-landed revival heartbeat must raise Conflict and
         be re-read, not be silently clobbered by a stale forced copy."""
-        from mpi_operator_tpu.machinery.store import Conflict
-
-        for _ in range(5):
-            try:
-                cur = self.store.get("Node", NODE_NAMESPACE, name)
-            except NotFound:
-                return
+        def mutate(cur) -> bool:
             cur.status.ready = False
-            try:
-                self.store.update(cur)
-                return
-            except Conflict:
-                continue
-        log.warning("node %s: lost the not-ready update race 5x", name)
+            return True
+
+        optimistic_update(
+            self.store, "Node", NODE_NAMESPACE, name, mutate,
+            what="mark-not-ready",
+        )
 
     def _evict_pods(self, stale_nodes: set) -> None:
         for pod in self.store.list("Pod"):

@@ -151,6 +151,17 @@ class NodeAgent:
         workdir: Optional[str] = None,
         heartbeat_interval: float = 2.0,
     ):
+        from mpi_operator_tpu.scheduler.gang import NODE_NAME as _LOCAL_SENTINEL
+
+        if node_name == _LOCAL_SENTINEL:
+            # 'local' is the scheduler's single-process sentinel binding;
+            # an agent claiming it would collide with the require_nodes
+            # healer (which unbinds PENDING 'local' pods every pass) and
+            # with any co-resident LocalExecutor
+            raise ValueError(
+                f"--node-name {node_name!r} is reserved (the scheduler's "
+                f"single-process sentinel); pick any other identity"
+            )
         self.store = store
         self.node_name = node_name
         self.advertise = advertise
@@ -305,20 +316,24 @@ def main(argv=None) -> int:
         return 2
     try:
         token = read_token_file(args.token_file)
-    except OSError as e:
+    except (OSError, ValueError) as e:
         print(f"error: --token-file: {e}", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token)
-    agent = NodeAgent(
-        store,
-        args.node_name,
-        advertise=args.advertise,
-        capacity_chips=args.chips,
-        logs_dir=args.logs_dir,
-        log_port=args.log_port,
-        workdir=args.workdir,
-        heartbeat_interval=args.heartbeat,
-    ).start()
+    try:
+        agent = NodeAgent(
+            store,
+            args.node_name,
+            advertise=args.advertise,
+            capacity_chips=args.chips,
+            logs_dir=args.logs_dir,
+            log_port=args.log_port,
+            workdir=args.workdir,
+            heartbeat_interval=args.heartbeat,
+        ).start()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     print(f"node agent {args.node_name} running "
           f"(logs http://{args.advertise}:{agent.log_server.port}/logs)",
           flush=True)

@@ -42,18 +42,34 @@ from mpi_operator_tpu.runtime.emulation import pin_host_device_count
 log = logging.getLogger("tpujob.executor")
 
 
+# dlopen + symbol resolution happen HERE, at import time in the parent:
+# the pre-exec hook below runs in the forked child of a heavily threaded
+# process, where glibc's allocator/loader locks may be held by a thread
+# that no longer exists — an import or CDLL there can deadlock the child
+# between fork and exec and the pod never starts. Linux-only; None elsewhere.
+try:
+    import ctypes as _ctypes
+    import signal as _signal
+
+    _LIBC = _ctypes.CDLL("libc.so.6", use_errno=True)
+    _LIBC.prctl  # resolve the symbol now, not after fork
+    _SIGKILL = int(_signal.SIGKILL)
+except Exception:
+    _LIBC = None
+    _SIGKILL = 9
+
+
 def _die_with_parent() -> None:
     """Child-side pre-exec hook: SIGKILL this process when the executor
     dies (PR_SET_PDEATHSIG). An executor crash therefore behaves like a
     node crash — no orphan workers silently holding ports/collectives —
     which is exactly what the NodeAgent's restart reconciliation and the
-    NodeMonitor's eviction already assume. Linux-only; a no-op elsewhere."""
+    NodeMonitor's eviction already assume. Only async-signal-safe-ish work
+    allowed here (see _LIBC above)."""
+    if _LIBC is None:
+        return
     try:
-        import ctypes
-        import signal as _signal
-
-        libc = ctypes.CDLL("libc.so.6", use_errno=True)
-        libc.prctl(1, _signal.SIGKILL)  # PR_SET_PDEATHSIG = 1
+        _LIBC.prctl(1, _SIGKILL)  # PR_SET_PDEATHSIG = 1
     except Exception:
         pass
 

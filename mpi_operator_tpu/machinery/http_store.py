@@ -75,14 +75,22 @@ class _BodyTooLarge(Exception):
 
 
 def read_token_file(path: Optional[str]) -> Optional[str]:
-    """Load a shared bearer token from a file (whitespace-stripped; empty
-    file = no token). File-sourced so the secret never sits on a command
-    line (≙ a mounted Secret, not a flag value visible in `ps`)."""
+    """Load a shared bearer token from a file (whitespace-stripped).
+    File-sourced so the secret never sits on a command line (≙ a mounted
+    Secret, not a flag value visible in `ps`). An EMPTY file is an error,
+    not 'no auth': a truncated/misconfigured Secret mount must fail closed —
+    silently starting unauthenticated would be an invisible downgrade.
+    'No auth' is expressed by not passing the flag at all."""
     if not path:
         return None
     with open(path) as f:
         tok = f.read().strip()
-    return tok or None
+    if not tok:
+        raise ValueError(
+            f"token file {path!r} is empty; refusing to run unauthenticated "
+            f"(omit the flag to disable auth)"
+        )
+    return tok
 
 
 def _quote(part: str) -> str:
@@ -688,7 +696,7 @@ def main(argv=None) -> int:
         raise SystemExit(f"error: --listen: {e}")
     try:
         token = read_token_file(args.token_file)
-    except OSError as e:
+    except (OSError, ValueError) as e:
         raise SystemExit(f"error: --token-file: {e}")
     if args.auth_reads and token is None:
         raise SystemExit("error: --auth-reads requires --token-file")

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from mpi_operator_tpu.api.types import Container, ObjectMeta, _Dictable
+from mpi_operator_tpu.machinery.store import optimistic_update
 
 
 class PodPhase:
@@ -182,27 +183,29 @@ def evict_pod(store, pod: "Pod", message: str) -> bool:
     `ctl drain`, and the agent's restart reconciliation so the semantics
     can never fork. Returns False when the pod is already gone/finished.
     Callers own their own events/metrics."""
-    try:
-        cur = store.get("Pod", pod.metadata.namespace, pod.metadata.name)
-    except KeyError:  # NotFound subclasses KeyError; machinery stays low-dep
-        return False
-    if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
-        # same name, different incarnation: a gang restart recreated the
-        # pod since the caller observed it — evicting the fresh one would
-        # fail a pod that was never on the dead/drained node (the same
-        # guard executor._set_phase applies)
-        return False
-    if cur.is_finished():
-        return False
-    cur.status.phase = PodPhase.FAILED
-    cur.status.ready = False
-    cur.status.reason = "Evicted"
-    cur.status.message = message
-    try:
-        store.update(cur, force=True)
-    except KeyError:
-        return False
-    return True
+    # Optimistic (NOT force) via optimistic_update: a reaper stamping
+    # Succeeded between the read and a forced write would be clobbered into
+    # a retryable Failed — turning a completed pod into a spurious gang
+    # restart. The preconditions re-check on every Conflict re-read.
+    def mutate(cur) -> bool:
+        if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
+            # same name, different incarnation: a gang restart recreated the
+            # pod since the caller observed it — evicting the fresh one would
+            # fail a pod that was never on the dead/drained node (the same
+            # guard executor._set_phase applies)
+            return False
+        if cur.is_finished():
+            return False
+        cur.status.phase = PodPhase.FAILED
+        cur.status.ready = False
+        cur.status.reason = "Evicted"
+        cur.status.message = message
+        return True
+
+    return optimistic_update(
+        store, "Pod", pod.metadata.namespace, pod.metadata.name, mutate,
+        what="evict_pod",
+    ) is not None
 
 
 KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event", "Node")

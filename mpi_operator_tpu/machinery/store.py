@@ -18,6 +18,7 @@ semantics preserved from the reference's usage:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import uuid
@@ -46,6 +47,40 @@ class Unauthorized(PermissionError):
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+
+def optimistic_update(store, kind, namespace, name, mutate, *,
+                      attempts: int = 5, what: str = "update"):
+    """get → ``mutate(copy)`` → non-force update, re-reading on Conflict.
+
+    THE write pattern for fields shared between writers (eviction vs the
+    reaper, cordon vs the heartbeat, unbind vs an executor launch): a forced
+    write would clobber whichever concurrent transition lands first; this
+    re-reads and re-checks instead. ``mutate(cur)`` edits the freshly-read
+    object in place and returns True to proceed (False aborts — the
+    precondition no longer holds on the current copy). Returns the committed
+    object, or None when the object is missing, the precondition failed, or
+    every attempt lost the race — exhaustion is logged, because callers are
+    often one-shot (``ctl drain``, agent restart reconciliation) and would
+    otherwise silently skip a live object."""
+    for _ in range(attempts):
+        try:
+            cur = store.get(kind, namespace, name)
+        except KeyError:  # NotFound subclasses KeyError
+            return None
+        if not mutate(cur):
+            return None
+        try:
+            return store.update(cur)
+        except KeyError:
+            return None
+        except Conflict:
+            continue
+    logging.getLogger("tpujob.machinery").warning(
+        "%s: optimistic update of %s %s/%s lost the write race %dx; left as-is",
+        what, kind, namespace, name, attempts,
+    )
+    return None
 
 
 @dataclass

@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="shared bearer token file: required from peers when "
                          "serving (--serve-store), presented when connecting "
                          "to a remote --store http://...")
+    ap.add_argument("--require-nodes", choices=["auto", "always", "never"],
+                    default="auto",
+                    help="bind gangs only to registered node agents, never "
+                         "the in-process 'local' sentinel. 'auto' (default) "
+                         "enables this when --executor none and no "
+                         "--inventory-slices: that shape IS the cluster "
+                         "deployment, and a gang bound to 'local' before the "
+                         "first agent registers would wedge forever")
     ap.add_argument("--node-grace", type=float, default=6.0,
                     help="seconds without a node-agent heartbeat before its "
                          "pods are evicted (the node-controller grace)")
@@ -101,7 +109,7 @@ def main(argv=None) -> int:
 
     try:
         token = read_token_file(args.token_file)
-    except OSError as e:
+    except (OSError, ValueError) as e:
         print(f"error: --token-file: {e}", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token)
@@ -166,10 +174,37 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: --inventory-slices: {e}", file=sys.stderr)
         return 2
+    if args.require_nodes == "always" and args.executor == "local":
+        # the in-process executor launches 'local'-bound pods; the heal
+        # loop would race it unbinding the same pods — a pod could run
+        # locally AND be re-placed onto a node (double execution)
+        print(
+            "error: --require-nodes always conflicts with --executor local "
+            "(the local executor runs the 'local'-bound pods the flag "
+            "forbids); use --executor none with node agents",
+            file=sys.stderr,
+        )
+        return 2
+    if args.require_nodes == "always" and inventory is not None:
+        # the require_nodes machinery is scalar-mode only: in topology mode
+        # binding targets are already inventory host names that agents claim
+        # — accepting 'always' here would be a silent no-op
+        print(
+            "error: --require-nodes always applies to scalar node mode only "
+            "(topology mode binds to inventory hosts, which agents claim "
+            "directly); drop the flag or the --inventory-slices",
+            file=sys.stderr,
+        )
+        return 2
+    require_nodes = args.require_nodes == "always" or (
+        args.require_nodes == "auto"
+        and args.executor == "none"
+        and inventory is None
+    )
     scheduler = (
         GangScheduler(
             store, recorder, chips=args.inventory_chips, inventory=inventory,
-            node_grace=args.node_grace,
+            node_grace=args.node_grace, require_nodes=require_nodes,
         )
         if gang
         else None

@@ -663,7 +663,7 @@ def main(argv=None) -> int:
 
     try:
         token = read_token_file(args.token_file)
-    except OSError as e:
+    except (OSError, ValueError) as e:
         print(f"error: --token-file: {e}", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token)

@@ -45,7 +45,11 @@ from mpi_operator_tpu.controller.placement import (
 )
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
-from mpi_operator_tpu.machinery.store import NotFound, ObjectStore
+from mpi_operator_tpu.machinery.store import (
+    NotFound,
+    ObjectStore,
+    optimistic_update,
+)
 from mpi_operator_tpu.scheduler.inventory import (
     SliceInventory,
     parse_node_name,
@@ -107,6 +111,7 @@ class GangScheduler:
         inventory: Optional[SliceInventory] = None,
         node_grace: float = 6.0,
         starvation_grace: float = 300.0,
+        require_nodes: bool = False,
     ):
         self.store = store
         self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
@@ -115,6 +120,14 @@ class GangScheduler:
         # scalar mode with registered Nodes: a node whose agent heartbeat is
         # older than this is not a binding target (matches the NodeMonitor)
         self.node_grace = node_grace
+        # node-mode deployment (operator runs --executor none and agents run
+        # the pods): binding targets are ONLY registered Nodes, never the
+        # single-process 'local' sentinel. Without this, a gang submitted in
+        # the operator-up/agents-not-yet window would be atomically bound to
+        # 'local' — which no agent ever claims — and wedge forever, because
+        # admitted gangs are never re-placed. With it, fresh gangs HOLD
+        # (Unschedulable) until the first agent heartbeats in.
+        self.require_nodes = require_nodes
         # starvation guard for priority ordering: a gang pending longer than
         # this jumps to the head of the queue (FIFO among the aged), so a
         # stream of high-priority jobs cannot starve a low-priority one
@@ -208,7 +221,6 @@ class GangScheduler:
             if job:
                 by_gang[(p.metadata.namespace, job)].append(p)
 
-        free = self.free_chips()  # None = unbounded
         occ = None  # topology occupancy, computed once on first use
         # scalar mode turns node-aware the moment agents register Nodes:
         # binding targets become live nodes (≙ kubelets posting NodeStatus)
@@ -217,9 +229,25 @@ class GangScheduler:
         node_used: Dict[str, int] = {}
         if self.inventory is None:
             all_nodes = self.store.list("Node", NODE_NAMESPACE)
-            if all_nodes:
+            if self.require_nodes:
+                # heal any 'local'-sentinel bindings (pre-upgrade state or a
+                # misconfigured operator): PENDING pods bound to 'local' can
+                # never be claimed by an agent — unbind so they re-place onto
+                # real nodes below. RUNNING ones have a live process behind
+                # a local executor; leave them to finish. This runs BEFORE
+                # any accounting: a healed pod must not be double-counted
+                # against this very pass's chip budget.
+                for p in pods:
+                    if (
+                        p.spec.node_name == NODE_NAME
+                        and p.status.phase == PodPhase.PENDING
+                        and self._unbind(p)
+                    ):
+                        p.spec.node_name = ""  # this pass sees it unbound
+            if all_nodes or self.require_nodes:
                 nodes = self._live_nodes(all_nodes)
                 node_used = self._node_used(pods)
+        free = self.free_chips()  # None = unbounded
         # (priority desc, FIFO) with an aging guard: aged gangs go first in
         # plain FIFO order — the queue the reference delegates to Volcano's
         # priorityClassName handling (mpi_job_controller.go:1215-1237),
@@ -546,6 +574,31 @@ class GangScheduler:
             return
         self._last_warning[key] = message
         self.recorder.event(pg, WARNING, EVENT_UNSCHEDULABLE, message)
+
+    def _unbind(self, pod: Pod) -> bool:
+        """Clear a 'local'-sentinel binding (require_nodes healing only).
+        Optimistic: a local executor launching the pod (RUNNING) between
+        read and write must win — a forced write would revert its phase and
+        make the job run twice. Only a pod still PENDING and 'local'-bound
+        at write time is safe to re-place: nothing has ever run it."""
+        def mutate(cur) -> bool:
+            if cur.spec.node_name != NODE_NAME or cur.is_finished():
+                return False
+            if cur.status.phase != PodPhase.PENDING:
+                return False
+            cur.spec.node_name = ""
+            return True
+
+        ok = optimistic_update(
+            self.store, "Pod", pod.metadata.namespace, pod.metadata.name,
+            mutate, what="unbind-local",
+        ) is not None
+        if ok:
+            log.info(
+                "unbound %s/%s from the 'local' sentinel (node-mode deployment)",
+                pod.metadata.namespace, pod.metadata.name,
+            )
+        return ok
 
     def _bind(self, pod: Pod, node: str = NODE_NAME) -> bool:
         """Set node_name (scheduler owns this field, like the kube binding

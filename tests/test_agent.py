@@ -97,6 +97,72 @@ def test_stale_or_notready_nodes_are_not_targets():
     assert [p.spec.node_name for p in bound_pods(store, "j")] == ["node-live"]
 
 
+def test_require_nodes_holds_gang_until_first_agent_registers():
+    """Operator-up/agents-not-yet window in a node-mode deployment
+    (--executor none, the cluster/helm shape): a fresh gang must HOLD, not
+    bind to the in-process 'local' sentinel no agent ever claims — admitted
+    gangs are never re-placed, so that binding would wedge the job forever."""
+    store = ObjectStore()
+    sched = GangScheduler(store, require_nodes=True)
+    make_gang(store, "j", min_member=2)
+    for i in range(2):
+        make_pod(store, "j", i)
+    sched.sync()
+    assert bound_pods(store, "j") == []  # held, not bound to 'local'
+    make_node(store, "node-a")
+    sched.sync()
+    bound = bound_pods(store, "j")
+    assert len(bound) == 2
+    assert all(p.spec.node_name == "node-a" for p in bound)
+
+
+def test_require_nodes_heals_local_sentinel_bindings():
+    """PENDING pods bound to 'local' (pre-upgrade state, or a gang that
+    slipped in while the operator ran without require_nodes): the scheduler
+    unbinds and re-places them onto real nodes instead of leaving them
+    wedged behind a binding nothing will ever claim."""
+    store = ObjectStore()
+    sched = GangScheduler(store, require_nodes=True)
+    make_gang(store, "j", min_member=1)
+    p = make_pod(store, "j", 0)
+    p.spec.node_name = "local"
+    store.update(p, force=True)
+    make_node(store, "node-a")
+    sched.sync()
+    assert [q.spec.node_name for q in bound_pods(store, "j")] == ["node-a"]
+
+
+def test_evict_pod_does_not_clobber_concurrent_success():
+    """A reaper stamping Succeeded between evict_pod's read and its write
+    must win: the optimistic conflict-retry re-reads, sees the pod finished,
+    and backs off — a forced write would flip a completed pod into a
+    retryable Failed and trigger a spurious gang restart."""
+    from mpi_operator_tpu.machinery.objects import evict_pod
+    from mpi_operator_tpu.machinery.store import Conflict
+
+    store = ObjectStore()
+    make_gang(store, "j", min_member=1)
+    pod = make_pod(store, "j", 0)
+
+    real_update = store.update
+    raced = {"done": False}
+
+    def racing_update(obj, force=False):
+        if not raced["done"] and obj.kind == "Pod":
+            raced["done"] = True
+            # the reaper lands Succeeded first — the evictor's copy is stale
+            cur = store.get("Pod", obj.metadata.namespace, obj.metadata.name)
+            cur.status.phase = PodPhase.SUCCEEDED
+            real_update(cur, force=True)
+            raise Conflict("stale write")
+        return real_update(obj, force=force)
+
+    store.update = racing_update
+    assert evict_pod(store, pod, "node drained") is False
+    cur = store.get("Pod", "default", pod.metadata.name)
+    assert cur.status.phase == PodPhase.SUCCEEDED  # completion preserved
+
+
 def test_inventory_mode_routes_around_dead_registered_nodes():
     """A dead slice host must not look free to the block search — a gang
     evicted off it would otherwise be re-placed there and bounce through

@@ -429,6 +429,21 @@ def test_bearer_token_guards_mutations():
         srv.stop()
 
 
+def test_empty_token_file_fails_closed(tmp_path):
+    """A truncated/misconfigured Secret mount (empty token key) must refuse
+    to start, not silently run unauthenticated — 'no auth' is expressed only
+    by omitting the flag."""
+    from mpi_operator_tpu.machinery.http_store import read_token_file
+
+    f = tmp_path / "token"
+    f.write_text("  \n")
+    with pytest.raises(ValueError, match="empty"):
+        read_token_file(str(f))
+    assert read_token_file(None) is None
+    f.write_text("  tok123  \n")
+    assert read_token_file(str(f)) == "tok123"
+
+
 def test_auth_reads_locks_list_get_and_watch():
     from mpi_operator_tpu.machinery.store import Unauthorized
 

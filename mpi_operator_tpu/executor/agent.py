@@ -40,7 +40,7 @@ import tempfile
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Sequence
 
 from mpi_operator_tpu.executor.local import LocalExecutor
 from mpi_operator_tpu.machinery.objects import (
@@ -64,10 +64,17 @@ class LogServer:
     names files uniquely per pod incarnation; traversal is rejected).
     ``?offset=N`` returns only bytes from N (the `ctl logs --follow`
     incremental-fetch contract, ≙ the kubelet's follow streaming).
+
+    When ``tokens`` is configured, every /logs request must present one of
+    them as a bearer token (training logs can contain data samples; the
+    store grew token auth in r4 and this endpoint honors the same tokens —
+    admin or read tier). /healthz stays open for probes.
     """
 
-    def __init__(self, logs_dir: str, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, logs_dir: str, host: str = "0.0.0.0", port: int = 0,
+                 tokens: Optional[Sequence[str]] = None):
         self.logs_dir = logs_dir
+        self.tokens = [t for t in (tokens or []) if t]
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -76,10 +83,28 @@ class LogServer:
             def log_message(self, fmt, *args):  # quiet
                 pass
 
+            def _authorized(self) -> bool:
+                if not server.tokens:
+                    return True
+                from mpi_operator_tpu.machinery.http_store import check_bearer
+
+                return check_bearer(
+                    self.headers.get("Authorization", ""), server.tokens
+                ) is not None
+
             def do_GET(self):
                 if self.path == "/healthz":
                     body = b'{"ok": true}'
                     self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if not self._authorized():
+                    body = (b'{"error": "Unauthorized", "message": '
+                            b'"missing or invalid bearer token"}')
+                    self.send_response(401)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -150,6 +175,7 @@ class NodeAgent:
         log_port: int = 0,
         workdir: Optional[str] = None,
         heartbeat_interval: float = 2.0,
+        log_tokens: Optional[Sequence[str]] = None,
     ):
         from mpi_operator_tpu.scheduler.gang import NODE_NAME as _LOCAL_SENTINEL
 
@@ -168,7 +194,8 @@ class NodeAgent:
         self.capacity_chips = capacity_chips
         self.heartbeat_interval = heartbeat_interval
         self.logs_dir = logs_dir or tempfile.mkdtemp(prefix="tpujob-agent-logs-")
-        self.log_server = LogServer(self.logs_dir, port=log_port)
+        self.log_server = LogServer(self.logs_dir, port=log_port,
+                                    tokens=log_tokens)
         self.executor = LocalExecutor(
             store,
             require_binding=True,
@@ -281,7 +308,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="the shared store ('http://HOST:PORT' across nodes; "
                          "'sqlite:PATH' for same-host testing)")
     ap.add_argument("--token-file", default=None,
-                    help="bearer token file for an authenticated http store")
+                    help="ADMIN bearer token file: presented to an "
+                         "authenticated http store, and accepted on this "
+                         "agent's log endpoint when configured")
+    ap.add_argument("--read-token-file", default=None,
+                    help="READ-ONLY bearer token file: additionally accepted "
+                         "on the log endpoint (so view-tier `ctl logs` "
+                         "works); never presented to the store")
     ap.add_argument("--node-name", required=True,
                     help="this node's identity — must match what the "
                          "scheduler binds (inventory mode: e.g. slice0/0x0)")
@@ -316,8 +349,16 @@ def main(argv=None) -> int:
         return 2
     try:
         token = read_token_file(args.token_file)
+        read_token = read_token_file(args.read_token_file)
     except (OSError, ValueError) as e:
-        print(f"error: --token-file: {e}", file=sys.stderr)
+        print(f"error: token file: {e}", file=sys.stderr)
+        return 2
+    if read_token is not None and token is None:
+        # same fail-closed posture as tpu-store and tpu-operator: a read
+        # tier without the admin tier means an unauthenticated store
+        # connection nobody asked for
+        print("error: --read-token-file requires --token-file "
+              "(the admin tier anchors auth)", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token)
     try:
@@ -330,6 +371,7 @@ def main(argv=None) -> int:
             log_port=args.log_port,
             workdir=args.workdir,
             heartbeat_interval=args.heartbeat,
+            log_tokens=[t for t in (token, read_token) if t],
         ).start()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

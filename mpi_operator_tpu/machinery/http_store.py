@@ -48,6 +48,7 @@ from mpi_operator_tpu.machinery.store import (
     MODIFIED,
     AlreadyExists,
     Conflict,
+    Forbidden,
     NotFound,
     Unauthorized,
     WatchEvent,
@@ -58,6 +59,7 @@ _ERROR_CLASSES = {
     "AlreadyExists": AlreadyExists,
     "Conflict": Conflict,
     "Unauthorized": Unauthorized,
+    "Forbidden": Forbidden,
 }
 
 # Store objects are manifests and status records — O(KB). The cap keeps an
@@ -91,6 +93,21 @@ def read_token_file(path: Optional[str]) -> Optional[str]:
             f"(omit the flag to disable auth)"
         )
     return tok
+
+
+def check_bearer(header: str, tokens) -> Optional[str]:
+    """THE bearer-token check (constant-time compare), shared by the store
+    server and the agent's log endpoint so the two security checks can
+    never drift. Returns the matching token from ``tokens`` (so callers can
+    tier on identity), or None when the header is absent/malformed/wrong."""
+    scheme, _, presented = header.partition(" ")
+    presented = presented.strip()
+    if scheme != "Bearer" or not presented:
+        return None
+    for tok in tokens:
+        if tok is not None and hmac.compare_digest(presented, tok):
+            return tok
+    return None
 
 
 def _quote(part: str) -> str:
@@ -196,13 +213,16 @@ class StoreServer:
 
     def __init__(self, backing: Any, host: str = "127.0.0.1", port: int = 0,
                  *, log_capacity: int = 4096, token: Optional[str] = None,
-                 auth_reads: bool = False):
+                 auth_reads: bool = False, read_token: Optional[str] = None):
         self.backing = backing
-        # shared bearer token (≙ the authn half of kube-apiserver's
-        # protection on this seam — see deploy/README.md trust boundary):
-        # required on every mutating route when set; reads too with
-        # auth_reads (watch included — watches carry full object payloads)
+        # two token tiers (≙ kube RBAC's aggregated edit-vs-view split,
+        # /root/reference/manifests/base/cluster-role.yaml:96-151):
+        # `token` is the ADMIN tier — every route; `read_token` is the
+        # READ-ONLY tier — GET routes only (watch included), mutations get
+        # 403 Forbidden (authenticated but not authorized). Reads require a
+        # token only with auth_reads (watches carry full object payloads).
         self.token = token
+        self.read_token = read_token
         self.auth_reads = auth_reads
         # the seq space is per-incarnation; clients echo this id so a
         # restarted server (fresh seqs) can't be confused with the old one
@@ -239,28 +259,47 @@ class StoreServer:
                     raise _BodyTooLarge(raw)
                 return json.loads(self.rfile.read(n)) if n else {}
 
-            def _authorized(self, method: str) -> bool:
+            def _auth_error(self, method: str) -> Optional[int]:
+                """None when allowed; else 401 (bad/absent token) or 403
+                (valid READ token on a mutating route)."""
                 if server.token is None:
-                    return True
+                    return None
                 if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
                     # liveness probes carry no headers; /healthz leaks
                     # nothing, so it stays open even under --auth-reads
-                    return True
-                if method == "GET" and not server.auth_reads:
-                    return True
-                header = self.headers.get("Authorization", "")
-                scheme, _, presented = header.partition(" ")
-                return scheme == "Bearer" and hmac.compare_digest(
-                    presented.strip(), server.token
+                    return None
+                matched = check_bearer(
+                    self.headers.get("Authorization", ""),
+                    (server.token, server.read_token),
                 )
+                # identity, not equality: check_bearer returns the exact
+                # object from the tuple, so tiering is not a string compare
+                is_admin = matched is server.token and matched is not None
+                is_read = matched is server.read_token and matched is not None
+                if method == "GET":
+                    if not server.auth_reads:
+                        return None
+                    return None if (is_admin or is_read) else 401
+                if is_admin:
+                    return None
+                return 403 if is_read else 401
 
             def _dispatch(self, method: str) -> None:
                 try:
-                    if not self._authorized(method):
+                    denied = self._auth_error(method)
+                    if denied is not None:
                         # drain the body first: an unread body would desync
                         # keep-alive framing (same concern as _BodyTooLarge)
                         if method in ("POST", "PUT"):
                             self._body()
+                        if denied == 403:
+                            self._send(403, {
+                                "error": "Forbidden",
+                                "message": "the read-only token cannot "
+                                           "mutate (server runs with "
+                                           "--read-token-file)",
+                            })
+                            return
                         self._send(401, {
                             "error": "Unauthorized",
                             "message": "missing or invalid bearer token "
@@ -682,10 +721,15 @@ def main(argv=None) -> int:
     ap.add_argument("--listen", default="127.0.0.1:8475",
                     help="host:port to bind")
     ap.add_argument("--token-file", default=None,
-                    help="file holding the shared bearer token; when set, "
+                    help="file holding the ADMIN bearer token; when set, "
                          "every mutating request must present it")
+    ap.add_argument("--read-token-file", default=None,
+                    help="file holding a READ-ONLY bearer token: it "
+                         "satisfies reads/watches under --auth-reads, and "
+                         "mutations presenting it get 403 (the kube "
+                         "view-vs-edit role split)")
     ap.add_argument("--auth-reads", action="store_true",
-                    help="require the token on reads/watches too")
+                    help="require a token (either tier) on reads/watches too")
     args = ap.parse_args(argv)
     from mpi_operator_tpu.opshell.__main__ import build_store
 
@@ -696,12 +740,20 @@ def main(argv=None) -> int:
         raise SystemExit(f"error: --listen: {e}")
     try:
         token = read_token_file(args.token_file)
+        read_token = read_token_file(args.read_token_file)
     except (OSError, ValueError) as e:
-        raise SystemExit(f"error: --token-file: {e}")
+        raise SystemExit(f"error: token file: {e}")
     if args.auth_reads and token is None:
         raise SystemExit("error: --auth-reads requires --token-file")
+    if read_token is not None and token is None:
+        raise SystemExit("error: --read-token-file requires --token-file "
+                         "(the admin tier anchors auth)")
     server = StoreServer(
-        backing, host, port, token=token, auth_reads=args.auth_reads
+        backing, host, port, token=token,
+        # a read tier with open reads would be meaningless: configuring it
+        # implies reads need a token (either tier)
+        auth_reads=args.auth_reads or read_token is not None,
+        read_token=read_token,
     ).start()
     print(f"store serving on {server.url}", flush=True)
     try:

@@ -44,6 +44,13 @@ class Unauthorized(PermissionError):
     /root/reference/manifests/base/cluster-role.yaml being the authz side)."""
 
 
+class Forbidden(PermissionError):
+    """A store request authenticated with the READ-ONLY token tried to
+    mutate (HTTP backend only; ≙ the aggregated view-vs-edit ClusterRole
+    split of /root/reference/manifests/base/cluster-role.yaml:96-151 —
+    a viewer physically cannot delete a job)."""
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"

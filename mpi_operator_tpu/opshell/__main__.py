@@ -60,9 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="additionally serve this operator's backing store "
                          "over HTTP so other nodes can use --store http://...")
     ap.add_argument("--token-file", default=None,
-                    help="shared bearer token file: required from peers when "
+                    help="ADMIN bearer token file: required from peers when "
                          "serving (--serve-store), presented when connecting "
                          "to a remote --store http://...")
+    ap.add_argument("--read-token-file", default=None,
+                    help="READ-ONLY bearer token file for --serve-store: "
+                         "read/watch requests may present it instead of the "
+                         "admin token; mutations with it get 403. Implies "
+                         "reads require a token.")
     ap.add_argument("--require-nodes", choices=["auto", "always", "never"],
                     default="auto",
                     help="bind gangs only to registered node agents, never "
@@ -109,8 +114,13 @@ def main(argv=None) -> int:
 
     try:
         token = read_token_file(args.token_file)
+        read_token = read_token_file(args.read_token_file)
     except (OSError, ValueError) as e:
-        print(f"error: --token-file: {e}", file=sys.stderr)
+        print(f"error: token file: {e}", file=sys.stderr)
+        return 2
+    if read_token is not None and token is None:
+        print("error: --read-token-file requires --token-file "
+              "(the admin tier anchors auth)", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token)
     store_server = None
@@ -130,7 +140,12 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"error: --serve-store: {e}", file=sys.stderr)
             return 2
-        store_server = StoreServer(store, host, port, token=token).start()
+        store_server = StoreServer(
+            store, host, port, token=token, read_token=read_token,
+            # a read tier with open reads would be meaningless (see the
+            # standalone tpu-store entry point, which does the same)
+            auth_reads=read_token is not None,
+        ).start()
         logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)
     controller = TPUJobController(

@@ -41,6 +41,7 @@ from mpi_operator_tpu.api.schema import ManifestError
 from mpi_operator_tpu.machinery.store import (
     AlreadyExists,
     Conflict,
+    Forbidden,
     NotFound,
     Unauthorized,
 )
@@ -321,12 +322,13 @@ def cmd_logs(client: TPUJobClient, args) -> int:
         return 1
     if args.stderr:
         path = path[: -len(".log")] + ".err" if path.endswith(".log") else path
+    token = getattr(args, "log_token", None)
     if getattr(args, "follow", False):
-        return _follow_logs(client, pod, path)
+        return _follow_logs(client, pod, path, token=token)
     try:
         offset = 0
         while True:
-            chunk = _read_log_from(path, offset)
+            chunk = _read_log_from(path, offset, token)
             if not chunk:
                 break
             sys.stdout.buffer.write(chunk)
@@ -445,11 +447,12 @@ def cmd_drain(client: TPUJobClient, args) -> int:
     return 0
 
 
-def _read_log_from(path: str, offset: int) -> bytes:
+def _read_log_from(path: str, offset: int, token: Optional[str] = None) -> bytes:
     """Bytes from ``offset`` — local file seek, or the agent log endpoint's
     ``?offset=`` contract. Raises OSError on any read/fetch failure (THE one
     copy of the http-vs-local branching; cmd_logs and _follow_logs both ride
-    it so the two paths can never diverge)."""
+    it so the two paths can never diverge). ``token`` rides along as a
+    bearer header for token-guarded agents (--token-file on the agent)."""
     if path.startswith("http://") or path.startswith("https://"):
         import urllib.error
         import urllib.request
@@ -457,8 +460,12 @@ def _read_log_from(path: str, offset: int) -> bytes:
         url = path if offset == 0 else (
             f"{path}{'&' if '?' in path else '?'}offset={offset}"
         )
+        req = urllib.request.Request(
+            url,
+            headers={"Authorization": f"Bearer {token}"} if token else {},
+        )
         try:
-            with urllib.request.urlopen(url, timeout=10) as r:
+            with urllib.request.urlopen(req, timeout=10) as r:
                 return r.read()
         except urllib.error.URLError as e:
             raise OSError(str(e)) from None
@@ -476,7 +483,8 @@ def _log_read_diagnostic(pod, path: str, err: Exception) -> str:
             f"{where} — with agents, log paths are served as URLs")
 
 
-def _follow_logs(client: TPUJobClient, pod, path: str) -> int:
+def _follow_logs(client: TPUJobClient, pod, path: str,
+                 token: Optional[str] = None) -> int:
     """≙ `kubectl logs -f`: stream the pod's output as it is written, exit
     when the pod finishes (0 on success; 130 on Ctrl-C like kubectl).
     Incremental byte-offset fetches — a log streamer's poll cadence, like
@@ -500,7 +508,7 @@ def _follow_logs(client: TPUJobClient, pod, path: str) -> int:
     try:
         while True:
             try:
-                chunk = _read_log_from(path, offset)
+                chunk = _read_log_from(path, offset, token)
                 failures = 0
             except OSError as e:
                 chunk = b""
@@ -521,7 +529,7 @@ def _follow_logs(client: TPUJobClient, pod, path: str) -> int:
                 return 1
             if cur.is_finished() and not chunk:
                 try:
-                    tail = _read_log_from(path, offset)
+                    tail = _read_log_from(path, offset, token)
                 except OSError:
                     tail = b""
                 if tail:
@@ -666,6 +674,7 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"error: --token-file: {e}", file=sys.stderr)
         return 2
+    args.log_token = token  # `ctl logs` presents it to guarded agents too
     store = build_store(args.store, token=token)
     client = TPUJobClient(store, namespace=args.namespace)
     try:
@@ -685,6 +694,11 @@ def main(argv=None) -> int:
             "uncordon": cmd_uncordon,
             "drain": cmd_drain,
         }[args.verb](client, args)
+    except Forbidden as e:
+        # read-tier token on a mutating verb: authenticated but not
+        # authorized — say so plainly (≙ kubectl's 'forbidden' errors)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except Unauthorized as e:
         # a wrong/missing token must read as a CLI error with the server's
         # hint, not a PermissionError traceback

@@ -163,6 +163,43 @@ def test_evict_pod_does_not_clobber_concurrent_success():
     assert cur.status.phase == PodPhase.SUCCEEDED  # completion preserved
 
 
+def test_log_endpoint_honors_tokens(tmp_path):
+    """With tokens configured the agent's log endpoint 401s anonymous
+    fetches and accepts either tier (admin or read) — training logs can
+    contain data samples and deserve the same guard the store has.
+    /healthz stays open for probes, and ctl's fetch helper presents the
+    token end to end."""
+    import urllib.error
+    import urllib.request
+
+    from mpi_operator_tpu.executor.agent import LogServer
+    from mpi_operator_tpu.opshell.ctl import _read_log_from
+
+    (tmp_path / "w.log").write_text("hello")
+    srv = LogServer(str(tmp_path), host="127.0.0.1",
+                    tokens=["adm1n", "v1ewer"]).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/logs/w.log"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 401
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200  # probes carry no headers
+        for tok in ("adm1n", "v1ewer"):
+            req = urllib.request.Request(
+                url, headers={"Authorization": f"Bearer {tok}"}
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.read() == b"hello"
+            assert _read_log_from(url, 0, tok) == b"hello"
+        with pytest.raises(OSError):
+            _read_log_from(url, 0, "wr0ng")
+    finally:
+        srv.stop()
+
+
 def test_inventory_mode_routes_around_dead_registered_nodes():
     """A dead slice host must not look free to the block search — a gang
     evicted off it would otherwise be re-placed there and bounce through

@@ -429,6 +429,50 @@ def test_bearer_token_guards_mutations():
         srv.stop()
 
 
+def test_read_token_tier_reads_but_cannot_mutate():
+    """Two-tier tokens ≙ the aggregated view-vs-edit ClusterRole split
+    (reference manifests/base/cluster-role.yaml:96-151): the read token
+    satisfies reads and watches, but mutations with it get 403 Forbidden —
+    distinct from 401, the holder is authenticated but not authorized."""
+    from mpi_operator_tpu.machinery.store import Forbidden, Unauthorized
+
+    srv = StoreServer(
+        ObjectStore(), "127.0.0.1", 0,
+        token="adm1n", read_token="v1ewer", auth_reads=True,
+    ).start()
+    admin = HttpStoreClient(srv.url, token="adm1n")
+    viewer = HttpStoreClient(srv.url, token="v1ewer", watch_poll_timeout=1.0)
+    anon = HttpStoreClient(srv.url)
+    try:
+        pod = admin.create(Pod(metadata=ObjectMeta(name="p", namespace="d")))
+        # read tier: get/list/watch all work
+        assert viewer.get("Pod", "d", "p").metadata.name == "p"
+        assert [p.metadata.name for p in viewer.list("Pod")] == ["p"]
+        q = viewer.watch("Pod")
+        admin.create(Pod(metadata=ObjectMeta(name="q", namespace="d")))
+        assert q.get(timeout=5).obj.metadata.name == "q"
+        # read tier: every mutation is Forbidden (403, not 401)
+        with pytest.raises(Forbidden):
+            viewer.create(Pod(metadata=ObjectMeta(name="r", namespace="d")))
+        with pytest.raises(Forbidden):
+            viewer.delete("Pod", "d", "p")
+        pod.status.phase = PodPhase.RUNNING
+        with pytest.raises(Forbidden):
+            viewer.update(pod, force=True)
+        # no token at all: still 401 on reads (auth_reads) and mutations
+        with pytest.raises(Unauthorized):
+            anon.get("Pod", "d", "p")
+        with pytest.raises(Unauthorized):
+            anon.delete("Pod", "d", "p")
+        # the admin tier is untouched by the read tier existing
+        admin.delete("Pod", "d", "p")
+    finally:
+        anon.close()
+        viewer.close()
+        admin.close()
+        srv.stop()
+
+
 def test_empty_token_file_fails_closed(tmp_path):
     """A truncated/misconfigured Secret mount (empty token key) must refuse
     to start, not silently run unauthenticated — 'no auth' is expressed only

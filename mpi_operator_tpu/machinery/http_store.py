@@ -104,8 +104,14 @@ def check_bearer(header: str, tokens) -> Optional[str]:
     presented = presented.strip()
     if scheme != "Bearer" or not presented:
         return None
+    # compare BYTES: hmac.compare_digest raises TypeError on non-ASCII str
+    # input, and a garbage header from a scanner must yield 401, not a
+    # handler crash (500 on the store, dropped connection on the agent)
+    presented_b = presented.encode("utf-8")
     for tok in tokens:
-        if tok is not None and hmac.compare_digest(presented, tok):
+        if tok is not None and hmac.compare_digest(
+            presented_b, tok.encode("utf-8")
+        ):
             return tok
     return None
 

@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--node-grace", type=float, default=6.0,
                     help="seconds without a node-agent heartbeat before its "
                          "pods are evicted (the node-controller grace)")
+    ap.add_argument("--preemption-grace", type=float, default=None,
+                    metavar="SECONDS",
+                    help="opt-in priority preemption: when the "
+                         "capacity-blocked head of the queue outranks a "
+                         "running gang and has waited this long, the "
+                         "minimal set of lowest-priority running gangs is "
+                         "evicted (whole-gang, checkpoint-resumable) to "
+                         "make room. Default: disabled")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
@@ -216,10 +224,18 @@ def main(argv=None) -> int:
         and args.executor == "none"
         and inventory is None
     )
+    if args.preemption_grace is not None and not gang:
+        print(
+            "error: --preemption-grace requires gang scheduling "
+            "(remove --no-gang-scheduling)",
+            file=sys.stderr,
+        )
+        return 2
     scheduler = (
         GangScheduler(
             store, recorder, chips=args.inventory_chips, inventory=inventory,
             node_grace=args.node_grace, require_nodes=require_nodes,
+            preemption_grace=args.preemption_grace,
         )
         if gang
         else None

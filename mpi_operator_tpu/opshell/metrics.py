@@ -105,3 +105,8 @@ pods_evicted = REGISTRY.counter(
     "heartbeating (ctl drain evictions happen client-side and are not "
     "counted here)",
 )
+gangs_preempted = REGISTRY.counter(
+    "tpu_operator_gangs_preempted_total",
+    "Counts running gangs evicted whole to make room for a "
+    "higher-priority pending gang (--preemption-grace)",
+)

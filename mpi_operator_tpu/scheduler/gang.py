@@ -44,7 +44,13 @@ from mpi_operator_tpu.controller.placement import (
     ANNOTATION_SLICE_ID,
 )
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
-from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
+from mpi_operator_tpu.machinery.objects import (
+    NODE_NAMESPACE,
+    Pod,
+    PodPhase,
+    evict_pod,
+)
+from mpi_operator_tpu.opshell import metrics
 from mpi_operator_tpu.machinery.store import (
     NotFound,
     ObjectStore,
@@ -62,6 +68,8 @@ ENV_CHIPS_PER_HOST = "TPUJOB_CHIPS_PER_HOST"
 
 EVENT_UNSCHEDULABLE = "Unschedulable"
 EVENT_SCHEDULED = "Scheduled"
+EVENT_PREEMPTED = "Preempted"
+EVENT_PREEMPTING = "Preempting"
 
 NODE_NAME = "local"  # single-host emulation: binding == admission
 
@@ -112,6 +120,7 @@ class GangScheduler:
         node_grace: float = 6.0,
         starvation_grace: float = 300.0,
         require_nodes: bool = False,
+        preemption_grace: Optional[float] = None,
     ):
         self.store = store
         self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
@@ -128,6 +137,18 @@ class GangScheduler:
         # admitted gangs are never re-placed. With it, fresh gangs HOLD
         # (Unschedulable) until the first agent heartbeats in.
         self.require_nodes = require_nodes
+        # OPT-IN priority preemption (None = off, the default): when the
+        # capacity-blocked head of the queue has priority strictly above
+        # some running gang and has been pending past this grace, the
+        # minimal set of lowest-priority running gangs that frees enough
+        # room is evicted whole-gang (reason=Preempted → retryable → the
+        # gang-coherent restart resumes the victim from checkpoint when
+        # room frees up again). ≙ the reclaim semantics the reference
+        # delegates to Volcano's priorityClassName handling
+        # (mpi_job_controller.go:1215-1237). Guards: never evict
+        # equal-or-higher priority, and never evict anything if the
+        # preemptor STILL would not fit (no thrash, no cascade).
+        self.preemption_grace = preemption_grace
         # starvation guard for priority ordering: a gang pending longer than
         # this jumps to the head of the queue (FIFO among the aged), so a
         # stream of high-priority jobs cannot starve a low-priority one
@@ -284,6 +305,10 @@ class GangScheduler:
             return (1, -pri, ts, pg.metadata.name)
 
         groups = sorted(all_groups, key=order)
+        # the fresh gang that capacity-blocked the FIFO this pass (if any):
+        # the preemption candidate — by construction the highest-priority
+        # gang that cannot currently fit
+        blocked: Optional[Tuple] = None
         for pg in groups:
             job = pg.metadata.labels.get(LABEL_JOB_NAME, pg.metadata.name)
             members = by_gang.get((pg.metadata.namespace, job), [])
@@ -301,6 +326,8 @@ class GangScheduler:
                     occ = self.occupancy()
                     self._occlude_dead_nodes(occ)
                 if not self._sync_gang_topology(pg, bound, unbound, occ):
+                    if not bound:
+                        blocked = (pg, unbound)
                     break  # strict FIFO, same as the scalar branch below
                 continue
             if bound:
@@ -343,6 +370,7 @@ class GangScheduler:
                 )
                 # strict FIFO: do not backfill later gangs past this one —
                 # a stream of small jobs could otherwise starve a large one
+                blocked = (pg, unbound)
                 break
             assignment = None
             if nodes is not None:
@@ -353,6 +381,7 @@ class GangScheduler:
                         f"gang needs {total} chips ({len(unbound)} pods) but "
                         f"no placement fits the {len(nodes)} live node(s)",
                     )
+                    blocked = (pg, unbound)
                     break  # capacity: hold the FIFO, same as the budget path
             n = 0
             for p in unbound:
@@ -367,6 +396,149 @@ class GangScheduler:
                 pg, "Normal", EVENT_SCHEDULED,
                 f"gang admitted: {n} pods, {sum(pod_cost(p) for p in unbound)} chips",
             )
+        if blocked is not None:
+            self._maybe_preempt(
+                blocked[0], blocked[1], free, nodes, node_used, occ
+            )
+
+    # -- priority preemption ------------------------------------------------
+
+    def _maybe_preempt(
+        self,
+        pg,
+        unbound: List[Pod],
+        free: Optional[int],
+        nodes: Optional[List],
+        node_used: Dict[str, int],
+        occ: Optional[Dict[str, set]],
+    ) -> None:
+        """Evict the minimal set of strictly-lower-priority running gangs
+        that lets the capacity-blocked queue head fit. Opt-in
+        (preemption_grace), whole-gang (reason=Preempted → retryable → the
+        victim's gang-coherent restart resumes from checkpoint later), and
+        guarded: nothing is evicted if even evicting EVERY lower-priority
+        gang would not make room (no thrash), and equal-or-higher priority
+        is never touched. Binding happens on the NEXT pass, level-triggered
+        off the eviction events — this pass only frees the room."""
+        if self.preemption_grace is None:
+            return
+        key = self._pg_key(pg)
+        since = self._pending_since.get(key)
+        now = time.time()
+        if since is None or now - since < self.preemption_grace:
+            return
+        pri = resolve_priority_class(pg.spec.priority_class)
+        if pri is None:
+            pri = 0
+        # admitted gangs of strictly lower priority, with their live bound
+        # pods (what actually holds capacity)
+        by_gang: Dict[Tuple[str, str], List[Pod]] = defaultdict(list)
+        for p in self.store.list("Pod"):
+            job = p.metadata.labels.get(LABEL_JOB_NAME, "")
+            if job and p.spec.node_name and not p.is_finished():
+                by_gang[(p.metadata.namespace, job)].append(p)
+        pool = []
+        for v in self.store.list("PodGroup"):
+            if self._pg_key(v) == key:
+                continue
+            vpri = resolve_priority_class(v.spec.priority_class)
+            if vpri is None:
+                vpri = 0
+            if vpri >= pri:
+                continue  # never preempt equal-or-higher priority
+            vjob = v.metadata.labels.get(LABEL_JOB_NAME, v.metadata.name)
+            held = by_gang.get((v.metadata.namespace, vjob), [])
+            if not held:
+                continue
+            pool.append((vpri, v, held))
+        # cheapest victims first: lowest priority, then youngest (evicting
+        # the most recently admitted loses the least progress), name-stable
+        pool.sort(key=lambda t: (
+            t[0], -(t[1].metadata.creation_timestamp or 0), t[1].metadata.name
+        ))
+        chosen: List[Tuple[int, object, List[Pod]]] = []
+        for item in pool:
+            chosen.append(item)
+            if self._fits_after_eviction(
+                unbound, [held for _, _, held in chosen],
+                free, nodes, node_used, occ,
+            ):
+                break
+        else:
+            return  # still would not fit: evict nothing
+        names = ", ".join(self._pg_key(v) for _, v, _ in chosen)
+        log.warning(
+            "preempting %s for %s (priority %d, pending %.0fs)",
+            names, key, pri, now - since,
+        )
+        for vpri, victim, held in chosen:
+            n = 0
+            for p in held:
+                if evict_pod(
+                    self.store, p,
+                    f"preempted by {key} (priority {pri} > {vpri})",
+                ):
+                    n += 1
+            # reset the victim's pending clock: if it was starvation-AGED,
+            # its recreated pods would otherwise jump the queue ahead of the
+            # very gang that preempted it and be preempted again — an
+            # admit/evict livelock that burns the victim's restart budget
+            # while the preemptor starves. Preemption means priority beats
+            # aging; the victim re-queues with a fresh clock.
+            self._pending_since.pop(self._pg_key(victim), None)
+            self.recorder.event(
+                victim, WARNING, EVENT_PREEMPTED,
+                f"gang preempted ({n} pods evicted) by higher-priority "
+                f"{key}; will restart when capacity frees",
+            )
+            metrics.gangs_preempted.inc()
+        self.recorder.event(
+            pg, "Normal", EVENT_PREEMPTING,
+            f"preempting lower-priority {names} after {now - since:.0f}s "
+            f"pending",
+        )
+
+    def _fits_after_eviction(
+        self,
+        unbound: List[Pod],
+        victim_pod_lists: List[List[Pod]],
+        free: Optional[int],
+        nodes: Optional[List],
+        node_used: Dict[str, int],
+        occ: Optional[Dict[str, set]],
+    ) -> bool:
+        """Would the blocked gang fit if these victims' pods were gone?
+        Simulated on scratch copies in whichever admission mode is active —
+        the same placement logic the real pass will run next sync."""
+        victims = [p for lst in victim_pod_lists for p in lst]
+        if self.inventory is not None:
+            occ2 = {k: set(v) for k, v in (occ or {}).items()}
+            for p in victims:
+                parsed = parse_node_name(p.spec.node_name)
+                if parsed is not None:
+                    occ2.get(parsed[0], set()).discard(parsed[1])
+            # dead-node slots must stay occluded even after their pods left
+            self._occlude_dead_nodes(occ2)
+            geos = {p.metadata.name: self._pod_geometry(p) for p in unbound}
+            if any(g is None for g in geos.values()):
+                return False
+            mesh = next(iter(geos.values()))[0]
+            num_slices = 1 + max(g[2] for g in geos.values())
+            return (
+                self.inventory.find_placement(mesh, num_slices, occ2)
+                is not None
+            )
+        freed = sum(pod_cost(p) for p in victims)
+        total = sum(pod_cost(p) for p in unbound)
+        if free is not None and total > free + freed:
+            return False
+        if nodes is not None:
+            used2 = dict(node_used)
+            for p in victims:
+                node = p.spec.node_name
+                used2[node] = max(0, used2.get(node, 0) - pod_cost(p))
+            return self._assign_gang(nodes, used2, unbound) is not None
+        return free is not None
 
     # -- topology-aware admission -------------------------------------------
 

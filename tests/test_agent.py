@@ -895,3 +895,27 @@ def test_scheduler_wakes_on_node_events():
         assert [p.spec.node_name for p in bound_pods(store, "j")] == ["node-a"]
     finally:
         sched.stop()
+
+
+def test_preemption_in_node_mode():
+    """Preemption under node-capacity scheduling: the victim's chips free
+    on its node and the critical gang binds there next pass."""
+    from test_scheduler import job_pods, make_priority_gang
+
+    store = ObjectStore()
+    sched = GangScheduler(store, preemption_grace=0.0)
+    make_node(store, "node-a", chips=2)
+    make_priority_gang(store, "lowjob", 2, "low")
+    for i in range(2):
+        make_pod(store, "lowjob", i)
+    sched.sync()
+    assert len(bound_pods(store, "lowjob")) == 2
+    make_priority_gang(store, "crit", 2, "critical")
+    for i in range(2):
+        make_pod(store, "crit", i)
+    sched.sync()
+    sched.sync()
+    assert all(p.status.reason == "Evicted" for p in job_pods(store, "lowjob"))
+    sched.sync()
+    assert [p.spec.node_name for p in bound_pods(store, "crit")] == \
+        ["node-a", "node-a"]

@@ -556,3 +556,33 @@ def test_malformed_watch_params_are_bad_request():
         assert ei.value.code == 400
     finally:
         srv.stop()
+
+
+def test_garbage_bearer_tokens_yield_401_not_500():
+    """A non-ASCII or junk Authorization header must be a clean 401:
+    hmac.compare_digest raises TypeError on non-ASCII str input, which
+    would turn scanner garbage into handler crashes (500 on the store,
+    dropped connections on the agent log endpoint)."""
+    import urllib.error
+    import urllib.request
+
+    from mpi_operator_tpu.machinery.http_store import check_bearer
+
+    assert check_bearer("Bearer ümlaut", ("secret",)) is None
+    assert check_bearer("Basic xyz", ("secret",)) is None
+    assert check_bearer("", ("secret",)) is None
+    assert check_bearer("Bearer secret", ("secret",)) == "secret"
+
+    srv = StoreServer(
+        ObjectStore(), "127.0.0.1", 0, token="secret", auth_reads=True
+    ).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/v1/objects/Pod",
+            headers={"Authorization": "Bearer ümlaut"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 401  # not 500
+    finally:
+        srv.stop()

@@ -68,7 +68,12 @@ def main():
     per_chip = int(os.environ.get("LLAMA_BATCH", "2"))
     seq_len = int(os.environ.get("LLAMA_SEQ", "64"))
     steps = int(os.environ.get("LLAMA_STEPS", "6"))
+    # explicit manifest path wins; otherwise the per-job directory on the
+    # shared checkpoint volume the node agent advertised (--ckpt-dir) — the
+    # path a restarted gang finds again even when re-placed on other nodes
     ckpt_dir = os.environ.get("LLAMA_CKPT", "")
+    if not ckpt_dir:
+        ckpt_dir = bootstrap.default_checkpoint_dir(ctx) or ""
 
     trainer = Trainer(
         lambda p, b: llama.loss_fn(cfg, p, b, mesh=mesh),

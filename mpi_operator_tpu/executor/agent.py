@@ -176,6 +176,7 @@ class NodeAgent:
         workdir: Optional[str] = None,
         heartbeat_interval: float = 2.0,
         log_tokens: Optional[Sequence[str]] = None,
+        ckpt_dir: Optional[str] = None,
     ):
         from mpi_operator_tpu.scheduler.gang import NODE_NAME as _LOCAL_SENTINEL
 
@@ -196,12 +197,23 @@ class NodeAgent:
         self.logs_dir = logs_dir or tempfile.mkdtemp(prefix="tpujob-agent-logs-")
         self.log_server = LogServer(self.logs_dir, port=log_port,
                                     tokens=log_tokens)
+        # the shared checkpoint volume's mount point ON THIS NODE: exported
+        # to every pod as TPUJOB_CKPT_DIR so workloads derive per-job
+        # checkpoint paths that survive the gang being re-placed onto other
+        # nodes (bootstrap.default_checkpoint_dir)
+        self.ckpt_dir = ckpt_dir
+        extra_env = {}
+        if ckpt_dir:
+            from mpi_operator_tpu.runtime.bootstrap import ENV_CKPT_DIR
+
+            extra_env[ENV_CKPT_DIR] = ckpt_dir
         self.executor = LocalExecutor(
             store,
             require_binding=True,
             node_name=node_name,
             logs_dir=self.logs_dir,
             workdir=workdir,
+            extra_env=extra_env,
             log_url_base=None,  # filled at start (needs the bound log port)
         )
         self._stop = threading.Event()
@@ -325,10 +337,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="chip capacity for scalar-mode node scheduling "
                          "(default: unbounded)")
     ap.add_argument("--logs-dir", default=None)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="node-local mount point of the cluster's SHARED "
+                         "checkpoint volume (exported to pods as "
+                         "TPUJOB_CKPT_DIR; workloads derive "
+                         "<dir>/<namespace>/<job> from it so a restarted "
+                         "gang re-placed onto other nodes resumes from the "
+                         "same path)")
     ap.add_argument("--log-port", type=int, default=0,
                     help="port for the log endpoint (default: ephemeral)")
     ap.add_argument("--heartbeat", type=float, default=2.0)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--tls-ca-file", default=None,
+                    help="CA bundle (or the self-signed cert itself) to "
+                         "verify a --store https://... against")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     return ap
 
@@ -360,7 +382,7 @@ def main(argv=None) -> int:
         print("error: --read-token-file requires --token-file "
               "(the admin tier anchors auth)", file=sys.stderr)
         return 2
-    store = build_store(args.store, token=token)
+    store = build_store(args.store, token=token, ca_file=args.tls_ca_file)
     try:
         agent = NodeAgent(
             store,
@@ -372,6 +394,7 @@ def main(argv=None) -> int:
             workdir=args.workdir,
             heartbeat_interval=args.heartbeat,
             log_tokens=[t for t in (token, read_token) if t],
+            ckpt_dir=args.ckpt_dir,
         ).start()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

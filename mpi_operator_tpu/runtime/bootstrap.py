@@ -38,6 +38,12 @@ ENV_HOST_MESH = "TPUJOB_HOST_MESH"
 ENV_HOST_COORD = "TPUJOB_HOST_COORD"
 ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
+# node-local mount point of the cluster's SHARED checkpoint volume, stamped
+# by the node agent (--ckpt-dir). A restarted gang can land on different
+# nodes, so checkpoints must never live on a node-local path the next
+# incarnation cannot see; workloads derive a per-job dir from this via
+# default_checkpoint_dir() instead of hardcoding node paths in manifests.
+ENV_CKPT_DIR = "TPUJOB_CKPT_DIR"
 
 
 def _parse_shape(s: str) -> Tuple[int, ...]:
@@ -104,6 +110,25 @@ def context_from_env(environ: Optional[Mapping[str, str]] = None) -> RuntimeCont
         slice_id=int(env.get(ENV_SLICE_ID, "0") or 0),
         num_slices=int(env.get(ENV_NUM_SLICES, "1") or 1),
     )
+
+
+def default_checkpoint_dir(
+    ctx: RuntimeContext,
+    environ: Optional[Mapping[str, str]] = None,
+) -> Optional[str]:
+    """Per-job checkpoint directory on the shared checkpoint volume the
+    node agent advertised (``TPUJOB_CKPT_DIR``), or None when no volume is
+    configured. ``<base>/<namespace>/<job>``: namespaced so two tenants'
+    jobs of the same name never collide, job-derived so a restarted gang
+    RE-PLACED ONTO DIFFERENT NODES resumes from the same path — the
+    property the reference inherits from PVCs mounted at a fixed path in
+    every worker pod (mpi_job_controller.go:817-877 just runs the template;
+    kubernetes mounts the same claim everywhere)."""
+    env = os.environ if environ is None else environ
+    base = env.get(ENV_CKPT_DIR, "")
+    if not base:
+        return None
+    return os.path.join(base, ctx.namespace, ctx.job_name)
 
 
 _initialized_ctx: Optional[RuntimeContext] = None

@@ -144,3 +144,28 @@ def test_mesh_plan_parse():
         MeshPlan.parse("fsdp=0")
     with pytest.raises(ValueError):
         MeshPlan.parse("fsdp=2,fsdp=4")  # duplicate axis is a typo
+
+
+def test_default_checkpoint_dir_contract():
+    """The shared-checkpoint-volume contract: the node agent advertises the
+    volume via TPUJOB_CKPT_DIR; the per-job path is <base>/<ns>/<job> so a
+    gang re-placed onto other nodes resumes from the same path, and two
+    tenants' same-named jobs never collide. No volume → None (workloads
+    fall back to their explicit paths or plain non-elastic loops)."""
+    from mpi_operator_tpu.runtime.bootstrap import (
+        ENV_CKPT_DIR,
+        context_from_env,
+        default_checkpoint_dir,
+    )
+
+    ctx = context_from_env(
+        {"TPUJOB_NAME": "llama", "TPUJOB_NAMESPACE": "team-a"}
+    )
+    assert default_checkpoint_dir(ctx, {}) is None
+    got = default_checkpoint_dir(ctx, {ENV_CKPT_DIR: "/mnt/ckpt"})
+    assert got == "/mnt/ckpt/team-a/llama"
+    other = context_from_env(
+        {"TPUJOB_NAME": "llama", "TPUJOB_NAMESPACE": "team-b"}
+    )
+    assert default_checkpoint_dir(other, {ENV_CKPT_DIR: "/mnt/ckpt"}) \
+        == "/mnt/ckpt/team-b/llama"

@@ -158,6 +158,10 @@ class GangScheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch_q = None
+        # True when the last sync saw work left to do (some gang with
+        # unbound pending pods): gates the PERIODIC resync only — events
+        # always wake the loop. An idle cluster does zero list traffic.
+        self._dirty = True
         self._last_warning: Dict[str, str] = {}  # pg key → message (dedupe)
         # pg key → when it last became pending (has unbound pods); drives
         # the starvation guard. PodGroups outlive gang restarts, so aging
@@ -184,22 +188,50 @@ class GangScheduler:
         last_sync = time.monotonic()
         while not self._stop.is_set():
             need_sync = False
+            def _wakes(ev) -> bool:
+                # Pod/PodGroup events always matter. Node events (uncordon,
+                # agent registration, returning heartbeat) can change a
+                # binding decision ONLY when some gang is waiting — agents
+                # heartbeat their Node every ~2s, so ungated Node events
+                # would have a 50-agent idle cluster syncing forever
+                return ev.kind in ("Pod", "PodGroup") or (
+                    ev.kind == "Node" and self._dirty
+                )
+
             try:
                 ev = self._watch_q.get(timeout=0.2)
-                # Node events matter since node-mode binding: an uncordon,
-                # a new agent registration, or a returning heartbeat must
-                # wake pending gangs
-                need_sync = ev.kind in ("Pod", "PodGroup", "Node")
+                need_sync = _wakes(ev)
+                # COALESCE the burst: creating one 100-pod gang emits 100+
+                # events, and every binding this scheduler writes emits one
+                # more — syncing per event is the O(events × full-relist)
+                # apiserver-load pattern the reference's redesign doc calls
+                # out (proposals/scalable-robust-operator.md:90-109). Drain
+                # whatever is already queued (the terminal queue.Empty ends
+                # the drain) and run ONE sync for the lot; level-triggered
+                # semantics make this safe — sync reads current state, not
+                # the events.
+                while True:
+                    ev = self._watch_q.get_nowait()
+                    need_sync = need_sync or _wakes(ev)
             except Exception:
                 pass
-            # periodic resync: a node going STALE emits no event at all —
-            # it is the absence of heartbeats — yet flips binding decisions
             if not need_sync and time.monotonic() - last_sync < 2.0:
+                continue
+            if not need_sync and not self._dirty:
+                # periodic resync exists ONLY because a node going stale
+                # emits no event (the absence of heartbeats) — which can
+                # change nothing unless some gang is waiting to bind. With
+                # nothing pending, the idle cluster does zero list traffic.
                 continue
             try:
                 self.sync()
                 last_sync = time.monotonic()
-            except Exception:  # keep the loop alive; next event resyncs
+            except Exception:
+                # keep the loop alive AND keep retrying: a transient store
+                # error (e.g. SQLITE_BUSY) must not strand a pending gang —
+                # with _dirty stale-False and the event already drained, no
+                # later wakeup would come
+                self._dirty = True
                 log.exception("scheduler sync failed")
 
     # -- accounting ---------------------------------------------------------
@@ -400,6 +432,10 @@ class GangScheduler:
             self._maybe_preempt(
                 blocked[0], blocked[1], free, nodes, node_used, occ
             )
+        # gangs bound this pass keep their pending_since entry until the
+        # next pass observes them bound — one extra periodic sync, then the
+        # idle cluster goes quiet
+        self._dirty = bool(self._pending_since)
 
     # -- priority preemption ------------------------------------------------
 

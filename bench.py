@@ -14,12 +14,14 @@ MFU / 0.50 (the BASELINE.md MFU target). The llama run also numerically
 checks the compiled flash kernel against the chunked XLA reference on-chip
 before timing and reports the max error in the JSON.
 
-Default run (BENCH_MODEL unset) executes BOTH workloads and prints one JSON
-line each — llama first, ResNet last, so the ResNet line remains the parsed
-headline while the llama MFU is archived in the same output tail:
+Default run (BENCH_MODEL unset) executes ALL acceptance workloads and prints
+one JSON line each — llama 2k first, then llama at 16k context
+(BENCH_SEQ_LONG), ResNet last so the ResNet line remains the parsed headline
+while the llama MFU and long-context claims are archived in the same tail:
   {"metric": "llama_train_throughput_per_chip", ..., "mfu": ...}
+  {"metric": "llama_longctx_train_throughput_per_chip", "seq_len": 16384, ...}
   {"metric": "resnet101_train_throughput_per_chip", "value": N, ...}
-``BENCH_MODEL=resnet`` / ``BENCH_MODEL=llama`` run just one.
+``BENCH_MODEL=resnet`` / ``llama`` / ``llama-long`` run just one.
 """
 
 import json
@@ -236,17 +238,23 @@ def llama_setup(per_chip_batch: int, seq_len: int):
     return cfg, trainer, state, batch, global_batch
 
 
-def bench_llama():
+def bench_llama(*, seq_len=None, per_chip_batch=None,
+                metric="llama_train_throughput_per_chip",
+                check_kernel=True):
     import jax
 
     from mpi_operator_tpu.models import llama
 
     n_chips, kind, peak = _device_info()
     on_tpu = jax.default_backend() == "tpu"
-    flash_err = _check_flash_kernel_on_chip() if on_tpu else None
+    flash_err = (
+        _check_flash_kernel_on_chip() if (on_tpu and check_kernel) else None
+    )
 
-    per_chip_batch = llama_per_chip_batch()
-    seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
+    if per_chip_batch is None:
+        per_chip_batch = llama_per_chip_batch()
+    if seq_len is None:
+        seq_len = int(os.environ.get("BENCH_SEQ", "2048"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "3")))
 
@@ -262,7 +270,7 @@ def bench_llama():
     print(
         json.dumps(
             {
-                "metric": "llama_train_throughput_per_chip",
+                "metric": metric,
                 "value": round(per_chip, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(mfu / TARGET_MFU, 3),
@@ -277,6 +285,26 @@ def bench_llama():
             }
         )
     )
+    return per_chip
+
+
+def bench_llama_longctx():
+    """The long-context acceptance line (VERDICT r4 weak #6: the 16k-context
+    number was builder-reported only — this puts it in the driver-captured
+    output). Same llama path at BENCH_SEQ_LONG (default 16384) and batch 1
+    per chip (the measured 16 GiB fit, PERF.md sequence-scaling table),
+    using the 16k-vocab long-context config. NOTE the mfu field here uses
+    the full-T attention-FLOPs convention, inflated ~1.6x at 16k because
+    the causal kernel does half that attention work — compare tokens/s
+    across rounds, not this mfu (PERF.md round-3 note)."""
+    seq = int(os.environ.get("BENCH_SEQ_LONG", "16384"))
+    batch = int(os.environ.get("BENCH_BATCH_LONG", "1"))
+    bench_llama(
+        seq_len=seq,
+        per_chip_batch=batch,
+        metric="llama_longctx_train_throughput_per_chip",
+        check_kernel=False,  # the 2k llama line already validated it
+    )
 
 
 def main():
@@ -285,19 +313,26 @@ def main():
         bench_llama()
     elif mode == "resnet":
         bench_resnet()
+    elif mode == "llama-long":
+        bench_llama_longctx()
     elif mode == "all":
-        # default: BOTH acceptance workloads in one invocation, llama first,
-        # ResNet last — the ResNet line stays the parsed headline (series
-        # continuity with BENCH_r01–r03) while the llama MFU line lands in
-        # the same captured tail (VERDICT r3 weak #1: the driver's own run
-        # must archive the llama claim, not PERF.md's word)
-        bench_llama()
+        # default: ALL acceptance workloads in one invocation — llama 2k,
+        # llama long-context, ResNet LAST so the ResNet line stays the
+        # parsed headline (series continuity with BENCH_r01–r04) while the
+        # llama MFU and 16k-context lines land in the same captured tail
+        # (VERDICT r3 weak #1 / r4 weak #6: the driver's own run must
+        # archive these claims, not PERF.md's word)
         import gc
 
-        gc.collect()  # drop llama's device buffers before ResNet allocates
+        bench_llama()
+        gc.collect()  # drop device buffers between workloads
+        bench_llama_longctx()
+        gc.collect()
         bench_resnet()
     else:
-        raise SystemExit(f"unknown BENCH_MODEL={mode!r} (resnet|llama|all)")
+        raise SystemExit(
+            f"unknown BENCH_MODEL={mode!r} (resnet|llama|llama-long|all)"
+        )
 
 
 if __name__ == "__main__":

@@ -5,7 +5,16 @@
 {{- define "tpu-operator.storeURL" -}}
 {{- if .Values.store.url -}}
 {{ .Values.store.url }}
+{{- else if .Values.store.tlsSecret -}}
+https://tpu-store:{{ .Values.store.port }}
 {{- else -}}
 http://tpu-store:{{ .Values.store.port }}
 {{- end -}}
+{{- end -}}
+
+{{- /* truthy when in-chart store clients (operator, agent) must pin the
+       served TLS cert as their trust root; external https store.url
+       deployments bring their own CA instead. */ -}}
+{{- define "tpu-operator.clientTLS" -}}
+{{- if and .Values.store.tlsSecret (not .Values.store.url) -}}true{{- end -}}
 {{- end -}}

@@ -219,7 +219,9 @@ class StoreServer:
 
     def __init__(self, backing: Any, host: str = "127.0.0.1", port: int = 0,
                  *, log_capacity: int = 4096, token: Optional[str] = None,
-                 auth_reads: bool = False, read_token: Optional[str] = None):
+                 auth_reads: bool = False, read_token: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         self.backing = backing
         # two token tiers (≙ kube RBAC's aggregated edit-vs-view split,
         # /root/reference/manifests/base/cluster-role.yaml:96-151):
@@ -240,6 +242,10 @@ class StoreServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # per-connection socket timeout: with deferred TLS handshakes
+            # (below) a silent client occupies a handler thread until first
+            # read; this bounds it. Must exceed the 55s watch long-poll cap.
+            timeout = 65.0
 
             def log_message(self, fmt, *args):  # quiet
                 pass
@@ -347,11 +353,43 @@ class StoreServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
+        class QuietThreadingHTTPServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # port scanners / plain-HTTP probes against a TLS listener
+                # fail their deferred handshake in the handler thread; one
+                # bad connection is not worth a stderr traceback
+                import logging as _logging
+
+                _logging.getLogger("tpujob.store").debug(
+                    "connection error from %s", client_address, exc_info=True
+                )
+
         # bind first — it is the only fallible step; registering the backing
         # watch before a failed bind would leak a never-drained queue that
         # the backing store fills forever (retry-on-EADDRINUSE loops)
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = QuietThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
+        # TLS on the seam (≙ kube-apiserver serving TLS): without it the
+        # bearer tokens and all job state — including the pod commands
+        # agents will execute — cross the cluster network sniffable.
+        # Self-signed is acceptable; clients pin the cert via --tls-ca-file.
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or None)
+            # handshake DEFERRED off the accept thread: with the default
+            # do_handshake_on_connect=True the handshake runs inside
+            # accept() in the single serve_forever thread, so one silent
+            # client (half-open connection, slowloris, `nc store PORT`)
+            # would freeze the whole control plane. Deferred, it runs on
+            # first read in the per-connection handler thread, bounded by
+            # the Handler.timeout above.
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self.host, self.port = self._httpd.server_address[:2]
         self._watch_q = backing.watch(None)
         self._drain = threading.Thread(
@@ -377,7 +415,8 @@ class StoreServer:
     @property
     def url(self) -> str:
         host = f"[{self.host}]" if ":" in self.host else self.host
-        return f"http://{host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{self.port}"
 
     def _drain_loop(self) -> None:
         while not self._stop.is_set():
@@ -531,11 +570,20 @@ class HttpStoreClient:
 
     def __init__(self, url: str, *, timeout: float = 10.0,
                  watch_poll_timeout: float = 25.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None):
         self.url = url.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
+        # https:// store with a self-signed cert: pin it (or its CA) here —
+        # certificate verification stays ON; we only change the trust root.
+        # None = system trust store.
+        self._ssl_ctx = None
+        if ca_file:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
         self._lock = threading.RLock()
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
         self._poller: Optional[threading.Thread] = None
@@ -558,7 +606,9 @@ class HttpStoreClient:
             self.url + path, data=data, method=method, headers=headers,
         )
         try:
-            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl_ctx
+            ) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
             payload = {}
@@ -736,7 +786,16 @@ def main(argv=None) -> int:
                          "view-vs-edit role split)")
     ap.add_argument("--auth-reads", action="store_true",
                     help="require a token (either tier) on reads/watches too")
+    ap.add_argument("--tls-cert", default=None,
+                    help="serve over TLS with this certificate (PEM; "
+                         "self-signed acceptable — clients pin it with "
+                         "--tls-ca-file)")
+    ap.add_argument("--tls-key", default=None,
+                    help="private key for --tls-cert (PEM; omit when the "
+                         "cert file bundles the key)")
     args = ap.parse_args(argv)
+    if args.tls_key and not args.tls_cert:
+        raise SystemExit("error: --tls-key requires --tls-cert")
     from mpi_operator_tpu.opshell.__main__ import build_store
 
     backing = build_store(args.store)
@@ -760,6 +819,7 @@ def main(argv=None) -> int:
         # implies reads need a token (either tier)
         auth_reads=args.auth_reads or read_token is not None,
         read_token=read_token,
+        tls_cert=args.tls_cert, tls_key=args.tls_key,
     ).start()
     print(f"store serving on {server.url}", flush=True)
     try:

@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "read/watch requests may present it instead of the "
                          "admin token; mutations with it get 403. Implies "
                          "reads require a token.")
+    ap.add_argument("--tls-cert", default=None,
+                    help="serve --serve-store over TLS with this certificate "
+                         "(PEM; ≙ kube-apiserver's TLS on the same seam)")
+    ap.add_argument("--tls-key", default=None,
+                    help="private key for --tls-cert (PEM; omit when the "
+                         "cert file bundles the key)")
+    ap.add_argument("--tls-ca-file", default=None,
+                    help="CA bundle (or the self-signed cert itself) to "
+                         "verify a remote --store https://... against; "
+                         "default: system trust store")
     ap.add_argument("--require-nodes", choices=["auto", "always", "never"],
                     default="auto",
                     help="bind gangs only to registered node agents, never "
@@ -93,7 +103,7 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def build_store(spec: str, token: str = None):
+def build_store(spec: str, token: str = None, ca_file: str = None):
     if spec == "memory":
         return ObjectStore()
     if spec.startswith("sqlite:"):
@@ -103,7 +113,7 @@ def build_store(spec: str, token: str = None):
     if spec.startswith("http://") or spec.startswith("https://"):
         from mpi_operator_tpu.machinery.http_store import HttpStoreClient
 
-        return HttpStoreClient(spec, token=token)
+        return HttpStoreClient(spec, token=token, ca_file=ca_file)
     raise SystemExit(f"error: unknown --store {spec!r}")
 
 
@@ -130,7 +140,10 @@ def main(argv=None) -> int:
         print("error: --read-token-file requires --token-file "
               "(the admin tier anchors auth)", file=sys.stderr)
         return 2
-    store = build_store(args.store, token=token)
+    if args.tls_key and not args.tls_cert:
+        print("error: --tls-key requires --tls-cert", file=sys.stderr)
+        return 2
+    store = build_store(args.store, token=token, ca_file=args.tls_ca_file)
     store_server = None
     if args.serve_store:
         from mpi_operator_tpu.machinery.http_store import (
@@ -153,6 +166,7 @@ def main(argv=None) -> int:
             # a read tier with open reads would be meaningless (see the
             # standalone tpu-store entry point, which does the same)
             auth_reads=read_token is not None,
+            tls_cert=args.tls_cert, tls_key=args.tls_key,
         ).start()
         logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)

@@ -611,6 +611,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "store an operator is running on)")
     ap.add_argument("--token-file", default=None,
                     help="bearer token file for an authenticated http store")
+    ap.add_argument("--tls-ca-file", default=None,
+                    help="CA bundle (or the self-signed cert itself) to "
+                         "verify a --store https://... against")
     ap.add_argument("-n", "--namespace", default="default")
     sub = ap.add_subparsers(dest="verb", required=True)
     p = sub.add_parser("create", help="submit a TPUJob manifest")
@@ -675,7 +678,7 @@ def main(argv=None) -> int:
         print(f"error: --token-file: {e}", file=sys.stderr)
         return 2
     args.log_token = token  # `ctl logs` presents it to guarded agents too
-    store = build_store(args.store, token=token)
+    store = build_store(args.store, token=token, ca_file=args.tls_ca_file)
     client = TPUJobClient(store, namespace=args.namespace)
     try:
         return {

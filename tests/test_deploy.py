@@ -1,0 +1,153 @@
+"""Deploy artifacts stay consistent without a cluster (VERDICT r4 weak #5:
+the helm chart was validated by nothing).
+
+Two layers of defense:
+- here (fast tier, no helm binary needed): every ``.Values.x.y`` reference
+  in the chart templates must resolve to a key defined in values.yaml (the
+  class of bug where a gate reads a value nobody can set), the kustomize
+  overlay manifests must parse as YAML and name the same workload objects
+  the chart renders, and chart/overlay flag surfaces must only use flags
+  the CLIs actually define;
+- in CI's lint job (helm binary available): ``helm lint`` + ``helm
+  template`` under several values profiles, parsed and diffed against the
+  golden object list in ``deploy/helm/golden-objects.txt``.
+"""
+
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deploy", "helm", "tpu-operator")
+OVERLAYS = os.path.join(REPO, "deploy", "overlays")
+
+
+def _chart_sources():
+    out = {}
+    tdir = os.path.join(CHART, "templates")
+    for name in sorted(os.listdir(tdir)):
+        with open(os.path.join(tdir, name)) as f:
+            out[name] = f.read()
+    return out
+
+
+def _values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def test_every_template_values_reference_is_defined():
+    """A template gating a flag on an undefined value renders the flag
+    never — silently (the token.readEnabled bug class). Every .Values path
+    used by any template must exist in values.yaml."""
+    values = _values()
+    missing = []
+    for name, src in _chart_sources().items():
+        for ref in re.findall(r"\.Values\.([A-Za-z0-9_.]+)", src):
+            node = values
+            for part in ref.split("."):
+                if not isinstance(node, dict) or part not in node:
+                    missing.append(f"{name}: .Values.{ref}")
+                    break
+                node = node[part]
+    assert not missing, "undefined values referenced:\n" + "\n".join(missing)
+
+
+def test_chart_golden_object_list():
+    """The (kind, name) pairs the chart's templates declare, extracted
+    statically, must match the checked-in golden list — a chart regression
+    (dropped Service, renamed Secret) fails here AND in CI's rendered-chart
+    check. Regenerate deliberately when the chart grows."""
+    pairs = set()
+    for name, src in _chart_sources().items():
+        if name.startswith("_"):
+            continue
+        for doc in src.split("\n---"):
+            kind = re.search(r"^kind:\s*(\S+)", doc, re.M)
+            nm = re.search(r"^\s*name:\s*([A-Za-z0-9.{}\s$._-]+)$", doc, re.M)
+            if kind and nm:
+                n = nm.group(1).strip()
+                if "{{" in n:  # templated names resolve in CI's helm pass
+                    n = "<templated>"
+                pairs.add(f"{kind.group(1)}/{n}")
+    golden_path = os.path.join(CHART, "..", "golden-objects.txt")
+    with open(golden_path) as f:
+        golden = {ln.strip() for ln in f if ln.strip() and not ln.startswith("#")}
+    assert pairs == golden, (
+        "chart object list drifted; update deploy/helm/golden-objects.txt "
+        f"deliberately.\nmissing: {sorted(golden - pairs)}\n"
+        f"new: {sorted(pairs - golden)}"
+    )
+
+
+def test_overlay_manifests_parse_and_cover_chart_workloads():
+    """The kustomize cluster overlay and the chart describe the same
+    three-tier shape: every workload object the chart ships must appear in
+    the base+overlay manifests too (deploy/README.md promises they are two
+    routes to one deployment)."""
+    docs = []
+    for root, _, files in os.walk(os.path.join(REPO, "deploy")):
+        if "helm" in root:
+            continue
+        for f in files:
+            if f.endswith(".yaml") and "kustomization" not in f:
+                with open(os.path.join(root, f)) as fh:
+                    docs.extend(d for d in yaml.safe_load_all(fh) if d)
+    have = {
+        f"{d.get('kind')}/{d.get('metadata', {}).get('name')}"
+        for d in docs
+        if isinstance(d, dict)
+    }
+    for required in (
+        "Deployment/tpu-store",
+        "Service/tpu-store",
+        "DaemonSet/tpu-node-agent",
+        "Secret/tpu-store-token",
+        "NetworkPolicy/tpu-store-ingress",
+        "NetworkPolicy/tpu-node-agent-ingress",
+    ):
+        assert required in have, f"{required} missing from kustomize manifests"
+
+
+def test_manifests_use_only_flags_the_clis_define():
+    """Every --flag in the chart templates and overlay manifests must be a
+    flag the corresponding CLI parser actually defines — a renamed flag
+    would otherwise crash-loop the deployment at rollout."""
+    from mpi_operator_tpu.executor.agent import build_parser as agent_parser
+    from mpi_operator_tpu.opshell.__main__ import build_parser as op_parser
+
+    def known(parser):
+        flags = set()
+        for a in parser._actions:
+            flags.update(o for o in a.option_strings if o.startswith("--"))
+        return flags
+
+    # the store CLI builds its parser inside main(): extract its flags
+    # from the module source instead of instantiating it
+    from mpi_operator_tpu.machinery import http_store
+
+    src = open(http_store.__file__).read()
+    store_flags = set(re.findall(r'add_argument\("(--[a-z-]+)"', src))
+
+    by_cli = {
+        "mpi_operator_tpu.opshell]": known(op_parser()),
+        "mpi_operator_tpu.executor.agent]": known(agent_parser()),
+        "mpi_operator_tpu.machinery.http_store]": store_flags,
+    }
+    sources = []
+    for root, _, files in os.walk(os.path.join(REPO, "deploy")):
+        for f in files:
+            if f.endswith((".yaml", ".tpl")):
+                sources.append(os.path.join(root, f))
+    bad = []
+    for path in sources:
+        text = open(path).read()
+        for cli, flags in by_cli.items():
+            for m in re.finditer(re.escape(cli) + r"(.*?)(?:ports:|env:|volumeMounts:|readinessProbe:)",
+                                 text, re.S):
+                for flag in re.findall(r"(--[a-z-]+)=?", m.group(1)):
+                    if flag not in flags:
+                        bad.append(f"{os.path.relpath(path, REPO)}: {flag} "
+                                   f"not defined by {cli[:-1]}")
+    assert not bad, "\n".join(bad)

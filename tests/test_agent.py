@@ -406,7 +406,7 @@ def _proc_logs(tmp_path, tags):
 
 
 def _start_cluster(tmp_path, *, node_grace=None, heartbeat=0.5,
-                   ckpt_dir=None):
+                   ckpt_dir=None, preemption_grace=None, agent_chips=None):
     """store-serving operator (no local executor) + two agent processes.
     ``ckpt_dir`` emulates the shared checkpoint volume of a real cluster:
     both agents advertise the same path via --ckpt-dir (≙ one PVC mounted
@@ -425,6 +425,8 @@ def _start_cluster(tmp_path, *, node_grace=None, heartbeat=0.5,
     ]
     if node_grace is not None:
         op_flags += ["--node-grace", str(node_grace)]
+    if preemption_grace is not None:
+        op_flags += ["--preemption-grace", str(preemption_grace)]
     procs.append(_spawn(tmp_path, "operator", op_flags))
     _wait_http(f"http://127.0.0.1:{port}/healthz")
     for x in ("a", "b"):
@@ -439,6 +441,8 @@ def _start_cluster(tmp_path, *, node_grace=None, heartbeat=0.5,
         ]
         if ckpt_dir is not None:
             agent_flags += ["--ckpt-dir", str(ckpt_dir)]
+        if agent_chips is not None:
+            agent_flags += ["--chips", str(agent_chips)]
         procs.append(_spawn(tmp_path, f"agent-{x}", agent_flags))
     return port, procs
 
@@ -928,26 +932,49 @@ def test_preemption_in_node_mode():
         ["node-a", "node-a"]
 
 
-def _job_manifest(name, *, replicas, env, restart=None, backoff=None):
+def _job_manifest(name, *, replicas, env, restart=None, backoff=None,
+                  command=None, priority=None):
     spec = {
         "slice": {"accelerator": "cpu", "chips_per_host": 1},
         "worker": {
             "replicas": replicas,
             "template": {"containers": [{
-                "name": "llama", "image": "local",
-                "command": ["python", "examples/llama_worker.py"],
+                "name": "w", "image": "local",
+                "command": command or ["python", "examples/llama_worker.py"],
                 "env": [{"name": k, "value": v} for k, v in env.items()],
             }]},
         },
     }
     if restart:
         spec["worker"]["restart_policy"] = restart
+    run_policy = {}
     if backoff is not None:
-        spec["run_policy"] = {"backoff_limit": backoff}
+        run_policy["backoff_limit"] = backoff
+    if priority is not None:
+        run_policy["scheduling_policy"] = {"priority_class": priority}
+    if run_policy:
+        spec["run_policy"] = run_policy
     return {
         "apiVersion": "tpujob.dev/v1", "kind": "TPUJob",
         "metadata": {"name": name}, "spec": spec,
     }
+
+
+def _wait_pods_running(store, job, n, deadline_s, tmp_path, tags):
+    """Until exactly ``n`` pods of ``job`` are RUNNING; returns them."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        pods = [p for p in store.list("Pod")
+                if p.metadata.labels.get(LABEL_JOB_NAME) == job
+                and p.status.phase == PodPhase.RUNNING]
+        if len(pods) == n:
+            return pods
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"{job}: {n} RUNNING pods never appeared "
+        f"(have {[(p.metadata.name, p.status.phase) for p in store.list('Pod') if p.metadata.labels.get(LABEL_JOB_NAME) == job]})\n"
+        + _proc_logs(tmp_path, tags)
+    )
 
 
 def _wait_job(store, name, deadline_s, tmp_path, tags):
@@ -1075,6 +1102,54 @@ def test_elastic_rescale_with_checkpoint_across_agents(tmp_path):
         # progress actually carried across the restart
         saved = sorted(int(p.name) for p in job_ckpt.iterdir() if p.is_dir())
         assert saved and saved[0] < 120, saved
+        store.close()
+    finally:
+        _reap(procs)
+
+
+@pytest.mark.slow  # full stack / subprocess e2e
+def test_preemption_across_agents_end_to_end(tmp_path):
+    """Preemption composed with the node-agent plane: a low-priority
+    sleeper gang fills both agents' capacity; a critical job arrives,
+    waits out --preemption-grace, the scheduler evicts the sleeper off
+    BOTH agents (whole-gang), the critical job runs spread across them,
+    and the sleeper gang restarts afterwards — the Volcano reclaim
+    semantics (mpi_job_controller.go:1215-1237) on real node boundaries."""
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+
+    tags = ["operator", "agent-a", "agent-b"]
+    port, procs = _start_cluster(tmp_path, preemption_grace=2, agent_chips=1)
+    try:
+        store = HttpStoreClient(f"http://127.0.0.1:{port}")
+        _wait_nodes_registered(store, ["agent-a", "agent-b"])
+        client = TPUJobClient(store)
+        client.create(_job_manifest(
+            "sleeper", replicas=2, env={}, priority="low",
+            command=["python", "-c", "import time; time.sleep(300)"],
+        ))
+        pods = _wait_pods_running(store, "sleeper", 2, 90, tmp_path, tags)
+        assert {p.spec.node_name for p in pods} == {"agent-a", "agent-b"}
+
+        client.create(_job_manifest(
+            "crit-pi", replicas=2, env={}, priority="critical",
+            command=["python", "examples/pi_worker.py", "50000"],
+        ))
+        _wait_job(store, "crit-pi", 240, tmp_path, tags)
+        pods = [p for p in store.list("Pod")
+                if p.metadata.labels.get(LABEL_JOB_NAME) == "crit-pi"]
+        # the critical gang ran spread across BOTH agents (the capacity the
+        # sleeper was evicted from), its SPMD gang seeing 2 hosts
+        assert {p.spec.node_name for p in pods} == {"agent-a", "agent-b"}
+        w0 = [p for p in pods if p.metadata.name.endswith("worker-0")]
+        assert w0 and w0[0].status.log_path.startswith("http://"), (
+            [(p.metadata.name, p.status.log_path) for p in pods])
+        with urllib.request.urlopen(w0[0].status.log_path, timeout=10) as r:
+            assert "(2 hosts)" in r.read().decode()
+        evs = [e for e in store.list("Event") if e.reason == "Preempted"]
+        assert evs, "no Preempted event recorded"
+        # and the victim restarts once the capacity frees again
+        _wait_pods_running(store, "sleeper", 2, 120, tmp_path, tags)
         store.close()
     finally:
         _reap(procs)

@@ -675,8 +675,19 @@ class TPUJobController:
                     self._note_drain_wait(job, failed)
                 return
             if retryable:
+                # Preemption is the scheduler's doing, not the workload
+                # failing: a preempted generation restarts for free — it
+                # neither burns backoffLimit nor counts as a restart (kube
+                # preemption never charges a Job's restart policy either).
+                # A busy cluster preempting a low-priority job 3 times must
+                # not permanently FAIL it with backoffLimit=2.
+                preempted = any(p.is_preempted() for p in failed)
                 backoff = job.spec.run_policy.backoff_limit
-                if backoff is not None and job.status.restart_count >= backoff:
+                if (
+                    not preempted
+                    and backoff is not None
+                    and job.status.restart_count >= backoff
+                ):
                     self._fail_job(
                         job,
                         workers,
@@ -685,8 +696,17 @@ class TPUJobController:
                         f"backoffLimit={backoff}",
                     )
                     return
-                job.status.restart_count += 1
-                metrics.jobs_restarted.inc()
+                if not preempted:
+                    job.status.restart_count += 1
+                    metrics.jobs_restarted.inc()
+                # a restart executed: the next generation gets its own
+                # drain-wait note even when the restart was free (the
+                # (uid, restart_count) key would otherwise collide across
+                # preempted generations and suppress the once-per-generation
+                # hang explanation)
+                self._drain_noted.discard(
+                    (job.metadata.uid, job.status.restart_count)
+                )
                 # delete every terminal pod — a succeeded non-coordinator
                 # must re-run too, or the relaunched gang waits on a member
                 # that never comes back; next reconcile recreates the gang

@@ -178,9 +178,9 @@ class NodeAgent:
         log_tokens: Optional[Sequence[str]] = None,
         ckpt_dir: Optional[str] = None,
     ):
-        from mpi_operator_tpu.scheduler.gang import NODE_NAME as _LOCAL_SENTINEL
+        from mpi_operator_tpu.machinery.objects import LOCAL_NODE
 
-        if node_name == _LOCAL_SENTINEL:
+        if node_name == LOCAL_NODE:
             # 'local' is the scheduler's single-process sentinel binding;
             # an agent claiming it would collide with the require_nodes
             # healer (which unbinds PENDING 'local' pods every pass) and

@@ -71,8 +71,22 @@ class Pod(_Dictable):
 
     def is_evicted(self) -> bool:
         """≙ isEvicted check on launcher pods (status.go:99-106 + controller
-        :935-950): Failed with reason Evicted."""
-        return self.status.phase == PodPhase.FAILED and self.status.reason == "Evicted"
+        :935-950): Failed with an eviction-flavored reason. Covers both
+        infrastructure eviction (node loss, drain) and priority preemption —
+        both are always-retryable."""
+        return self.status.phase == PodPhase.FAILED and self.status.reason in (
+            "Evicted", "Preempted",
+        )
+
+    def is_preempted(self) -> bool:
+        """Preemption specifically: retryable like any eviction, but it must
+        NOT burn the job's backoffLimit — being preempted is the scheduler's
+        doing, not the workload failing (kube preemption never counts
+        against a Job's restart policy either)."""
+        return (
+            self.status.phase == PodPhase.FAILED
+            and self.status.reason == "Preempted"
+        )
 
 
 @dataclass
@@ -117,6 +131,12 @@ class PodGroup(_Dictable):
 # Nodes are cluster-scoped in kubernetes; this store is namespaced, so they
 # live under one well-known pseudo-namespace
 NODE_NAMESPACE = "nodes"
+
+# The single-process binding sentinel: the scheduler binds to it when no
+# Node objects exist (dev/standalone shape), the LocalExecutor claims it,
+# and agents must REJECT it as an identity. A cross-plane contract, so it
+# lives here rather than inside the scheduler package.
+LOCAL_NODE = "local"
 
 
 @dataclass
@@ -176,7 +196,8 @@ class Event(_Dictable):
     timestamp: float = 0.0
 
 
-def evict_pod(store, pod: "Pod", message: str) -> bool:
+def evict_pod(store, pod: "Pod", message: str, *,
+              reason: str = "Evicted") -> bool:
     """Mark a pod Evicted — THE eviction primitive (reason=Evicted is what
     controller._pod_retryable treats as always-retryable, driving the
     gang-coherent restart). Shared by the node monitor (lost nodes),
@@ -198,7 +219,7 @@ def evict_pod(store, pod: "Pod", message: str) -> bool:
             return False
         cur.status.phase = PodPhase.FAILED
         cur.status.ready = False
-        cur.status.reason = "Evicted"
+        cur.status.reason = reason  # "Evicted" | "Preempted" (is_evicted)
         cur.status.message = message
         return True
 

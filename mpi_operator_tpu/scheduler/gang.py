@@ -45,6 +45,7 @@ from mpi_operator_tpu.controller.placement import (
 )
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
+    LOCAL_NODE,
     NODE_NAMESPACE,
     Pod,
     PodPhase,
@@ -71,7 +72,7 @@ EVENT_SCHEDULED = "Scheduled"
 EVENT_PREEMPTED = "Preempted"
 EVENT_PREEMPTING = "Preempting"
 
-NODE_NAME = "local"  # single-host emulation: binding == admission
+NODE_NAME = LOCAL_NODE  # single-host emulation: binding == admission
 
 # Built-in priority classes (≙ the PriorityClass objects a k8s cluster would
 # define; the reference stamps the name onto a Volcano PodGroup and relies on
@@ -284,19 +285,30 @@ class GangScheduler:
             all_nodes = self.store.list("Node", NODE_NAMESPACE)
             if self.require_nodes:
                 # heal any 'local'-sentinel bindings (pre-upgrade state or a
-                # misconfigured operator): PENDING pods bound to 'local' can
-                # never be claimed by an agent — unbind so they re-place onto
-                # real nodes below. RUNNING ones have a live process behind
-                # a local executor; leave them to finish. This runs BEFORE
-                # any accounting: a healed pod must not be double-counted
+                # misconfigured operator). In a node-mode deployment no
+                # local executor exists by construction (opshell rejects the
+                # combination), so NOTHING can run a 'local'-bound pod:
+                # PENDING ones are unbound to re-place onto real nodes;
+                # RUNNING ones are orphans from a pre-upgrade single-host
+                # operator — the store says Running but no process backs it;
+                # left alone they would hold chip budget forever. Evict them
+                # (retryable → gang-coherent restart onto real nodes). Runs
+                # BEFORE any accounting so healed pods are not counted
                 # against this very pass's chip budget.
                 for p in pods:
-                    if (
-                        p.spec.node_name == NODE_NAME
-                        and p.status.phase == PodPhase.PENDING
-                        and self._unbind(p)
+                    if p.spec.node_name != NODE_NAME or p.is_finished():
+                        continue
+                    if p.status.phase == PodPhase.PENDING:
+                        if self._unbind(p):
+                            p.spec.node_name = ""  # pass sees it unbound
+                    elif evict_pod(
+                        self.store, p,
+                        "bound to the 'local' sentinel in a node-mode "
+                        "deployment; no executor can run it",
                     ):
-                        p.spec.node_name = ""  # this pass sees it unbound
+                        # pass sees it finished (not holding capacity)
+                        p.status.phase = PodPhase.FAILED
+                        p.status.reason = "Evicted"
             if all_nodes or self.require_nodes:
                 nodes = self._live_nodes(all_nodes)
                 node_used = self._node_used(pods)
@@ -429,8 +441,16 @@ class GangScheduler:
                 f"gang admitted: {n} pods, {sum(pod_cost(p) for p in unbound)} chips",
             )
         if blocked is not None:
+            # pods/all_groups are THIS pass's snapshots (no extra store
+            # round-trips), and deliberately stale with respect to bindings
+            # made during the pass: a gang admitted seconds ago in this very
+            # pass still looks unbound in the snapshot and therefore can
+            # never be selected as a victim — an aged low-priority gang that
+            # admitted ahead of the blocked head is not admit-then-evicted
+            # in the same breath
             self._maybe_preempt(
-                blocked[0], blocked[1], free, nodes, node_used, occ
+                blocked[0], blocked[1], free, nodes, node_used, occ,
+                pods, all_groups,
             )
         # gangs bound this pass keep their pending_since entry until the
         # next pass observes them bound — one extra periodic sync, then the
@@ -447,6 +467,8 @@ class GangScheduler:
         nodes: Optional[List],
         node_used: Dict[str, int],
         occ: Optional[Dict[str, set]],
+        pods: List[Pod],
+        all_groups: List,
     ) -> None:
         """Evict the minimal set of strictly-lower-priority running gangs
         that lets the capacity-blocked queue head fit. Opt-in
@@ -467,14 +489,15 @@ class GangScheduler:
         if pri is None:
             pri = 0
         # admitted gangs of strictly lower priority, with their live bound
-        # pods (what actually holds capacity)
+        # pods (what actually holds capacity) — from the caller's pass
+        # snapshots (see the call site for why staleness is a feature)
         by_gang: Dict[Tuple[str, str], List[Pod]] = defaultdict(list)
-        for p in self.store.list("Pod"):
+        for p in pods:
             job = p.metadata.labels.get(LABEL_JOB_NAME, "")
             if job and p.spec.node_name and not p.is_finished():
                 by_gang[(p.metadata.namespace, job)].append(p)
         pool = []
-        for v in self.store.list("PodGroup"):
+        for v in all_groups:
             if self._pg_key(v) == key:
                 continue
             vpri = resolve_priority_class(v.spec.priority_class)
@@ -502,6 +525,22 @@ class GangScheduler:
                 break
         else:
             return  # still would not fit: evict nothing
+        # prune-back to a MINIMAL victim set: greedy accumulation can pick
+        # up collateral whose eviction contributes nothing (a tiny lowest-
+        # priority gang on a node that could never host the preemptor
+        # anyway) — drop any member whose removal still leaves a fit, so no
+        # gang suffers a useless restart
+        for item in list(chosen):
+            if len(chosen) == 1:
+                break
+            if not any(v is item for v in chosen):
+                continue  # already pruned: trial would equal chosen
+            trial = [v for v in chosen if v is not item]
+            if self._fits_after_eviction(
+                unbound, [held for _, _, held in trial],
+                free, nodes, node_used, occ,
+            ):
+                chosen = trial
         names = ", ".join(self._pg_key(v) for _, v, _ in chosen)
         log.warning(
             "preempting %s for %s (priority %d, pending %.0fs)",
@@ -513,6 +552,8 @@ class GangScheduler:
                 if evict_pod(
                     self.store, p,
                     f"preempted by {key} (priority {pri} > {vpri})",
+                    reason="Preempted",  # retryable, but does NOT burn
+                    # the victim's backoffLimit (controller exempts it)
                 ):
                     n += 1
             # reset the victim's pending clock: if it was starvation-AGED,

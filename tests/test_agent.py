@@ -926,7 +926,7 @@ def test_preemption_in_node_mode():
         make_pod(store, "crit", i)
     sched.sync()
     sched.sync()
-    assert all(p.status.reason == "Evicted" for p in job_pods(store, "lowjob"))
+    assert all(p.status.reason == "Preempted" for p in job_pods(store, "lowjob"))
     sched.sync()
     assert [p.spec.node_name for p in bound_pods(store, "crit")] == \
         ["node-a", "node-a"]
@@ -1153,3 +1153,59 @@ def test_preemption_across_agents_end_to_end(tmp_path):
         store.close()
     finally:
         _reap(procs)
+
+
+def test_require_nodes_evicts_running_local_orphans():
+    """Upgrade scenario: a pre-upgrade single-host operator left pods
+    RUNNING bound to 'local', then the deployment moved to node mode
+    (--executor none + agents). No local executor exists there by
+    construction, so the store's RUNNING is a lie — left alone the orphans
+    would hold chip budget forever and block future gangs. The healer
+    evicts them (retryable), freeing the capacity for re-placement."""
+    store = ObjectStore()
+    sched = GangScheduler(store, require_nodes=True)
+    make_gang(store, "orphan", min_member=1)
+    p = make_pod(store, "orphan", 0)
+    p.spec.node_name = "local"
+    p.status.phase = PodPhase.RUNNING
+    store.update(p, force=True)
+    make_node(store, "node-a", chips=1)
+    # a fresh gang contends for the capacity the orphan is squatting on
+    make_gang(store, "fresh", min_member=1)
+    make_pod(store, "fresh", 0)
+    sched.sync()
+    cur = store.get("Pod", "default", "orphan-worker-0")
+    assert cur.is_evicted(), (cur.status.phase, cur.status.reason)
+    # the orphan no longer holds budget: the fresh gang places this pass
+    assert [q.spec.node_name for q in bound_pods(store, "fresh")] == ["node-a"]
+
+
+def test_preemption_prunes_useless_collateral_victims():
+    """Minimal victim set, for real: the greedy accumulation walks victims
+    lowest-priority-first, so it can pick up a tiny low gang whose node
+    could never host the preemptor before reaching the one whose eviction
+    actually makes room. The prune-back pass drops the useless collateral —
+    no gang suffers a restart that buys nothing."""
+    from test_scheduler import job_pods, make_priority_gang
+
+    store = ObjectStore()
+    sched = GangScheduler(store, preemption_grace=0.0)
+    make_node(store, "node-1", chips=4)
+    make_node(store, "node-2", chips=8)
+    make_priority_gang(store, "tiny-low", 1, "low")        # priority -100
+    make_pod(store, "tiny-low", 0, chips=2)                # lands on node-1
+    make_priority_gang(store, "big-mid", 1, "-50")         # integer class
+    make_pod(store, "big-mid", 0, chips=8)                 # fills node-2
+    sched.sync()
+    assert [p.spec.node_name for p in bound_pods(store, "tiny-low")] == ["node-1"]
+    assert [p.spec.node_name for p in bound_pods(store, "big-mid")] == ["node-2"]
+    make_priority_gang(store, "crit", 1, "critical")
+    make_pod(store, "crit", 0, chips=8)  # only node-2 could ever host it
+    sched.sync()
+    sched.sync()
+    # ONLY big-mid evicted: evicting tiny-low would contribute nothing
+    # (node-1's capacity can never host the 8-chip preemptor)
+    assert all(p.status.reason == "Preempted" for p in job_pods(store, "big-mid"))
+    assert all(not p.is_finished() for p in job_pods(store, "tiny-low"))
+    sched.sync()
+    assert [p.spec.node_name for p in bound_pods(store, "crit")] == ["node-2"]

@@ -441,3 +441,30 @@ def test_per_job_coordinator_ports(f):
     assert f.job(a).status.coordinator_port == pa  # stable
     pod = f.pods(a)[0]
     assert pod.spec.container.env["TPUJOB_COORDINATOR_ADDRESS"].endswith(f":{pa}")
+
+
+def test_preemption_does_not_burn_backoff_limit(f):
+    """Preemption is the scheduler's doing, not the workload failing: a
+    preempted generation restarts without incrementing restart_count or
+    tripping backoffLimit — otherwise a busy cluster preempting a
+    low-priority job backoff_limit+1 times would permanently FAIL it,
+    contradicting 'will restart when capacity frees'."""
+    job = make_job(name="pre", replicas=1)
+    job.spec.run_policy.backoff_limit = 1
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    # preempted twice in a row: would exceed backoffLimit=1 if counted
+    for _ in range(2):
+        f.set_pod_phase(job, 0, PodPhase.FAILED, reason="Preempted")
+        f.sync(job)
+        st = f.job(job).status
+        assert not conditions.is_failed(st), st.conditions
+        assert st.restart_count == 0  # free restart
+        f.sync(job)  # recreate the gang
+        pods = f.pods(job)
+        assert all(p.status.phase == PodPhase.PENDING for p in pods)
+        f.run_to_phase(job)
+    # a GENUINE eviction still counts (the existing backoff semantics)
+    f.set_pod_phase(job, 0, PodPhase.FAILED, reason="Evicted")
+    f.sync(job)
+    assert f.job(job).status.restart_count == 1

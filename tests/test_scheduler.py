@@ -512,7 +512,7 @@ def test_critical_gang_preempts_running_low_gang():
     _time.sleep(0.1)
     sched.sync()  # grace elapsed: the low gang is evicted, whole-gang
     lows = job_pods(store, "lowjob")
-    assert all(p.status.reason == "Evicted" for p in lows)
+    assert all(p.status.reason == "Preempted" for p in lows)
     assert "preempted by default/crit-gang" in lows[0].status.message
     assert bound_pods(store, "crit") == []  # binding is NEXT pass
     sched.sync()
@@ -586,7 +586,7 @@ def test_preemption_evicts_minimal_victim_set():
     sched.sync()
     sched.sync()
     # youngest victim evicted, oldest untouched
-    assert all(p.status.reason == "Evicted" for p in job_pods(store, "low-new"))
+    assert all(p.status.reason == "Preempted" for p in job_pods(store, "low-new"))
     assert all(not p.is_finished() for p in job_pods(store, "low-old"))
     sched.sync()
     assert len(bound_pods(store, "crit")) == 2
@@ -637,7 +637,7 @@ def test_preemption_in_topology_mode():
     store.update(pg, force=True)
     _time.sleep(0.1)
     sched.sync()  # grace elapsed: low gang evicted off the slice
-    assert all(p.status.reason == "Evicted" for p in job_pods(store, "lowjob"))
+    assert all(p.status.reason == "Preempted" for p in job_pods(store, "lowjob"))
     sched.sync()
     assert len(bound_pods(store, "crit")) == 4
 

@@ -680,8 +680,17 @@ class TPUJobController:
                 # neither burns backoffLimit nor counts as a restart (kube
                 # preemption never charges a Job's restart policy either).
                 # A busy cluster preempting a low-priority job 3 times must
-                # not permanently FAIL it with backoffLimit=2.
-                preempted = any(p.is_preempted() for p in failed)
+                # not permanently FAIL it with backoffLimit=2. The free pass
+                # requires every RETRYABLE failure in the generation to be a
+                # preemption — non-retryable companions (rc=1 collective
+                # errors) are collateral of the eviction, but a pod that
+                # failed retryably on its own (exit 137, EXIT_RESTART)
+                # means the workload was crashing anyway and the generation
+                # must still count toward backoffLimit.
+                preempted = any(p.is_preempted() for p in failed) and all(
+                    p.is_preempted() or not self._pod_retryable(job, p)
+                    for p in failed
+                )
                 backoff = job.spec.run_policy.backoff_limit
                 if (
                     not preempted

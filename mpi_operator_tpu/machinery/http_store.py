@@ -95,6 +95,48 @@ def read_token_file(path: Optional[str]) -> Optional[str]:
     return tok
 
 
+def read_agent_tokens_file(path: Optional[str]) -> Optional[Dict[str, str]]:
+    """Per-agent scoped credentials (beyond the two shared tiers — the
+    'agent-scoped would be better' half of the kube RBAC parity): a file of
+    ``node-name:token`` lines. The holder of an agent token can read the
+    cluster, register/heartbeat ITS OWN Node, and update pods bound to its
+    node — nothing else. A compromised node can no longer delete other
+    tenants' jobs or rebind work to itself. Fails closed on an empty or
+    malformed file, and on duplicate tokens (ambiguous identity)."""
+    if not path:
+        return None
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, sep, tok = line.rpartition(":")
+            if not sep or not name or not tok:
+                raise ValueError(
+                    f"{path}:{i}: expected 'node-name:token', got {line!r}"
+                )
+            if tok in out:
+                raise ValueError(
+                    f"{path}:{i}: token reused for {out[tok]!r} and "
+                    f"{name!r} (identity must be unambiguous)"
+                )
+            out[tok] = name
+    if not out:
+        raise ValueError(
+            f"agent tokens file {path!r} defines no tokens; refusing to run "
+            f"(omit the flag to disable the agent tier)"
+        )
+    return out
+
+
+def _route_parts(path: str) -> List[str]:
+    """Decoded path segments of a request path (shared by routing and the
+    agent-scope authorization so the two can never parse differently)."""
+    parsed = urllib.parse.urlparse(path)
+    return [urllib.parse.unquote(p) for p in parsed.path.split("/") if p]
+
+
 def check_bearer(header: str, tokens) -> Optional[str]:
     """THE bearer-token check (constant-time compare), shared by the store
     server and the agent's log endpoint so the two security checks can
@@ -221,16 +263,31 @@ class StoreServer:
                  *, log_capacity: int = 4096, token: Optional[str] = None,
                  auth_reads: bool = False, read_token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None):
+                 tls_key: Optional[str] = None,
+                 agent_tokens: Optional[Dict[str, str]] = None):
         self.backing = backing
-        # two token tiers (≙ kube RBAC's aggregated edit-vs-view split,
-        # /root/reference/manifests/base/cluster-role.yaml:96-151):
+        # three token tiers (≙ kube RBAC: the aggregated edit-vs-view split
+        # of /root/reference/manifests/base/cluster-role.yaml:96-151, plus
+        # the node-scoped kubelet credential model):
         # `token` is the ADMIN tier — every route; `read_token` is the
         # READ-ONLY tier — GET routes only (watch included), mutations get
-        # 403 Forbidden (authenticated but not authorized). Reads require a
-        # token only with auth_reads (watches carry full object payloads).
+        # 403 Forbidden; `agent_tokens` (token → node name) is the NODE
+        # tier — reads, its own Node, and pods bound to its node only (see
+        # _agent_denied). Reads require a token only with auth_reads
+        # (watches carry full object payloads).
         self.token = token
         self.read_token = read_token
+        self.agent_tokens = agent_tokens or {}
+        for tok, node in self.agent_tokens.items():
+            # cross-tier reuse must fail closed at startup: check_bearer
+            # matches the admin tier first, so an agent-tokens entry that
+            # reuses the admin token would silently grant that node full
+            # admin — the opposite of the scoped posture
+            if tok in (token, read_token):
+                raise ValueError(
+                    f"agent token for node {node!r} duplicates the "
+                    f"admin/read token; every tier needs a distinct secret"
+                )
         self.auth_reads = auth_reads
         # the seq space is per-incarnation; clients echo this id so a
         # restarted server (fresh seqs) can't be confused with the old one
@@ -271,56 +328,66 @@ class StoreServer:
                     raise _BodyTooLarge(raw)
                 return json.loads(self.rfile.read(n)) if n else {}
 
-            def _auth_error(self, method: str) -> Optional[int]:
-                """None when allowed; else 401 (bad/absent token) or 403
-                (valid READ token on a mutating route)."""
-                if server.token is None:
+            def _auth_error(
+                self, method: str, body: Dict[str, Any]
+            ) -> Optional[Tuple[int, str]]:
+                """None when allowed; else (401, msg) for a bad/absent
+                token or (403, msg) for a valid token outside its scope."""
+                if server.token is None and not server.agent_tokens:
                     return None
                 if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
                     # liveness probes carry no headers; /healthz leaks
                     # nothing, so it stays open even under --auth-reads
                     return None
+                candidates = (server.token, server.read_token,
+                              *server.agent_tokens)
                 matched = check_bearer(
-                    self.headers.get("Authorization", ""),
-                    (server.token, server.read_token),
+                    self.headers.get("Authorization", ""), candidates
                 )
                 # identity, not equality: check_bearer returns the exact
                 # object from the tuple, so tiering is not a string compare
                 is_admin = matched is server.token and matched is not None
                 is_read = matched is server.read_token and matched is not None
+                agent_node = (
+                    server.agent_tokens.get(matched)
+                    if matched is not None and not (is_admin or is_read)
+                    else None
+                )
                 if method == "GET":
                     if not server.auth_reads:
                         return None
-                    return None if (is_admin or is_read) else 401
+                    if is_admin or is_read or agent_node is not None:
+                        return None
+                    return (401, "missing or invalid bearer token "
+                                 "(server runs with --token-file)")
                 if is_admin:
                     return None
-                return 403 if is_read else 401
+                if is_read:
+                    return (403, "the read-only token cannot mutate "
+                                 "(server runs with --read-token-file)")
+                if agent_node is not None:
+                    msg = server._agent_denied(
+                        method, self.path, body, agent_node
+                    )
+                    return None if msg is None else (403, msg)
+                return (401, "missing or invalid bearer token "
+                             "(server runs with --token-file)")
 
             def _dispatch(self, method: str) -> None:
                 try:
-                    denied = self._auth_error(method)
+                    # body BEFORE auth: the agent scope check inspects it,
+                    # and an unread body would desync keep-alive framing
+                    body = self._body() if method in ("POST", "PUT") else {}
+                    denied = self._auth_error(method, body)
                     if denied is not None:
-                        # drain the body first: an unread body would desync
-                        # keep-alive framing (same concern as _BodyTooLarge)
-                        if method in ("POST", "PUT"):
-                            self._body()
-                        if denied == 403:
-                            self._send(403, {
-                                "error": "Forbidden",
-                                "message": "the read-only token cannot "
-                                           "mutate (server runs with "
-                                           "--read-token-file)",
-                            })
-                            return
-                        self._send(401, {
-                            "error": "Unauthorized",
-                            "message": "missing or invalid bearer token "
-                                       "(server runs with --token-file)",
+                        code, msg = denied
+                        self._send(code, {
+                            "error": "Forbidden" if code == 403
+                            else "Unauthorized",
+                            "message": msg,
                         })
                         return
-                    code, payload = server._handle(
-                        method, self.path, self._body() if method in ("POST", "PUT") else {}
-                    )
+                    code, payload = server._handle(method, self.path, body)
                     self._send(code, payload)
                 except _BodyTooLarge as e:
                     # the unread body would desync keep-alive framing: close
@@ -429,6 +496,58 @@ class StoreServer:
                 continue
             self._log.append(ev.type, ev.kind, encode(ev.obj))
 
+    # -- authorization ------------------------------------------------------
+
+    def _agent_denied(
+        self, method: str, path: str, body: Dict[str, Any], node: str
+    ) -> Optional[str]:
+        """The NODE tier's scope (≙ the kubelet's node-restricted
+        credential): reads everywhere; create/update ITS OWN Node; update
+        pods CURRENTLY bound to its node (without rebinding them). None
+        when allowed, else the 403 message. The current binding is checked
+        against the BACKING store, not the submitted object — a compromised
+        agent must not claim another node's pod by writing its own name
+        into spec.node_name."""
+        from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+        parts = _route_parts(path)
+        obj = body.get("object") if isinstance(body, dict) else None
+        obj = obj if isinstance(obj, dict) else {}
+        meta = obj.get("metadata")
+        meta = meta if isinstance(meta, dict) else {}
+        if method == "POST" and parts == ["v1", "objects"]:
+            if (
+                body.get("kind") == "Node"
+                and meta.get("namespace") == NODE_NAMESPACE
+                and meta.get("name") == node
+            ):
+                return None  # its own registration
+            return (f"agent {node!r} may only create its own Node object, "
+                    f"not {body.get('kind')}/{meta.get('name')}")
+        if (
+            method == "PUT"
+            and len(parts) == 5
+            and parts[:2] == ["v1", "objects"]
+        ):
+            kind, ns, name = parts[2:]
+            if kind == "Node":
+                if ns == NODE_NAMESPACE and name == node:
+                    return None  # its own heartbeat
+                return f"agent {node!r} may only update its own Node"
+            if kind == "Pod":
+                spec = obj.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                try:
+                    cur = self.backing.get("Pod", ns, name)
+                    bound_to = cur.spec.node_name
+                except KeyError:
+                    bound_to = None  # authz before existence, like kube RBAC
+                if bound_to == node and spec.get("node_name") == node:
+                    return None  # status mirror / eviction of its own pod
+                return (f"agent {node!r} may only update pods bound to its "
+                        f"node (pod {ns}/{name} is bound to {bound_to!r})")
+        return f"agent {node!r} may not {method} this route"
+
     # -- request handling ---------------------------------------------------
 
     def _handle(
@@ -437,8 +556,8 @@ class StoreServer:
         parsed = urllib.parse.urlparse(path)
         qs = urllib.parse.parse_qs(parsed.query)
         # unquote AFTER splitting: %2F inside an object name must not create
-        # path segments (Node names are slice0/0x0)
-        parts = [urllib.parse.unquote(p) for p in parsed.path.split("/") if p]
+        # path segments (Node names are slice0/0x0) — _route_parts does this
+        parts = _route_parts(path)
         try:
             if parts == ["healthz"]:
                 return 200, {"ok": True}
@@ -492,6 +611,23 @@ class StoreServer:
                 return 200, {"object": encode(self.backing.get(kind, namespace, name))}
             if method == "PUT":
                 obj = decode(kind, body["object"])
+                if (
+                    obj.kind != kind
+                    or obj.metadata.namespace != namespace
+                    or obj.metadata.name != name
+                ):
+                    # the URL is what authorization was decided on; the
+                    # backing update keys off the BODY's identity — letting
+                    # them disagree would turn every scope check into a
+                    # bypass (authorize against pod A, overwrite pod B)
+                    return 400, {
+                        "error": "BadRequest",
+                        "message": (
+                            f"URL names {kind}/{namespace}/{name} but the "
+                            f"body object is {obj.kind}/"
+                            f"{obj.metadata.namespace}/{obj.metadata.name}"
+                        ),
+                    }
                 force = qs.get("force", ["0"])[0] == "1"
                 return 200, {"object": encode(self.backing.update(obj, force=force))}
             if method == "DELETE":
@@ -784,8 +920,13 @@ def main(argv=None) -> int:
                          "satisfies reads/watches under --auth-reads, and "
                          "mutations presenting it get 403 (the kube "
                          "view-vs-edit role split)")
+    ap.add_argument("--agent-tokens-file", default=None,
+                    help="file of 'node-name:token' lines: per-agent SCOPED "
+                         "credentials (reads + own Node + pods bound to its "
+                         "node only — the kubelet credential model); agents "
+                         "present theirs via their --token-file")
     ap.add_argument("--auth-reads", action="store_true",
-                    help="require a token (either tier) on reads/watches too")
+                    help="require a token (any tier) on reads/watches too")
     ap.add_argument("--tls-cert", default=None,
                     help="serve over TLS with this certificate (PEM; "
                          "self-signed acceptable — clients pin it with "
@@ -806,19 +947,20 @@ def main(argv=None) -> int:
     try:
         token = read_token_file(args.token_file)
         read_token = read_token_file(args.read_token_file)
+        agent_tokens = read_agent_tokens_file(args.agent_tokens_file)
     except (OSError, ValueError) as e:
         raise SystemExit(f"error: token file: {e}")
     if args.auth_reads and token is None:
         raise SystemExit("error: --auth-reads requires --token-file")
-    if read_token is not None and token is None:
-        raise SystemExit("error: --read-token-file requires --token-file "
-                         "(the admin tier anchors auth)")
+    if (read_token is not None or agent_tokens) and token is None:
+        raise SystemExit("error: --read-token-file/--agent-tokens-file "
+                         "require --token-file (the admin tier anchors auth)")
     server = StoreServer(
         backing, host, port, token=token,
         # a read tier with open reads would be meaningless: configuring it
         # implies reads need a token (either tier)
         auth_reads=args.auth_reads or read_token is not None,
-        read_token=read_token,
+        read_token=read_token, agent_tokens=agent_tokens,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
     ).start()
     print(f"store serving on {server.url}", flush=True)

@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "read/watch requests may present it instead of the "
                          "admin token; mutations with it get 403. Implies "
                          "reads require a token.")
+    ap.add_argument("--agent-tokens-file", default=None,
+                    help="for --serve-store: file of 'node-name:token' "
+                         "lines — per-agent SCOPED credentials (reads + own "
+                         "Node + pods bound to its node only)")
     ap.add_argument("--tls-cert", default=None,
                     help="serve --serve-store over TLS with this certificate "
                          "(PEM; ≙ kube-apiserver's TLS on the same seam)")
@@ -128,17 +132,21 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    from mpi_operator_tpu.machinery.http_store import read_token_file
+    from mpi_operator_tpu.machinery.http_store import (
+        read_agent_tokens_file,
+        read_token_file,
+    )
 
     try:
         token = read_token_file(args.token_file)
         read_token = read_token_file(args.read_token_file)
+        agent_tokens = read_agent_tokens_file(args.agent_tokens_file)
     except (OSError, ValueError) as e:
         print(f"error: token file: {e}", file=sys.stderr)
         return 2
-    if read_token is not None and token is None:
-        print("error: --read-token-file requires --token-file "
-              "(the admin tier anchors auth)", file=sys.stderr)
+    if (read_token is not None or agent_tokens) and token is None:
+        print("error: --read-token-file/--agent-tokens-file require "
+              "--token-file (the admin tier anchors auth)", file=sys.stderr)
         return 2
     if args.tls_key and not args.tls_cert:
         print("error: --tls-key requires --tls-cert", file=sys.stderr)
@@ -163,6 +171,7 @@ def main(argv=None) -> int:
             return 2
         store_server = StoreServer(
             store, host, port, token=token, read_token=read_token,
+            agent_tokens=agent_tokens,
             # a read tier with open reads would be meaningless (see the
             # standalone tpu-store entry point, which does the same)
             auth_reads=read_token is not None,

@@ -533,8 +533,6 @@ class GangScheduler:
         for item in list(chosen):
             if len(chosen) == 1:
                 break
-            if not any(v is item for v in chosen):
-                continue  # already pruned: trial would equal chosen
             trial = [v for v in chosen if v is not item]
             if self._fits_after_eviction(
                 unbound, [held for _, _, held in trial],

@@ -1209,3 +1209,75 @@ def test_preemption_prunes_useless_collateral_victims():
     assert all(not p.is_finished() for p in job_pods(store, "tiny-low"))
     sched.sync()
     assert [p.spec.node_name for p in bound_pods(store, "crit")] == ["node-2"]
+
+
+@pytest.mark.slow  # full stack / subprocess e2e
+def test_real_agent_workflow_on_scoped_token(tmp_path):
+    """The entire agent workflow — register, heartbeat, claim, execute,
+    status-mirror, serve logs — runs on a NODE-scoped credential (no admin
+    token on the execution node at all), while job-level powers stay
+    admin-only. ≙ running kubelets on node-restricted credentials instead
+    of cluster-admin."""
+    import subprocess
+    import sys
+
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+    from mpi_operator_tpu.machinery.store import Forbidden
+    from mpi_operator_tpu.runtime.emulation import free_port
+
+    adm = tmp_path / "admin-token"
+    adm.write_text("admintok\n")
+    agents_file = tmp_path / "agent-tokens"
+    agents_file.write_text("agent-a:agenttok\n")
+    agent_tok_file = tmp_path / "agent-a-token"
+    agent_tok_file.write_text("agenttok\n")
+    port = free_port()
+    procs = []
+    tags = ["store", "operator", "agent-a"]
+    procs.append(_spawn(tmp_path, "store", [
+        sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+        "--store", f"sqlite:{tmp_path / 'store.db'}",
+        "--listen", f"127.0.0.1:{port}",
+        "--token-file", str(adm),
+        "--agent-tokens-file", str(agents_file),
+    ]))
+    try:
+        _wait_http(f"http://127.0.0.1:{port}/healthz")
+        procs.append(_spawn(tmp_path, "operator", [
+            sys.executable, "-m", "mpi_operator_tpu.opshell",
+            "--store", f"http://127.0.0.1:{port}",
+            "--token-file", str(adm), "--monitoring-port", "0",
+        ]))
+        (tmp_path / "logs-a").mkdir()
+        procs.append(_spawn(tmp_path, "agent-a", [
+            sys.executable, "-m", "mpi_operator_tpu.executor.agent",
+            "--store", f"http://127.0.0.1:{port}",
+            "--token-file", str(agent_tok_file),  # the SCOPED credential
+            "--node-name", "agent-a",
+            "--logs-dir", str(tmp_path / "logs-a"), "--workdir", REPO,
+        ]))
+        admin_store = HttpStoreClient(f"http://127.0.0.1:{port}",
+                                      token="admintok")
+        _wait_nodes_registered(admin_store, ["agent-a"])
+
+        from mpi_operator_tpu.api.client import TPUJobClient
+
+        TPUJobClient(admin_store).create(_job_manifest(
+            "scoped", replicas=1, env={},
+            command=["python", "examples/pi_worker.py", "50000"],
+        ))
+        _wait_job(admin_store, "scoped", 180, tmp_path, tags)
+        pods = [p for p in admin_store.list("Pod")
+                if p.metadata.labels.get(LABEL_JOB_NAME) == "scoped"]
+        assert pods and pods[0].spec.node_name == "agent-a"
+        assert pods[0].status.phase == PodPhase.SUCCEEDED
+
+        # the scoped token cannot do job-level things
+        agent_store = HttpStoreClient(f"http://127.0.0.1:{port}",
+                                      token="agenttok")
+        with pytest.raises(Forbidden):
+            agent_store.delete("TPUJob", "default", "scoped")
+        agent_store.close()
+        admin_store.close()
+    finally:
+        _reap(procs)

@@ -468,3 +468,20 @@ def test_preemption_does_not_burn_backoff_limit(f):
     f.set_pod_phase(job, 0, PodPhase.FAILED, reason="Evicted")
     f.sync(job)
     assert f.job(job).status.restart_count == 1
+
+
+def test_mixed_crash_and_preemption_still_burns_backoff(f):
+    """The free preemption pass requires every RETRYABLE failure to be a
+    preemption: a pod that crashed retryably on its own (exit 137) in the
+    same generation means the workload was failing anyway — the generation
+    counts toward backoffLimit (otherwise a crash-looping low-priority job
+    that keeps getting preempted would restart forever)."""
+    job = make_job(name="mix", replicas=2)
+    job.spec.worker.restart_policy = RestartPolicy.EXIT_CODE
+    job.spec.run_policy.backoff_limit = 5
+    job = f.create_job(job)
+    f.run_to_phase(job)
+    f.set_pod_phase(job, 0, PodPhase.FAILED, exit_code=137)  # genuine crash
+    f.set_pod_phase(job, 1, PodPhase.FAILED, reason="Preempted")
+    f.sync(job)
+    assert f.job(job).status.restart_count == 1  # counted, not free

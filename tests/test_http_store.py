@@ -641,3 +641,177 @@ def test_tls_round_trip_with_self_signed_cert(tmp_path):
     finally:
         authed.close()
         srv.stop()
+
+
+def test_agent_scoped_tokens_enforce_node_scope():
+    """The NODE token tier (≙ the kubelet's node-restricted credential,
+    beyond the view/edit split): an agent token can read, register and
+    heartbeat ITS OWN Node, and update pods currently bound to its node —
+    and nothing else. The current binding is checked against the backing
+    store, so a compromised agent cannot claim another node's pod by
+    writing its own name into spec.node_name."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+    from mpi_operator_tpu.machinery.store import Forbidden
+
+    backing = ObjectStore()
+    srv = StoreServer(
+        backing, "127.0.0.1", 0, token="adm1n",
+        agent_tokens={"tok-a": "agent-a", "tok-b": "agent-b"},
+    ).start()
+    admin = HttpStoreClient(srv.url, token="adm1n")
+    agent_a = HttpStoreClient(srv.url, token="tok-a")
+    try:
+        # registration + heartbeat of ITS OWN Node
+        node = Node()
+        node.metadata.namespace = NODE_NAMESPACE
+        node.metadata.name = "agent-a"
+        node.status.ready = True
+        created = agent_a.create(node)
+        created.status.last_heartbeat = 123.0
+        agent_a.update(created)
+        # ...but not somebody else's
+        other = Node()
+        other.metadata.namespace = NODE_NAMESPACE
+        other.metadata.name = "agent-b"
+        with pytest.raises(Forbidden, match="own Node"):
+            agent_a.create(other)
+        b = Node()
+        b.metadata.namespace = NODE_NAMESPACE
+        b.metadata.name = "agent-b"
+        stored_b = backing.create(b)
+        stored_b.status.ready = False
+        with pytest.raises(Forbidden, match="own Node"):
+            agent_a.update(stored_b, force=True)
+
+        # pods: only ones CURRENTLY bound to its node
+        mine = backing.create(Pod(metadata=ObjectMeta(name="mine", namespace="d")))
+        mine.spec.node_name = "agent-a"
+        backing.update(mine, force=True)
+        theirs = backing.create(Pod(metadata=ObjectMeta(name="theirs", namespace="d")))
+        theirs.spec.node_name = "agent-b"
+        backing.update(theirs, force=True)
+        loose = backing.create(Pod(metadata=ObjectMeta(name="loose", namespace="d")))
+
+        got = agent_a.get("Pod", "d", "mine")  # reads are open (no auth_reads)
+        got.status.phase = PodPhase.RUNNING
+        agent_a.update(got, force=True)  # status mirror on its own pod
+        bad = agent_a.get("Pod", "d", "theirs")
+        bad.status.phase = PodPhase.FAILED
+        with pytest.raises(Forbidden, match="bound to"):
+            agent_a.update(bad, force=True)
+        # rebind-to-self is NOT a status update: the stored pod is unbound
+        grab = agent_a.get("Pod", "d", "loose")
+        grab.spec.node_name = "agent-a"
+        with pytest.raises(Forbidden, match="bound to"):
+            agent_a.update(grab, force=True)
+        # and unbinding its own pod is not allowed either (the submitted
+        # object must keep the binding)
+        flee = agent_a.get("Pod", "d", "mine")
+        flee.spec.node_name = ""
+        with pytest.raises(Forbidden):
+            agent_a.update(flee, force=True)
+
+        # job-level powers stay admin-only
+        from mpi_operator_tpu.api.types import TPUJob
+
+        with pytest.raises(Forbidden):
+            agent_a.create(TPUJob(metadata=ObjectMeta(name="evil", namespace="d")))
+        with pytest.raises(Forbidden):
+            agent_a.delete("Pod", "d", "theirs")
+        # admin unaffected
+        admin.delete("Pod", "d", "loose")
+    finally:
+        agent_a.close()
+        admin.close()
+        srv.stop()
+
+
+def test_agent_tokens_file_parses_and_fails_closed(tmp_path):
+    from mpi_operator_tpu.machinery.http_store import read_agent_tokens_file
+
+    f = tmp_path / "agents"
+    f.write_text("# comment\nslice0/0x0:tok-one\nagent-b:tok-two\n")
+    assert read_agent_tokens_file(str(f)) == {
+        "tok-one": "slice0/0x0", "tok-two": "agent-b",
+    }
+    assert read_agent_tokens_file(None) is None
+    f.write_text("")
+    with pytest.raises(ValueError, match="no tokens"):
+        read_agent_tokens_file(str(f))
+    f.write_text("missing-colon-token\n")
+    with pytest.raises(ValueError, match="expected"):
+        read_agent_tokens_file(str(f))
+    f.write_text("a:dup\nb:dup\n")
+    with pytest.raises(ValueError, match="reused"):
+        read_agent_tokens_file(str(f))
+
+
+def test_put_url_body_identity_mismatch_rejected():
+    """Authorization is decided on the URL; the backing update keys off the
+    body — letting them disagree turns every scope check into a bypass
+    (authorize against your own pod, overwrite someone else's). The server
+    rejects the mismatch for every tier."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+
+    backing = ObjectStore()
+    srv = StoreServer(
+        backing, "127.0.0.1", 0, token="adm1n",
+        agent_tokens={"tok-a": "agent-a"},
+    ).start()
+    agent_a = HttpStoreClient(srv.url, token="tok-a")
+    admin = HttpStoreClient(srv.url, token="adm1n")
+    try:
+        mine = backing.create(Pod(metadata=ObjectMeta(name="mine", namespace="d")))
+        mine.spec.node_name = "agent-a"
+        backing.update(mine, force=True)
+        theirs = backing.create(Pod(metadata=ObjectMeta(name="theirs", namespace="d")))
+        theirs.spec.node_name = "agent-b"
+        backing.update(theirs, force=True)
+        # the bypass attempt: authorized URL (its own pod), body names the
+        # victim pod rebound to agent-a
+        import json as _json
+        import urllib.request
+
+        from mpi_operator_tpu.machinery.serialize import encode
+
+        stolen = backing.get("Pod", "d", "theirs")
+        stolen.spec.node_name = "agent-a"
+        req = urllib.request.Request(
+            f"{srv.url}/v1/objects/Pod/d/mine?force=1",
+            data=_json.dumps({"object": encode(stolen)}).encode(),
+            method="PUT",
+            headers={"Authorization": "Bearer tok-a",
+                     "Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+        cur = backing.get("Pod", "d", "theirs")
+        assert cur.spec.node_name == "agent-b"  # untouched
+        # admin hits the same integrity wall (it is not an authz rule)
+        req = urllib.request.Request(
+            f"{srv.url}/v1/objects/Pod/d/mine?force=1",
+            data=_json.dumps({"object": encode(stolen)}).encode(),
+            method="PUT",
+            headers={"Authorization": "Bearer adm1n",
+                     "Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+    finally:
+        agent_a.close()
+        admin.close()
+        srv.stop()
+
+
+def test_cross_tier_token_reuse_fails_closed():
+    """An agent-tokens entry that reuses the admin (or read) token would be
+    classified admin by the first-match bearer check — the server refuses
+    to start instead."""
+    with pytest.raises(ValueError, match="distinct secret"):
+        StoreServer(ObjectStore(), "127.0.0.1", 0, token="same",
+                    agent_tokens={"same": "node-1"})
+    with pytest.raises(ValueError, match="distinct secret"):
+        StoreServer(ObjectStore(), "127.0.0.1", 0, token="adm",
+                    read_token="view", agent_tokens={"view": "node-1"})

@@ -66,9 +66,13 @@ class LogServer:
     incremental-fetch contract, ≙ the kubelet's follow streaming).
 
     When ``tokens`` is configured, every /logs request must present one of
-    them as a bearer token (training logs can contain data samples; the
-    store grew token auth in r4 and this endpoint honors the same tokens —
-    admin or read tier). /healthz stays open for probes.
+    them as a bearer token (training logs can contain data samples).
+    The accepted set is whatever the agent was HANDED — its own store
+    token (shared admin, or its node-scoped credential) plus the read
+    token. In agent-scoped deployments the admin token is deliberately
+    absent from execution nodes, so log fetches use the READ token
+    (`ctl --read-token-file`); that is also the least-privilege practice,
+    since this endpoint is plain HTTP. /healthz stays open for probes.
     """
 
     def __init__(self, logs_dir: str, host: str = "0.0.0.0", port: int = 0,
@@ -79,6 +83,11 @@ class LogServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # idle/half-open keep-alive connections must not pin handler
+            # threads forever: an agent OOM from unbounded thread growth
+            # would PDEATHSIG-kill every worker on the node (same guard as
+            # the store server's handler)
+            timeout = 65.0
 
             def log_message(self, fmt, *args):  # quiet
                 pass
@@ -306,9 +315,18 @@ class NodeAgent:
         self._stop.set()
         self.executor.stop()
         try:
-            cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
-            cur.status.ready = False
-            self.store.update(cur, force=True)
+            from mpi_operator_tpu.machinery.store import optimistic_update
+
+            def mutate(cur) -> bool:
+                cur.status.ready = False
+                return True
+
+            # optimistic, not force: node-scoped credentials forbid force,
+            # and a concurrent cordon must not be clobbered
+            optimistic_update(
+                self.store, "Node", NODE_NAMESPACE, self.node_name, mutate,
+                what="agent-stop",
+            )
         except Exception:
             pass  # best-effort drain mark; the monitor catches it anyway
         self.log_server.stop()

@@ -376,37 +376,42 @@ class LocalExecutor:
         exit_code: Optional[int] = None,
         log_path: str = "",
     ) -> None:
-        # re-read (controller may have updated the pod since); force-update
-        # status like a kubelet (status is the executor's to own)
-        try:
-            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
-        except NotFound:
-            return
-        if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
-            # same name, different incarnation: a gang restart deleted and
-            # recreated the pod while this update was in flight (e.g. the
-            # reaper of a process _forget just killed, rc=-9). Stamping the
-            # old incarnation's exit onto the fresh PENDING pod would fail
-            # the restarted job with its predecessor's corpse.
-            return
-        if cur.is_finished():
-            # terminal status is WRITE-ONCE: an external eviction (drain /
-            # node monitor) must not be overwritten by the reaper of the
-            # process we then killed (its rc=-9 would erase the Evicted
-            # reason — the signal that makes the failure retryable)
-            return
-        cur.status.phase = phase
-        cur.status.ready = phase == PodPhase.RUNNING
-        cur.status.reason = reason
-        if message:
-            cur.status.message = message
-        if ip:
-            cur.status.pod_ip = ip
-        if exit_code is not None:
-            cur.status.exit_code = exit_code
-        if log_path:
-            cur.status.log_path = log_path
-        try:
-            self.store.update(cur, force=True)
-        except NotFound:
-            pass
+        # optimistic conflict-retry, NOT force (status is the executor's to
+        # own like a kubelet, but a concurrent controller/scheduler write
+        # must surface as Conflict and be re-read, and node-scoped store
+        # credentials forbid force outright). The guards re-check on every
+        # attempt.
+        from mpi_operator_tpu.machinery.store import optimistic_update
+
+        def mutate(cur) -> bool:
+            if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
+                # same name, different incarnation: a gang restart deleted
+                # and recreated the pod while this update was in flight
+                # (e.g. the reaper of a process _forget just killed,
+                # rc=-9). Stamping the old incarnation's exit onto the
+                # fresh PENDING pod would fail the restarted job with its
+                # predecessor's corpse.
+                return False
+            if cur.is_finished():
+                # terminal status is WRITE-ONCE: an external eviction
+                # (drain / node monitor) must not be overwritten by the
+                # reaper of the process we then killed (its rc=-9 would
+                # erase the Evicted reason — the retryable signal)
+                return False
+            cur.status.phase = phase
+            cur.status.ready = phase == PodPhase.RUNNING
+            cur.status.reason = reason
+            if message:
+                cur.status.message = message
+            if ip:
+                cur.status.pod_ip = ip
+            if exit_code is not None:
+                cur.status.exit_code = exit_code
+            if log_path:
+                cur.status.log_path = log_path
+            return True
+
+        optimistic_update(
+            self.store, "Pod", pod.metadata.namespace, pod.metadata.name,
+            mutate, what="set-phase",
+        )

@@ -130,6 +130,14 @@ def read_agent_tokens_file(path: Optional[str]) -> Optional[Dict[str, str]]:
     return out
 
 
+def _force_requested(qs: Dict[str, List[str]]) -> bool:
+    """THE force-flag parse, shared by the agent-tier authorization and the
+    PUT handler: any drift between the two would turn a write authorized
+    as non-force into a forced one (the same never-parse-differently rule
+    as _route_parts)."""
+    return qs.get("force", ["0"])[0] == "1"
+
+
 def _route_parts(path: str) -> List[str]:
     """Decoded path segments of a request path (shared by routing and the
     agent-scope authorization so the two can never parse differently)."""
@@ -288,6 +296,15 @@ class StoreServer:
                     f"agent token for node {node!r} duplicates the "
                     f"admin/read token; every tier needs a distinct secret"
                 )
+        if token is None and (read_token is not None or auth_reads):
+            # the CLIs guard this combination too, but an embedded caller
+            # passing read_token/auth_reads without the anchoring admin
+            # token would otherwise get a silently UNAUTHENTICATED server
+            # (mutations included) — fail closed at construction
+            raise ValueError(
+                "read_token/auth_reads require the admin token "
+                "(auth would otherwise be silently disabled)"
+            )
         self.auth_reads = auth_reads
         # the seq space is per-incarnation; clients echo this id so a
         # restarted server (fresh seqs) can't be confused with the old one
@@ -315,7 +332,7 @@ class StoreServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _body(self) -> Dict[str, Any]:
+            def _body_bytes(self) -> bytes:
                 raw = self.headers.get("Content-Length", "0")
                 try:
                     n = int(raw)
@@ -326,13 +343,16 @@ class StoreServer:
                     # drive an arbitrary allocation (or an
                     # rfile.read(-1)-to-EOF stall) through a length field
                     raise _BodyTooLarge(raw)
-                return json.loads(self.rfile.read(n)) if n else {}
+                return self.rfile.read(n) if n else b""
 
             def _auth_error(
-                self, method: str, body: Dict[str, Any]
+                self, method: str, body
             ) -> Optional[Tuple[int, str]]:
                 """None when allowed; else (401, msg) for a bad/absent
-                token or (403, msg) for a valid token outside its scope."""
+                token or (403, msg) for a valid token outside its scope.
+                ``body`` is a CALLABLE returning the parsed body — only the
+                agent tier (already authenticated) ever parses it, so
+                anonymous peers cannot drive json.loads CPU."""
                 if server.token is None and not server.agent_tokens:
                     return None
                 if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
@@ -367,7 +387,7 @@ class StoreServer:
                                  "(server runs with --read-token-file)")
                 if agent_node is not None:
                     msg = server._agent_denied(
-                        method, self.path, body, agent_node
+                        method, self.path, body(), agent_node
                     )
                     return None if msg is None else (403, msg)
                 return (401, "missing or invalid bearer token "
@@ -375,9 +395,21 @@ class StoreServer:
 
             def _dispatch(self, method: str) -> None:
                 try:
-                    # body BEFORE auth: the agent scope check inspects it,
-                    # and an unread body would desync keep-alive framing
-                    body = self._body() if method in ("POST", "PUT") else {}
+                    # DRAIN the body for EVERY method before anything else:
+                    # an unread body on a keep-alive connection desyncs
+                    # framing — a bodied DELETE/GET would smuggle its body
+                    # bytes as the next request (classic request smuggling
+                    # behind a connection-reusing proxy). Drained but NOT
+                    # parsed: json.loads on 8 MB of pathological input must
+                    # not be reachable pre-authentication.
+                    raw = self._body_bytes()
+                    cache: Dict[str, Any] = {}
+
+                    def body() -> Dict[str, Any]:
+                        if "v" not in cache:
+                            cache["v"] = json.loads(raw) if raw else {}
+                        return cache["v"]
+
                     denied = self._auth_error(method, body)
                     if denied is not None:
                         code, msg = denied
@@ -387,8 +419,18 @@ class StoreServer:
                             "message": msg,
                         })
                         return
-                    code, payload = server._handle(method, self.path, body)
+                    code, payload = server._handle(
+                        method, self.path,
+                        body() if method in ("POST", "PUT") else {},
+                    )
                     self._send(code, payload)
+                except json.JSONDecodeError as e:
+                    # malformed body from an (authenticated) peer: a 400,
+                    # not an opaque 500
+                    self._send(400, {
+                        "error": "BadRequest",
+                        "message": f"body is not valid JSON: {e}",
+                    })
                 except _BodyTooLarge as e:
                     # the unread body would desync keep-alive framing: close
                     self.close_connection = True
@@ -529,11 +571,46 @@ class StoreServer:
             and len(parts) == 5
             and parts[:2] == ["v1", "objects"]
         ):
+            qs = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+            if _force_requested(qs):
+                # force bypasses optimistic concurrency: a compromised
+                # agent could clobber a concurrent rebind/eviction/reaper
+                # write without a Conflict ever surfacing. The real agent
+                # uses optimistic conflict-retry everywhere.
+                return (f"agent {node!r} may not force-update (optimistic "
+                        f"writes only — retry on Conflict)")
             kind, ns, name = parts[2:]
             if kind == "Node":
-                if ns == NODE_NAMESPACE and name == node:
-                    return None  # its own heartbeat
-                return f"agent {node!r} may only update its own Node"
+                if ns != NODE_NAMESPACE or name != node:
+                    return f"agent {node!r} may only update its own Node"
+                status = obj.get("status")
+                status = status if isinstance(status, dict) else {}
+                try:
+                    stored = self.backing.get("Node", ns, name)
+                    cordoned = stored.status.unschedulable
+                    stored_rv = stored.metadata.resource_version
+                except KeyError:
+                    cordoned = False
+                    stored_rv = None
+                submitted_rv = (obj.get("metadata") or {}).get(
+                    "resource_version"
+                )
+                if (
+                    bool(status.get("unschedulable", False)) != bool(cordoned)
+                    and submitted_rv == stored_rv
+                ):
+                    # the cordon flag belongs to the OPERATOR (`ctl
+                    # cordon/drain` is containment against exactly a
+                    # compromised node): an agent un-cordoning itself would
+                    # pull other tenants' gangs back onto it. Deny ONLY
+                    # when the write would otherwise land (same resource
+                    # version): a stale copy from a benign cordon-vs-
+                    # heartbeat race must surface as Conflict so the
+                    # agent's optimistic retry re-reads and preserves the
+                    # flag — a 403 there would abort the retry loop.
+                    return (f"agent {node!r} may not change its own "
+                            f"cordon flag (status.unschedulable)")
+                return None  # its own heartbeat
             if kind == "Pod":
                 spec = obj.get("spec")
                 spec = spec if isinstance(spec, dict) else {}
@@ -628,7 +705,7 @@ class StoreServer:
                             f"{obj.metadata.namespace}/{obj.metadata.name}"
                         ),
                     }
-                force = qs.get("force", ["0"])[0] == "1"
+                force = _force_requested(qs)
                 return 200, {"object": encode(self.backing.update(obj, force=force))}
             if method == "DELETE":
                 return 200, {"object": encode(self.backing.delete(kind, namespace, name))}

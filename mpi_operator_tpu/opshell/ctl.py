@@ -611,6 +611,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "store an operator is running on)")
     ap.add_argument("--token-file", default=None,
                     help="bearer token file for an authenticated http store")
+    ap.add_argument("--read-token-file", default=None,
+                    help="READ-ONLY token file: when given, `ctl logs` "
+                         "presents THIS to agent log endpoints instead of "
+                         "the admin token — log fetches cross per-node "
+                         "servers (plain HTTP), so send the least-"
+                         "privileged credential that works there")
     ap.add_argument("--tls-ca-file", default=None,
                     help="CA bundle (or the self-signed cert itself) to "
                          "verify a --store https://... against")
@@ -674,11 +680,18 @@ def main(argv=None) -> int:
 
     try:
         token = read_token_file(args.token_file)
+        read_token = read_token_file(args.read_token_file)
     except (OSError, ValueError) as e:
-        print(f"error: --token-file: {e}", file=sys.stderr)
+        print(f"error: token file: {e}", file=sys.stderr)
         return 2
-    args.log_token = token  # `ctl logs` presents it to guarded agents too
-    store = build_store(args.store, token=token, ca_file=args.tls_ca_file)
+    # `ctl logs` crosses per-node log servers: present the LEAST-privileged
+    # credential that works there (an admin token sent to a compromised
+    # node's endpoint would be harvestable from the header). The STORE
+    # client conversely uses the strongest credential in hand — a viewer
+    # running with only --read-token-file still authenticates its reads.
+    args.log_token = read_token or token
+    store = build_store(args.store, token=token or read_token,
+                        ca_file=args.tls_ca_file)
     client = TPUJobClient(store, namespace=args.namespace)
     try:
         return {

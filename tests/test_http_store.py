@@ -681,7 +681,7 @@ def test_agent_scoped_tokens_enforce_node_scope():
         stored_b = backing.create(b)
         stored_b.status.ready = False
         with pytest.raises(Forbidden, match="own Node"):
-            agent_a.update(stored_b, force=True)
+            agent_a.update(stored_b)
 
         # pods: only ones CURRENTLY bound to its node
         mine = backing.create(Pod(metadata=ObjectMeta(name="mine", namespace="d")))
@@ -694,22 +694,22 @@ def test_agent_scoped_tokens_enforce_node_scope():
 
         got = agent_a.get("Pod", "d", "mine")  # reads are open (no auth_reads)
         got.status.phase = PodPhase.RUNNING
-        agent_a.update(got, force=True)  # status mirror on its own pod
+        agent_a.update(got)  # status mirror on its own pod (optimistic)
         bad = agent_a.get("Pod", "d", "theirs")
         bad.status.phase = PodPhase.FAILED
         with pytest.raises(Forbidden, match="bound to"):
-            agent_a.update(bad, force=True)
+            agent_a.update(bad)
         # rebind-to-self is NOT a status update: the stored pod is unbound
         grab = agent_a.get("Pod", "d", "loose")
         grab.spec.node_name = "agent-a"
         with pytest.raises(Forbidden, match="bound to"):
-            agent_a.update(grab, force=True)
+            agent_a.update(grab)
         # and unbinding its own pod is not allowed either (the submitted
         # object must keep the binding)
         flee = agent_a.get("Pod", "d", "mine")
         flee.spec.node_name = ""
         with pytest.raises(Forbidden):
-            agent_a.update(flee, force=True)
+            agent_a.update(flee)
 
         # job-level powers stay admin-only
         from mpi_operator_tpu.api.types import TPUJob
@@ -777,7 +777,7 @@ def test_put_url_body_identity_mismatch_rejected():
         stolen = backing.get("Pod", "d", "theirs")
         stolen.spec.node_name = "agent-a"
         req = urllib.request.Request(
-            f"{srv.url}/v1/objects/Pod/d/mine?force=1",
+            f"{srv.url}/v1/objects/Pod/d/mine",
             data=_json.dumps({"object": encode(stolen)}).encode(),
             method="PUT",
             headers={"Authorization": "Bearer tok-a",
@@ -815,3 +815,108 @@ def test_cross_tier_token_reuse_fails_closed():
     with pytest.raises(ValueError, match="distinct secret"):
         StoreServer(ObjectStore(), "127.0.0.1", 0, token="adm",
                     read_token="view", agent_tokens={"view": "node-1"})
+
+
+def test_agent_tier_cannot_force_or_uncordon():
+    """Two compromised-agent containment rules: (a) force=1 is denied to
+    the NODE tier (it would bypass optimistic concurrency and clobber a
+    concurrent rebind/eviction without a Conflict surfacing); (b) an agent
+    may not flip its own cordon flag — `ctl cordon` is the operator's
+    containment against exactly this node."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+    from mpi_operator_tpu.machinery.store import Forbidden
+
+    backing = ObjectStore()
+    srv = StoreServer(
+        backing, "127.0.0.1", 0, token="adm1n",
+        agent_tokens={"tok-a": "agent-a"},
+    ).start()
+    agent_a = HttpStoreClient(srv.url, token="tok-a")
+    try:
+        node = Node()
+        node.metadata.namespace = NODE_NAMESPACE
+        node.metadata.name = "agent-a"
+        node.status.ready = True
+        agent_a.create(node)
+        # the operator cordons the node (admin-side, direct to backing)
+        stored = backing.get("Node", NODE_NAMESPACE, "agent-a")
+        stored.status.unschedulable = True
+        backing.update(stored, force=True)
+        # heartbeat that PRESERVES the cordon flag: allowed
+        beat = agent_a.get("Node", NODE_NAMESPACE, "agent-a")
+        beat.status.last_heartbeat = 99.0
+        agent_a.update(beat)
+        # self-uncordon: denied
+        esc = agent_a.get("Node", NODE_NAMESPACE, "agent-a")
+        esc.status.unschedulable = False
+        with pytest.raises(Forbidden, match="cordon"):
+            agent_a.update(esc)
+        assert backing.get("Node", NODE_NAMESPACE, "agent-a").status.unschedulable
+        # a STALE copy from a benign cordon-vs-heartbeat race must surface
+        # as Conflict (so the optimistic retry re-reads and preserves the
+        # flag), not Forbidden (which would abort the retry loop)
+        stale = agent_a.get("Node", NODE_NAMESPACE, "agent-a")
+        behind = backing.get("Node", NODE_NAMESPACE, "agent-a")
+        backing.update(behind, force=True)  # rv bumps behind the agent
+        stale.status.unschedulable = False
+        with pytest.raises(Conflict):
+            agent_a.update(stale)
+
+        # force denied even on its own pod
+        pod = backing.create(Pod(metadata=ObjectMeta(name="p", namespace="d")))
+        pod.spec.node_name = "agent-a"
+        backing.update(pod, force=True)
+        mine = agent_a.get("Pod", "d", "p")
+        mine.status.phase = PodPhase.RUNNING
+        with pytest.raises(Forbidden, match="force"):
+            agent_a.update(mine, force=True)
+        agent_a.update(mine)  # optimistic write is fine
+    finally:
+        agent_a.close()
+        srv.stop()
+
+
+def test_body_hygiene_bad_json_and_bodied_delete():
+    """(a) A malformed body from an authenticated peer is a 400, not a
+    500; anonymous peers never reach json.loads at all (parse is deferred
+    past authentication). (b) A DELETE carrying a body must have it
+    drained — otherwise the body bytes replay as the NEXT request on the
+    keep-alive connection (request smuggling behind a reusing proxy)."""
+    import http.client
+
+    backing = ObjectStore()
+    srv = StoreServer(backing, "127.0.0.1", 0, token="adm1n").start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        # authenticated, malformed body → 400
+        conn.request("POST", "/v1/objects", body=b"{not json",
+                     headers={"Authorization": "Bearer adm1n"})
+        r = conn.getresponse()
+        assert r.status == 400, r.status
+        r.read()
+        # bodied DELETE on the SAME keep-alive connection: the body must
+        # not desync framing — the follow-up request must be answered
+        # normally (a smuggled 'GET /healthz' inside the body must NOT
+        # produce an extra response)
+        backing.create(Pod(metadata=ObjectMeta(name="x", namespace="d")))
+        smuggle = b"GET /evil HTTP/1.1\r\nHost: x\r\n\r\n"
+        conn.request("DELETE", "/v1/objects/Pod/d/x",
+                     body=smuggle,
+                     headers={"Authorization": "Bearer adm1n"})
+        r = conn.getresponse()
+        assert r.status == 200, (r.status, r.read())
+        r.read()
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_store_server_constructor_fails_closed_without_admin_token():
+    with pytest.raises(ValueError, match="admin token"):
+        StoreServer(ObjectStore(), "127.0.0.1", 0, read_token="view")
+    with pytest.raises(ValueError, match="admin token"):
+        StoreServer(ObjectStore(), "127.0.0.1", 0, auth_reads=True)

@@ -315,6 +315,13 @@ def main():
         bench_resnet()
     elif mode == "llama-long":
         bench_llama_longctx()
+    elif mode == "controlplane":
+        # no TPU work requested: the pure-python control-plane storm
+        # (reconcile p50/p99 + store read QPS, with/without the informer
+        # cache — bench_controlplane.py); runs anywhere, no jax needed
+        import bench_controlplane
+
+        bench_controlplane.main()
     elif mode == "all":
         # default: ALL acceptance workloads in one invocation — llama 2k,
         # llama long-context, ResNet LAST so the ResNet line stays the
@@ -331,7 +338,8 @@ def main():
         bench_resnet()
     else:
         raise SystemExit(
-            f"unknown BENCH_MODEL={mode!r} (resnet|llama|llama-long|all)"
+            f"unknown BENCH_MODEL={mode!r} "
+            f"(resnet|llama|llama-long|controlplane|all)"
         )
 
 

@@ -1,0 +1,206 @@
+"""Control-plane benchmark: reconcile storm against the sqlite-backed HTTP
+store, with and without the informer cache (machinery/cache.py).
+
+The metric the informer/lister subsystem exists to move: before it, every
+reconcile issued full ``store.list``/``get`` round-trips — over HTTP in the
+distributed deployment — so store read load scaled as
+O(jobs × pods × resyncs). With listers, steady-state controller reads come
+from the watch-fed cache and the store sees only writes plus one long-poll.
+
+Shape: N synthetic TPUJobs × M workers each (default 200 × 8 — the ISSUE 1
+acceptance point) are created through a real HttpStoreClient against a real
+StoreServer backed by SqliteStore. The controller converges them (service,
+configmap, podgroup, workers, status), the gang scheduler binds every gang,
+and then a steady-state storm re-reconciles every job for R rounds while
+measuring per-sync latency and the server's read counters. Run it via::
+
+  python bench_controlplane.py                      # both modes + compare
+  BENCH_MODEL=controlplane python bench.py          # same, no TPU work
+
+Knobs: BENCH_CP_JOBS, BENCH_CP_PODS, BENCH_CP_ROUNDS, BENCH_CP_MODES
+("store", "informer", or "store,informer"). No jax required — this is the
+pure-python control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mpi_operator_tpu.api.types import (  # noqa: E402
+    Container,
+    ObjectMeta,
+    PodTemplate,
+    ReplicaSpec,
+    RunPolicy,
+    SliceSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from mpi_operator_tpu.controller.controller import (  # noqa: E402
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.machinery.cache import InformerCache  # noqa: E402
+from mpi_operator_tpu.machinery.events import EventRecorder  # noqa: E402
+from mpi_operator_tpu.machinery.http_store import (  # noqa: E402
+    HttpStoreClient,
+    StoreServer,
+)
+from mpi_operator_tpu.machinery.sqlite_store import SqliteStore  # noqa: E402
+from mpi_operator_tpu.scheduler.gang import GangScheduler  # noqa: E402
+
+
+def _make_job(i: int, pods: int) -> TPUJob:
+    return TPUJob(
+        metadata=ObjectMeta(name=f"storm-{i:04d}", namespace="bench"),
+        spec=TPUJobSpec(
+            slots_per_worker=1,
+            run_policy=RunPolicy(clean_pod_policy="None"),
+            worker=ReplicaSpec(
+                replicas=pods,
+                restart_policy="Never",
+                template=PodTemplate(
+                    container=Container(image="bench/noop", command=["true"])
+                ),
+            ),
+            slice=SliceSpec(accelerator="cpu", chips_per_host=1),
+        ),
+    )
+
+
+def _reads(stats: dict) -> int:
+    """Store-side read requests: object gets + lists. Watch long-polls are
+    reported separately — they are the informer's O(1) replacement, not the
+    per-reconcile load this benchmark measures."""
+    return stats.get("get", 0) + stats.get("list", 0)
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, max(0, int(round(p * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def run_mode(mode: str, jobs: int, pods: int, rounds: int) -> dict:
+    """One full converge + storm in ``mode`` ('store' = direct reads,
+    'informer' = lister reads) against a fresh sqlite-backed HTTP store."""
+    tmp = tempfile.mkdtemp(prefix=f"bench-cp-{mode}-")
+    backing = SqliteStore(os.path.join(tmp, "store.db"))
+    server = StoreServer(backing, "127.0.0.1", 0).start()
+    client = HttpStoreClient(server.url, timeout=30.0, watch_poll_timeout=5.0)
+    cache = None
+    try:
+        if mode == "informer":
+            cache = InformerCache(client).start()
+            if not cache.wait_for_sync(30.0):
+                raise RuntimeError("informer cache never synced")
+        recorder = EventRecorder(client)
+        controller = TPUJobController(
+            client, recorder, ControllerOptions(threadiness=0), cache=cache
+        )
+        scheduler = GangScheduler(client, recorder, cache=cache)
+
+        keys = []
+        for i in range(jobs):
+            job = client.create(_make_job(i, pods))
+            keys.append(job.metadata.key())
+
+        # converge: drive sync_handler + scheduler.sync directly (no worker
+        # threads — deterministic measurement) until a full pass of syncs
+        # succeeds twice; informer mode needs the watch to carry each pass's
+        # writes back into the cache before the next pass settles
+        t_conv = time.perf_counter()
+        clean_passes = 0
+        for _ in range(30):
+            ok = all([controller.sync_handler(k) for k in keys])
+            scheduler.sync()
+            clean_passes = clean_passes + 1 if ok else 0
+            if clean_passes >= 2:
+                break
+            if cache is not None:
+                time.sleep(0.3)  # let the watch land this pass's writes
+        converge_s = time.perf_counter() - t_conv
+        if cache is not None:
+            time.sleep(0.5)  # quiesce: cache observes the final writes
+
+        # steady-state storm: every job re-reconciled, rounds times over
+        stats0 = server.stats()
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for k in keys:
+                t = time.perf_counter()
+                controller.sync_handler(k)
+                lat.append(time.perf_counter() - t)
+            scheduler.sync()
+        elapsed = time.perf_counter() - t0
+        stats1 = server.stats()
+
+        lat.sort()
+        reads = _reads(stats1) - _reads(stats0)
+        writes = sum(
+            stats1.get(w, 0) - stats0.get(w, 0)
+            for w in ("create", "update", "delete")
+        )
+        return {
+            "metric": "controlplane_reconcile",
+            "mode": mode,
+            "jobs": jobs,
+            "pods_per_job": pods,
+            "rounds": rounds,
+            "syncs": len(lat),
+            "sync_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "sync_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            "store_read_qps": round(reads / elapsed, 1),
+            "store_reads_per_sync": round(reads / max(1, len(lat)), 2),
+            "store_writes": writes,
+            "watch_polls": stats1.get("watch", 0) - stats0.get("watch", 0),
+            "storm_elapsed_s": round(elapsed, 2),
+            "converge_s": round(converge_s, 2),
+        }
+    finally:
+        if cache is not None:
+            cache.stop()
+        client.close()
+        server.stop()
+        backing.close()
+
+
+def main() -> None:
+    jobs = int(os.environ.get("BENCH_CP_JOBS", "200"))
+    pods = int(os.environ.get("BENCH_CP_PODS", "8"))
+    rounds = int(os.environ.get("BENCH_CP_ROUNDS", "3"))
+    modes = os.environ.get("BENCH_CP_MODES", "store,informer").split(",")
+    results = {}
+    for mode in modes:
+        mode = mode.strip()
+        r = run_mode(mode, jobs, pods, rounds)
+        results[mode] = r
+        print(json.dumps(r), flush=True)
+    if "store" in results and "informer" in results:
+        s, i = results["store"], results["informer"]
+        print(json.dumps({
+            "metric": "controlplane_informer_speedup",
+            "jobs": jobs,
+            "pods_per_job": pods,
+            "p50_speedup": round(
+                s["sync_p50_ms"] / max(1e-9, i["sync_p50_ms"]), 2
+            ),
+            "p99_speedup": round(
+                s["sync_p99_ms"] / max(1e-9, i["sync_p99_ms"]), 2
+            ),
+            "read_qps_store_mode": s["store_read_qps"],
+            "read_qps_informer_mode": i["store_read_qps"],
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,3 +18,15 @@ http://tpu-store:{{ .Values.store.port }}
 {{- define "tpu-operator.clientTLS" -}}
 {{- if and .Values.store.tlsSecret (not .Values.store.url) -}}true{{- end -}}
 {{- end -}}
+
+{{- /* readEnabled=true makes store+agent pods mount and require
+       /etc/tpujob/read-token — but with create=true the chart renders the
+       Secret itself, and without readValue it has no read-token key to put
+       in it: every pod would crash-loop on the missing file (fail-closed,
+       but a silent values-combination footgun). Fail the RENDER instead.
+       Included by every template that gates --read-token-file. */ -}}
+{{- define "tpu-operator.validateReadToken" -}}
+{{- if and .Values.token.readEnabled .Values.token.create (not .Values.token.readValue) -}}
+{{- fail "token.readEnabled=true with token.create=true requires token.readValue (the chart-rendered Secret needs a read-token key); set token.readValue, or bring your own Secret with token.create=false" -}}
+{{- end -}}
+{{- end -}}

@@ -64,7 +64,13 @@ from mpi_operator_tpu.machinery.objects import (
     Service,
     ServiceSpec,
 )
-from mpi_operator_tpu.machinery.store import Conflict, ObjectStore, WatchEvent
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    Conflict,
+    ObjectStore,
+    WatchEvent,
+)
 from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
 from mpi_operator_tpu.opshell import metrics
 
@@ -130,8 +136,16 @@ class TPUJobController:
         store: ObjectStore,
         recorder: Optional[EventRecorder] = None,
         options: Optional[ControllerOptions] = None,
+        cache: Optional["InformerCache"] = None,
     ):
         self.store = store
+        # informer-style read path (≙ the listers syncHandler reads instead
+        # of the apiserver): when a started InformerCache is supplied, every
+        # read goes to it — writes still hit the store, and the cache
+        # observes them through its watch, exactly like client-go. Without
+        # one, reads fall through to the store (tests, runlocal).
+        self.cache = cache
+        self.read = cache if cache is not None else store
         self.options = options or ControllerOptions()
         self.recorder = recorder or EventRecorder(store)
         self.queue = RateLimitingQueue()
@@ -155,18 +169,51 @@ class TPUJobController:
 
     def run(self) -> None:
         """Start the watch pump + worker threads. Non-blocking; stop()."""
-        self._watch_q = self.store.watch(None)
-        pump = threading.Thread(target=self._pump, name="tpujob-watch-pump", daemon=True)
-        pump.start()
-        self._threads.append(pump)
+        if self.cache is not None:
+            # the workqueue is fed FROM the informer (≙ the event handlers
+            # client-go registers on the SharedInformer, :300-339): handler
+            # callbacks fire only after the cache applied the event, so a
+            # worker dequeuing the key is guaranteed a cache at-or-after
+            # that event. A separate direct store watch could enqueue a
+            # fresh job BEFORE the cache observed it — the worker's cache
+            # miss would read as "deleted", return success, and nothing
+            # would ever re-enqueue it.
+            self.cache.add_event_handler(
+                lambda etype, obj: self._pump_obj(obj)
+            )
+        else:
+            self._watch_q = self.store.watch(None)
+            pump = threading.Thread(
+                target=self._pump, name="tpujob-watch-pump", daemon=True
+            )
+            pump.start()
+            self._threads.append(pump)
         for i in range(self.options.threadiness):
             t = threading.Thread(
                 target=self._run_worker, name=f"tpujob-worker-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
-        # prime: enqueue all existing jobs (informer initial list)
-        for job in self.store.list("TPUJob", self.options.namespace):
+        # prime: enqueue all existing jobs (informer initial list) — from
+        # the cache once it has synced (≙ WaitForCacheSync before workers)
+        prime = threading.Thread(target=self._prime, name="tpujob-prime", daemon=True)
+        prime.start()
+        self._threads.append(prime)
+
+    def _wait_cache_synced(self) -> bool:
+        """Block until the informer cache (if any) has its initial snapshot,
+        or stop() was called. True = safe to reconcile."""
+        if self.cache is None:
+            return True
+        while not self._stop.is_set():
+            if self.cache.wait_for_sync(0.2):
+                return True
+        return False
+
+    def _prime(self) -> None:
+        if not self._wait_cache_synced():
+            return
+        for job in self.read.list("TPUJob", self.options.namespace):
             self.enqueue(job.metadata.key())
 
     def stop(self) -> None:
@@ -181,26 +228,30 @@ class TPUJobController:
         self.queue.add(key)
 
     def _pump(self) -> None:
-        """Watch events → job keys (≙ the event handlers of :300-339: job
-        events enqueue directly; owned-object events enqueue the controller
-        owner via handleObject)."""
+        """Direct-watch pump (cache-less wiring only): watch events → job
+        keys (≙ the event handlers of :300-339)."""
         while not self._stop.is_set():
             try:
                 ev: WatchEvent = self._watch_q.get(timeout=0.2)
             except Exception:
                 continue
-            obj = ev.obj
             if ev.kind == "Event":
                 continue
-            ns = obj.metadata.namespace
-            if self.options.namespace is not None and ns != self.options.namespace:
-                continue
-            if ev.kind == "TPUJob":
-                self.enqueue(obj.metadata.key())
-                continue
-            owner = self._controller_owner(obj)
-            if owner is not None:
-                self.enqueue(f"{ns}/{owner.name}")
+            self._pump_obj(ev.obj)
+
+    def _pump_obj(self, obj) -> None:
+        """One object observation → the TPUJob key to reconcile (job events
+        enqueue directly; owned-object events enqueue the controller owner
+        via the handleObject rule)."""
+        ns = obj.metadata.namespace
+        if self.options.namespace is not None and ns != self.options.namespace:
+            return
+        if obj.kind == "TPUJob":
+            self.enqueue(obj.metadata.key())
+            return
+        owner = self._controller_owner(obj)
+        if owner is not None:
+            self.enqueue(f"{ns}/{owner.name}")
 
     @staticmethod
     def _controller_owner(obj) -> Optional[OwnerReference]:
@@ -210,14 +261,20 @@ class TPUJobController:
         return None
 
     def _run_worker(self) -> None:
+        # a worker reconciling against a cold cache would observe an empty
+        # world — and e.g. recreate every pod of a live job (AlreadyExists
+        # storms) or mark a running job freshly Created
+        if not self._wait_cache_synced():
+            return
         while True:
             key = self.queue.get()
             if key is None:
                 return
             try:
+                # sync_handler owns the Conflict/AlreadyExists → requeue
+                # mapping (stale cached reads); only unexpected errors
+                # reach the backstop below
                 ok = self.sync_handler(key)
-            except Conflict:
-                ok = False  # stale read; retry
             except Exception:
                 log.exception("sync %s failed", key)
                 ok = False
@@ -238,7 +295,12 @@ class TPUJobController:
         t0 = time.time()
         try:
             return self._sync(key)
-        except Conflict:
+        except (Conflict, AlreadyExists):
+            # Conflict: stale read lost an update race. AlreadyExists: the
+            # cache had not yet observed a dependent this controller created
+            # moments ago (the informer lag client-go controllers absorb the
+            # same way) — requeue; the rate limiter spaces the retry past
+            # the watch latency.
             return False
         except RuntimeError as e:
             log.warning("sync %s: %s", key, e)
@@ -248,7 +310,7 @@ class TPUJobController:
 
     def _sync(self, key: str) -> bool:
         namespace, name = key.split("/", 1)
-        job = self.store.try_get("TPUJob", namespace, name)
+        job = self.read.try_get("TPUJob", namespace, name)
         if job is None:
             with self._port_lock:  # release the port reservation
                 self._ports_inflight.pop(key, None)
@@ -344,14 +406,14 @@ class TPUJobController:
         return {LABEL_JOB_NAME: job.name}
 
     def _list_workers(self, job: TPUJob) -> List[Pod]:
-        pods = self.store.list("Pod", job.namespace, selector=self._selector(job))
+        pods = self.read.list("Pod", job.namespace, selector=self._selector(job))
         pods.sort(key=lambda p: int(p.metadata.labels.get(LABEL_REPLICA_INDEX, "0")))
         return pods
 
     def _get_or_create_service(self, job: TPUJob) -> Service:
         """Headless service giving workers stable DNS (≙ newWorkersService
         :1141-1171)."""
-        existing = self.store.try_get("Service", job.namespace, job.service_name())
+        existing = self.read.try_get("Service", job.namespace, job.service_name())
         if existing is not None:
             self._check_owned(job, existing)
             return existing
@@ -390,7 +452,7 @@ class TPUJobController:
                 return reserved
             used = {
                 j.status.coordinator_port
-                for j in self.store.list("TPUJob")
+                for j in self.read.list("TPUJob")
                 if j.status.coordinator_port
                 and j.metadata.uid != job.metadata.uid
                 and not cond.is_finished(j.status)
@@ -438,7 +500,7 @@ class TPUJobController:
 
     def _get_or_create_configmap(self, job: TPUJob, workers: List[Pod]) -> ConfigMap:
         data = self._config_data(job, workers)
-        existing = self.store.try_get("ConfigMap", job.namespace, job.config_name())
+        existing = self.read.try_get("ConfigMap", job.namespace, job.config_name())
         if existing is not None:
             self._check_owned(job, existing)
             if existing.data != data:
@@ -469,7 +531,7 @@ class TPUJobController:
         because there is no launcher pod). A schedulingPolicy.minAvailable
         overrides, on both the create and the reconcile-update path."""
         desired = self._desired_min_member(job)
-        existing = self.store.try_get("PodGroup", job.namespace, job.podgroup_name())
+        existing = self.read.try_get("PodGroup", job.namespace, job.podgroup_name())
         if existing is not None:
             self._check_owned(job, existing)
             if existing.spec.min_member != desired:
@@ -838,7 +900,7 @@ class TPUJobController:
     def _default_write_status(self, job: TPUJob) -> bool:
         """Persist status only when it changed (≙ UpdateStatus-on-change,
         :602 + :921-996 tail). Conflict → requeue (False)."""
-        stored = self.store.try_get("TPUJob", job.namespace, job.name)
+        stored = self.read.try_get("TPUJob", job.namespace, job.name)
         if stored is None:
             return True
         if stored.status.to_dict() == job.status.to_dict():

@@ -43,8 +43,15 @@ class NodeMonitor:
         *,
         grace: float = 6.0,
         interval: float = 1.0,
+        cache=None,
     ):
         self.store = store
+        # informer read path: the per-tick Node scan (and the Pod scan when
+        # nodes are stale) reads the watch-fed cache when one is wired — a
+        # 1 Hz full list against the store was pure cache-miss traffic.
+        # Evictions/mark-not-ready still write via optimistic re-reads.
+        self.cache = cache
+        self.read = cache if cache is not None else store
         self.recorder = recorder or EventRecorder(
             store, component="tpujob-node-monitor"
         )
@@ -70,9 +77,11 @@ class NodeMonitor:
                 log.exception("node monitor sync failed")  # next tick retries
 
     def sync(self) -> None:
+        if self.cache is not None and not self.cache.has_synced():
+            return  # cold cache = empty world; next tick retries
         now = time.time()
         stale = []
-        for node in self.store.list("Node", NODE_NAMESPACE):
+        for node in self.read.list("Node", NODE_NAMESPACE):
             hb = node.status.last_heartbeat
             if not hb:
                 continue  # static node: no heartbeat contract
@@ -109,7 +118,7 @@ class NodeMonitor:
         )
 
     def _evict_pods(self, stale_nodes: set) -> None:
-        for pod in self.store.list("Pod"):
+        for pod in self.read.list("Pod"):
             if pod.spec.node_name not in stale_nodes or pod.is_finished():
                 continue
             node_name = pod.spec.node_name

@@ -30,3 +30,4 @@ from mpi_operator_tpu.machinery.store import (  # noqa: F401
 )
 from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue  # noqa: F401
 from mpi_operator_tpu.machinery.events import EventRecorder  # noqa: F401
+from mpi_operator_tpu.machinery.cache import InformerCache, Lister  # noqa: F401

@@ -1,0 +1,369 @@
+"""Informer/lister cache: watch-fed local reads for the control plane.
+
+≙ client-go's SharedInformer + indexed Lister pair, the machinery the whole
+reference control plane reads through (informer wiring in
+NewMPIJobController, v2/pkg/controller/mpi_job_controller.go:248-341;
+syncHandler reads listers, never the apiserver, :443-608). Before this
+module, every reconcile issued full ``store.list`` round-trips — over HTTP
+in the distributed deployment — so store load scaled as
+O(jobs × pods × resyncs). With it:
+
+- **One watch feeds everything.** The cache registers a single
+  ``store.watch(None)``, snapshots every kind with an initial LIST, then
+  applies events forever. Components read via :meth:`InformerCache.get` /
+  ``list`` — the same duck-typed read surface as a store — and the steady-
+  state store traffic drops to writes plus one long-poll.
+- **Label indices.** Kinds are indexed by configured label keys (by default
+  ``tpujob.dev/job-name``), so "this job's workers" is a dict hit, not a
+  scan over every pod in the cluster (≙ the namespace/label indexers every
+  client-go lister is built on).
+- **has_synced gating.** Reads before the initial snapshot completes would
+  observe an empty world and make eager decisions (delete "missing"
+  dependents, admit gangs against phantom-free capacity); consumers gate on
+  :meth:`has_synced` exactly like client-go's WaitForCacheSync.
+- **Resync correctness.** Events are applied under a resource_version guard
+  (strictly increasing per object now that deletes also bump rv), so the
+  LIST-vs-watch interleave can never regress the cache. When a backend has
+  to relist after a watch gap (SqliteStore poll stall, http server restart
+  past the event ring), the per-object MODIFIED replay cannot express
+  deletions — so the cache registers a relist listener
+  (``add_relist_listener``) and REPLACES its world from the snapshot,
+  closing the deleted-object leak.
+
+Writes never go through the cache: components keep writing to the store and
+observe their own updates through the watch, exactly like client-go.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    NotFound,
+    WatchEvent,
+)
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.cache")
+
+# the one label every control-plane lookup keys on (duplicated from
+# controller/controller.py so machinery stays import-light; the controller
+# tests assert the two never drift)
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+
+# default kind set mirrors machinery.objects.KINDS minus Event: events are
+# an append-only audit stream nobody ever gets/lists on the hot path, and
+# caching them would grow the cache without bound
+DEFAULT_KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Node")
+
+
+class _Relist:
+    """Queue marker carrying a full live-object snapshot (watch-gap
+    recovery): the drain loop replaces the cached world with it."""
+
+    def __init__(self, objects: List[Any]):
+        self.objects = objects
+
+
+def _rv(obj: Any) -> int:
+    return obj.metadata.resource_version or 0
+
+
+class Lister:
+    """Read-only, thread-safe view over one kind. Objects are deep-copied on
+    the way out — the informer-cache rule ("read-only + DeepCopy before
+    mutation", SURVEY.md §5.2) enforced mechanically, because controller
+    code mutates what it reads."""
+
+    def __init__(self, kind: str, index_labels: Tuple[str, ...] = ()):
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str], Any] = {}  # (ns, name) → obj
+        # label key → label value → {(ns, name)}
+        self._index_labels = tuple(index_labels)
+        self._index: Dict[str, Dict[str, set]] = {
+            k: {} for k in self._index_labels
+        }
+
+    # -- mutation (informer thread only) ------------------------------------
+
+    def _unindex(self, key: Tuple[str, str], obj: Any) -> None:
+        for lk in self._index_labels:
+            lv = obj.metadata.labels.get(lk)
+            if lv is None:
+                continue
+            bucket = self._index[lk].get(lv)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._index[lk][lv]
+
+    def _reindex(self, key: Tuple[str, str], obj: Any) -> None:
+        for lk in self._index_labels:
+            lv = obj.metadata.labels.get(lk)
+            if lv is not None:
+                self._index[lk].setdefault(lv, set()).add(key)
+
+    def apply(self, etype: str, obj: Any) -> None:
+        """Apply one watch event under the rv guard: a stale event (queued
+        before a fresher LIST/relist merged) can never regress the cache."""
+        key = (obj.metadata.namespace, obj.metadata.name)
+        with self._lock:
+            cur = self._objects.get(key)
+            if cur is not None and _rv(obj) < _rv(cur):
+                return  # stale replay
+            if etype == DELETED:
+                if cur is not None:
+                    self._unindex(key, cur)
+                    del self._objects[key]
+                return
+            if cur is not None:
+                self._unindex(key, cur)
+            self._objects[key] = obj
+            self._reindex(key, obj)
+
+    def merge(self, objects: List[Any]) -> None:
+        """Merge an initial LIST snapshot: upsert under the rv guard without
+        deleting — events already applied may be fresher than the snapshot,
+        never the other way around."""
+        with self._lock:
+            for obj in objects:
+                self.apply(MODIFIED, obj)
+
+    def replace(self, objects: List[Any]) -> None:
+        """Full-state replacement (watch-gap relist): anything absent from
+        the snapshot was deleted inside the gap and is dropped — the leak a
+        MODIFIED-only replay cannot close. Present objects still merge under
+        the rv guard (an event that raced ahead of the snapshot wins)."""
+        with self._lock:
+            keep = {(o.metadata.namespace, o.metadata.name) for o in objects}
+            for key in [k for k in self._objects if k not in keep]:
+                self._unindex(key, self._objects[key])
+                del self._objects[key]
+            for obj in objects:
+                self.apply(MODIFIED, obj)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._objects.get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{self.kind} {namespace}/{name} not found")
+            return obj.deepcopy()
+
+    def try_get(self, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(namespace, name)
+        except NotFound:
+            return None
+
+    def by_label(self, label_key: str, label_value: str) -> List[Any]:
+        """Indexed lookup: every cached object carrying label_key=label_value
+        (label_key must be one of the configured index labels)."""
+        with self._lock:
+            keys = self._index[label_key].get(label_value, ())
+            out = [self._objects[k].deepcopy() for k in keys]
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        """Same contract (and sort order) as ``store.list(kind, ...)``. When
+        the selector carries an indexed label the candidate set is a dict
+        hit; the remaining selector pairs and the namespace filter apply on
+        top."""
+        with self._lock:
+            candidates = None
+            if selector:
+                for lk in self._index_labels:
+                    if lk in selector:
+                        keys = self._index[lk].get(selector[lk], ())
+                        candidates = [self._objects[k] for k in keys]
+                        break
+            if candidates is None:
+                candidates = self._objects.values()
+            out = []
+            for obj in candidates:
+                m = obj.metadata
+                if namespace is not None and m.namespace != namespace:
+                    continue
+                if selector and any(
+                    m.labels.get(sk) != sv for sk, sv in selector.items()
+                ):
+                    continue
+                out.append(obj.deepcopy())
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class InformerCache:
+    """Watch-fed cache over every control-plane kind, exposing the store's
+    read surface (get/try_get/list) plus per-kind indexed listers.
+
+    Lifecycle: ``start()`` registers the watch, then a background thread
+    takes the initial LIST snapshot, flips :meth:`has_synced`, and applies
+    events until ``stop()``. Consumers that would act on an empty world
+    must gate on ``has_synced()`` / ``wait_for_sync()`` (≙ client-go's
+    WaitForCacheSync before starting workers).
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        kinds: Tuple[str, ...] = DEFAULT_KINDS,
+        index_labels: Tuple[str, ...] = (LABEL_JOB_NAME,),
+    ):
+        self.store = store
+        self.kinds = tuple(kinds)
+        self._listers: Dict[str, Lister] = {
+            k: Lister(k, index_labels) for k in self.kinds
+        }
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._q = None
+        self._thread: Optional[threading.Thread] = None
+        self._handlers_lock = threading.Lock()
+        self._handlers: List = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InformerCache":
+        """Register the watch (and the relist listener, when the backend can
+        gap) BEFORE listing: events raced between watch registration and the
+        LIST are queued and merge under the rv guard, so nothing is missed —
+        the list-then-watch ordering a kube Reflector needs its
+        resourceVersion anchor for, inverted to fit this watch contract."""
+        if self._thread is not None:
+            return self
+        self._q = self.store.watch(None)
+        add_listener = getattr(self.store, "add_relist_listener", None)
+        if callable(add_listener):
+            add_listener(self._on_relist)
+        self._thread = threading.Thread(
+            target=self._run, name="informer-cache", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._q is not None:
+            self.store.stop_watch(self._q)
+            self._q.put(None)  # wake the drain
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_sync(self, timeout: Optional[float] = None) -> bool:
+        return self._synced.wait(timeout)
+
+    def add_event_handler(self, cb) -> None:
+        """Register ``cb(etype, obj)``, invoked on the informer thread AFTER
+        each event is applied to its lister (relists fire MODIFIED per
+        surviving object). THE workqueue coupling of client-go: a consumer
+        that enqueues work from this callback is guaranteed the cache
+        already reflects the event when the work is processed — an enqueue
+        fed by a separate direct store watch can race ahead of the cache,
+        read a miss, and drop the key forever."""
+        with self._handlers_lock:
+            self._handlers.append(cb)
+
+    def _fire(self, etype: str, obj: Any) -> None:
+        with self._handlers_lock:
+            handlers = list(self._handlers)
+        for cb in handlers:
+            try:
+                cb(etype, obj)
+            except Exception:
+                log.exception("informer event handler failed")
+
+    # -- pump ----------------------------------------------------------------
+
+    def _on_relist(self, objects: List[Any]) -> None:
+        """Relist listener (store poll thread): enqueue the snapshot as a
+        marker IN EVENT ORDER — the drain loop replaces the world when it
+        reaches it, so deletions inside the gap are dropped."""
+        if self._q is not None:
+            self._q.put(_Relist(objects))
+
+    def _initial_sync(self) -> None:
+        for kind in self.kinds:
+            if self._stop.is_set():
+                return
+            while not self._stop.is_set():
+                try:
+                    self._listers[kind].merge(self.store.list(kind))
+                    break
+                except Exception:
+                    # store briefly unreachable at startup: informer
+                    # backoff-and-retry; has_synced stays False so gated
+                    # consumers keep waiting
+                    log.warning("initial list of %s failed; retrying", kind,
+                                exc_info=True)
+                    if self._stop.wait(0.5):
+                        return
+        self._synced.set()
+        for kind in self.kinds:
+            metrics.informer_objects.set(len(self._listers[kind]), kind=kind)
+        metrics.informer_synced.set(1)
+
+    def _run(self) -> None:
+        self._initial_sync()
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.2)
+            except Exception:
+                continue
+            if item is None:
+                continue  # stop() wake-up
+            if isinstance(item, _Relist):
+                by_kind: Dict[str, List[Any]] = {k: [] for k in self.kinds}
+                for obj in item.objects:
+                    if obj.kind in by_kind:
+                        by_kind[obj.kind].append(obj)
+                for kind, objs in by_kind.items():
+                    self._listers[kind].replace(objs)
+                    metrics.informer_objects.set(
+                        len(self._listers[kind]), kind=kind)
+                for objs in by_kind.values():
+                    for obj in objs:
+                        self._fire(MODIFIED, obj)
+                continue
+            ev: WatchEvent = item
+            lister = self._listers.get(ev.kind)
+            if lister is not None and ev.type in (ADDED, MODIFIED, DELETED):
+                lister.apply(ev.type, ev.obj)
+                metrics.informer_objects.set(len(lister), kind=ev.kind)
+                self._fire(ev.type, ev.obj)
+
+    # -- read surface (duck-typed like a store, reads only) ------------------
+
+    def lister(self, kind: str) -> Lister:
+        return self._listers[kind]
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return self._listers[kind].get(namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self._listers[kind].try_get(namespace, name)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        return self._listers[kind].list(namespace, selector)

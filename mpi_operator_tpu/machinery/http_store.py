@@ -18,9 +18,16 @@ v2/pkg/client/). This module is that pair for this framework:
 
 Watch semantics match the file-backed store: the server keeps a bounded
 in-memory event log with contiguous sequence numbers; clients long-poll
-``/v1/watch?after=N``. A client that falls behind the retention window gets
-a relist (every live object as MODIFIED) — the kube "resourceVersion too
-old" → relist contract, same recovery path as SqliteStore._relist_to.
+``/v1/watch?after=N``. Every event also carries the object's (strictly
+increasing) resource_version, and a client whose seq cursor is invalid —
+server restarted, fell off the retention window — may present
+``?resource_version=N`` to resume: the server replays the ring from the
+first event with rv > N when it can prove completeness, and otherwise
+falls back to a relist (every live object as MODIFIED) — the kube
+"resourceVersion too old" (410 Gone) → relist contract, same recovery
+path as SqliteStore._relist_to. The informer cache (machinery/cache.py)
+rides this seam: lister reads come from the watch-fed cache, so the store
+sees only writes and one long-poll, not a LIST per reconcile.
 
 Run standalone (the etcd-equivalent process):
 
@@ -212,14 +219,28 @@ class _EventLog:
     """Bounded event log with contiguous seqs and blocking reads.
 
     ≙ etcd's revision-indexed watch window: readers cursor by seq; a reader
-    whose cursor fell off the retained window must relist.
+    whose cursor fell off the retained window must relist — or, since every
+    event also records the object's strictly-increasing resource_version,
+    resume by rv (``resume_after_rv``) when the ring provably retains the
+    full history past that rv.
     """
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self._cond = threading.Condition()
-        self._events: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        self._events: List[Tuple[int, str, str, Dict[str, Any], int]] = []
         self._next_seq = 1
+        # rv completeness bounds for resume_after_rv: events with
+        # rv <= _base_rv predate this server incarnation (unknown history);
+        # _dropped_rv is the highest rv trimmed out of the ring. None base =
+        # the backing store exposes no current_rv() → resume never provable.
+        self._base_rv: Optional[int] = None
+        self._dropped_rv = 0
+        self._max_rv = 0
+
+    def set_base_rv(self, rv: Optional[int]) -> None:
+        with self._cond:
+            self._base_rv = rv
 
     @property
     def head(self) -> int:
@@ -227,13 +248,46 @@ class _EventLog:
         with self._cond:
             return self._next_seq - 1
 
-    def append(self, etype: str, kind: str, data: Dict[str, Any]) -> None:
+    def watermark_rv(self) -> int:
+        """Highest rv this incarnation can vouch for (base ∨ newest event)."""
         with self._cond:
-            self._events.append((self._next_seq, etype, kind, data))
+            return max(self._base_rv or 0, self._max_rv)
+
+    def append(self, etype: str, kind: str, data: Dict[str, Any],
+               rv: int = 0) -> None:
+        with self._cond:
+            self._events.append((self._next_seq, etype, kind, data, rv))
             self._next_seq += 1
+            self._max_rv = max(self._max_rv, rv)
             if len(self._events) > self.capacity:
-                del self._events[: len(self._events) - self.capacity]
+                drop = len(self._events) - self.capacity
+                self._dropped_rv = max(
+                    self._dropped_rv, max(e[4] for e in self._events[:drop])
+                )
+                del self._events[:drop]
             self._cond.notify_all()
+
+    def resume_after_rv(
+        self, rv: int
+    ) -> Optional[List[Tuple[int, str, str, Dict[str, Any], int]]]:
+        """Events with object rv > ``rv``, oldest first — or None when the
+        ring cannot PROVE it retains every such event (rv predates this
+        incarnation's base, or needed events were trimmed): the caller must
+        relist (the kube 410 Gone fallback). A complete empty replay is a
+        valid resume (the client missed nothing)."""
+        with self._cond:
+            if self._base_rv is None or rv < self._base_rv:
+                return None
+            if rv < self._dropped_rv:
+                return None
+            if rv > max(self._base_rv, self._max_rv):
+                # an anchor ABOVE everything this incarnation has vouched
+                # for can only come from a different/reset rv space (e.g. a
+                # restarted in-memory backing): treating it as an empty
+                # resume would silently strand the client on its old-world
+                # cache — relist instead
+                return None
+            return [e for e in self._events if e[4] > rv]
 
     def read_after(
         self, after: int, timeout: float
@@ -296,6 +350,15 @@ class StoreServer:
                     f"agent token for node {node!r} duplicates the "
                     f"admin/read token; every tier needs a distinct secret"
                 )
+        if read_token is not None and read_token == token:
+            # same fail-closed rule as the agent tier: check_bearer matches
+            # the admin entry first, so a read token misconfigured to the
+            # admin value would silently grant holders of the "read-only"
+            # credential full mutation rights
+            raise ValueError(
+                "read token duplicates the admin token; every tier needs "
+                "a distinct secret"
+            )
         if token is None and (read_token is not None or auth_reads):
             # the CLIs guard this combination too, but an embedded caller
             # passing read_token/auth_reads without the anchoring admin
@@ -320,6 +383,12 @@ class StoreServer:
             # (below) a silent client occupies a handler thread until first
             # read; this bounds it. Must exceed the 55s watch long-poll cap.
             timeout = 65.0
+            # TCP_NODELAY (consulted by StreamRequestHandler.setup, so it
+            # must live on the HANDLER, not the server class): the response
+            # is written as status/headers then body — with Nagle on, the
+            # body segment waits on the peer's delayed ACK (tens of ms per
+            # request), dwarfing the actual store work on every get/list
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet
                 pass
@@ -386,10 +455,9 @@ class StoreServer:
                     return (403, "the read-only token cannot mutate "
                                  "(server runs with --read-token-file)")
                 if agent_node is not None:
-                    msg = server._agent_denied(
+                    return server._agent_denied(
                         method, self.path, body(), agent_node
                     )
-                    return None if msg is None else (403, msg)
                 return (401, "missing or invalid bearer token "
                              "(server runs with --token-file)")
 
@@ -414,8 +482,13 @@ class StoreServer:
                     if denied is not None:
                         code, msg = denied
                         self._send(code, {
-                            "error": "Forbidden" if code == 403
-                            else "Unauthorized",
+                            # 409 Conflict: agent-tier writes whose stale rv
+                            # would race a concurrent operator write are
+                            # bounced BEFORE authz can be gamed — the client
+                            # surfaces it as Conflict so optimistic retry
+                            # loops re-read instead of aborting
+                            "error": {403: "Forbidden", 409: "Conflict"}.get(
+                                code, "Unauthorized"),
                             "message": msg,
                         })
                         return
@@ -500,7 +573,21 @@ class StoreServer:
                 do_handshake_on_connect=False,
             )
         self.host, self.port = self._httpd.server_address[:2]
+        # request counters (read by bench_controlplane.py to measure the
+        # store-side read load informer caches remove); plain dict under a
+        # lock — snapshot with stats()
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "get": 0, "list": 0, "watch": 0,
+            "create": 0, "update": 0, "delete": 0, "relist": 0,
+        }
         self._watch_q = backing.watch(None)
+        # rv anchor: everything at or below the backing's CURRENT rv is
+        # outside this incarnation's event ring, so ?resource_version=
+        # resume is provable only above it (registered-watch events all
+        # land later). Backings without current_rv() never prove resume.
+        current_rv = getattr(backing, "current_rv", None)
+        self._log.set_base_rv(current_rv() if callable(current_rv) else None)
         self._drain = threading.Thread(
             target=self._drain_loop, name="http-store-drain", daemon=True
         )
@@ -536,20 +623,34 @@ class StoreServer:
             if isinstance(ev, _RegistrationBarrier):
                 ev.reached.set()
                 continue
-            self._log.append(ev.type, ev.kind, encode(ev.obj))
+            self._log.append(
+                ev.type, ev.kind, encode(ev.obj),
+                ev.obj.metadata.resource_version or 0,
+            )
+
+    def stats(self) -> Dict[str, int]:
+        """Snapshot of per-route request counters (reads: get/list/watch;
+        writes: create/update/delete; relist = full-state recoveries served)."""
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def _count(self, what: str) -> None:
+        with self._stats_lock:
+            self._stats[what] = self._stats.get(what, 0) + 1
 
     # -- authorization ------------------------------------------------------
 
     def _agent_denied(
         self, method: str, path: str, body: Dict[str, Any], node: str
-    ) -> Optional[str]:
+    ) -> Optional[Tuple[int, str]]:
         """The NODE tier's scope (≙ the kubelet's node-restricted
         credential): reads everywhere; create/update ITS OWN Node; update
-        pods CURRENTLY bound to its node (without rebinding them). None
-        when allowed, else the 403 message. The current binding is checked
-        against the BACKING store, not the submitted object — a compromised
-        agent must not claim another node's pod by writing its own name
-        into spec.node_name."""
+        pods CURRENTLY bound to its node (without rebinding, relabeling, or
+        re-uid-ing them). None when allowed, else ``(status, message)`` —
+        403 for out-of-scope, 409 for stale-rv writes that must retry. The
+        current binding is checked against the BACKING store, not the
+        submitted object — a compromised agent must not claim another
+        node's pod by writing its own name into spec.node_name."""
         from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
 
         parts = _route_parts(path)
@@ -564,7 +665,8 @@ class StoreServer:
                 and meta.get("name") == node
             ):
                 return None  # its own registration
-            return (f"agent {node!r} may only create its own Node object, "
+            return (403,
+                    f"agent {node!r} may only create its own Node object, "
                     f"not {body.get('kind')}/{meta.get('name')}")
         if (
             method == "PUT"
@@ -577,12 +679,13 @@ class StoreServer:
                 # agent could clobber a concurrent rebind/eviction/reaper
                 # write without a Conflict ever surfacing. The real agent
                 # uses optimistic conflict-retry everywhere.
-                return (f"agent {node!r} may not force-update (optimistic "
+                return (403,
+                        f"agent {node!r} may not force-update (optimistic "
                         f"writes only — retry on Conflict)")
             kind, ns, name = parts[2:]
             if kind == "Node":
                 if ns != NODE_NAMESPACE or name != node:
-                    return f"agent {node!r} may only update its own Node"
+                    return 403, f"agent {node!r} may only update its own Node"
                 status = obj.get("status")
                 status = status if isinstance(status, dict) else {}
                 try:
@@ -590,40 +693,74 @@ class StoreServer:
                     cordoned = stored.status.unschedulable
                     stored_rv = stored.metadata.resource_version
                 except KeyError:
-                    cordoned = False
-                    stored_rv = None
+                    return None  # authz before existence; backing 404s it
                 submitted_rv = (obj.get("metadata") or {}).get(
                     "resource_version"
                 )
-                if (
-                    bool(status.get("unschedulable", False)) != bool(cordoned)
-                    and submitted_rv == stored_rv
-                ):
+                if submitted_rv != stored_rv:
+                    # stale (or predicted-future) rv: bounce with Conflict
+                    # BEFORE the scope checks below. The old rule denied a
+                    # cordon flip only when submitted rv == stored rv, which
+                    # was TOCTOU-racy: an agent could submit a future rv
+                    # (mismatch at authz → allowed) while a concurrent benign
+                    # heartbeat advanced the node to exactly that rv, landing
+                    # the un-cordon. Conflict preserves the benign agent's
+                    # optimistic retry loop (re-read, preserve the flag,
+                    # retry) where a 403 would abort it.
+                    return (409,
+                            f"Node {ns}/{name}: resource_version "
+                            f"{submitted_rv} != {stored_rv}")
+                if bool(status.get("unschedulable", False)) != bool(cordoned):
                     # the cordon flag belongs to the OPERATOR (`ctl
                     # cordon/drain` is containment against exactly a
                     # compromised node): an agent un-cordoning itself would
-                    # pull other tenants' gangs back onto it. Deny ONLY
-                    # when the write would otherwise land (same resource
-                    # version): a stale copy from a benign cordon-vs-
-                    # heartbeat race must surface as Conflict so the
-                    # agent's optimistic retry re-reads and preserves the
-                    # flag — a 403 there would abort the retry loop.
-                    return (f"agent {node!r} may not change its own "
+                    # pull other tenants' gangs back onto it
+                    return (403,
+                            f"agent {node!r} may not change its own "
                             f"cordon flag (status.unschedulable)")
                 return None  # its own heartbeat
             if kind == "Pod":
                 spec = obj.get("spec")
                 spec = spec if isinstance(spec, dict) else {}
+                if (
+                    meta.get("name", name) != name
+                    or meta.get("namespace", ns) != ns
+                ):
+                    # body identity disagrees with the URL: the handler's
+                    # URL/body integrity wall 400s this for every tier —
+                    # fall through so the response stays a BadRequest, not
+                    # a misleading scope denial
+                    return None
                 try:
                     cur = self.backing.get("Pod", ns, name)
-                    bound_to = cur.spec.node_name
                 except KeyError:
-                    bound_to = None  # authz before existence, like kube RBAC
-                if bound_to == node and spec.get("node_name") == node:
-                    return None  # status mirror / eviction of its own pod
-                return (f"agent {node!r} may only update pods bound to its "
-                        f"node (pod {ns}/{name} is bound to {bound_to!r})")
-        return f"agent {node!r} may not {method} this route"
+                    return (403,
+                            f"agent {node!r} may only update pods bound to "
+                            f"its node (pod {ns}/{name} is bound to None)")
+                bound_to = cur.spec.node_name
+                if bound_to != node or spec.get("node_name") != node:
+                    return (403,
+                            f"agent {node!r} may only update pods bound to "
+                            f"its node (pod {ns}/{name} is bound to "
+                            f"{bound_to!r})")
+                # identity pinning: labels and uid are controller-owned. An
+                # agent that could relabel a pod (LABEL_JOB_NAME) would
+                # inject it into another job's worker set — controller and
+                # scheduler group pods purely by that label — triggering
+                # spurious gang restarts or permanently failing another
+                # tenant's job. Same for uid: the eviction/phase guards key
+                # incarnations off it.
+                if meta.get("uid", cur.metadata.uid) != cur.metadata.uid:
+                    return (403,
+                            f"agent {node!r} may not change metadata.uid "
+                            f"of pod {ns}/{name}")
+                if (meta.get("labels") or {}) != (cur.metadata.labels or {}):
+                    return (403,
+                            f"agent {node!r} may not change metadata.labels "
+                            f"of pod {ns}/{name} (labels are "
+                            f"controller-owned identity)")
+                return None  # status mirror / eviction of its own pod
+        return 403, f"agent {node!r} may not {method} this route"
 
     # -- request handling ---------------------------------------------------
 
@@ -661,9 +798,11 @@ class StoreServer:
     ) -> Tuple[int, Dict[str, Any]]:
         if method == "POST" and not rest:
             obj = decode(body["kind"], body["object"])
+            self._count("create")
             created = self.backing.create(obj)
             return 200, {"object": encode(created)}
         if method == "GET" and len(rest) == 1:
+            self._count("list")
             kind = rest[0]
             namespace = qs.get("namespace", [None])[0]
             selector = None
@@ -685,6 +824,7 @@ class StoreServer:
         if len(rest) == 3:
             kind, namespace, name = rest
             if method == "GET":
+                self._count("get")
                 return 200, {"object": encode(self.backing.get(kind, namespace, name))}
             if method == "PUT":
                 obj = decode(kind, body["object"])
@@ -706,8 +846,10 @@ class StoreServer:
                         ),
                     }
                 force = _force_requested(qs)
+                self._count("update")
                 return 200, {"object": encode(self.backing.update(obj, force=force))}
             if method == "DELETE":
+                self._count("delete")
                 return 200, {"object": encode(self.backing.delete(kind, namespace, name))}
         return 404, {"error": "NotFound", "message": "bad objects route"}
 
@@ -715,12 +857,21 @@ class StoreServer:
         try:
             after = int(qs.get("after", ["-1"])[0])
             timeout = min(float(qs.get("timeout", ["25"])[0]), 55.0)
+            resume_rv = qs.get("resource_version", [None])[0]
+            resume_rv = int(resume_rv) if resume_rv is not None else None
         except ValueError as e:
             # malformed query from a skewed client: a 400, not an opaque 500
             # (same posture as the selector parameter above)
             return 400, {"error": "BadRequest", "message": f"bad watch param: {e}"}
+        self._count("watch")
         client_instance = qs.get("instance", [self.instance])[0]
         if after < 0:
+            if resume_rv is not None:
+                # rv-anchored (re)registration: a client (typically an
+                # informer cache) that has observed everything up to
+                # resume_rv asks for the tail — replayed from the ring when
+                # provable, relist otherwise (the 410 Gone fallback)
+                return 200, self._resume_or_relist(resume_rv)
             # registration: hand the current head so the client sees only
             # post-registration events (ObjectStore watch semantics); the
             # barrier makes sure already-committed events are in the log
@@ -734,31 +885,55 @@ class StoreServer:
             }
         if client_instance != self.instance:
             # cursor from a previous incarnation: its seqs mean nothing in
-            # this log (even if numerically <= head) → relist
-            return 200, self._relist_payload()
+            # this log (even if numerically <= head) — but the client's rv
+            # anchor is backed by the DURABLE store sequence, so a restarted
+            # server can often resume a caught-up client without a relist
+            return 200, self._resume_or_relist(resume_rv)
         events, head = self._log.read_after(after, timeout)
         if events is None:
-            # cursor fell off the window → relist (kube 'rv too old')
-            return 200, self._relist_payload()
+            # cursor fell off the window → rv resume or relist ('rv too old')
+            return 200, self._resume_or_relist(resume_rv)
         return 200, {
             "events": [
-                {"seq": s, "type": t, "kind": k, "object": d}
-                for (s, t, k, d) in events
+                {"seq": s, "type": t, "kind": k, "object": d, "rv": rv}
+                for (s, t, k, d, rv) in events
             ],
             "next": head,
             "instance": self.instance,
         }
+
+    def _resume_or_relist(self, resume_rv: Optional[int]) -> Dict[str, Any]:
+        """Serve an rv-anchored resume from the event ring when the ring
+        provably retains every event past ``resume_rv``; otherwise fall back
+        to a full relist (≙ kube's 410 Gone → relist)."""
+        if resume_rv is not None:
+            events = self._log.resume_after_rv(resume_rv)
+            if events is not None:
+                return {
+                    "events": [
+                        {"seq": s, "type": t, "kind": k, "object": d, "rv": rv}
+                        for (s, t, k, d, rv) in events
+                    ],
+                    "next": events[-1][0] if events else self._log.head,
+                    "instance": self.instance,
+                }
+        return self._relist_payload()
 
     def _relist_payload(self) -> Dict[str, Any]:
         # capture the cursor BEFORE listing: an event appended during the
         # list then replays after the relist (benign for level-triggered
         # consumers) instead of being skipped (lost update) — the same
         # ordering SqliteStore._poll_loop uses for its gap recovery
+        self._count("relist")
         head = self._log.head
+        watermark = self._log.watermark_rv()
         objs = []
         for kind in _all_kinds():
             objs.extend(encode(o) for o in self.backing.list(kind))
-        return {"relist": objs, "next": head, "instance": self.instance}
+        return {
+            "relist": objs, "next": head, "instance": self.instance,
+            "rv": watermark,
+        }
 
 
 def _all_kinds() -> List[str]:
@@ -799,8 +974,14 @@ class HttpStoreClient:
             self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
         self._lock = threading.RLock()
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        self._relist_listeners: List = []
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # highest object resource_version observed on the watch: the DURABLE
+        # resume anchor. When the seq cursor goes stale (server restart,
+        # fell off the event window) the server replays from this rv out of
+        # its ring instead of relisting, whenever it can prove completeness.
+        self._max_rv = 0
 
     # -- transport ----------------------------------------------------------
 
@@ -916,6 +1097,16 @@ class HttpStoreClient:
         with self._lock:
             self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
 
+    def add_relist_listener(self, cb) -> None:
+        """Register ``cb(objects)``: invoked on the poll thread, in event
+        order, with the full live-object snapshot whenever the watch had to
+        relist. Informer caches require this — a relist's MODIFIED stream
+        cannot express deletions that happened inside the gap, so the cache
+        replaces its world from the snapshot instead (same contract as
+        SqliteStore.add_relist_listener)."""
+        with self._lock:
+            self._relist_listeners.append(cb)
+
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -923,33 +1114,65 @@ class HttpStoreClient:
                     "GET",
                     f"/v1/watch?after={self._cursor}"
                     f"&timeout={self.watch_poll_timeout}"
-                    f"&instance={self._instance}",
+                    f"&instance={self._instance}"
+                    + (f"&resource_version={self._max_rv}"
+                       if self._max_rv else ""),
                     timeout=self.watch_poll_timeout + self.timeout,
                 )
             except Exception:
                 # server briefly unreachable (restart, network): informer
-                # backoff-and-retry, cursor preserved; the echoed instance
-                # id makes the restarted server relist us regardless of
-                # where its new seq space has advanced to
+                # backoff-and-retry, cursor preserved; on reconnect the rv
+                # anchor above lets a restarted server REPLAY the gap from
+                # its ring when provable — the relist is the fallback, not
+                # the first resort
                 if self._stop.wait(0.5):
                     return
                 continue
             try:
                 with self._lock:
                     watchers = list(self._watchers)
+                    listeners = list(self._relist_listeners)
                 if "relist" in r:
+                    objs = []
                     for d in r["relist"]:
-                        self._fan_out(watchers, MODIFIED, d)
+                        obj = self._decode_event(d)
+                        if obj is not None:
+                            objs.append(obj)
+                    # listeners first: a cache's world-replacement must
+                    # precede the per-object MODIFIED replay it subsumes
+                    for cb in listeners:
+                        try:
+                            cb([o.deepcopy() for o in objs])
+                        except Exception:
+                            pass  # a broken listener must not kill the poll
+                    for obj in objs:
+                        self._fan_out(watchers, MODIFIED, obj)
                     # cursor and instance move together, only after the
                     # relist fully lands: adopting the new instance id with
                     # the old cursor would satisfy the server's instance
                     # check and silently skip everything before the cursor
                     self._cursor = r["next"]
                     self._instance = r.get("instance", self._instance)
+                    # ADOPT the relist watermark, never max() with the old
+                    # anchor: after an rv-space reset (restarted in-memory
+                    # backing) the stale higher anchor would later satisfy a
+                    # resume in the NEW space and silently skip the events
+                    # (deletions included) between the client's true
+                    # knowledge and the stale number
+                    self._max_rv = r.get("rv", 0)
                     continue
                 for ev in r["events"]:
                     self._cursor = ev["seq"]
-                    self._fan_out(watchers, ev["type"], ev["object"], ev["kind"])
+                    self._max_rv = max(self._max_rv, ev.get("rv", 0))
+                    obj = self._decode_event(ev["object"], ev["kind"])
+                    if obj is not None:
+                        self._fan_out(watchers, ev["type"], obj)
+                # adopt the response's cursor/instance only once the whole
+                # batch landed: an empty rv-anchored resume from a restarted
+                # server moves the seq cursor into the NEW incarnation's
+                # space without any event to carry it
+                self._cursor = r.get("next", self._cursor)
+                self._instance = r.get("instance", self._instance)
             except Exception:
                 # malformed response (proxy interposing, version skew): a
                 # dead poll thread would silently stall every watcher
@@ -958,17 +1181,18 @@ class HttpStoreClient:
                     return
 
     @staticmethod
-    def _fan_out(watchers, etype: str, data: Dict[str, Any],
-                 kind: Optional[str] = None) -> None:
-        kind = kind or data.get("kind")
+    def _decode_event(data: Dict[str, Any], kind: Optional[str] = None):
         try:
-            obj = decode(kind, data)
+            return decode(kind or data.get("kind"), data)
         except Exception:
-            return  # unknown kind / skewed shape from a newer server —
+            return None  # unknown kind / skewed shape from a newer server —
             # skip the object rather than abort the whole batch
+
+    @staticmethod
+    def _fan_out(watchers, etype: str, obj) -> None:
         for want, wq in watchers:
-            if want is None or want == kind:
-                wq.put(WatchEvent(etype, kind, obj.deepcopy()))
+            if want is None or want == obj.kind:
+                wq.put(WatchEvent(etype, obj.kind, obj.deepcopy()))
 
     def close(self) -> None:
         self._stop.set()

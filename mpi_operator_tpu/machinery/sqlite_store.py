@@ -98,7 +98,20 @@ class SqliteStore:
         self._conn.execute("PRAGMA synchronous=NORMAL")
         with self._lock, self._conn:
             self._conn.executescript(_SCHEMA)
+        # probe JSON1 exactly once, at init: selector lists compile to
+        # json_each SQL only when the build has it. Probing here (not by
+        # catching OperationalError in list()) matters because transient
+        # operational errors — 'database is locked' — must keep propagating
+        # as such, not silently demote every future selector list to the
+        # O(cluster) python-filter path.
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1 FROM json_each('{}')")
+            self._json1 = True
+        except sqlite3.OperationalError:
+            self._json1 = False
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        self._relist_listeners: List = []
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
         with self._lock:
@@ -212,7 +225,14 @@ class SqliteStore:
                 "DELETE FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (kind, namespace, name),
             )
-            self._log(cur, DELETED, obj)
+            # the DELETED log row allocates a fresh global rv; stamp it on the
+            # object (kube does the same) so watch events carry strictly
+            # increasing rvs — the anchor informer caches resume from
+            rv = self._log(cur, DELETED, obj)
+            obj.metadata.resource_version = rv
+            cur.execute(
+                "UPDATE log SET data=? WHERE rv=?", (self._dump(obj), rv)
+            )
         return obj
 
     def try_delete(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -220,6 +240,18 @@ class SqliteStore:
             return self.delete(kind, namespace, name)
         except NotFound:
             return None
+
+    # selector filtering is pushed into SQL (fully parameterized json_each —
+    # label keys/values are data, never SQL) so a label-selected list of 8
+    # pods in a 1600-pod cluster decodes 8 objects, not 1600: without this,
+    # the server side of every `_list_workers` call was an O(cluster) JSON
+    # decode — the exact load the informer cache exists to remove, paid
+    # even by the residual non-cached callers (CLIs, cold caches)
+    _SELECTOR_CLAUSE = (
+        " AND EXISTS (SELECT 1 FROM"
+        " json_each(COALESCE(json_extract(data, '$.metadata.labels'), '{}'))"
+        " je WHERE je.key=? AND je.value=?)"
+    )
 
     def list(
         self,
@@ -232,12 +264,17 @@ class SqliteStore:
         if namespace is not None:
             q += " AND namespace=?"
             args.append(namespace)
+        sql_selector = bool(selector) and self._json1
+        if sql_selector:
+            for k, v in selector.items():
+                q += self._SELECTOR_CLAUSE
+                args.extend((k, v))
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         out = []
         for (data,) in rows:
             obj = self._load(kind, data)
-            if selector:
+            if selector and not sql_selector:
                 lbls = obj.metadata.labels
                 if any(lbls.get(k) != v for k, v in selector.items()):
                     continue
@@ -245,7 +282,28 @@ class SqliteStore:
         out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
         return out
 
+    def current_rv(self) -> int:
+        """Global rv high-water mark (MAX over the log; the log keeps a
+        retention floor so the newest rows are always present). Watch-resume
+        anchor, same contract as ObjectStore.current_rv."""
+        with self._lock:
+            row = self._conn.execute("SELECT MAX(rv) FROM log").fetchone()
+            if row[0]:
+                return row[0]
+            row = self._conn.execute("SELECT MAX(rv) FROM objects").fetchone()
+            return row[0] or 0
+
     # -- watch ---------------------------------------------------------------
+
+    def add_relist_listener(self, cb) -> None:
+        """Register ``cb(objects)`` to be invoked (on the poll thread, in
+        event order) whenever gap recovery relists. Informer caches need
+        this: the relist's per-watcher MODIFIED stream cannot express
+        deletions that happened inside the gap, so a cache must treat the
+        relist as a full-state replacement — the callback hands it the
+        complete live-object snapshot to do exactly that."""
+        with self._lock:
+            self._relist_listeners.append(cb)
 
     def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
         q: "queue.Queue[WatchEvent]" = queue.Queue()
@@ -313,17 +371,27 @@ class SqliteStore:
 
     def _relist_to(self, watchers) -> None:
         """Watch-gap recovery: emit a MODIFIED event per live object (the
-        informer relist) to the given watchers."""
+        informer relist) to the given watchers, after handing relist
+        listeners the full snapshot (they fire first so a cache's world-
+        replacement precedes the redundant MODIFIED replay)."""
         with self._lock:
             rows = self._conn.execute("SELECT kind, data FROM objects").fetchall()
+            listeners = list(self._relist_listeners)
+        objs = []
         for kind, data in rows:
             try:
-                obj = self._load(kind, data)
+                objs.append(self._load(kind, data))
             except Exception:
                 continue
+        for cb in listeners:
+            try:
+                cb([o.deepcopy() for o in objs])
+            except Exception:
+                pass  # a broken listener must not stall the watch pump
+        for obj in objs:
             for want, wq in watchers:
-                if want is None or want == kind:
-                    wq.put(WatchEvent(MODIFIED, kind, obj.deepcopy()))
+                if want is None or want == obj.kind:
+                    wq.put(WatchEvent(MODIFIED, obj.kind, obj.deepcopy()))
 
     # -- log retention -------------------------------------------------------
 

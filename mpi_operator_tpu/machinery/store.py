@@ -185,6 +185,12 @@ class ObjectStore:
             if k not in self._objects:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             obj = self._objects.pop(k)
+            # deletion consumes a resource_version (kube does the same): every
+            # watch event then carries a strictly increasing rv, which is what
+            # the informer cache and the http watch ?resource_version= resume
+            # anchor on — a DELETED event sharing the rv of the preceding
+            # MODIFIED would be skippable on resume (a lost deletion)
+            _meta(obj).resource_version = self._next_rv()
             self._notify(DELETED, kind, obj)
             return obj.deepcopy()
 
@@ -193,6 +199,14 @@ class ObjectStore:
             return self.delete(kind, namespace, name)
         except NotFound:
             return None
+
+    def current_rv(self) -> int:
+        """The store's resource_version high-water mark. Watch-resume anchor:
+        a consumer that has observed every event up to ``current_rv()`` holds
+        a complete picture (≙ the list resourceVersion a kube Reflector
+        starts its watch from)."""
+        with self._lock:
+            return self._rv
 
     # -- list / select ------------------------------------------------------
 

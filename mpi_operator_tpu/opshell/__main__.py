@@ -23,6 +23,7 @@ import threading
 from mpi_operator_tpu.controller.controller import ControllerOptions, TPUJobController
 from mpi_operator_tpu.controller.node_monitor import NodeMonitor
 from mpi_operator_tpu.executor import LocalExecutor
+from mpi_operator_tpu.machinery.cache import InformerCache
 from mpi_operator_tpu.machinery.events import EventRecorder
 from mpi_operator_tpu.machinery.store import ObjectStore
 from mpi_operator_tpu.opshell.election import ElectionConfig, LeaderElector
@@ -39,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--monitoring-port", type=int, default=8080)
     ap.add_argument("--lock-namespace", default="kube-system")
     ap.add_argument("--no-gang-scheduling", action="store_true")
+    ap.add_argument("--no-informer-cache", action="store_true",
+                    help="read the store directly instead of the "
+                         "watch-fed informer cache (debugging escape "
+                         "hatch; the cache is what keeps store read "
+                         "load O(1) in cluster size)")
     ap.add_argument("--executor", choices=["none", "local"], default="none",
                     help="'local' runs worker pods as OS processes")
     ap.add_argument("--logs-dir", default=None,
@@ -179,6 +185,12 @@ def main(argv=None) -> int:
         ).start()
         logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)
+    # ONE shared informer cache feeds every control-plane reader (≙ the
+    # SharedInformerFactory of the reference): controller, gang scheduler
+    # and node monitor all read local watch-fed listers; only writes and a
+    # single watch long-poll hit the store — the difference between O(1)
+    # and O(jobs × pods × resyncs) store load (opt out: --no-informer-cache)
+    cache = None if args.no_informer_cache else InformerCache(store)
     controller = TPUJobController(
         store,
         recorder,
@@ -188,6 +200,7 @@ def main(argv=None) -> int:
             coordinator_port=args.coordinator_port,
             gang_scheduling=not args.no_gang_scheduling,
         ),
+        cache=cache,
     )
     gang = not args.no_gang_scheduling
     if args.inventory_chips is not None and not gang:
@@ -258,7 +271,7 @@ def main(argv=None) -> int:
         GangScheduler(
             store, recorder, chips=args.inventory_chips, inventory=inventory,
             node_grace=args.node_grace, require_nodes=require_nodes,
-            preemption_grace=args.preemption_grace,
+            preemption_grace=args.preemption_grace, cache=cache,
         )
         if gang
         else None
@@ -270,11 +283,13 @@ def main(argv=None) -> int:
     )
     # the node-controller role (leader-only): evicts pods off nodes whose
     # agents stop heartbeating, so gang restarts land on live nodes
-    monitor = NodeMonitor(store, recorder, grace=args.node_grace)
+    monitor = NodeMonitor(store, recorder, grace=args.node_grace, cache=cache)
 
     stop = threading.Event()
 
     def on_started():
+        if cache is not None:
+            cache.start()
         controller.run()
         if scheduler:
             scheduler.start()
@@ -291,6 +306,8 @@ def main(argv=None) -> int:
         if executor:
             executor.stop()
         monitor.stop()
+        if cache is not None:
+            cache.stop()
         stop.set()
 
     elector = LeaderElector(

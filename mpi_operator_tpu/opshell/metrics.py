@@ -110,3 +110,13 @@ gangs_preempted = REGISTRY.counter(
     "Counts running gangs evicted whole to make room for a "
     "higher-priority pending gang (--preemption-grace)",
 )
+informer_synced = REGISTRY.gauge(
+    "tpu_operator_informer_synced",
+    "1 once the informer cache holds its initial snapshot (reconcilers "
+    "gate on this, like WaitForCacheSync); 0 while cold, absent when "
+    "running with --no-informer-cache",
+)
+informer_objects = REGISTRY.gauge(
+    "tpu_operator_informer_objects",
+    "Objects held per kind by the informer cache (the lister working set)",
+)

@@ -52,9 +52,11 @@ from mpi_operator_tpu.machinery.objects import (
     evict_pod,
 )
 from mpi_operator_tpu.opshell import metrics
+from mpi_operator_tpu.machinery.cache import InformerCache
 from mpi_operator_tpu.machinery.store import (
     NotFound,
     ObjectStore,
+    WatchEvent,
     optimistic_update,
 )
 from mpi_operator_tpu.scheduler.inventory import (
@@ -122,8 +124,16 @@ class GangScheduler:
         starvation_grace: float = 300.0,
         require_nodes: bool = False,
         preemption_grace: Optional[float] = None,
+        cache: Optional["InformerCache"] = None,
     ):
         self.store = store
+        # informer read path: every full-cluster list in the sync pass (Pod,
+        # PodGroup, Node) comes from the watch-fed cache when one is wired —
+        # the per-resync store.list round-trips were the scheduler's whole
+        # store footprint. Writes (binding, eviction) still hit the store:
+        # they need fresh optimistic-concurrency reads anyway.
+        self.cache = cache
+        self.read = cache if cache is not None else store
         self.recorder = recorder or EventRecorder(store, component="tpujob-scheduler")
         self.chips = chips
         self.inventory = inventory  # topology mode; overrides the chip budget
@@ -159,6 +169,15 @@ class GangScheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch_q = None
+        # assume-cache (≙ kube-scheduler's assumed-pods): bindings this
+        # scheduler wrote that the informer cache may not have echoed back
+        # yet, keyed (ns, name) → (uid, node). Without it, the pass after
+        # an admission could read the still-unbound cached copies of the
+        # gang it just bound, undercount used capacity, and admit a second
+        # gang onto the same chips. Entries drop once the cache observes
+        # the binding (or the pod is gone/reincarnated). Only meaningful
+        # with a cache; direct store reads see their own writes.
+        self._assumed: Dict[Tuple[str, str], Tuple[str, str]] = {}
         # True when the last sync saw work left to do (some gang with
         # unbound pending pods): gates the PERIODIC resync only — events
         # always wake the loop. An idle cluster does zero list traffic.
@@ -173,7 +192,26 @@ class GangScheduler:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
-        self._watch_q = self.store.watch(None)
+        if self.cache is not None:
+            # wake events must come from the INFORMER, not a separate
+            # direct store watch: a direct watch can wake (and drain) this
+            # loop before the cache has applied the very events it was
+            # woken for — the pass reads a world with no unbound pods, sets
+            # _dirty=False, and on a quiet cluster nothing ever wakes it
+            # again for that (now event-silent) gang. Handler callbacks
+            # fire after the cache applied the event, so a sync they wake
+            # is guaranteed to observe it (same coupling the controller's
+            # workqueue uses).
+            import queue as _queue
+
+            self._watch_q = _queue.Queue()
+            self.cache.add_event_handler(
+                lambda etype, obj: self._watch_q.put(
+                    WatchEvent(etype, obj.kind, obj)
+                )
+            )
+        else:
+            self._watch_q = self.store.watch(None)
         self._thread = threading.Thread(
             target=self._run, name="gang-scheduler", daemon=True
         )
@@ -182,7 +220,7 @@ class GangScheduler:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._watch_q is not None:
+        if self._watch_q is not None and self.cache is None:
             self.store.stop_watch(self._watch_q)
 
     def _run(self) -> None:
@@ -237,23 +275,35 @@ class GangScheduler:
 
     # -- accounting ---------------------------------------------------------
 
-    def used_chips(self) -> int:
+    def used_chips(self, pods: Optional[List[Pod]] = None) -> int:
+        """Chips held by live bound pods. Pass the current pass's (assume-
+        overlaid) snapshot inside a sync — a fresh cache read here could
+        miss this scheduler's own un-echoed bindings and undercount."""
+        if pods is None:
+            with self._lock:
+                pods = self.read.list("Pod")
+                self._overlay_assumed(pods)
         return sum(
             pod_cost(p)
-            for p in self.store.list("Pod")
+            for p in pods
             if p.spec.node_name and not p.is_finished()
         )
 
-    def free_chips(self) -> Optional[int]:
+    def free_chips(self, pods: Optional[List[Pod]] = None) -> Optional[int]:
         if self.chips is None:
             return None
-        return self.chips - self.used_chips()
+        return self.chips - self.used_chips(pods)
 
-    def occupancy(self) -> Dict[str, set]:
+    def occupancy(self, pods: Optional[List[Pod]] = None) -> Dict[str, set]:
         """Topology mode: physical-slice host coords held by live bound pods
-        (recomputed from the store each pass — nothing to drift)."""
+        (recomputed each pass — nothing to drift; same snapshot rule as
+        used_chips)."""
+        if pods is None:
+            with self._lock:
+                pods = self.read.list("Pod")
+                self._overlay_assumed(pods)
         occ: Dict[str, set] = {}
-        for p in self.store.list("Pod"):
+        for p in pods:
             if not p.spec.node_name or p.is_finished():
                 continue
             parsed = parse_node_name(p.spec.node_name)
@@ -264,11 +314,39 @@ class GangScheduler:
     # -- the scheduling pass ------------------------------------------------
 
     def sync(self) -> None:
+        if self.cache is not None and not self.cache.has_synced():
+            # a cold cache looks like an empty cluster: admitting against
+            # phantom-free capacity (or healing "local" bindings that are
+            # merely unobserved yet) would be acting on a world that is not
+            # there. Stay dirty so the periodic resync retries until the
+            # initial snapshot lands (≙ WaitForCacheSync).
+            self._dirty = True
+            return
         with self._lock:
             self._sync_locked()
 
+    def _overlay_assumed(self, pods: List[Pod]) -> None:
+        """Apply not-yet-echoed bindings onto the cached pod snapshot and
+        retire assumptions the cache has caught up with."""
+        if not self._assumed:
+            return
+        present: Dict[Tuple[str, str], Pod] = {}
+        for p in pods:
+            present[(p.metadata.namespace, p.metadata.name)] = p
+        for key, (uid, node) in list(self._assumed.items()):
+            cur = present.get(key)
+            if cur is None or cur.metadata.uid != uid:
+                # pod gone or a new incarnation under the same name: the
+                # assumption must not shadow-bind an object it never bound
+                del self._assumed[key]
+            elif cur.spec.node_name:
+                del self._assumed[key]  # echo landed
+            else:
+                cur.spec.node_name = node  # still in flight: overlay
+
     def _sync_locked(self) -> None:
-        pods = self.store.list("Pod")
+        pods = self.read.list("Pod")
+        self._overlay_assumed(pods)
         by_gang: Dict[Tuple[str, str], List[Pod]] = defaultdict(list)
         for p in pods:
             job = p.metadata.labels.get(LABEL_JOB_NAME, "")
@@ -282,7 +360,7 @@ class GangScheduler:
         nodes: Optional[List] = None
         node_used: Dict[str, int] = {}
         if self.inventory is None:
-            all_nodes = self.store.list("Node", NODE_NAMESPACE)
+            all_nodes = self.read.list("Node", NODE_NAMESPACE)
             if self.require_nodes:
                 # heal any 'local'-sentinel bindings (pre-upgrade state or a
                 # misconfigured operator). In a node-mode deployment no
@@ -312,13 +390,13 @@ class GangScheduler:
             if all_nodes or self.require_nodes:
                 nodes = self._live_nodes(all_nodes)
                 node_used = self._node_used(pods)
-        free = self.free_chips()  # None = unbounded
+        free = self.free_chips(pods)  # None = unbounded
         # (priority desc, FIFO) with an aging guard: aged gangs go first in
         # plain FIFO order — the queue the reference delegates to Volcano's
         # priorityClassName handling (mpi_job_controller.go:1215-1237),
         # implemented here because admission IS this component
         now = time.time()
-        all_groups = self.store.list("PodGroup")
+        all_groups = self.read.list("PodGroup")
         keys = set()
         for pg in all_groups:
             key = self._pg_key(pg)
@@ -367,7 +445,7 @@ class GangScheduler:
                 continue
             if self.inventory is not None:
                 if occ is None:
-                    occ = self.occupancy()
+                    occ = self.occupancy(pods)
                     self._occlude_dead_nodes(occ)
                 if not self._sync_gang_topology(pg, bound, unbound, occ):
                     if not bound:
@@ -742,7 +820,7 @@ class GangScheduler:
         through evict/restart until backoffLimit kills the job. Hosts with
         no registered agent stay schedulable (pure-inventory deployments
         carry no Node objects at all)."""
-        all_nodes = self.store.list("Node", NODE_NAMESPACE)
+        all_nodes = self.read.list("Node", NODE_NAMESPACE)
         if not all_nodes:
             return
         live = {n.metadata.name for n in self._live_nodes(all_nodes)}
@@ -841,6 +919,9 @@ class GangScheduler:
             mutate, what="unbind-local",
         ) is not None
         if ok:
+            self._assumed.pop(
+                (pod.metadata.namespace, pod.metadata.name), None
+            )
             log.info(
                 "unbound %s/%s from the 'local' sentinel (node-mode deployment)",
                 pod.metadata.namespace, pod.metadata.name,
@@ -858,7 +939,13 @@ class GangScheduler:
             return False
         cur.spec.node_name = node
         try:
-            self.store.update(cur, force=True)
+            committed = self.store.update(cur, force=True)
         except NotFound:
             return False
+        if self.cache is not None:
+            # remember the binding until the informer echoes it back — the
+            # next pass's cached snapshot must not undercount this gang
+            self._assumed[
+                (pod.metadata.namespace, pod.metadata.name)
+            ] = (committed.metadata.uid, node)
         return True

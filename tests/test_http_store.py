@@ -181,11 +181,14 @@ def test_fallen_behind_watcher_recovers_by_relist():
         srv.stop()
 
 
-def test_cursor_from_previous_server_incarnation_relists():
+def test_cursor_from_previous_server_incarnation_resumes():
     """A store-server restart resets the event-log seq space; a client
-    reconnecting with its old (now ahead-of-head) cursor must get a relist,
-    not a silent stall — otherwise an operator replica would stop
-    reconciling forever after a store restart."""
+    reconnecting with its old (now meaningless) cursor must not silently
+    stall — otherwise an operator replica would stop reconciling forever
+    after a store restart. A CAUGHT-UP client now rides the durable
+    ?resource_version= anchor: the restarted server proves an empty replay
+    and the stream continues with NO relist — the next event the watcher
+    sees is the first post-restart write, exactly once."""
     backing = ObjectStore()
     srv = StoreServer(backing, "127.0.0.1", 0).start()
     port = srv.port
@@ -197,7 +200,8 @@ def test_cursor_from_previous_server_incarnation_relists():
         for _ in range(5):
             q.get(timeout=5.0)
         # restart: a NEW server (fresh seq space) on the same port, same
-        # backing data; the client keeps its cursor (now > head)
+        # backing data; the client keeps its cursor (now > head) but also
+        # its rv anchor (valid forever against the same backing)
         srv.stop()
         deadline = time.time() + 10
         while time.time() < deadline:
@@ -206,16 +210,11 @@ def test_cursor_from_previous_server_incarnation_relists():
                 break
             except OSError:
                 time.sleep(0.2)
-        seen = set()
-        deadline = time.time() + 10
-        while time.time() < deadline and len(seen) < 5:
-            try:
-                ev = q.get(timeout=0.5)
-            except Exception:
-                continue
-            assert ev.type == "MODIFIED"  # relist synthesizes MODIFIED
-            seen.add(ev.obj.metadata.name)
-        assert seen == {f"old{i}" for i in range(5)}
+        backing.create(Pod(metadata=ObjectMeta(name="post-restart")))
+        ev = q.get(timeout=10.0)
+        assert ev.type == "ADDED"  # resumed: no relist replay, no stall
+        assert ev.obj.metadata.name == "post-restart"
+        assert srv.stats()["relist"] == 0
     finally:
         c.close()
         srv.stop()
@@ -920,3 +919,108 @@ def test_store_server_constructor_fails_closed_without_admin_token():
         StoreServer(ObjectStore(), "127.0.0.1", 0, read_token="view")
     with pytest.raises(ValueError, match="admin token"):
         StoreServer(ObjectStore(), "127.0.0.1", 0, auth_reads=True)
+
+
+def test_agent_cordon_toctou_future_rv_is_conflict():
+    """ADVICE r5 (medium): the old rule denied a cordon flip only when the
+    submitted rv EQUALLED the stored rv at authz time — racy, because authz
+    and the backing update are not atomic: a compromised agent could submit
+    unschedulable=false with a predicted FUTURE rv (mismatch at authz →
+    allowed) while a concurrent benign heartbeat advanced the node to that
+    exact rv, landing the un-cordon. Now ANY rv-mismatched agent Node PUT is
+    bounced 409 at authz — the flip can only ever be judged against the rv
+    it would actually commit over."""
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+
+    backing = ObjectStore()
+    srv = StoreServer(
+        backing, "127.0.0.1", 0, token="adm1n",
+        agent_tokens={"tok-a": "agent-a"},
+    ).start()
+    agent_a = HttpStoreClient(srv.url, token="tok-a")
+    try:
+        node = Node()
+        node.metadata.namespace = NODE_NAMESPACE
+        node.metadata.name = "agent-a"
+        node.status.ready = True
+        agent_a.create(node)
+        stored = backing.get("Node", NODE_NAMESPACE, "agent-a")
+        stored.status.unschedulable = True
+        backing.update(stored, force=True)
+        # the attack: un-cordon stamped with a PREDICTED future rv
+        attack = agent_a.get("Node", NODE_NAMESPACE, "agent-a")
+        attack.status.unschedulable = False
+        attack.metadata.resource_version += 1
+        with pytest.raises(Conflict):
+            agent_a.update(attack)
+        assert backing.get(
+            "Node", NODE_NAMESPACE, "agent-a").status.unschedulable
+        # current-rv flip is still the hard 403 (explicit self-uncordon)
+        from mpi_operator_tpu.machinery.store import Forbidden
+
+        esc = agent_a.get("Node", NODE_NAMESPACE, "agent-a")
+        esc.status.unschedulable = False
+        with pytest.raises(Forbidden, match="cordon"):
+            agent_a.update(esc)
+    finally:
+        agent_a.close()
+        srv.stop()
+
+
+def test_agent_cannot_relabel_or_reuid_its_pods():
+    """ADVICE r5 (medium): the NODE tier's Pod scope pins identity fields.
+    Relabeling a pod's job-name label would inject it into another job's
+    worker set (controller and scheduler group pods purely by that label) —
+    spurious gang restarts, or permanently failing another tenant's job.
+    The uid guards incarnation checks the same way. Status mirroring stays
+    allowed."""
+    from mpi_operator_tpu.controller.controller import LABEL_JOB_NAME
+    from mpi_operator_tpu.machinery.store import Forbidden
+
+    backing = ObjectStore()
+    srv = StoreServer(
+        backing, "127.0.0.1", 0, token="adm1n",
+        agent_tokens={"tok-a": "agent-a"},
+    ).start()
+    agent_a = HttpStoreClient(srv.url, token="tok-a")
+    try:
+        pod = backing.create(Pod(metadata=ObjectMeta(
+            name="w-0", namespace="d", labels={LABEL_JOB_NAME: "victim"})))
+        pod.spec.node_name = "agent-a"
+        backing.update(pod, force=True)
+
+        # relabel into another job's worker set: denied
+        evil = agent_a.get("Pod", "d", "w-0")
+        evil.metadata.labels[LABEL_JOB_NAME] = "other-tenant"
+        with pytest.raises(Forbidden, match="labels"):
+            agent_a.update(evil)
+        # dropping the label entirely: denied too
+        evil = agent_a.get("Pod", "d", "w-0")
+        del evil.metadata.labels[LABEL_JOB_NAME]
+        with pytest.raises(Forbidden, match="labels"):
+            agent_a.update(evil)
+        # uid swap (forging a different incarnation): denied
+        evil = agent_a.get("Pod", "d", "w-0")
+        evil.metadata.uid = "forged-uid"
+        with pytest.raises(Forbidden, match="uid"):
+            agent_a.update(evil)
+        assert backing.get("Pod", "d", "w-0").metadata.labels == {
+            LABEL_JOB_NAME: "victim"}
+        # the legitimate flow — status mirror with identity intact — works
+        ok = agent_a.get("Pod", "d", "w-0")
+        ok.status.phase = PodPhase.RUNNING
+        agent_a.update(ok)
+        assert backing.get("Pod", "d", "w-0").status.phase == PodPhase.RUNNING
+    finally:
+        agent_a.close()
+        srv.stop()
+
+
+def test_read_token_equal_to_admin_token_fails_closed():
+    """ADVICE r5 (low): a read token misconfigured to the admin value would
+    match the admin entry first in check_bearer — silently granting 'read
+    only' holders full mutation rights. The server refuses to start, same
+    rule as agent-token reuse."""
+    with pytest.raises(ValueError, match="distinct secret"):
+        StoreServer(ObjectStore(), "127.0.0.1", 0,
+                    token="same", read_token="same")

@@ -18,8 +18,15 @@ measuring per-sync latency and the server's read counters. Run it via::
   BENCH_MODEL=controlplane python bench.py          # same, no TPU work
 
 Knobs: BENCH_CP_JOBS, BENCH_CP_PODS, BENCH_CP_ROUNDS, BENCH_CP_MODES
-("store", "informer", or "store,informer"). No jax required — this is the
-pure-python control plane.
+("store", "informer", "write", or a comma list). No jax required — this is
+the pure-python control plane.
+
+The **write mode** (BENCH_CP_MODES=write) measures the write-path twin of
+the informer work: status updates as server-side merge-patch (1 request)
+vs the GET+PUT optimistic loop (2+), simulated agent ticks (Node heartbeat
++ dirty pod mirrors) as one patch-batch vs per-object round-trips —
+O(pods) → O(1) — plus the idle-writes-are-zero check, at 200 jobs × 8
+pods with BENCH_CP_AGENTS (default 16) simulated agents churning.
 """
 
 from __future__ import annotations
@@ -146,10 +153,7 @@ def run_mode(mode: str, jobs: int, pods: int, rounds: int) -> dict:
 
         lat.sort()
         reads = _reads(stats1) - _reads(stats0)
-        writes = sum(
-            stats1.get(w, 0) - stats0.get(w, 0)
-            for w in ("create", "update", "delete")
-        )
+        writes = _writes(stats1) - _writes(stats0)
         return {
             "metric": "controlplane_reconcile",
             "mode": mode,
@@ -174,15 +178,220 @@ def run_mode(mode: str, jobs: int, pods: int, rounds: int) -> dict:
         backing.close()
 
 
+def _writes(stats: dict) -> int:
+    """Store-side write requests (patch_batch counts as ONE request — that
+    collapse is the point; its per-item patches are server-internal)."""
+    return sum(stats.get(w, 0) for w in ("create", "update", "delete",
+                                         "patch", "patch_batch"))
+
+
+def run_write_mode(jobs: int, pods: int, agents: int) -> dict:
+    """The write-path benchmark: converge the cluster once (informer reads,
+    patch writes), then measure
+
+    - **status update**: old GET+PUT optimistic loop vs status-subresource
+      PATCH, p50/p99 and store requests per update;
+    - **agent tick**: old per-object round-trips (Node GET+PUT + per-dirty-
+      pod GET+PUT) vs ONE patch-batch, requests per tick;
+    - **agent churn**: ``agents`` threads ticking concurrently with a
+      job's worth of dirty mirrors each, both write paths, wall + QPS +
+      server-bounced conflicts;
+    - **idle**: after everything drains, a 5s window must show ZERO writes
+      (the elision guarantee, mirroring the zero-read one).
+    """
+    import threading
+
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+
+    tmp = tempfile.mkdtemp(prefix="bench-cp-write-")
+    backing = SqliteStore(os.path.join(tmp, "store.db"))
+    server = StoreServer(backing, "127.0.0.1", 0).start()
+    client = HttpStoreClient(server.url, timeout=30.0, watch_poll_timeout=5.0)
+    cache = InformerCache(client).start()
+    try:
+        if not cache.wait_for_sync(30.0):
+            raise RuntimeError("informer cache never synced")
+        recorder = EventRecorder(client)
+        controller = TPUJobController(
+            client, recorder, ControllerOptions(threadiness=0), cache=cache
+        )
+        scheduler = GangScheduler(client, recorder, cache=cache)
+        keys = []
+        for i in range(jobs):
+            job = client.create(_make_job(i, pods))
+            keys.append(job.metadata.key())
+        stats0 = server.stats()
+        clean = 0
+        for _ in range(30):
+            ok = all([controller.sync_handler(k) for k in keys])
+            scheduler.sync()
+            clean = clean + 1 if ok else 0
+            if clean >= 2:
+                break
+            time.sleep(0.3)
+        time.sleep(0.5)
+        stats_conv = server.stats()
+        converge_writes = _writes(stats_conv) - _writes(stats0)
+
+        all_pods = client.list("Pod", "bench")
+        # ---- status update: GET+PUT loop vs one PATCH --------------------
+        n_updates = min(400, len(all_pods))
+        s0 = server.stats()
+        put_lat = []
+        for i, p in enumerate(all_pods[:n_updates]):
+            t = time.perf_counter()
+            cur = client.get("Pod", p.metadata.namespace, p.metadata.name)
+            cur.status.message = f"put {i}"
+            client.update(cur)
+            put_lat.append(time.perf_counter() - t)
+        s1 = server.stats()
+        patch_lat = []
+        for i, p in enumerate(all_pods[:n_updates]):
+            t = time.perf_counter()
+            client.patch(
+                "Pod", p.metadata.namespace, p.metadata.name,
+                {"status": {"message": f"patch {i}"}}, subresource="status",
+            )
+            patch_lat.append(time.perf_counter() - t)
+        s2 = server.stats()
+        put_req = (_reads(s1) - _reads(s0)) + (_writes(s1) - _writes(s0))
+        patch_req = (_reads(s2) - _reads(s1)) + (_writes(s2) - _writes(s1))
+        put_lat.sort()
+        patch_lat.sort()
+
+        # ---- agent ticks: per-object round-trips vs one patch-batch ------
+        for a in range(agents):
+            node = Node()
+            node.metadata.namespace = NODE_NAMESPACE
+            node.metadata.name = f"bench-agent-{a:02d}"
+            node.status.ready = True
+            node.status.last_heartbeat = time.time()
+            client.try_get("Node", NODE_NAMESPACE, node.metadata.name) \
+                or client.create(node)
+        shard = [all_pods[a::agents] for a in range(agents)]
+
+        def old_tick(cl, a: int, dirty: list) -> None:
+            cur = cl.get("Node", NODE_NAMESPACE, f"bench-agent-{a:02d}")
+            cur.status.last_heartbeat = time.time()
+            cl.update(cur)
+            for p in dirty:
+                cp = cl.get("Pod", p.metadata.namespace, p.metadata.name)
+                cp.status.message = "old-tick"
+                cl.update(cp)
+
+        def new_tick(cl, a: int, dirty: list) -> None:
+            items = [{
+                "kind": "Node", "namespace": NODE_NAMESPACE,
+                "name": f"bench-agent-{a:02d}", "subresource": "status",
+                "patch": {"status": {"last_heartbeat": time.time()}},
+            }]
+            items += [{
+                "kind": "Pod", "namespace": p.metadata.namespace,
+                "name": p.metadata.name, "subresource": "status",
+                "patch": {"status": {"message": "new-tick"}},
+            } for p in dirty]
+            cl.patch_batch(items)
+
+        dirty_per_tick = pods  # a job's worth of mirrors lands each tick
+        s0 = server.stats()
+        old_tick(client, 0, shard[0][:dirty_per_tick])
+        s1 = server.stats()
+        new_tick(client, 0, shard[0][:dirty_per_tick])
+        s2 = server.stats()
+        tick_req_old = (_reads(s1) - _reads(s0)) + (_writes(s1) - _writes(s0))
+        tick_req_new = (_reads(s2) - _reads(s1)) + (_writes(s2) - _writes(s1))
+
+        churn = {}
+        ticks = 20
+        for label, tick in (("old", old_tick), ("new", new_tick)):
+            clients = [
+                HttpStoreClient(server.url, timeout=30.0,
+                                watch_poll_timeout=5.0)
+                for _ in range(agents)
+            ]
+            s0 = server.stats()
+            t0 = time.perf_counter()
+
+            def run_agent(a, cl):
+                for _ in range(ticks):
+                    tick(cl, a, shard[a][:dirty_per_tick])
+
+            threads = [
+                threading.Thread(target=run_agent, args=(a, cl))
+                for a, cl in enumerate(clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            s1 = server.stats()
+            req = (_reads(s1) - _reads(s0)) + (_writes(s1) - _writes(s0))
+            churn[label] = {
+                "elapsed_s": round(elapsed, 2),
+                "requests": req,
+                "requests_per_tick": round(req / (agents * ticks), 2),
+                "store_qps": round(req / elapsed, 1),
+                "conflicts": s1.get("conflict", 0) - s0.get("conflict", 0),
+            }
+            for cl in clients:
+                cl.close()
+
+        # ---- idle: the elision guarantee ---------------------------------
+        for _ in range(2):  # settle reconciles of everything above
+            for k in keys:
+                controller.sync_handler(k)
+            scheduler.sync()
+            time.sleep(0.3)
+        s0 = server.stats()
+        time.sleep(5.0)
+        for k in keys:
+            controller.sync_handler(k)  # a full reconcile pass, all no-ops
+        scheduler.sync()
+        s1 = server.stats()
+        idle_writes = _writes(s1) - _writes(s0)
+
+        return {
+            "metric": "controlplane_write_path",
+            "jobs": jobs,
+            "pods_per_job": pods,
+            "agents": agents,
+            "converge_writes_per_job": round(converge_writes / jobs, 2),
+            "status_put_p50_ms": round(_percentile(put_lat, 0.50) * 1e3, 3),
+            "status_put_p99_ms": round(_percentile(put_lat, 0.99) * 1e3, 3),
+            "status_put_requests_per_update": round(put_req / n_updates, 2),
+            "status_patch_p50_ms": round(_percentile(patch_lat, 0.50) * 1e3, 3),
+            "status_patch_p99_ms": round(_percentile(patch_lat, 0.99) * 1e3, 3),
+            "status_patch_requests_per_update": round(
+                patch_req / n_updates, 2),
+            "agent_tick_requests_old": tick_req_old,
+            "agent_tick_requests_new": tick_req_new,
+            "churn_ticks_per_agent": ticks,
+            "churn_dirty_pods_per_tick": dirty_per_tick,
+            "churn_old": churn["old"],
+            "churn_new": churn["new"],
+            "idle_writes": idle_writes,
+        }
+    finally:
+        cache.stop()
+        client.close()
+        server.stop()
+        backing.close()
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_CP_JOBS", "200"))
     pods = int(os.environ.get("BENCH_CP_PODS", "8"))
     rounds = int(os.environ.get("BENCH_CP_ROUNDS", "3"))
+    agents = int(os.environ.get("BENCH_CP_AGENTS", "16"))
     modes = os.environ.get("BENCH_CP_MODES", "store,informer").split(",")
     results = {}
     for mode in modes:
         mode = mode.strip()
-        r = run_mode(mode, jobs, pods, rounds)
+        if mode == "write":
+            r = run_write_mode(jobs, pods, agents)
+        else:
+            r = run_mode(mode, jobs, pods, rounds)
         results[mode] = r
         print(json.dumps(r), flush=True)
     if "store" in results and "informer" in results:

@@ -68,8 +68,10 @@ from mpi_operator_tpu.machinery.cache import InformerCache
 from mpi_operator_tpu.machinery.store import (
     AlreadyExists,
     Conflict,
+    NotFound,
     ObjectStore,
     WatchEvent,
+    diff_merge_patch,
 )
 from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
 from mpi_operator_tpu.opshell import metrics
@@ -504,8 +506,14 @@ class TPUJobController:
         if existing is not None:
             self._check_owned(job, existing)
             if existing.data != data:
-                existing.data = data
-                return self.store.update(existing)
+                # merge-patch of just the changed keys (nulls delete):
+                # one request, and a cached copy lagging our own last
+                # write can never 409 the reconcile
+                return self.store.patch(
+                    "ConfigMap", job.namespace, job.config_name(),
+                    {"data": diff_merge_patch(existing.data, data)},
+                )
+            metrics.store_writes_elided.inc(component="controller")
             return existing
         cm = ConfigMap(
             metadata=ObjectMeta(
@@ -535,8 +543,10 @@ class TPUJobController:
         if existing is not None:
             self._check_owned(job, existing)
             if existing.spec.min_member != desired:
-                existing.spec.min_member = desired
-                return self.store.update(existing)
+                return self.store.patch(
+                    "PodGroup", job.namespace, job.podgroup_name(),
+                    {"spec": {"min_member": desired}},
+                )
             return existing
         sp = job.spec.run_policy.scheduling_policy
         pg = PodGroup(
@@ -899,15 +909,28 @@ class TPUJobController:
 
     def _default_write_status(self, job: TPUJob) -> bool:
         """Persist status only when it changed (≙ UpdateStatus-on-change,
-        :602 + :921-996 tail). Conflict → requeue (False)."""
+        :602 + :921-996 tail — the no-op elision that keeps an idle
+        cluster at ZERO store writes, the write-side twin of the lister's
+        zero-read guarantee), via ONE status-subresource merge-patch
+        carrying just the changed keys (nulls for removed ones). No rv
+        precondition: this controller is the only TPUJob-status writer
+        (leader-elected), so patching latest is exactly right and the old
+        GET+PUT Conflict/requeue cycle disappears."""
         stored = self.read.try_get("TPUJob", job.namespace, job.name)
         if stored is None:
             return True
-        if stored.status.to_dict() == job.status.to_dict():
+        old, new = stored.status.to_dict(), job.status.to_dict()
+        if old == new:
+            metrics.store_writes_elided.inc(component="controller")
             return True
-        stored.status = job.status
         try:
-            self.store.update(stored)
+            self.store.patch(
+                "TPUJob", job.namespace, job.name,
+                {"status": diff_merge_patch(old, new)},
+                subresource="status",
+            )
+        except NotFound:
+            return True  # deleted under us; nothing to mirror
         except Conflict:
-            return False
+            return False  # only reachable with a precondition-injecting test hook
         return True

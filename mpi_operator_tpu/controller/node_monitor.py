@@ -27,7 +27,7 @@ from typing import Optional
 
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, evict_pod
-from mpi_operator_tpu.machinery.store import optimistic_update
+from mpi_operator_tpu.machinery.store import NotFound
 from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.nodemonitor")
@@ -105,17 +105,20 @@ class NodeMonitor:
             self._evict_pods(set(stale))
 
     def _mark_not_ready(self, name: str) -> None:
-        """Optimistic (non-force) update with retry: a concurrent `ctl
-        cordon` or a just-landed revival heartbeat must raise Conflict and
-        be re-read, not be silently clobbered by a stale forced copy."""
-        def mutate(cur) -> bool:
-            cur.status.ready = False
-            return True
-
-        optimistic_update(
-            self.store, "Node", NODE_NAMESPACE, name, mutate,
-            what="mark-not-ready",
-        )
+        """One status-subresource merge-patch touching ONLY ``ready``: a
+        concurrent `ctl cordon` or a just-landed revival heartbeat keeps
+        every field it wrote (merge semantics — the old GET+PUT loop
+        re-read and retried Conflicts to achieve the same). Writes happen
+        only on the ready→not-ready transition (sync() gates on
+        ``node.status.ready``), so a permanently dead node costs zero
+        steady-state writes."""
+        try:
+            self.store.patch(
+                "Node", NODE_NAMESPACE, name,
+                {"status": {"ready": False}}, subresource="status",
+            )
+        except NotFound:
+            pass  # node deleted between the scan and the mark
 
     def _evict_pods(self, stale_nodes: set) -> None:
         for pod in self.read.list("Pod"):

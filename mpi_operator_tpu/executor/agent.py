@@ -13,7 +13,11 @@ component for this framework:
   LocalExecutor process machinery, and mirrors phases back,
 - registers itself as a :class:`Node` object and **heartbeats** it, so the
   leader's NodeMonitor can evict pods off a dead node (≙ the node
-  controller's pod eviction),
+  controller's pod eviction). The heartbeat and every dirty pod-status
+  mirror ride ONE ``patch_batch`` request per tick (StatusBatcher below):
+  agent store load is O(1) per tick regardless of pod count, and the
+  status-subresource patches fit the NODE token tier's patch-status-only
+  grant,
 - serves its pods' log files over HTTP and stamps *URLs* (not local paths)
   into ``pod.status.log_path``, so ``ctl logs`` works from any node
   (≙ ``kubectl logs`` riding the kubelet API),
@@ -48,10 +52,123 @@ from mpi_operator_tpu.machinery.objects import (
     Node,
     PodPhase,
     evict_pod,
+    patch_pod_status,
 )
-from mpi_operator_tpu.machinery.store import NotFound
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    Conflict,
+    Forbidden,
+    NotFound,
+    json_merge_patch,
+)
 
 log = logging.getLogger("tpujob.agent")
+
+
+class StatusBatcher:
+    """Collects the executor's pod status mirrors between agent ticks so
+    the heartbeat loop can flush them — together with the Node heartbeat —
+    as ONE ``patch_batch`` request. This is the write-side answer to the
+    O(workers × jobs) apiserver-load shape the reference's redesign
+    proposal names (proposals/scalable-robust-operator.md:90-109): an
+    agent's store traffic is O(1) per tick regardless of how many pods it
+    runs.
+
+    Entries coalesce per pod (a RUNNING mirror followed by the terminal
+    mirror inside one tick merges, later keys winning — RFC 7386 over the
+    status changes), and each carries the rv the executor believes current
+    as the patch's precondition: a Conflict at flush time falls back to
+    patch_pod_status's guarded re-read, which re-applies the incarnation
+    and write-once-terminal guards exactly like the direct path.
+    ``on_dirty`` (the agent's wake event) makes the flush prompt — the
+    batch rides the next tick, the tick just happens immediately."""
+
+    def __init__(self, on_dirty=None):
+        self._lock = threading.Lock()
+        # (namespace, name) → entry dict; insertion-ordered (flush order)
+        self._entries: "dict" = {}
+        # (namespace, name) → (uid, rv) of our last committed mirror: a
+        # later mirror of the same incarnation (the reaper's terminal
+        # write after our RUNNING commit) anchors its precondition here
+        # instead of on its stale launch-time snapshot, keeping the flush
+        # at one request. Dropped on terminal commit (the pod is done).
+        self._committed: "dict" = {}
+        self._on_dirty = on_dirty
+
+    def enqueue(self, namespace, name, uid, rv, changes) -> None:
+        key = (namespace, name)
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is not None and cur["uid"] == uid:
+                # same incarnation: merge, keeping the FIRST rv anchor (the
+                # store hasn't seen either write yet, so the precondition
+                # must reference the pre-batch state)
+                cur["changes"] = json_merge_patch(cur["changes"], changes)
+            else:
+                known = self._committed.get(key)
+                if known is not None and known[0] == uid:
+                    rv = max(rv, known[1])
+                self._entries[key] = {
+                    "namespace": namespace, "name": name, "uid": uid,
+                    "rv": rv, "changes": dict(changes),
+                }
+        if self._on_dirty is not None:
+            self._on_dirty()
+
+    # anchor-memory bound: a long-lived agent churning through many pod
+    # names must not grow _committed forever (forget() handles the normal
+    # disappearances; this is the backstop — oldest entries drop first,
+    # costing at worst one Conflict-and-re-read on their next mirror)
+    _COMMITTED_CAP = 4096
+
+    def note_committed(self, entry, committed) -> None:
+        """Record a flush result (the committed pod) for rv anchoring."""
+        key = (entry["namespace"], entry["name"])
+        terminal = entry["changes"].get("phase") in (
+            PodPhase.SUCCEEDED, PodPhase.FAILED,
+        )
+        with self._lock:
+            if terminal:
+                self._committed.pop(key, None)
+            else:
+                self._committed[key] = (
+                    committed.metadata.uid,
+                    committed.metadata.resource_version,
+                )
+                while len(self._committed) > self._COMMITTED_CAP:
+                    self._committed.pop(next(iter(self._committed)))
+
+    def forget(self, namespace, name) -> None:
+        """Drop the rv anchor for a pod that disappeared without a local
+        terminal commit (deleted by gang cleanup, rebound out of scope) —
+        the counterpart of LocalExecutor._forget's _status_rv cleanup."""
+        with self._lock:
+            self._committed.pop((namespace, name), None)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._entries.values())
+            self._entries.clear()
+        return out
+
+    def requeue(self, entries) -> None:
+        """Put drained-but-unflushed entries back (the whole batch request
+        failed — store unreachable past the client's retry window). An
+        entry enqueued meanwhile for the same pod merges ON TOP of the
+        requeued one: the requeued changes are the older state."""
+        with self._lock:
+            for e in entries:
+                key = (e["namespace"], e["name"])
+                cur = self._entries.get(key)
+                if cur is not None and cur["uid"] == e["uid"]:
+                    cur["changes"] = json_merge_patch(
+                        e["changes"], cur["changes"]
+                    )
+                    cur["rv"] = e["rv"]  # the pre-batch anchor stands
+                elif cur is None:
+                    self._entries[key] = e
+                # different uid: the pod was reincarnated while the store
+                # was away — the old incarnation's mirror is moot
 
 # largest single /logs response (clients loop on ?offset= for the rest)
 MAX_LOG_CHUNK = 8 << 20
@@ -216,6 +333,11 @@ class NodeAgent:
             from mpi_operator_tpu.runtime.bootstrap import ENV_CKPT_DIR
 
             extra_env[ENV_CKPT_DIR] = ckpt_dir
+        # wake-driven flush: pod mirrors enqueue here and set the wake
+        # event, so the batch rides an immediate tick instead of waiting
+        # out the heartbeat interval (prompt transitions, still 1 request)
+        self._wake = threading.Event()
+        self.batcher = StatusBatcher(on_dirty=self._wake.set)
         self.executor = LocalExecutor(
             store,
             require_binding=True,
@@ -224,6 +346,7 @@ class NodeAgent:
             workdir=workdir,
             extra_env=extra_env,
             log_url_base=None,  # filled at start (needs the bound log port)
+            status_sink=self.batcher,
         )
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
@@ -241,43 +364,167 @@ class NodeAgent:
         node.status.last_heartbeat = time.time()
         return node
 
-    def _register(self) -> None:
-        from mpi_operator_tpu.machinery.store import Conflict
+    def _heartbeat_status(self) -> dict:
+        """The Node status fields a heartbeat refreshes, as a merge-patch
+        value. ``unschedulable`` is deliberately ABSENT: the cordon flag is
+        operator-owned and a merge-patch leaves untouched keys alone — the
+        old GET+PUT loop had to copy the flag forward and retry Conflicts
+        to get the same guarantee (and the store server 403s the key for
+        NODE-tier credentials outright)."""
+        s = self._node_template().status.to_dict()
+        s.pop("unschedulable", None)
+        return s
 
-        tmpl = self._node_template()
-        for _ in range(5):
-            if self._stop.is_set():
-                # stop() force-marks ready=False; a beat retrying past that
-                # would resurrect a Ready record for a dead agent and make
-                # the monitor burn the full grace window
-                return
+    def _register(self) -> None:
+        """Create-or-refresh this agent's Node: ONE status-subresource
+        patch when it exists (the steady-state beat — no GET leg, no
+        conflict loop, cordon preserved by construction), create when it
+        does not (first start, or the Node was deleted out from under
+        us)."""
+        if self._stop.is_set():
+            # stop() marks ready=False; a beat racing past that would
+            # resurrect a Ready record for a dead agent and make the
+            # monitor burn the full grace window
+            return
+        try:
+            self.store.patch(
+                "Node", NODE_NAMESPACE, self.node_name,
+                {"status": self._heartbeat_status()}, subresource="status",
+            )
+            return
+        except NotFound:
+            pass
+        try:
+            self.store.create(self._node_template())
+        except AlreadyExists:
+            # raced another registration of the same identity: the next
+            # beat's patch lands on whichever copy won
+            log.warning("node registration raced; next beat refreshes")
+
+    def _tick(self) -> None:
+        """One agent tick = ONE store round-trip: the Node heartbeat plus
+        every dirty pod-status mirror the executor enqueued since the last
+        tick, shipped as a single patch_batch. Per-item failures are
+        handled item-by-item (a deleted pod must not cost the heartbeat):
+        Conflict falls back to patch_pod_status's guarded re-read (the
+        same incarnation/write-once checks as the direct path), NotFound
+        on the Node recreates it."""
+        if self._stop.is_set():
+            return  # stop() owns the final (ready=False) write
+        entries = self.batcher.drain()
+        items = [{
+            "kind": "Node", "namespace": NODE_NAMESPACE,
+            "name": self.node_name, "subresource": "status",
+            "patch": {"status": self._heartbeat_status()},
+        }]
+        for e in entries:
+            patch = {"status": e["changes"]}
+            if e["rv"]:
+                patch["metadata"] = {"resource_version": e["rv"]}
+            items.append({
+                "kind": "Pod", "namespace": e["namespace"], "name": e["name"],
+                "subresource": "status", "patch": patch,
+            })
+        try:
+            results = self.store.patch_batch(items)
+        except Forbidden as denial:
+            # authz fails the whole batch when ANY item is out of scope —
+            # e.g. a stale mirror for a pod that was deleted and recreated
+            # UNBOUND under the same name (the new incarnation is not ours
+            # to patch, and rightly so). Degrade this tick to per-item
+            # writes: the heartbeat and every legitimate mirror land, and
+            # only the entries authz genuinely denies are dropped (their
+            # pod is not ours anymore; the mirror is moot).
+            log.warning("batch rejected (%s); retrying per-item", denial)
             try:
-                cur = self.store.get("Node", NODE_NAMESPACE, self.node_name)
-            except NotFound:
-                self.store.create(tmpl)
-                return
-            # the cordon flag belongs to the operator (`ctl cordon/drain`),
-            # not to this agent: a heartbeat must never un-cordon the node.
-            # Optimistic update (NOT force): a cordon committed between our
-            # read and write raises Conflict and we re-read — a forced write
-            # would silently resurrect the stale uncordoned copy.
-            tmpl.status.unschedulable = cur.status.unschedulable
-            cur.status = tmpl.status
+                self._register()
+            except Exception:
+                self.batcher.requeue(entries)  # nothing flushed yet
+                raise
+            for i, e in enumerate(entries):
+                try:
+                    committed = patch_pod_status(
+                        self.store, e["namespace"], e["name"], e["uid"],
+                        e["changes"], expected_rv=e["rv"],
+                        what="agent-mirror",
+                    )
+                    if committed is not None:
+                        self.batcher.note_committed(e, committed)
+                except Forbidden as fe:
+                    log.warning(
+                        "dropping out-of-scope mirror %s/%s: %s",
+                        e["namespace"], e["name"], fe,
+                    )
+                    self.batcher.forget(e["namespace"], e["name"])
+                except Exception:
+                    # store went away mid-loop: keep the rest for next tick
+                    self.batcher.requeue(entries[i:])
+                    raise
+            return
+        except Exception:
+            # the REQUEST failed (not an item): nothing committed — put the
+            # mirrors back so the next tick retries them
+            self.batcher.requeue(entries)
+            raise
+        node_res = results[0] if results else None
+        if isinstance(node_res, NotFound):
             try:
-                self.store.update(cur)
-                return
-            except Conflict:
-                continue
-        log.warning("heartbeat lost a conflict race 5x; next beat retries")
+                self._register()  # Node deleted out from under us: recreate
+            except Exception:
+                # re-registration died (store went away again): the pod
+                # entries' Conflict fallbacks below haven't run — keep them
+                # for the next tick (re-applying committed ones is
+                # idempotent; terminal re-sends drop on the finished guard)
+                self.batcher.requeue(entries)
+                raise
+        elif isinstance(node_res, Exception):
+            log.warning("node heartbeat rejected: %s", node_res)
+        pod_results = list(zip(entries, results[1:]))
+        for i, (e, res) in enumerate(pod_results):
+            try:
+                if isinstance(res, Conflict):
+                    committed = patch_pod_status(
+                        self.store, e["namespace"], e["name"], e["uid"],
+                        e["changes"], what="agent-mirror",
+                    )
+                    if committed is not None:
+                        self.batcher.note_committed(e, committed)
+                elif isinstance(res, NotFound):
+                    # pod deleted (gang cleanup): nothing to mirror, and
+                    # its rv anchor has nothing left to anchor
+                    self.batcher.forget(e["namespace"], e["name"])
+                elif isinstance(res, Exception):
+                    log.warning(
+                        "status mirror of %s/%s rejected: %s",
+                        e["namespace"], e["name"], res,
+                    )
+                else:
+                    self.batcher.note_committed(e, res)
+            except Exception:
+                # the store went away mid-fallback (past the client's
+                # retry window): the mirror for THIS entry and every one
+                # not yet processed must survive to the next tick — a
+                # dropped terminal mirror would leave its pod RUNNING in
+                # the store forever (the executor enqueues each transition
+                # exactly once). Re-applying an already-committed patch on
+                # retry is idempotent (same merge, conflict path re-reads).
+                self.batcher.requeue([x for x, _ in pod_results[i:]])
+                raise
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(self.heartbeat_interval):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.heartbeat_interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
-                self._register()  # create-or-refresh: survives node deletion
+                self._tick()
             except Exception:
-                # store briefly unreachable: keep trying — the monitor's
-                # grace period absorbs short gaps
-                log.warning("heartbeat failed; retrying", exc_info=True)
+                # store briefly unreachable past the client's own
+                # retry/backoff window: keep trying — the monitor's grace
+                # period absorbs short gaps, and the batcher re-coalesces
+                # mirrors enqueued meanwhile
+                log.warning("heartbeat tick failed; retrying", exc_info=True)
 
     def _evict_orphans(self) -> None:
         """A restarted agent lost its child processes: any pod the store
@@ -311,21 +558,54 @@ class NodeAgent:
         )
         return self
 
+    def _drain_mirrors(self) -> None:
+        """Flush every queued pod mirror synchronously (shutdown path —
+        best-effort per entry; the monitor's eviction is the backstop)."""
+        for e in self.batcher.drain():
+            try:
+                patch_pod_status(
+                    self.store, e["namespace"], e["name"], e["uid"],
+                    e["changes"], expected_rv=e["rv"], what="agent-drain",
+                )
+            except Exception:
+                pass
+
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock the heartbeat loop promptly
+        if self._hb_thread is not None:
+            # wait out any in-flight tick BEFORE the shutdown writes: a
+            # stalled tick (store restarting, client mid-backoff) could
+            # otherwise commit ready=True AFTER our final ready=False —
+            # resurrecting a heartbeat for a dead agent — or requeue its
+            # failed batch's mirrors after the drain below already ran,
+            # stranding them forever. Bounded: a tick blocks at most the
+            # client's request timeout plus its conn-refused backoff.
+            self._hb_thread.join(timeout=15.0)
         self.executor.stop()
+        # the stop just killed every child process; their reapers enqueue
+        # terminal mirrors into the batcher, whose flusher is exiting —
+        # drain them synchronously so killed pods are marked Failed NOW
+        # (the old direct-write path did this implicitly; leaving them
+        # RUNNING would stall the gang restart for the monitor's whole
+        # heartbeat grace window)
+        self.executor.join_reapers(timeout=2.0)
+        self._drain_mirrors()
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            # a degraded tick (one request per entry against a slow store)
+            # can outlive the first join: wait it out and sweep whatever
+            # its failure path requeued after our drain. If the store is
+            # down hard even past this, the monitor's heartbeat-grace
+            # eviction is the documented backstop.
+            self._hb_thread.join(timeout=30.0)
+            self._drain_mirrors()
         try:
-            from mpi_operator_tpu.machinery.store import optimistic_update
-
-            def mutate(cur) -> bool:
-                cur.status.ready = False
-                return True
-
-            # optimistic, not force: node-scoped credentials forbid force,
-            # and a concurrent cordon must not be clobbered
-            optimistic_update(
-                self.store, "Node", NODE_NAMESPACE, self.node_name, mutate,
-                what="agent-stop",
+            # one unconditional status patch: the cordon flag is untouched
+            # by construction (merge semantics), and NODE-tier credentials
+            # are allowed exactly this write
+            self.store.patch(
+                "Node", NODE_NAMESPACE, self.node_name,
+                {"status": {"ready": False}}, subresource="status",
             )
         except Exception:
             pass  # best-effort drain mark; the monitor catches it anyway

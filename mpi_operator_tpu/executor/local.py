@@ -92,6 +92,7 @@ class LocalExecutor:
         logs_dir: Optional[str] = None,
         node_name: Optional[str] = None,
         log_url_base: Optional[str] = None,
+        status_sink=None,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
@@ -108,7 +109,17 @@ class LocalExecutor:
         self.log_url_base = log_url_base.rstrip("/") if log_url_base else None
         self.extra_env = dict(extra_env or {})
         self.workdir = workdir
+        # when set (agent mode), status mirrors are enqueued here instead of
+        # written directly: the NodeAgent flushes the sink together with its
+        # Node heartbeat as ONE patch-batch request per tick
+        self.status_sink = status_sink
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
+        # pod key → (uid, rv) of our last committed status write: anchors
+        # the next patch's rv precondition so the mirror stays 1 request
+        # (only this executor writes a bound pod's status in steady state).
+        # Own lock: _set_phase runs both inside and outside _lock.
+        self._status_rv: Dict[str, tuple] = {}
+        self._rv_lock = threading.Lock()
         self.logs: Dict[str, tuple] = {}  # pod key → (stdout, stderr)
         # kubelet log dir: pod stdout/stderr stream to files here while the
         # pod runs; the stdout path is stamped into pod.status.log_path so
@@ -142,6 +153,19 @@ class LocalExecutor:
             for p in self._procs.values():
                 if p.poll() is None:
                     p.kill()
+
+    def join_reapers(self, timeout: float = 2.0) -> None:
+        """Wait for in-flight reap threads to finish recording their pods'
+        exits (stop() just killed the processes, so they return promptly).
+        A stopping NodeAgent calls this before its final batcher flush —
+        otherwise the terminal mirrors the reapers are about to enqueue
+        would land in a sink nobody drains again."""
+        import time
+
+        deadline = time.time() + timeout
+        for t in list(self._threads):
+            if t.is_alive() and t.name.startswith("reap-"):
+                t.join(timeout=max(0.0, deadline - time.time()))
 
     def wait_idle(self, timeout: float = 60.0) -> bool:
         """Block until no managed process is still running (for tests/CLI)."""
@@ -194,7 +218,11 @@ class LocalExecutor:
         d = self._config_dir(cm.metadata.namespace, job_name)
         os.makedirs(d, exist_ok=True)
         for fname, content in cm.data.items():
-            tmp = os.path.join(d, f".{fname}.tmp")
+            # unique tmp per writer: start()'s adoption pass and the watch
+            # thread can project the same ConfigMap concurrently — a shared
+            # tmp name let one writer replace the file out from under the
+            # other (FileNotFoundError on the loser's os.replace)
+            tmp = os.path.join(d, f".{fname}.{uuid.uuid4().hex[:8]}.tmp")
             with open(tmp, "w") as f:
                 f.write(content)
             os.replace(tmp, os.path.join(d, fname))  # atomic swap, no torn reads
@@ -225,6 +253,8 @@ class LocalExecutor:
         with self._lock:
             proc = self._procs.pop(key, None)
             self.logs.pop(key, None)
+        with self._rv_lock:
+            self._status_rv.pop(key, None)
         if proc is not None and proc.poll() is None:
             proc.kill()
 
@@ -353,14 +383,21 @@ class LocalExecutor:
         except OSError:
             pass  # log files are best-effort; phase/exit code still land
         self.logs[self._pod_key(pod)] = (out, err)
-        if proc.returncode == 0:
-            self._set_phase(pod, PodPhase.SUCCEEDED, exit_code=0)
-        else:
-            tail = (err or out or "").strip()[-1024:]  # ≙ truncateMessage(:1524)
-            self._set_phase(
-                pod, PodPhase.FAILED, reason=f"ExitCode{proc.returncode}",
-                message=tail, exit_code=proc.returncode,
-            )
+        try:
+            if proc.returncode == 0:
+                self._set_phase(pod, PodPhase.SUCCEEDED, exit_code=0)
+            else:
+                tail = (err or out or "").strip()[-1024:]  # ≙ truncateMessage(:1524)
+                self._set_phase(
+                    pod, PodPhase.FAILED, reason=f"ExitCode{proc.returncode}",
+                    message=tail, exit_code=proc.returncode,
+                )
+        except Exception:
+            # store gone mid-teardown (closed sqlite, hard outage past the
+            # client's retry window): the mirror is lost but the thread
+            # must not die noisily — the monitor's eviction is the backstop
+            log.warning("pod %s exit mirror failed", self._pod_key(pod),
+                        exc_info=True)
         log.info(
             "pod %s exited rc=%d", self._pod_key(pod), proc.returncode
         )
@@ -376,42 +413,56 @@ class LocalExecutor:
         exit_code: Optional[int] = None,
         log_path: str = "",
     ) -> None:
-        # optimistic conflict-retry, NOT force (status is the executor's to
-        # own like a kubelet, but a concurrent controller/scheduler write
-        # must surface as Conflict and be re-read, and node-scoped store
-        # credentials forbid force outright). The guards re-check on every
-        # attempt.
-        from mpi_operator_tpu.machinery.store import optimistic_update
+        # status mirror over the PATCH verb (status subresource — the only
+        # write scope the NODE token tier needs): one request in the
+        # common case, with the same guards the old GET+PUT loop enforced —
+        # incarnation (uid) and write-once terminal — carried by
+        # patch_pod_status's rv precondition + conflict re-check. The
+        # snapshot anchoring the rv is the watch event that triggered the
+        # launch (binding is its freshest write) or our own last committed
+        # status, so the precondition almost never misses.
+        changes = {
+            "phase": phase,
+            "ready": phase == PodPhase.RUNNING,
+            "reason": reason,
+        }
+        if message:
+            changes["message"] = message
+        if ip:
+            changes["pod_ip"] = ip
+        if exit_code is not None:
+            changes["exit_code"] = exit_code
+        if log_path:
+            changes["log_path"] = log_path
+        key = self._pod_key(pod)
+        if self.status_sink is not None:
+            # agent mode: the sink coalesces this with every other dirty
+            # mirror and the Node heartbeat into ONE patch-batch request
+            # per tick (O(pods) requests → O(1)); ordering per pod is
+            # preserved, commit is asynchronous but prompt (the sink wakes
+            # its flusher). The sink owns the rv anchoring there
+            # (StatusBatcher._committed) — _status_rv is the DIRECT path's
+            # anchor only.
+            self.status_sink.enqueue(
+                pod.metadata.namespace, pod.metadata.name, pod.metadata.uid,
+                pod.metadata.resource_version or 0, changes,
+            )
+            return
+        with self._rv_lock:
+            known = self._status_rv.get(key)
+        expected_rv = pod.metadata.resource_version or 0
+        if known is not None and known[0] == pod.metadata.uid:
+            expected_rv = max(expected_rv, known[1])
+        from mpi_operator_tpu.machinery.objects import patch_pod_status
 
-        def mutate(cur) -> bool:
-            if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
-                # same name, different incarnation: a gang restart deleted
-                # and recreated the pod while this update was in flight
-                # (e.g. the reaper of a process _forget just killed,
-                # rc=-9). Stamping the old incarnation's exit onto the
-                # fresh PENDING pod would fail the restarted job with its
-                # predecessor's corpse.
-                return False
-            if cur.is_finished():
-                # terminal status is WRITE-ONCE: an external eviction
-                # (drain / node monitor) must not be overwritten by the
-                # reaper of the process we then killed (its rc=-9 would
-                # erase the Evicted reason — the retryable signal)
-                return False
-            cur.status.phase = phase
-            cur.status.ready = phase == PodPhase.RUNNING
-            cur.status.reason = reason
-            if message:
-                cur.status.message = message
-            if ip:
-                cur.status.pod_ip = ip
-            if exit_code is not None:
-                cur.status.exit_code = exit_code
-            if log_path:
-                cur.status.log_path = log_path
-            return True
-
-        optimistic_update(
-            self.store, "Pod", pod.metadata.namespace, pod.metadata.name,
-            mutate, what="set-phase",
+        committed = patch_pod_status(
+            self.store, pod.metadata.namespace, pod.metadata.name,
+            pod.metadata.uid, changes, expected_rv=expected_rv,
+            what="set-phase",
         )
+        if committed is not None:
+            with self._rv_lock:
+                self._status_rv[key] = (
+                    committed.metadata.uid,
+                    committed.metadata.resource_version,
+                )

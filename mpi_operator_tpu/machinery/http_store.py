@@ -51,14 +51,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from mpi_operator_tpu.machinery.serialize import decode, encode
+from mpi_operator_tpu.opshell import metrics
 from mpi_operator_tpu.machinery.store import (
     MODIFIED,
     AlreadyExists,
+    BadPatch,
     Conflict,
     Forbidden,
     NotFound,
     Unauthorized,
     WatchEvent,
+    patch_batch_via_loop,
 )
 
 _ERROR_CLASSES = {
@@ -67,6 +70,7 @@ _ERROR_CLASSES = {
     "Conflict": Conflict,
     "Unauthorized": Unauthorized,
     "Forbidden": Forbidden,
+    "BadPatch": BadPatch,
 }
 
 # Store objects are manifests and status records — O(KB). The cap keeps an
@@ -494,7 +498,7 @@ class StoreServer:
                         return
                     code, payload = server._handle(
                         method, self.path,
-                        body() if method in ("POST", "PUT") else {},
+                        body() if method in ("POST", "PUT", "PATCH") else {},
                     )
                     self._send(code, payload)
                 except json.JSONDecodeError as e:
@@ -531,6 +535,9 @@ class StoreServer:
 
             def do_PUT(self):
                 self._dispatch("PUT")
+
+            def do_PATCH(self):
+                self._dispatch("PATCH")
 
             def do_DELETE(self):
                 self._dispatch("DELETE")
@@ -580,6 +587,7 @@ class StoreServer:
         self._stats: Dict[str, int] = {
             "get": 0, "list": 0, "watch": 0,
             "create": 0, "update": 0, "delete": 0, "relist": 0,
+            "patch": 0, "patch_batch": 0, "patch_item": 0, "conflict": 0,
         }
         self._watch_q = backing.watch(None)
         # rv anchor: everything at or below the backing's CURRENT rv is
@@ -628,15 +636,25 @@ class StoreServer:
                 ev.obj.metadata.resource_version or 0,
             )
 
+    # verbs that mirror into the tpu_operator_store_write_requests_total
+    # counter (patch_batch = the batch request, patch_item = its items)
+    _WRITE_VERBS = ("create", "update", "delete", "patch", "patch_batch",
+                    "patch_item")
+
     def stats(self) -> Dict[str, int]:
         """Snapshot of per-route request counters (reads: get/list/watch;
-        writes: create/update/delete; relist = full-state recoveries served)."""
+        writes: create/update/delete/patch/patch_batch; relist = full-state
+        recoveries served; conflict = optimistic 409s bounced)."""
         with self._stats_lock:
             return dict(self._stats)
 
     def _count(self, what: str) -> None:
         with self._stats_lock:
             self._stats[what] = self._stats.get(what, 0) + 1
+        if what in self._WRITE_VERBS:
+            metrics.store_write_requests.inc(verb=what)
+        elif what == "conflict":
+            metrics.store_write_conflicts.inc()
 
     # -- authorization ------------------------------------------------------
 
@@ -658,6 +676,31 @@ class StoreServer:
         obj = obj if isinstance(obj, dict) else {}
         meta = obj.get("metadata")
         meta = meta if isinstance(meta, dict) else {}
+        if method == "POST" and parts == ["v1", "patch-batch"]:
+            items = body.get("items") if isinstance(body, dict) else None
+            if not isinstance(items, list):
+                return None  # malformed: the handler 400s it for every tier
+            for it in items:
+                it = it if isinstance(it, dict) else {}
+                denied = self._agent_patch_denied(
+                    [str(it.get("kind", "")), str(it.get("namespace", "")),
+                     str(it.get("name", "")),
+                     str(it.get("subresource") or "")],
+                    it.get("patch"), node,
+                )
+                if denied is not None:
+                    return denied  # one out-of-scope item fails the batch
+            return None
+        if (
+            method == "PATCH"
+            and len(parts) in (5, 6)
+            and parts[:2] == ["v1", "objects"]
+        ):
+            rest = parts[2:] + ([""] if len(parts) == 5 else [])
+            return self._agent_patch_denied(
+                rest, body.get("patch") if isinstance(body, dict) else None,
+                node,
+            )
         if method == "POST" and parts == ["v1", "objects"]:
             if (
                 body.get("kind") == "Node"
@@ -762,6 +805,86 @@ class StoreServer:
                 return None  # status mirror / eviction of its own pod
         return 403, f"agent {node!r} may not {method} this route"
 
+    def _agent_patch_denied(
+        self, rest: List[str], patch: Any, node: str
+    ) -> Optional[Tuple[int, str]]:
+        """The NODE tier's PATCH scope — strictly TIGHTER than its PUT
+        scope: **status subresource only** (spec/metadata are frozen by the
+        store itself, so a compromised agent physically cannot rebind,
+        relabel or re-uid anything through this verb), on its own Node
+        (minus the cordon flag) and on pods currently bound to it. ``rest``
+        is [kind, namespace, name, subresource]; None = allowed."""
+        from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+        if len(rest) != 4:
+            return 403, f"agent {node!r} may not PATCH this route"
+        kind, ns, name, subresource = rest
+        if subresource != "status":
+            return (403,
+                    f"agent {node!r} is granted patch-status-only "
+                    f"(use the /status subresource)")
+        patch = patch if isinstance(patch, dict) else {}
+        status = patch.get("status")
+        status = status if isinstance(status, dict) else {}
+        if kind == "Node":
+            if ns != NODE_NAMESPACE or name != node:
+                return 403, f"agent {node!r} may only patch its own Node"
+            if "unschedulable" in status:
+                # the cordon flag belongs to the OPERATOR; rejecting the
+                # KEY outright (not just value flips) keeps the check
+                # TOCTOU-free — there is no stored state to race against,
+                # and a heartbeat has no reason to mention the flag
+                return (403,
+                        f"agent {node!r} may not touch "
+                        f"status.unschedulable (cordon is operator-owned)")
+            return None  # its own heartbeat
+        if kind == "Pod":
+            try:
+                cur = self.backing.get("Pod", ns, name)
+            except KeyError:
+                # pod already deleted (gang cleanup racing the agent's
+                # flush): ALLOW, and let the handler produce the per-item
+                # NotFound the agent expects in-band — a 403 here would
+                # fail the whole batch, heartbeat included, and the agent
+                # would requeue the dead pod's mirror and 403 on every
+                # subsequent tick until the monitor declared it lost.
+                # Pin "absent" so a pod recreated (possibly bound to
+                # another tenant's node) between this check and the apply
+                # can NEVER be hit: the impossible uid precondition turns
+                # such a race into an in-band Conflict.
+                self._pin_uid(patch, "")
+                return None
+            if cur.spec.node_name != node:
+                return (403,
+                        f"agent {node!r} may only patch pods bound to its "
+                        f"node (pod {ns}/{name} is bound to "
+                        f"{cur.spec.node_name!r})")
+            # apply-time scope enforcement: pin the patch to the EXACT
+            # incarnation whose binding was just verified — the store's
+            # uid precondition is checked atomically with the merge, so
+            # the authz-to-apply window (delete + recreate, batch items
+            # applying one by one) cannot be exploited to write a pod
+            # this agent does not own
+            self._pin_uid(patch, cur.metadata.uid)
+            return None  # status mirror of its own pod
+        return 403, f"agent {node!r} may not patch {kind} objects"
+
+    @staticmethod
+    def _pin_uid(patch: Any, uid: str) -> None:
+        """Inject a uid precondition into an (in-place shared) patch dict:
+        the handler applies the SAME object _auth_error inspected, so the
+        pin travels with the request. Overwrites any client-supplied uid —
+        the server-observed incarnation is authoritative for scope. A
+        malformed patch (non-dict, non-dict metadata) is left alone; the
+        backing rejects it with BadPatch anyway."""
+        if not isinstance(patch, dict):
+            return
+        meta = patch.get("metadata")
+        if meta is None:
+            patch["metadata"] = {"uid": uid}
+        elif isinstance(meta, dict):
+            meta["uid"] = uid
+
     # -- request handling ---------------------------------------------------
 
     def _handle(
@@ -777,6 +900,8 @@ class StoreServer:
                 return 200, {"ok": True}
             if parts == ["v1", "watch"] and method == "GET":
                 return self._handle_watch(qs)
+            if parts == ["v1", "patch-batch"] and method == "POST":
+                return self._handle_patch_batch(body)
             if parts[:2] == ["v1", "objects"]:
                 return self._handle_objects(method, parts[2:], qs, body)
             return 404, {"error": "NotFound", "message": f"no route {parsed.path}"}
@@ -785,7 +910,10 @@ class StoreServer:
         except AlreadyExists as e:
             return 409, {"error": "AlreadyExists", "message": str(e)}
         except Conflict as e:
+            self._count("conflict")
             return 409, {"error": "Conflict", "message": str(e)}
+        except BadPatch as e:
+            return 400, {"error": "BadPatch", "message": str(e)}
         except KeyError as e:  # unknown kind from serialize registry
             return 400, {"error": "BadRequest", "message": str(e)}
 
@@ -851,7 +979,47 @@ class StoreServer:
             if method == "DELETE":
                 self._count("delete")
                 return 200, {"object": encode(self.backing.delete(kind, namespace, name))}
+        if method == "PATCH" and len(rest) in (3, 4):
+            kind, namespace, name = rest[:3]
+            subresource = rest[3] if len(rest) == 4 else None
+            self._count("patch")
+            obj = self.backing.patch(
+                kind, namespace, name, body.get("patch"),
+                subresource=subresource,
+            )
+            return 200, {"object": encode(obj)}
         return 404, {"error": "NotFound", "message": "bad objects route"}
+
+    def _handle_patch_batch(
+        self, body: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """One request, many merge-patches (the agent-tick verb: Node
+        heartbeat + every dirty pod mirror in a single round-trip). Items
+        apply in order, each atomic on its own; per-item errors come back
+        in-band so one missing pod can't fail the heartbeat riding next
+        to it."""
+        items = body.get("items")
+        if not isinstance(items, list):
+            return 400, {"error": "BadPatch", "message": "items must be a list"}
+        self._count("patch_batch")
+        results = []
+        # ONE source of truth for batch semantics: the same loop the
+        # in-process backends run (item validation, error-to-value mapping)
+        # — only the wire encoding and counters are HTTP concerns
+        for val in patch_batch_via_loop(self.backing, items):
+            if isinstance(val, Exception):
+                if isinstance(val, Conflict):
+                    self._count("conflict")
+                results.append(
+                    {"error": type(val).__name__, "message": str(val)}
+                )
+            else:
+                # patch_item, NOT patch: "patch" counts REQUESTS (the
+                # round-trips the verb exists to collapse); items ride one
+                # patch_batch request and are tallied separately
+                self._count("patch_item")
+                results.append({"object": encode(val)})
+        return 200, {"results": results}
 
     def _handle_watch(self, qs: Dict[str, List[str]]) -> Tuple[int, Dict[str, Any]]:
         try:
@@ -959,11 +1127,25 @@ class HttpStoreClient:
     def __init__(self, url: str, *, timeout: float = 10.0,
                  watch_poll_timeout: float = 25.0,
                  token: Optional[str] = None,
-                 ca_file: Optional[str] = None):
+                 ca_file: Optional[str] = None,
+                 conn_refused_retries: int = 5,
+                 retry_base_delay: float = 0.1):
         self.url = url.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
+        # bounded retry/backoff across a store restart window (the
+        # apiserver-HA resilience the reference gets for free,
+        # proposals/scalable-robust-operator.md:90-113): a CONNECTION-
+        # REFUSED request never reached the server, so replaying it is
+        # safe for every verb — rv-guarded PUT/PATCH would 409 on a
+        # phantom duplicate anyway. Default 5 retries, 0.1s doubling to a
+        # 2s cap (~3s window) rides out a quick restart without turning a
+        # hard outage into a hang. 0 disables.
+        self.conn_refused_retries = conn_refused_retries
+        self.retry_base_delay = retry_base_delay
+        # observable by tests/benches: how often the backoff path fired
+        self.retry_stats = {"conn_refused_retries": 0}
         # https:// store with a self-signed cert: pin it (or its CA) here —
         # certificate verification stays ON; we only change the trust root.
         # None = system trust store.
@@ -999,21 +1181,39 @@ class HttpStoreClient:
         req = urllib.request.Request(
             self.url + path, data=data, method=method, headers=headers,
         )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ssl_ctx
-            ) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            payload = {}
+        delay = self.retry_base_delay
+        attempt = 0
+        while True:
             try:
-                payload = json.loads(e.read())
-            except Exception:
-                pass
-            cls = _ERROR_CLASSES.get(payload.get("error", ""))
-            if cls is not None:
-                raise cls(payload.get("message", str(e))) from None
-            raise
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout, context=self._ssl_ctx
+                ) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                payload = {}
+                try:
+                    payload = json.loads(e.read())
+                except Exception:
+                    pass
+                cls = _ERROR_CLASSES.get(payload.get("error", ""))
+                if cls is not None:
+                    raise cls(payload.get("message", str(e))) from None
+                raise
+            except urllib.error.URLError as e:
+                # connection refused = the request NEVER reached the server
+                # (unlike a reset mid-flight, there is nothing ambiguous to
+                # replay): bounded backoff so a store restart window does
+                # not kill heartbeating agents or drop a status mirror
+                if (
+                    attempt >= self.conn_refused_retries
+                    or not isinstance(e.reason, ConnectionRefusedError)
+                ):
+                    raise
+                attempt += 1
+                self.retry_stats["conn_refused_retries"] += 1
+                if self._stop.wait(delay):
+                    raise  # closing: don't outlive the client
+                delay = min(delay * 2, 2.0)
 
     # -- CRUD (same contracts as ObjectStore) -------------------------------
 
@@ -1044,6 +1244,45 @@ class HttpStoreClient:
             {"object": encode(obj)},
         )
         return decode(obj.kind, r["object"])
+
+    def patch(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Any,
+        *,
+        subresource: Optional[str] = None,
+    ) -> Any:
+        """Server-side merge-patch: ONE round-trip where the GET+PUT
+        optimistic loop needed two-plus (same contract as the other
+        backends — rv precondition via metadata.resource_version in the
+        patch, status subresource via ``subresource='status'``)."""
+        path = f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
+        if subresource:
+            path += f"/{_quote(subresource)}"
+        r = self._request("PATCH", path, {"patch": patch})
+        return decode(kind, r["object"])
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        """Many patches, one request (the agent-tick verb). Same result
+        contract as the in-process backends: committed objects in item
+        order, per-item failures as exception VALUES."""
+        r = self._request(
+            "POST", "/v1/patch-batch",
+            {"items": [
+                {k: v for k, v in it.items() if v is not None}
+                for it in items
+            ]},
+        )
+        out: List[Any] = []
+        for it, res in zip(items, r.get("results", [])):
+            if "object" in res:
+                out.append(decode(it["kind"], res["object"]))
+            else:
+                cls = _ERROR_CLASSES.get(res.get("error", ""), RuntimeError)
+                out.append(cls(res.get("message", "")))
+        return out
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         r = self._request(

@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from mpi_operator_tpu.api.types import Container, ObjectMeta, _Dictable
-from mpi_operator_tpu.machinery.store import optimistic_update
+from mpi_operator_tpu.machinery.store import Conflict, NotFound
 
 
 class PodPhase:
@@ -196,6 +196,78 @@ class Event(_Dictable):
     timestamp: float = 0.0
 
 
+def patch_pod_status(
+    store,
+    namespace: str,
+    name: str,
+    uid: str,
+    changes: Dict,
+    *,
+    expected_rv=None,
+    attempts: int = 5,
+    what: str = "patch-pod-status",
+):
+    """THE pod status-mirror write (kubelet semantics over the PATCH verb),
+    shared by the executor's phase mirror and evict_pod so the guards can
+    never fork:
+
+    - **incarnation guard**: ``uid`` must still match — a gang restart
+      deleting and recreating the pod same-name must not inherit its
+      predecessor's exit;
+    - **write-once terminal**: a finished pod is never overwritten (an
+      external eviction's retryable reason must survive the reaper of the
+      process the eviction then killed).
+
+    Fast path: when the caller holds a snapshot it already verified the
+    guards against, ``expected_rv`` rides the patch as an rv precondition —
+    a match PROVES the object is byte-identical to that snapshot, so the
+    guards hold and the write is ONE request (no GET leg, the
+    GET+PUT+409-retry loop collapsed). Only on Conflict does it fall back
+    to read-and-re-check, which is exactly what the old loop did every
+    time. Returns the committed pod, or None when the pod is gone, a new
+    incarnation, or already terminal."""
+    body = {"status": dict(changes)}
+    if expected_rv:
+        try:
+            return store.patch(
+                "Pod", namespace, name,
+                {"metadata": {"resource_version": expected_rv}, **body},
+                subresource="status",
+            )
+        except NotFound:
+            return None
+        except Conflict:
+            pass  # snapshot went stale: re-read and re-check the guards
+    for _ in range(attempts):
+        try:
+            cur = store.get("Pod", namespace, name)
+        except NotFound:
+            return None
+        if uid and cur.metadata.uid != uid:
+            return None
+        if cur.is_finished():
+            return None
+        try:
+            return store.patch(
+                "Pod", namespace, name,
+                {"metadata": {
+                    "resource_version": cur.metadata.resource_version,
+                 }, **body},
+                subresource="status",
+            )
+        except NotFound:
+            return None
+        except Conflict:
+            continue
+    import logging
+
+    logging.getLogger("tpujob.machinery").warning(
+        "%s: status patch of Pod %s/%s lost the write race %dx; left as-is",
+        what, namespace, name, attempts,
+    )
+    return None
+
+
 def evict_pod(store, pod: "Pod", message: str, *,
               reason: str = "Evicted") -> bool:
     """Mark a pod Evicted — THE eviction primitive (reason=Evicted is what
@@ -203,28 +275,25 @@ def evict_pod(store, pod: "Pod", message: str, *,
     gang-coherent restart). Shared by the node monitor (lost nodes),
     `ctl drain`, and the agent's restart reconciliation so the semantics
     can never fork. Returns False when the pod is already gone/finished.
-    Callers own their own events/metrics."""
-    # Optimistic (NOT force) via optimistic_update: a reaper stamping
-    # Succeeded between the read and a forced write would be clobbered into
-    # a retryable Failed — turning a completed pod into a spurious gang
-    # restart. The preconditions re-check on every Conflict re-read.
-    def mutate(cur) -> bool:
-        if pod.metadata.uid and cur.metadata.uid != pod.metadata.uid:
-            # same name, different incarnation: a gang restart recreated the
-            # pod since the caller observed it — evicting the fresh one would
-            # fail a pod that was never on the dead/drained node (the same
-            # guard executor._set_phase applies)
-            return False
-        if cur.is_finished():
-            return False
-        cur.status.phase = PodPhase.FAILED
-        cur.status.ready = False
-        cur.status.reason = reason  # "Evicted" | "Preempted" (is_evicted)
-        cur.status.message = message
-        return True
+    Callers own their own events/metrics.
 
-    return optimistic_update(
-        store, "Pod", pod.metadata.namespace, pod.metadata.name, mutate,
+    Rides patch_pod_status: the caller's snapshot anchors the rv fast
+    path, so the common eviction is one status-subresource PATCH — which
+    also means the NODE token tier can evict its own pods without
+    full-object write rights."""
+    if pod.is_finished():
+        # the snapshot itself is terminal: the rv fast path would otherwise
+        # trust it and overwrite the write-once terminal status
+        return False
+    return patch_pod_status(
+        store, pod.metadata.namespace, pod.metadata.name, pod.metadata.uid,
+        {
+            "phase": PodPhase.FAILED,
+            "ready": False,
+            "reason": reason,  # "Evicted" | "Preempted" (is_evicted)
+            "message": message,
+        },
+        expected_rv=pod.metadata.resource_version,
         what="evict_pod",
     ) is not None
 

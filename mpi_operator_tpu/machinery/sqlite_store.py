@@ -41,6 +41,8 @@ from mpi_operator_tpu.machinery.store import (
     Conflict,
     NotFound,
     WatchEvent,
+    apply_merge_patch_dict,
+    patch_batch_via_loop,
 )
 
 _SCHEMA = """
@@ -210,6 +212,52 @@ class SqliteStore:
                 (rv, self._dump(obj), obj.kind, m.namespace, m.name),
             )
         return obj.deepcopy()
+
+    def patch(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Any,
+        *,
+        subresource: Optional[str] = None,
+    ) -> Any:
+        """Merge-patch applied inside one sqlite transaction (read-merge-
+        write under the database lock): rv precondition, identity freeze
+        and the status subresource come from the shared
+        apply_merge_patch_dict core, so semantics match ObjectStore
+        exactly. The log row allocates the fresh global rv like any
+        update."""
+        with self._lock, self._conn:
+            cur = self._conn.cursor()
+            row = cur.execute(
+                "SELECT rv, data FROM objects "
+                "WHERE kind=? AND namespace=? AND name=?",
+                (kind, namespace, name),
+            ).fetchone()
+            if row is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            merged = apply_merge_patch_dict(
+                kind, json.loads(row[1]), patch, subresource=subresource,
+                current_rv=row[0],
+            )
+            obj = self._load(kind, json.dumps(merged))
+            rv = self._log(cur, MODIFIED, obj)
+            obj.metadata.resource_version = rv
+            cur.execute(
+                "UPDATE log SET data=? WHERE rv=?", (self._dump(obj), rv)
+            )
+            cur.execute(
+                "UPDATE objects SET rv=?, data=? "
+                "WHERE kind=? AND namespace=? AND name=?",
+                (rv, self._dump(obj), kind, namespace, name),
+            )
+        return obj
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        """Per-item atomic patches in order, errors as values (the shared
+        patch_batch contract; each item is its own transaction)."""
+        return patch_batch_via_loop(self, items)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock, self._conn:

@@ -51,9 +51,182 @@ class Forbidden(PermissionError):
     a viewer physically cannot delete a job)."""
 
 
+class BadPatch(ValueError):
+    """A merge-patch was malformed or tried to cross a boundary the patch
+    surface freezes (identity metadata; anything but status through the
+    status subresource). 400 on the HTTP seam — a caller bug, never a
+    retryable condition."""
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+# Metadata fields a merge-patch may never change: they ARE the object's
+# identity (the store key + the incarnation guard every optimistic consumer
+# leans on). resource_version is excluded — submitting it is the documented
+# precondition mechanism, and the store restamps it anyway.
+_IDENTITY_META = ("name", "namespace", "uid", "creation_timestamp")
+
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge-patch: maps merge recursively, ``null`` deletes
+    the key, everything else (lists included) replaces wholesale."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(out.get(k), v)
+    return out
+
+
+_MISSING = object()
+
+
+def diff_merge_patch(old: Any, new: Any) -> Dict[str, Any]:
+    """The minimal RFC 7386 patch transforming ``old`` into ``new`` (both
+    plain dicts): unchanged keys are omitted, removed keys become ``null``.
+    THE way write paths build their patches — sending the full intended
+    object as a merge-patch could never *delete* a stale key, and sending
+    only hand-picked fields forgets the deletions too."""
+    patch: Dict[str, Any] = {}
+    old = old if isinstance(old, dict) else {}
+    for k, v in new.items():
+        ov = old.get(k, _MISSING)
+        if isinstance(v, dict) and isinstance(ov, dict):
+            sub = diff_merge_patch(ov, v)
+            if sub:
+                patch[k] = sub
+        elif ov is _MISSING or ov != v:
+            patch[k] = v
+    for k in old:
+        if k not in new:
+            patch[k] = None
+    return patch
+
+
+def apply_merge_patch_dict(
+    kind: str,
+    current: Dict[str, Any],
+    patch: Any,
+    *,
+    subresource: Optional[str] = None,
+    current_rv: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Validate + apply a merge-patch to an encoded object dict — THE shared
+    core of every backend's ``patch`` verb, so the three stores can never
+    drift on semantics. Enforces, atomically with the merge:
+
+    - **rv precondition**: a ``metadata.resource_version`` in the patch must
+      match ``current_rv`` or the write raises Conflict (the optimistic
+      hook for writers that must not build on a state they haven't seen —
+      e.g. the scheduler's binding). Omitting it applies the patch to
+      whatever is latest: status mirrors want exactly that.
+    - **identity freeze**: name/namespace/uid/creation_timestamp and kind
+      are immutable through ANY patch (they are the store key and the
+      incarnation guard).
+    - **status subresource**: ``subresource='status'`` may touch only
+      ``status`` (plus the rv precondition) — spec and metadata are frozen
+      server-side, which is what lets the NODE token tier be granted
+      patch-status-only on its pods (≙ the kube /status subresource).
+
+    Returns the merged dict; the caller stamps the fresh resource_version
+    and persists under its own lock.
+    """
+    if not isinstance(patch, dict):
+        raise BadPatch(
+            f"merge patch must be a JSON object, got {type(patch).__name__}"
+        )
+    meta_patch = patch.get("metadata")
+    if meta_patch is not None and not isinstance(meta_patch, dict):
+        raise BadPatch("metadata patch must be a JSON object")
+    expected = (meta_patch or {}).get("resource_version")
+    if expected is not None and current_rv is not None and expected != current_rv:
+        raise Conflict(
+            f"{kind}: resource_version {expected} != {current_rv}"
+        )
+    # uid PRECONDITION (≙ kube's metadata.uid preconditions): the write
+    # applies only to this exact incarnation. Checked atomically with the
+    # merge, which is what lets an authorizer PIN the object it inspected —
+    # the agent tier's apply-time scope enforcement rides this (a pod
+    # deleted and recreated between authz and apply can never be hit).
+    expected_uid = (meta_patch or {}).get("uid")
+    cur_uid = (current.get("metadata") or {}).get("uid")
+    if expected_uid is not None and expected_uid != cur_uid:
+        raise Conflict(f"{kind}: uid {expected_uid!r} != {cur_uid!r}")
+    if subresource is not None and subresource != "status":
+        raise BadPatch(f"unknown subresource {subresource!r}")
+    if subresource == "status":
+        for key in patch:
+            if key not in ("status", "metadata"):
+                raise BadPatch(
+                    f"status subresource cannot modify {key!r} "
+                    f"(spec/metadata are frozen)"
+                )
+        frozen = set(meta_patch or ()) - {"resource_version", "uid"}
+        if frozen:
+            raise BadPatch(
+                f"status subresource cannot modify "
+                f"metadata.{sorted(frozen)[0]} (spec/metadata are frozen)"
+            )
+        status_patch = patch.get("status")
+        if status_patch is not None and not isinstance(status_patch, dict):
+            raise BadPatch("status patch must be a JSON object")
+        out = dict(current)
+        merged_status = json_merge_patch(
+            current.get("status", {}), status_patch or {}
+        )
+        if merged_status:
+            out["status"] = merged_status
+        else:
+            out.pop("status", None)
+        return out
+    out = json_merge_patch(current, patch)
+    if out.get("kind", kind) != current.get("kind", kind):
+        raise BadPatch(f"patch may not change kind {current.get('kind')!r}")
+    cur_meta = current.get("metadata", {})
+    new_meta = out.get("metadata", {})
+    for f in _IDENTITY_META:
+        if new_meta.get(f) != cur_meta.get(f):
+            raise BadPatch(
+                f"patch may not change metadata.{f} "
+                f"({cur_meta.get(f)!r} -> {new_meta.get(f)!r})"
+            )
+    return out
+
+
+# error classes a single batch item may resolve to without failing the
+# whole batch (everything else — store down, bad wire shape — is the
+# request's problem, not the item's)
+PATCH_ITEM_ERRORS = (NotFound, Conflict, BadPatch)
+
+
+def patch_batch_via_loop(store, items: List[Dict[str, Any]]) -> List[Any]:
+    """Default ``patch_batch``: apply each item's patch in order, mapping
+    per-item failures to exception VALUES (not raises) so one bad item
+    can't hide the others' results. Each item is atomic on its own; the
+    batch deliberately is not a transaction — it exists to collapse
+    round-trips (the HTTP backend ships it as one request), not to couple
+    unrelated objects' fates."""
+    out: List[Any] = []
+    for it in items:
+        try:
+            if not isinstance(it, dict):
+                raise BadPatch("batch item must be an object")
+            out.append(
+                store.patch(
+                    it["kind"], it["namespace"], it["name"], it.get("patch"),
+                    subresource=it.get("subresource"),
+                )
+            )
+        except PATCH_ITEM_ERRORS as e:
+            out.append(e)
+        except KeyError as e:  # a missing kind/namespace/name key
+            out.append(BadPatch(f"batch item missing {e}"))
+    return out
 
 
 def optimistic_update(store, kind, namespace, name, mutate, *,
@@ -178,6 +351,48 @@ class ObjectStore:
             self._objects[k] = obj
             self._notify(MODIFIED, obj.kind, obj)
             return obj.deepcopy()
+
+    def patch(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Any,
+        *,
+        subresource: Optional[str] = None,
+    ) -> Any:
+        """Apply a JSON merge-patch atomically under the store lock: one
+        round-trip replaces the whole GET+PUT+409-retry loop for writers
+        that only touch fields they own (status mirrors, heartbeats,
+        bindings). Semantics — rv precondition, identity freeze, the
+        status subresource — live in :func:`apply_merge_patch_dict`;
+        the commit bumps resource_version and emits MODIFIED like any
+        update."""
+        from mpi_operator_tpu.machinery.serialize import decode, encode
+
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            current = self._objects[k]
+            merged = apply_merge_patch_dict(
+                kind, encode(current), patch, subresource=subresource,
+                current_rv=_meta(current).resource_version,
+            )
+            obj = decode(kind, merged)
+            _meta(obj).resource_version = self._next_rv()
+            self._objects[k] = obj
+            self._notify(MODIFIED, kind, obj)
+            return obj.deepcopy()
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        """Apply a list of ``{kind, namespace, name, patch[, subresource]}``
+        items in order; per-item errors come back as exception values (see
+        patch_batch_via_loop). In-process this is just a loop — the verb
+        exists so agents batching a heartbeat + pod mirrors run unchanged
+        against every backend, and the HTTP backend collapses it to ONE
+        request."""
+        return patch_batch_via_loop(self, items)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:

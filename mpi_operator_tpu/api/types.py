@@ -538,6 +538,13 @@ class JobStatus(_Dictable):
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
     restart_count: int = 0
+    # gang launch generation: advances on EVERY executed whole-gang restart
+    # — free preemption restarts included, which restart_count (the
+    # backoffLimit budget) deliberately does not count. Stamped onto worker
+    # pods as the tpujob.dev/generation label, the observable that lets
+    # the chaos invariant checker prove "one generation launching at a
+    # time" even across preemption-driven restarts.
+    restart_generation: int = 0
     # rendezvous port the controller allocated this job (per-job so two
     # concurrent gangs under one executor never collide on bind; the
     # reference gets isolation for free from per-pod DNS)
@@ -554,6 +561,7 @@ class JobStatus(_Dictable):
             completion_time=d.get("completion_time"),
             last_reconcile_time=d.get("last_reconcile_time"),
             restart_count=d.get("restart_count", 0),
+            restart_generation=d.get("restart_generation", 0),
             coordinator_port=d.get("coordinator_port"),
         )
 

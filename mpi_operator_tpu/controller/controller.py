@@ -82,6 +82,12 @@ log = logging.getLogger("tpujob.controller")
 LABEL_JOB_NAME = "tpujob.dev/job-name"
 LABEL_ROLE = "tpujob.dev/job-role"
 LABEL_REPLICA_INDEX = "tpujob.dev/replica-index"
+# restart generation the pod was launched for (status.restart_count at
+# creation): the observable that lets the chaos invariant checker prove
+# "at most one gang generation launching at a time" from the event trail
+# alone (tests/invariants.py) — without it, two overlapping generations
+# are indistinguishable from one
+LABEL_GENERATION = "tpujob.dev/generation"
 ROLE_WORKER = "worker"
 
 # Rendezvous env contract (≙ the OMPI/Intel env of :176-200; consumed by
@@ -316,7 +322,13 @@ class TPUJobController:
         if job is None:
             with self._port_lock:  # release the port reservation
                 self._ports_inflight.pop(key, None)
-            return True  # deleted; nothing to do (≙ :460-467)
+            # ≙ the kube garbage collector's cascade delete: the job is
+            # gone, so every dependent it owned must go too. Before this,
+            # deleting a RUNNING job stranded its pods (and their worker
+            # processes) forever — the orphan the chaos invariant checker
+            # flags (tests/invariants.py no_orphaned_dependents).
+            self._reap_orphans(namespace, name)
+            return True  # deleted; nothing left to do (≙ :460-467)
         set_defaults(job)  # store returned a deep copy (≙ DeepCopy + Default :470-475)
 
         errs = validate_tpujob(job)
@@ -387,6 +399,22 @@ class TPUJobController:
     # ------------------------------------------------------------------
     # dependents
     # ------------------------------------------------------------------
+
+    def _reap_orphans(self, namespace: str, name: str) -> None:
+        """Delete every dependent of a deleted job. Selection is by the
+        job-name label every dependent carries, guarded by the controller
+        owner ref (never GC an object some other owner claims); reads ride
+        the lister, so a job with no leftovers costs zero store traffic.
+        Idempotent and level-triggered: each dependent's own DELETED event
+        re-enqueues this job key until nothing is left."""
+        for kind in ("Pod", "ConfigMap", "Service", "PodGroup"):
+            for obj in self.read.list(
+                kind, namespace, selector={LABEL_JOB_NAME: name}
+            ):
+                owner = self._controller_owner(obj)
+                if owner is None or owner.name != name:
+                    continue
+                self.store.try_delete(kind, namespace, obj.metadata.name)
 
     def _owner_ref(self, job: TPUJob) -> OwnerReference:
         return OwnerReference(name=job.name, uid=job.metadata.uid, controller=True)
@@ -591,6 +619,12 @@ class TPUJobController:
         labels.update(self._selector(job))
         labels[LABEL_ROLE] = ROLE_WORKER
         labels[LABEL_REPLICA_INDEX] = str(index)
+        # restart_generation, NOT restart_count: free preemption restarts
+        # don't burn the backoff budget but ARE new launch generations —
+        # labeling them with the unchanged count would blind the
+        # single-generation invariant in exactly the preemption scenarios
+        # the chaos suite injects
+        labels[LABEL_GENERATION] = str(job.status.restart_generation)
         annotations = dict(tmpl.annotations)
         annotations.update(placement.annotations_for(index))
         # ExitCode policy is controller-owned: the pod itself never restarts
@@ -780,6 +814,12 @@ class TPUJobController:
                 if not preempted:
                     job.status.restart_count += 1
                     metrics.jobs_restarted.inc()
+                # every EXECUTED generation restart counts here, free
+                # preemption restarts included: the restart-storm tripwire
+                # (tests/test_stress.py) and the `ctl`-visible rate ride
+                # this, and a storm of "free" restarts is still a storm
+                job.status.restart_generation += 1
+                metrics.gang_restarts.inc()
                 # a restart executed: the next generation gets its own
                 # drain-wait note even when the restart was free (the
                 # (uid, restart_count) key would otherwise collide across
@@ -919,6 +959,13 @@ class TPUJobController:
         stored = self.read.try_get("TPUJob", job.namespace, job.name)
         if stored is None:
             return True
+        if stored.metadata.uid != job.metadata.uid:
+            # the job this reconcile computed for was deleted and a new
+            # same-name incarnation exists: stamping the OLD incarnation's
+            # status (restart_count, Failed/Restarting conditions) onto the
+            # fresh job would e.g. pre-burn its backoffLimit — and the
+            # absorbed restart_count would never self-heal
+            return True
         old, new = stored.status.to_dict(), job.status.to_dict()
         if old == new:
             metrics.store_writes_elided.inc(component="controller")
@@ -926,11 +973,17 @@ class TPUJobController:
         try:
             self.store.patch(
                 "TPUJob", job.namespace, job.name,
-                {"status": diff_merge_patch(old, new)},
+                # uid-pinned (checked atomically with the merge): the
+                # recreation race between the read above and this write —
+                # or a deposed leader's in-flight write landing over the
+                # new leader's — bounces as Conflict instead of silently
+                # cross-stamping incarnations
+                {"status": diff_merge_patch(old, new),
+                 "metadata": {"uid": job.metadata.uid}},
                 subresource="status",
             )
         except NotFound:
             return True  # deleted under us; nothing to mirror
         except Conflict:
-            return False  # only reachable with a precondition-injecting test hook
+            return False  # recreated under us: requeue reads the new world
         return True

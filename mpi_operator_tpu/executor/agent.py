@@ -303,6 +303,7 @@ class NodeAgent:
         heartbeat_interval: float = 2.0,
         log_tokens: Optional[Sequence[str]] = None,
         ckpt_dir: Optional[str] = None,
+        eviction_grace: float = 5.0,
     ):
         from mpi_operator_tpu.machinery.objects import LOCAL_NODE
 
@@ -347,6 +348,7 @@ class NodeAgent:
             extra_env=extra_env,
             log_url_base=None,  # filled at start (needs the bound log port)
             status_sink=self.batcher,
+            eviction_grace=eviction_grace,
         )
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
@@ -645,6 +647,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--log-port", type=int, default=0,
                     help="port for the log endpoint (default: ephemeral)")
     ap.add_argument("--heartbeat", type=float, default=2.0)
+    ap.add_argument("--eviction-grace", type=float, default=5.0,
+                    help="seconds between SIGTERM and SIGKILL for evicted "
+                         "pods (≙ terminationGracePeriodSeconds) — the "
+                         "window a preempted trainer uses to force-"
+                         "checkpoint; 0 = immediate SIGKILL")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--tls-ca-file", default=None,
                     help="CA bundle (or the self-signed cert itself) to "
@@ -693,6 +700,7 @@ def main(argv=None) -> int:
             heartbeat_interval=args.heartbeat,
             log_tokens=[t for t in (token, read_token) if t],
             ckpt_dir=args.ckpt_dir,
+            eviction_grace=args.eviction_grace,
         ).start()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

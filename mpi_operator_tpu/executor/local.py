@@ -93,6 +93,7 @@ class LocalExecutor:
         node_name: Optional[str] = None,
         log_url_base: Optional[str] = None,
         status_sink=None,
+        eviction_grace: float = 5.0,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
@@ -113,7 +114,26 @@ class LocalExecutor:
         # written directly: the NodeAgent flushes the sink together with its
         # Node heartbeat as ONE patch-batch request per tick
         self.status_sink = status_sink
+        # eviction termination grace (≙ terminationGracePeriodSeconds): an
+        # evicted pod gets SIGTERM first so checkpoint-capable workloads
+        # force-save before the SIGKILL lands (ops/elastic.py routes the
+        # signal into a gang-synchronized checkpoint-and-exit). 0 = the old
+        # immediate-SIGKILL behavior.
+        self.eviction_grace = eviction_grace
         self._procs: Dict[str, subprocess.Popen] = {}  # pod key → process
+        # pod key → SIGKILL backstop timer of an in-progress graceful
+        # termination: a deletion landing inside the grace window (the
+        # controller's gang restart deletes evicted pods moments after the
+        # monitor/scheduler marked them) must NOT hard-kill the draining
+        # process — that would snatch the force-checkpoint window the
+        # SIGTERM just granted (kube honors the grace period on delete too)
+        self._terminating: Dict[str, threading.Timer] = {}
+        # pod key → deleted-but-still-draining predecessor process: a
+        # recreated same-name pod (the next restart generation) must not
+        # launch until this process exits — the job's coordinator port is
+        # stable across generations, so two live generations would collide
+        # on the bind (EADDRINUSE → non-retryable crash → burnt backoff)
+        self._draining: Dict[str, subprocess.Popen] = {}
         # pod key → (uid, rv) of our last committed status write: anchors
         # the next patch's rv precondition so the mirror stays 1 request
         # (only this executor writes a bound pod's status in steady state).
@@ -150,7 +170,9 @@ class LocalExecutor:
         if self._watch_q is not None:
             self.store.stop_watch(self._watch_q)
         with self._lock:
-            for p in self._procs.values():
+            # draining predecessors included: their grace ends with the
+            # executor (same as every other managed process)
+            for p in (*self._procs.values(), *self._draining.values()):
                 if p.poll() is None:
                     p.kill()
 
@@ -241,9 +263,40 @@ class LocalExecutor:
         with self._lock:
             proc = self._procs.get(key)
         if proc is not None and proc.poll() is None:
-            log.info("pod %s externally finished (%s); killing its process",
-                     key, pod.status.reason or pod.status.phase)
-            proc.kill()
+            with self._lock:
+                if key in self._terminating:
+                    # the grace sequence already ran for this process; a
+                    # re-delivered event (watch-gap relists replay every
+                    # live object as MODIFIED) must not SIGTERM it again —
+                    # workloads may treat a second SIGTERM as abort-now,
+                    # forfeiting the force-checkpoint the grace granted —
+                    # nor leak the armed backstop timer by overwriting it
+                    return
+            if self.eviction_grace > 0:
+                # SIGTERM-then-SIGKILL (≙ the kubelet's graceful pod
+                # termination): a preempted checkpointing trainer uses the
+                # grace window to force-save at a gang-uniform step, so the
+                # relaunched gang resumes instead of replaying from the
+                # last periodic save. The backstop timer makes the grace a
+                # bound, not a trust: a wedged process still dies.
+                log.info(
+                    "pod %s externally finished (%s); SIGTERM with %.1fs "
+                    "grace", key, pod.status.reason or pod.status.phase,
+                    self.eviction_grace,
+                )
+                proc.terminate()
+                timer = threading.Timer(
+                    self.eviction_grace,
+                    lambda: proc.poll() is None and proc.kill(),
+                )
+                timer.daemon = True
+                with self._lock:
+                    self._terminating[key] = timer
+                timer.start()
+            else:
+                log.info("pod %s externally finished (%s); killing its "
+                         "process", key, pod.status.reason or pod.status.phase)
+                proc.kill()
 
     def _forget(self, pod: Pod) -> None:
         """Pod deleted (controller restart path / cleanup policy): kill any
@@ -253,9 +306,20 @@ class LocalExecutor:
         with self._lock:
             proc = self._procs.pop(key, None)
             self.logs.pop(key, None)
+            draining = self._terminating.pop(key, None)
         with self._rv_lock:
             self._status_rv.pop(key, None)
         if proc is not None and proc.poll() is None:
+            if draining is not None:
+                # eviction already granted this process a termination grace
+                # (SIGTERM sent, SIGKILL backstop armed): the deletion must
+                # not revoke the force-checkpoint window — the armed timer
+                # still bounds the process's lifetime, and _maybe_launch
+                # holds the key's next incarnation until the reaper
+                # confirms this process exited
+                with self._lock:
+                    self._draining[key] = proc
+                return
             proc.kill()
 
     def _maybe_launch(self, pod: Pod) -> None:
@@ -269,6 +333,15 @@ class LocalExecutor:
         with self._lock:
             if key in self._procs:
                 return
+            predecessor = self._draining.get(key)
+            if predecessor is not None:
+                if predecessor.poll() is None:
+                    # the previous generation's process is still inside its
+                    # eviction grace: launching now would collide on the
+                    # job's stable coordinator port. The predecessor's
+                    # reaper re-invokes _maybe_launch once it exits.
+                    return
+                self._draining.pop(key, None)
             container = pod.spec.container
             argv = list(container.command) + list(container.args)
             if not argv:
@@ -374,6 +447,14 @@ class LocalExecutor:
 
     def _reap(self, pod: Pod, proc: subprocess.Popen, base: str) -> None:
         proc.wait()
+        key = self._pod_key(pod)
+        with self._lock:
+            timer = self._terminating.pop(key, None)
+            was_draining = self._draining.get(key) is proc
+            if was_draining:
+                self._draining.pop(key)
+        if timer is not None:
+            timer.cancel()  # exited inside its grace: no backstop needed
         out = err = ""
         try:
             with open(base + ".log") as f:
@@ -401,6 +482,19 @@ class LocalExecutor:
         log.info(
             "pod %s exited rc=%d", self._pod_key(pod), proc.returncode
         )
+        if was_draining:
+            # the next generation may already be bound and waiting on this
+            # exit (its binding event fired while we were draining, and
+            # _maybe_launch deferred it): level-trigger the launch now
+            try:
+                cur = self.store.try_get(
+                    "Pod", pod.metadata.namespace, pod.metadata.name
+                )
+                if cur is not None:
+                    self._maybe_launch(cur)
+            except Exception:
+                log.warning("post-drain relaunch check for %s failed", key,
+                            exc_info=True)
 
     def _set_phase(
         self,

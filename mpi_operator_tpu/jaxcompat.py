@@ -1,0 +1,29 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The codebase is written against the current jax API; deployment images can
+lag a few releases behind. Each shim presents the NEW api's name and
+keywords and adapts downward, so call sites never branch on versions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None) -> Any:
+    """``jax.shard_map`` across releases: jax >= 0.6 exposes it at top
+    level with ``check_vma``; older releases ship
+    ``jax.experimental.shard_map.shard_map`` where the same knob is called
+    ``check_rep``. Call sites use the new spelling."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kw)

@@ -1,0 +1,676 @@
+"""Chaos plane: deterministic, scriptable fault injection for the control
+plane's seams.
+
+The operator's whole value proposition is surviving churn — gang-coherent
+restarts, leader failover, watch relist, client retry/backoff — but until
+this module those mechanisms were only ever exercised by happy-path e2e or
+unit tests faking one side of the seam. This is the harness that drives
+them through REAL failures, reproducibly:
+
+- :class:`ChaosProxy` — an HTTP-aware TCP proxy that sits on the plaintext
+  store seam (client ↔ StoreServer) and can **drop**, **delay**, or
+  **duplicate** individual requests, **sever** live connections (watch
+  streams included — they are classified by their request path), or
+  **blackhole** the seam entirely. Probabilistic faults draw from a
+  per-connection RNG seeded by ``(script seed, connection index)``, so two
+  runs of the same script against the same traffic make the same
+  decisions regardless of thread interleaving.
+- :class:`ProcessTarget` / :class:`SelfTarget` — process-level fault
+  actions: SIGKILL/SIGTERM/restart the store server, an operator replica,
+  or a node agent (the crash-recovery scenarios of tests/test_chaos.py).
+- :class:`ChaosScript` + :class:`ChaosController` — a scripted timeline
+  (YAML/JSON) binding the above to deterministic fire times, so every
+  chaos run is a replayable artifact, not a flake generator. The operator
+  CLI accepts ``--chaos-script`` and arms the script against itself when
+  it becomes leader (the leader-failover scenario kills the leader at a
+  fixed offset into its reign).
+
+Script format (YAML or JSON; times are seconds relative to ``arm()``)::
+
+    seed: 42
+    actions:
+      - {at: 2.0, fault: sever, match: watch}      # cut live watch streams
+      - {at: 3.0, fault: blackhole, duration: 1.5} # refuse the seam for 1.5s
+      - {at: 5.0, fault: kill, target: store}      # SIGKILL a registered proc
+      - {at: 6.5, fault: restart, target: store}   # respawn it
+      - {at: 1.0, fault: drop, match: mutation, prob: 0.3, duration: 3.0}
+      - {at: 1.0, fault: delay, seconds: 0.05, duration: 3.0}
+      - {at: 4.0, fault: duplicate, match: mutation, prob: 1.0, duration: 1.0}
+
+Dropped requests are closed BEFORE being forwarded upstream, so the client
+observes a transport error for a request the server never saw — the same
+ambiguity class as a connection refused, which every client in this
+framework already handles (bounded retry/backoff, level-triggered
+reconciles). Duplicated requests exercise idempotence: the first response
+is swallowed, the second returned, so the server has applied the verb
+twice while the client saw it once.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("tpujob.chaos")
+
+# faults acting on a registered process target
+PROCESS_FAULTS = ("kill", "term", "restart")
+# faults acting on the proxy seam
+PROXY_FAULTS = ("sever", "blackhole", "restore", "drop", "delay",
+                "duplicate", "clear")
+MATCHES = ("any", "watch", "mutation", "read")
+
+
+class ChaosScriptError(ValueError):
+    """Malformed chaos script (fail fast: a typo'd fault name silently doing
+    nothing would make a 'passing' chaos run meaningless)."""
+
+
+# which optional knobs each fault actually consumes — anything else in the
+# action is rejected at parse time for the same fail-fast reason: a knob
+# the runner ignores ('duration' on a sever, 'prob' on a kill) would make
+# the script claim more chaos than it injects
+_FAULT_KNOBS: Dict[str, frozenset] = {
+    "kill": frozenset({"target"}),
+    "term": frozenset({"target"}),
+    "restart": frozenset({"target"}),
+    "sever": frozenset({"match"}),
+    "blackhole": frozenset({"duration"}),
+    "restore": frozenset(),
+    "clear": frozenset(),
+    "drop": frozenset({"match", "prob", "duration"}),
+    "delay": frozenset({"match", "prob", "seconds", "duration"}),
+    "duplicate": frozenset({"match", "prob", "duration"}),
+}
+
+
+# ---------------------------------------------------------------------------
+# script
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChaosAction:
+    at: float                      # seconds after arm()
+    fault: str
+    target: str = ""               # process faults: registry name
+    match: str = "any"             # any | watch | mutation | read | /path-prefix
+    prob: float = 1.0              # drop/duplicate: per-request probability
+    seconds: float = 0.0           # delay: added latency per request
+    until: Optional[float] = None  # rule faults: deactivate at this offset
+
+
+class ChaosScript:
+    """A validated, ordered fault timeline. Parse once, run anywhere —
+    the same script object drives both runs of a determinism check."""
+
+    def __init__(self, seed: int, actions: List[ChaosAction]):
+        self.seed = seed
+        self.actions = sorted(actions, key=lambda a: a.at)
+
+    @classmethod
+    def parse(cls, doc: Dict[str, Any]) -> "ChaosScript":
+        if not isinstance(doc, dict):
+            raise ChaosScriptError("chaos script must be a mapping")
+        seed = doc.get("seed", 0)
+        if not isinstance(seed, int):
+            raise ChaosScriptError(f"seed must be an integer, got {seed!r}")
+        raw = doc.get("actions")
+        if not isinstance(raw, list) or not raw:
+            raise ChaosScriptError("chaos script needs a non-empty 'actions' list")
+        actions: List[ChaosAction] = []
+        for i, a in enumerate(raw):
+            if not isinstance(a, dict):
+                raise ChaosScriptError(f"actions[{i}]: must be a mapping")
+            unknown = set(a) - {"at", "fault", "target", "match", "prob",
+                                "seconds", "duration"}
+            if unknown:
+                raise ChaosScriptError(
+                    f"actions[{i}]: unknown keys {sorted(unknown)}"
+                )
+            try:
+                at = float(a["at"])
+                fault = str(a["fault"])
+            except (KeyError, TypeError, ValueError):
+                raise ChaosScriptError(
+                    f"actions[{i}]: 'at' (seconds) and 'fault' are required"
+                ) from None
+            if at < 0:
+                raise ChaosScriptError(f"actions[{i}]: at must be >= 0")
+            if fault not in PROCESS_FAULTS + PROXY_FAULTS:
+                raise ChaosScriptError(
+                    f"actions[{i}]: unknown fault {fault!r} (known: "
+                    f"{', '.join(PROCESS_FAULTS + PROXY_FAULTS)})"
+                )
+            inapplicable = set(a) - {"at", "fault"} - _FAULT_KNOBS[fault]
+            if inapplicable:
+                raise ChaosScriptError(
+                    f"actions[{i}]: {sorted(inapplicable)} do(es) not apply "
+                    f"to fault {fault!r} (it would be silently ignored; "
+                    f"valid knobs: {sorted(_FAULT_KNOBS[fault]) or 'none'})"
+                )
+            target = str(a.get("target", ""))
+            if fault in PROCESS_FAULTS and not target:
+                raise ChaosScriptError(
+                    f"actions[{i}]: fault {fault!r} needs a 'target'"
+                )
+            match = str(a.get("match", "any"))
+            if match not in MATCHES and not match.startswith("/"):
+                raise ChaosScriptError(
+                    f"actions[{i}]: match must be one of {MATCHES} or a "
+                    f"'/path' prefix, got {match!r}"
+                )
+            prob = float(a.get("prob", 1.0))
+            if not 0.0 <= prob <= 1.0:
+                raise ChaosScriptError(f"actions[{i}]: prob must be in [0, 1]")
+            seconds = float(a.get("seconds", 0.0))
+            duration = float(a.get("duration", 0.0))
+            until = at + duration if duration > 0 else None
+            if fault == "blackhole" and until is not None:
+                # expand the window into an explicit restore action so the
+                # executed log shows both edges
+                actions.append(ChaosAction(at=at, fault="blackhole"))
+                actions.append(ChaosAction(at=until, fault="restore"))
+                continue
+            actions.append(ChaosAction(
+                at=at, fault=fault, target=target, match=match, prob=prob,
+                seconds=seconds, until=until,
+            ))
+        return cls(seed, actions)
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosScript":
+        import yaml  # YAML is a superset of JSON: one loader serves both
+
+        with open(path) as f:
+            try:
+                doc = yaml.safe_load(f)
+            except yaml.YAMLError as e:
+                raise ChaosScriptError(f"{path}: {e}") from None
+        try:
+            return cls.parse(doc)
+        except ChaosScriptError as e:
+            raise ChaosScriptError(f"{path}: {e}") from None
+
+
+# ---------------------------------------------------------------------------
+# process targets
+# ---------------------------------------------------------------------------
+
+
+class ProcessTarget:
+    """A killable/restartable subprocess (store server, operator replica,
+    node agent). ``spawn`` returns a fresh ``subprocess.Popen``; ``proc``
+    seeds the currently-running instance."""
+
+    def __init__(self, spawn: Callable[[], Any], proc: Any = None):
+        self.spawn = spawn
+        self.proc = proc
+
+    def _signal(self, sig: int) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(sig)
+            if sig == signal.SIGKILL:
+                self.proc.wait()  # SIGKILL is not ignorable: reap promptly
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def term(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def restart(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.kill()
+        self.proc = self.spawn()
+
+
+class SelfTarget:
+    """The current process as a fault target (the operator's
+    ``--chaos-script`` self-destruct: SIGKILL mid-reign is how the
+    leader-failover e2e makes 'the leader dies mid-reconcile' a
+    deterministic, scripted event instead of a manual race)."""
+
+    def kill(self) -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def term(self) -> None:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def restart(self) -> None:
+        raise RuntimeError("the current process cannot restart itself")
+
+
+# ---------------------------------------------------------------------------
+# HTTP-aware proxy
+# ---------------------------------------------------------------------------
+
+
+def _read_http_message(
+    rfile, what: str
+) -> Optional[Tuple[bytes, str, Dict[str, str]]]:
+    """Read one framed HTTP/1.1 message (start line + headers +
+    Content-Length body — the only framing the store server emits).
+    Returns (raw bytes, start line, headers) or None on clean EOF at a
+    message boundary."""
+    start = rfile.readline(65536)
+    while start in (b"\r\n", b"\n"):  # tolerate stray separators
+        start = rfile.readline(65536)
+    if not start:
+        return None
+    chunks = [start]
+    headers: Dict[str, str] = {}
+    while True:
+        line = rfile.readline(65536)
+        if not line:
+            raise ConnectionError(f"EOF inside {what} headers")
+        chunks.append(line)
+        if line in (b"\r\n", b"\n"):
+            break
+        key, _, val = line.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = val.strip()
+    try:
+        length = int(headers.get("content-length", "0") or "0")
+    except ValueError:
+        raise ConnectionError(f"bad {what} Content-Length") from None
+    if length:
+        body = rfile.read(length)
+        if len(body) < length:
+            raise ConnectionError(f"EOF inside {what} body")
+        chunks.append(body)
+    return b"".join(chunks), start.decode("latin-1").strip(), headers
+
+
+def _classify(request_line: str) -> Tuple[str, str]:
+    """(class, path) of a request line: 'watch' for the long-poll route,
+    'mutation' for write verbs, 'read' otherwise."""
+    parts = request_line.split(" ")
+    method = parts[0] if parts else ""
+    path = parts[1] if len(parts) > 1 else ""
+    bare = path.split("?", 1)[0]
+    if bare == "/v1/watch":
+        return "watch", bare
+    if method in ("POST", "PUT", "PATCH", "DELETE"):
+        return "mutation", bare
+    return "read", bare
+
+
+def _matches(match: str, klass: str, path: str) -> bool:
+    if match == "any":
+        return True
+    if match.startswith("/"):
+        return path.startswith(match)
+    return match == klass
+
+
+@dataclass
+class _Rule:
+    fault: str          # drop | delay | duplicate
+    match: str = "any"
+    prob: float = 1.0
+    seconds: float = 0.0
+    until: Optional[float] = None  # monotonic deadline; None = forever
+
+
+class _ProxyConn(threading.Thread):
+    """One proxied client connection: parse requests, apply fault rules,
+    forward over a dedicated upstream connection, relay responses."""
+
+    def __init__(self, proxy: "ChaosProxy", client: socket.socket, conn_id: int):
+        super().__init__(name=f"chaos-conn-{conn_id}", daemon=True)
+        self.proxy = proxy
+        self.client = client
+        self.conn_id = conn_id
+        self.klass = "idle"  # class of the most recent request (sever match)
+        # per-connection RNG: decisions replay identically for the same
+        # (seed, connection index) regardless of thread interleaving
+        self.rng = random.Random(f"{proxy.seed}:{conn_id}")
+        self.upstream: Optional[socket.socket] = None
+        self.upstream_rfile = None
+        self._dead = threading.Event()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _connect_upstream(self):
+        s = socket.create_connection(self.proxy.upstream_addr, timeout=10.0)
+        s.settimeout(self.proxy.upstream_timeout)
+        self.upstream = s
+        # ONE buffered reader for the connection's lifetime: a fresh
+        # makefile per response would read-ahead into its private buffer
+        # and swallow the start of the next response (keep-alive framing)
+        self.upstream_rfile = s.makefile("rb")
+        return s
+
+    def sever(self) -> None:
+        """Hard-close both sides (the fault, not cleanup: the peer sees a
+        reset mid-exchange, exactly what a network partition looks like)."""
+        self._dead.set()
+        for s in (self.client, self.upstream):
+            if s is not None:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- request loop -------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            self.client.settimeout(self.proxy.client_timeout)
+            crfile = self.client.makefile("rb")
+            while not self._dead.is_set() and not self.proxy._stop.is_set():
+                msg = _read_http_message(crfile, "request")
+                if msg is None:
+                    break
+                raw, line, _headers = msg
+                self.klass, path = _classify(line)
+                if self.proxy._blackhole.is_set():
+                    self.proxy._count("blackholed")
+                    break  # close without forwarding
+                faults = self.proxy._decide(self.rng, self.klass, path)
+                if "drop" in faults:
+                    self.proxy._count("dropped")
+                    break  # request never reaches the server
+                if faults.get("delay"):
+                    time.sleep(faults["delay"])
+                    self.proxy._count("delayed")
+                copies = 2 if "duplicate" in faults else 1
+                resp = self._forward(raw, copies)
+                if resp is None:
+                    break
+                if copies == 2:
+                    self.proxy._count("duplicated")
+                try:
+                    self.client.sendall(resp)
+                except OSError:
+                    break
+                self.proxy._count("forwarded")
+        except (ConnectionError, OSError, ValueError):
+            pass  # severed / reset / timed out: the fault did its job
+        finally:
+            self.sever()
+            self.proxy._forget(self)
+
+    def _close_upstream(self) -> None:
+        if self.upstream is not None:
+            try:
+                self.upstream.close()
+            except OSError:
+                pass
+        self.upstream = None
+        self.upstream_rfile = None
+
+    def _forward(self, raw: bytes, copies: int) -> Optional[bytes]:
+        """Send the request ``copies`` times upstream; return the LAST
+        response's bytes (duplicate swallows the first — the server applied
+        the verb twice, the client sees one response). Clients send
+        ``Connection: close`` per request (urllib), so each copy may need a
+        fresh upstream connection; a copy is retried once on a dead
+        connection and the failure is otherwise relayed by dropping the
+        client (a mid-exchange upstream kill IS the injected fault)."""
+        resp = None
+        for _ in range(copies):
+            msg = None
+            for attempt in (0, 1):
+                if self.upstream is None:
+                    try:
+                        self._connect_upstream()
+                    except OSError:
+                        return None
+                try:
+                    self.upstream.sendall(raw)
+                    msg = _read_http_message(self.upstream_rfile, "response")
+                except (ConnectionError, OSError):
+                    msg = None
+                if msg is not None:
+                    break
+                self._close_upstream()  # stale keep-alive: reconnect once
+            if msg is None:
+                return None
+            resp, _, rheaders = msg
+            if rheaders.get("connection", "").lower() == "close":
+                self._close_upstream()
+        return resp
+
+
+class ChaosProxy:
+    """An HTTP-aware fault-injecting proxy for the plaintext store seam.
+
+    Point clients at :attr:`url` instead of the real server; drive faults
+    directly (:meth:`sever`, :meth:`set_blackhole`, :meth:`add_rule`) or
+    through a :class:`ChaosController` timeline."""
+
+    def __init__(self, upstream_url: str, host: str = "127.0.0.1",
+                 port: int = 0, *, seed: int = 0):
+        if not upstream_url.startswith("http://"):
+            raise ValueError(
+                "ChaosProxy fronts the plaintext seam only (an https "
+                "upstream would require MITM certificates)"
+            )
+        hostport = upstream_url[len("http://"):].rstrip("/")
+        uhost, _, uport = hostport.rpartition(":")
+        self.upstream_addr = (uhost.strip("[]") or "127.0.0.1", int(uport))
+        self.seed = seed
+        self.client_timeout = 120.0
+        self.upstream_timeout = 90.0  # > the 55s watch long-poll cap
+        self._listen = socket.create_server((host, port))
+        self.host, self.port = self._listen.getsockname()[:2]
+        self._stop = threading.Event()
+        self._blackhole = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: List[_ProxyConn] = []
+        self._next_conn = 0
+        self._rules: List[_Rule] = []
+        self.stats: Dict[str, int] = {
+            "forwarded": 0, "dropped": 0, "delayed": 0, "duplicated": 0,
+            "severed": 0, "blackholed": 0, "connections": 0,
+        }
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-proxy-accept", daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ChaosProxy":
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.sever()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listen.accept()
+            except OSError:
+                return  # listener closed
+            if self._blackhole.is_set():
+                self._count("blackholed")
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                conn = _ProxyConn(self, client, self._next_conn)
+                self._next_conn += 1
+                self._conns.append(conn)
+                self.stats["connections"] += 1
+            conn.start()
+
+    def _forget(self, conn: _ProxyConn) -> None:
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def _count(self, what: str) -> None:
+        with self._lock:
+            self.stats[what] = self.stats.get(what, 0) + 1
+
+    # -- fault surface ------------------------------------------------------
+
+    def sever(self, match: str = "any") -> int:
+        """Hard-close live connections whose latest request matches (the
+        'network partition mid-exchange' fault; 'watch' cuts long-polls)."""
+        with self._lock:
+            # connection-level fault: class matches only (a path prefix has
+            # no meaning for an idle keep-alive connection) — '/...' severs
+            # everything, like 'any'
+            victims = [
+                c for c in self._conns
+                if match.startswith("/") or _matches(match, c.klass, "")
+            ]
+        for c in victims:
+            c.sever()
+            self._count("severed")
+        return len(victims)
+
+    def set_blackhole(self, on: bool) -> None:
+        """While on, new connections are closed at accept and in-flight
+        connections drop their next request — the seam is gone."""
+        if on:
+            self._blackhole.set()
+        else:
+            self._blackhole.clear()
+
+    def add_rule(self, fault: str, *, match: str = "any", prob: float = 1.0,
+                 seconds: float = 0.0, until: Optional[float] = None) -> None:
+        if fault not in ("drop", "delay", "duplicate"):
+            raise ValueError(f"unknown proxy rule fault {fault!r}")
+        with self._lock:
+            self._rules.append(_Rule(fault, match, prob, seconds, until))
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def _decide(self, rng: random.Random, klass: str, path: str) -> Dict[str, Any]:
+        """Evaluate active rules against one request. The RNG is consulted
+        for EVERY matching probabilistic rule whether or not an earlier rule
+        already fired — the draw sequence per connection depends only on its
+        request sequence, keeping replays aligned."""
+        now = time.monotonic()
+        out: Dict[str, Any] = {}
+        with self._lock:
+            rules = list(self._rules)
+        for r in rules:
+            if r.until is not None and now > r.until:
+                continue
+            if not _matches(r.match, klass, path):
+                continue
+            fired = r.prob >= 1.0 or rng.random() < r.prob
+            if not fired:
+                continue
+            if r.fault == "delay":
+                out["delay"] = out.get("delay", 0.0) + r.seconds
+            else:
+                out[r.fault] = True
+        return out
+
+
+# ---------------------------------------------------------------------------
+# timeline driver
+# ---------------------------------------------------------------------------
+
+
+class ChaosController:
+    """Executes a :class:`ChaosScript` against a proxy and/or process
+    targets on a deterministic wall-clock timeline. ``executed`` records
+    (elapsed, action, error) for every fired action — a chaos run leaves an
+    audit trail just like the control plane it torments."""
+
+    def __init__(self, script: ChaosScript, *,
+                 proxy: Optional[ChaosProxy] = None,
+                 targets: Optional[Dict[str, Any]] = None):
+        self.script = script
+        self.proxy = proxy
+        self.targets = dict(targets or {})
+        self.executed: List[Tuple[float, ChaosAction, Optional[str]]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    def arm(self) -> "ChaosController":
+        """Start the timeline; action times are relative to this call."""
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-timeline", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def _run(self) -> None:
+        for action in self.script.actions:
+            delay = self._t0 + action.at - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            err = None
+            try:
+                self._apply(action)
+            except Exception as e:  # a failed action must not end the run
+                err = f"{type(e).__name__}: {e}"
+                log.warning("chaos action %s failed: %s", action, err)
+            self.executed.append((time.monotonic() - self._t0, action, err))
+            log.info("chaos: t=%.2fs %s%s", time.monotonic() - self._t0,
+                     action.fault,
+                     f" target={action.target}" if action.target else "")
+
+    def _apply(self, a: ChaosAction) -> None:
+        if a.fault in PROCESS_FAULTS:
+            target = self.targets.get(a.target)
+            if target is None:
+                raise KeyError(f"no process target {a.target!r} registered")
+            getattr(target, {"kill": "kill", "term": "term",
+                             "restart": "restart"}[a.fault])()
+            return
+        if self.proxy is None:
+            raise RuntimeError(f"fault {a.fault!r} needs a ChaosProxy")
+        if a.fault == "sever":
+            self.proxy.sever(a.match)
+        elif a.fault == "blackhole":
+            self.proxy.set_blackhole(True)
+        elif a.fault == "restore":
+            self.proxy.set_blackhole(False)
+        elif a.fault == "clear":
+            self.proxy.clear_rules()
+        else:  # drop | delay | duplicate
+            until = None
+            if a.until is not None:
+                until = self._t0 + a.until
+            self.proxy.add_rule(
+                a.fault, match=a.match, prob=a.prob, seconds=a.seconds,
+                until=until,
+            )

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
+import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
 from mpi_operator_tpu.ops.checkpoint import CheckpointManager
@@ -66,6 +68,31 @@ class ElasticResult:
         return 0 if self.outcome == "done" else EXIT_RESTART
 
 
+# preemption signal: eviction (scheduler preemption, `ctl drain`, node
+# shutdown) reaches the worker as SIGTERM with a kill grace behind it
+# (executor/local.py eviction_grace — ≙ terminationGracePeriodSeconds).
+# The handler only sets a flag: checkpointing from inside a signal handler
+# would re-enter orbax/XLA mid-step. The step loop folds the flag into its
+# gang-synchronized membership check so every host force-checkpoints at
+# the SAME step — a lone host checkpointing on its own signal timing would
+# diverge the SPMD control flow and hang the gang's collectives.
+_PREEMPTED = threading.Event()
+
+
+def install_preemption_handler() -> None:
+    """Route SIGTERM into the elastic loop's checkpoint-and-exit path.
+    Main-thread only (signal module contract); a no-op elsewhere so
+    library callers embedded in servers don't crash."""
+    try:
+        signal.signal(signal.SIGTERM, lambda sig, frame: _PREEMPTED.set())
+    except ValueError:
+        pass  # not the main thread: the host process owns signal routing
+
+
+def preemption_requested() -> bool:
+    return _PREEMPTED.is_set()
+
+
 def declared_world_size() -> int:
     """Desired gang size per the controller: hostfile lines in the projected
     config dir (≙ discover_hosts.sh consumers; the executor/kubelet syncs
@@ -99,21 +126,36 @@ def run_elastic(
     if current_world is None:
         current_world = jax.process_count()
 
-    def agreed_membership() -> int:
-        """Host 0's membership view, broadcast to the gang. Each host polls
-        its own projected hostfile, and projection timing skews across
-        hosts — if hosts acted on their *local* read they could diverge on
-        which step to exit at, desynchronizing the collectives (the step
-        loop is SPMD: every control-flow decision must be gang-uniform).
-        A one-to-all broadcast runs at a synchronized point of every
-        participant's loop, so the decision is uniform by construction.
-        Single-process: a passthrough."""
+    def agreed_gang_state() -> "tuple[int, bool]":
+        """(desired world size, preemption requested) as ONE gang-uniform
+        decision. Each host polls its own projected hostfile, and
+        projection timing skews across hosts — if hosts acted on their
+        *local* read they could diverge on which step to exit at,
+        desynchronizing the collectives (the step loop is SPMD: every
+        control-flow decision must be gang-uniform). Same argument for
+        SIGTERM: eviction delivers it to each host on its own schedule, so
+        the checkpoint-and-exit decision is an allgather-OR (any host
+        signaled → the whole gang exits at this step), not a local check.
+        Membership stays host 0's view (the old broadcast semantics);
+        single-process is a passthrough."""
         if jax.process_count() == 1:
-            return membership()
+            return membership(), _PREEMPTED.is_set()
         import numpy as np
         from jax.experimental import multihost_utils
 
-        return int(multihost_utils.broadcast_one_to_all(np.int32(membership())))
+        gathered = multihost_utils.process_allgather(
+            np.array([membership(), int(_PREEMPTED.is_set())],
+                     dtype=np.int32)
+        )
+        return int(gathered[0][0]), bool(gathered[:, 1].any())
+
+    # clear-then-install: a fresh incarnation cannot still be preempted by
+    # a signal delivered to a PREVIOUS run in this process (the flag would
+    # otherwise force-restart every later run at its first sync point). A
+    # SIGTERM before the install kills the process outright (default
+    # disposition), so nothing meaningful can race the clear.
+    _PREEMPTED.clear()
+    install_preemption_handler()
     mgr = CheckpointManager(
         config.checkpoint_dir,
         save_interval_steps=config.save_interval_steps,
@@ -137,20 +179,23 @@ def run_elastic(
             profiler.observe(step)
             if step % config.save_interval_steps == 0:
                 mgr.save(step, state)
-            if (
-                step % config.membership_check_every == 0
-                and agreed_membership() != current_world
-            ):
-                if mgr.latest_step() != step:
-                    mgr.save(step, state, force=True)
-                mgr.wait()
-                return ElasticResult(
-                    "restart",
-                    state,
-                    step,
-                    {k: float(v) for k, v in (metrics or {}).items()},
-                    start_step=start_step,
-                )
+            if step % config.membership_check_every == 0:
+                want, preempted = agreed_gang_state()
+                if preempted or want != current_world:
+                    # force-checkpoint BEFORE exiting: for preemption this
+                    # runs inside the executor's eviction grace window, so
+                    # the next incarnation resumes from this step instead
+                    # of the last periodic save
+                    if mgr.latest_step() != step:
+                        mgr.save(step, state, force=True)
+                    mgr.wait()
+                    return ElasticResult(
+                        "restart",
+                        state,
+                        step,
+                        {k: float(v) for k, v in (metrics or {}).items()},
+                        start_step=start_step,
+                    )
         if mgr.latest_step() != step:
             mgr.save(step, state, force=True)
         mgr.wait()

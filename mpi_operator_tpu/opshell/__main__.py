@@ -107,6 +107,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "minimal set of lowest-priority running gangs is "
                          "evicted (whole-gang, checkpoint-resumable) to "
                          "make room. Default: disabled")
+    ap.add_argument("--lease-duration", type=float, default=15.0,
+                    help="leader lease duration in seconds (≙ the reference's "
+                         "15s; lower it only for failover testing)")
+    ap.add_argument("--renew-deadline", type=float, default=10.0,
+                    help="seconds without a successful lease renew before "
+                         "this replica stops leading")
+    ap.add_argument("--retry-period", type=float, default=5.0,
+                    help="seconds between lease acquire/renew attempts")
+    ap.add_argument("--chaos-script", default=None, metavar="PATH",
+                    help="fault-injection timeline (machinery/chaos.py "
+                         "format) armed when this replica becomes leader; "
+                         "'kill'/'term' actions on target 'self' crash this "
+                         "process at a deterministic offset into its reign — "
+                         "the scripted half of the crash-recovery e2e suite")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
@@ -285,6 +299,39 @@ def main(argv=None) -> int:
     # agents stop heartbeating, so gang restarts land on live nodes
     monitor = NodeMonitor(store, recorder, grace=args.node_grace, cache=cache)
 
+    chaos_script = None
+    if args.chaos_script:
+        from mpi_operator_tpu.machinery.chaos import (
+            ChaosScript,
+            ChaosScriptError,
+        )
+
+        try:
+            chaos_script = ChaosScript.load(args.chaos_script)
+        except (OSError, ChaosScriptError) as e:
+            # fail fast: a typo'd script silently injecting nothing would
+            # make a "passing" chaos run meaningless
+            print(f"error: --chaos-script: {e}", file=sys.stderr)
+            return 2
+        # satisfiability, same fail-fast contract: the operator arms the
+        # script with ONE target ('self') and no proxy, so any other
+        # fault would be skipped at fire time and the run would claim
+        # chaos it never injected (proxy faults and multi-process targets
+        # belong to a driving harness, e.g. tests/test_chaos.py)
+        unusable = [
+            a for a in chaos_script.actions
+            if a.fault not in ("kill", "term") or a.target != "self"
+        ]
+        if unusable:
+            bad = unusable[0]
+            print(
+                f"error: --chaos-script: fault {bad.fault!r} "
+                f"target={bad.target or '<none>'!r} is not executable by "
+                f"the operator (only kill/term on target 'self' are)",
+                file=sys.stderr,
+            )
+            return 2
+
     stop = threading.Event()
 
     def on_started():
@@ -296,6 +343,19 @@ def main(argv=None) -> int:
         if executor:
             executor.start()
         monitor.start()
+        if chaos_script is not None:
+            # armed at leadership, not at process start: "kill the leader
+            # N seconds into its reign" is then a deterministic, scripted
+            # event — the only clock a failover scenario can anchor on
+            from mpi_operator_tpu.machinery.chaos import (
+                ChaosController,
+                SelfTarget,
+            )
+
+            logging.warning("chaos script armed (leader reign t=0)")
+            ChaosController(
+                chaos_script, targets={"self": SelfTarget()}
+            ).arm()
 
     def on_stopped():
         # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
@@ -312,7 +372,12 @@ def main(argv=None) -> int:
 
     elector = LeaderElector(
         store,
-        config=ElectionConfig(namespace=args.lock_namespace),
+        config=ElectionConfig(
+            namespace=args.lock_namespace,
+            lease_duration=args.lease_duration,
+            renew_deadline=args.renew_deadline,
+            retry_period=args.retry_period,
+        ),
         on_started=on_started,
         on_stopped=on_stopped,
     )

@@ -89,6 +89,13 @@ jobs_failed = REGISTRY.counter(
 jobs_restarted = REGISTRY.counter(
     "tpu_operator_jobs_restarted_total", "Counts number of TPU job restarts"
 )
+gang_restarts = REGISTRY.counter(
+    "tpu_operator_gang_restarts_total",
+    "Counts executed gang restart generations (whole-gang teardown + "
+    "relaunch), INCLUDING free preemption restarts that do not burn "
+    "backoffLimit — the restart-storm signal: a single injected failure "
+    "must move this by exactly one",
+)
 job_info = REGISTRY.gauge(
     "tpu_operator_job_info", "Info about a TPU job (coordinator pod, namespace)"
 )

@@ -22,6 +22,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from mpi_operator_tpu.jaxcompat import shard_map
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -168,7 +170,7 @@ def ring_attention(
 
             return chunked_reference(q, k, v, causal=causal, scale=scale)
         return dense_attention(q, k, v, causal=causal, scale=scale)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

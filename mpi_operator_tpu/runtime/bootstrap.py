@@ -168,6 +168,20 @@ def initialize(
             ctx.num_hosts,
             ctx.coordinator_address,
         )
+        if ctx.accelerator in ("", "cpu"):
+            # cross-process collectives on the CPU backend need the gloo
+            # implementation selected BEFORE the distributed handshake —
+            # without it every multi-process jit (and orbax's process-sync
+            # barrier, so any multi-host checkpoint/restore) dies with
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend". Newer jax makes gloo the default; the guard keeps
+            # this a no-op there.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:
+                pass
         jax.distributed.initialize(
             coordinator_address=ctx.coordinator_address,
             num_processes=ctx.num_hosts,

@@ -1,0 +1,316 @@
+"""Control-plane invariant checker: safety properties asserted from the
+store's event trail alone.
+
+The chaos e2e suite (tests/test_chaos.py) does not just check "the job
+eventually succeeded" — it records every watch event the store emitted
+while faults were being injected and asserts the trail never shows a state
+the control plane promises is impossible:
+
+- **no orphaned dependents**: at quiesce, every live Pod/ConfigMap/Service/
+  PodGroup's owning TPUJob still exists (job deletion cascades).
+- **single gang generation**: live worker pods of a job all carry the same
+  ``tpujob.dev/generation`` label at every instant, and the generation
+  number never decreases — two generations launching concurrently is the
+  double-create a leader failover must not cause.
+- **terminal write-once**: a pod incarnation (uid) that reached
+  Succeeded/Failed never shows any other phase afterwards; a job that
+  reached Succeeded never un-succeeds (no Succeeded→anything).
+- **condition machine**: each observed job status obeys api/conditions.py
+  (Running and Restarting mutually exclusive, Succeeded and Failed mutually
+  exclusive, Running implies a Created record).
+- **restart monotonicity**: ``status.restart_count`` never decreases across
+  a job uid's lifetime — a store crash/restart must not rewind it.
+- **rv monotonicity**: per object, resource_version never decreases across
+  the trail (the durable-store contract the sqlite WAL reopen test pins).
+
+Use::
+
+    trail = Trail(store)          # any duck-typed store with watch()
+    ... inject chaos ...
+    trail.stop()                  # also snapshots the final live state
+    check_invariants(trail)       # raises with EVERY violation listed
+
+``checkpoint_steps_monotonic`` is the filesystem-side sibling for orbax
+checkpoint dirs: scenario drivers sample the latest saved step over time
+and assert progress never went backwards across restarts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_GENERATION = "tpujob.dev/generation"
+
+_TERMINAL = ("Succeeded", "Failed")
+
+
+class Trail:
+    """Records every watch event from a store, in delivery order, plus a
+    final live-state snapshot at stop(). Relist re-deliveries arrive as
+    MODIFIED events — the checkers are written to tolerate replay (level-
+    triggered, like every consumer of this watch protocol)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.events: List[Any] = []  # WatchEvent, delivery order
+        self.final: Dict[str, List[Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._q = store.watch(None)
+        self._thread = threading.Thread(
+            target=self._pump, name="invariant-trail", daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                ev = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                self.events.append(ev)
+
+    def stop(self, snapshot: bool = True) -> "Trail":
+        """Stop recording; snapshot the store's final live state (the
+        authority for orphan checks — DELETED events inside a watch gap are
+        unobservable by design, the end state is not)."""
+        self._stop.set()
+        self.store.stop_watch(self._q)
+        self._thread.join(timeout=2.0)
+        if snapshot:
+            from mpi_operator_tpu.machinery.serialize import KIND_CLASSES
+
+            self.final = {
+                kind: self.store.list(kind) for kind in KIND_CLASSES
+            }
+        return self
+
+    def snapshot_events(self) -> List[Any]:
+        with self._lock:
+            return list(self.events)
+
+
+# ---------------------------------------------------------------------------
+# checkers — each returns a list of violation strings
+# ---------------------------------------------------------------------------
+
+
+def _job_key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def no_orphaned_dependents(trail: Trail) -> List[str]:
+    """Every live dependent's owning job exists in the final snapshot."""
+    out: List[str] = []
+    if not trail.final:
+        return out
+    jobs = {_job_key(j) for j in trail.final.get("TPUJob", [])}
+    for kind in ("Pod", "ConfigMap", "Service", "PodGroup"):
+        for obj in trail.final.get(kind, []):
+            owner = obj.metadata.labels.get(LABEL_JOB_NAME)
+            if not owner:
+                continue  # not controller-owned (test fixtures, nodes)
+            if f"{obj.metadata.namespace}/{owner}" not in jobs:
+                out.append(
+                    f"orphaned {kind} {_job_key(obj)}: its TPUJob "
+                    f"{obj.metadata.namespace}/{owner} no longer exists"
+                )
+    return out
+
+
+def single_gang_generation(trail: Trail) -> List[str]:
+    """At every instant, a job's live worker pods share ONE generation
+    label, and the generation never decreases."""
+    out: List[str] = []
+    # (ns, pod name) -> (uid, job key, generation) for live (non-terminal) pods
+    live: Dict[tuple, tuple] = {}
+    max_gen: Dict[str, int] = {}
+    for ev in trail.snapshot_events():
+        if ev.kind != "Pod":
+            continue
+        pod = ev.obj
+        key = (pod.metadata.namespace, pod.metadata.name)
+        gen_s = pod.metadata.labels.get(LABEL_GENERATION)
+        job = pod.metadata.labels.get(LABEL_JOB_NAME)
+        if gen_s is None or not job:
+            continue  # unstamped pods (hand-built fixtures) are out of scope
+        jk = f"{pod.metadata.namespace}/{job}"
+        gen = int(gen_s)
+        if ev.type == "DELETED" or pod.status.phase in _TERMINAL:
+            live.pop(key, None)
+            continue
+        live[key] = (pod.metadata.uid, jk, gen)
+        gens = {g for (_, j, g) in live.values() if j == jk}
+        if len(gens) > 1:
+            out.append(
+                f"job {jk}: generations {sorted(gens)} live concurrently "
+                f"after {ev.type} of pod {key[1]} (double-created gang)"
+            )
+        if gen < max_gen.get(jk, 0):
+            out.append(
+                f"job {jk}: pod {key[1]} launched with generation {gen} "
+                f"after generation {max_gen[jk]} was observed"
+            )
+        max_gen[jk] = max(max_gen.get(jk, 0), gen)
+    return out
+
+
+def terminal_write_once(trail: Trail) -> List[str]:
+    """Pod incarnations never leave a terminal phase; jobs never leave
+    Succeeded."""
+    from mpi_operator_tpu.api.conditions import is_succeeded
+
+    out: List[str] = []
+    pod_terminal: Dict[str, str] = {}   # pod uid -> terminal phase
+    job_succeeded: Dict[str, bool] = {}  # job uid -> ever succeeded
+    for ev in trail.snapshot_events():
+        if ev.type == "DELETED":
+            continue  # the tombstone carries the last state; nothing new
+        obj = ev.obj
+        uid = obj.metadata.uid
+        if ev.kind == "Pod":
+            prior = pod_terminal.get(uid)
+            phase = obj.status.phase
+            if prior is not None and phase != prior:
+                out.append(
+                    f"pod {_job_key(obj)} (uid {uid[:8]}) transitioned "
+                    f"{prior} -> {phase}: terminal phases are write-once"
+                )
+            if phase in _TERMINAL:
+                pod_terminal[uid] = phase
+        elif ev.kind == "TPUJob":
+            succ = is_succeeded(obj.status)
+            if job_succeeded.get(uid) and not succ:
+                out.append(
+                    f"job {_job_key(obj)} (uid {uid[:8]}) left Succeeded: "
+                    f"no Succeeded->anything transitions allowed"
+                )
+            if succ:
+                job_succeeded[uid] = True
+    return out
+
+
+def conditions_obey_state_machine(trail: Trail) -> List[str]:
+    """Each observed TPUJob status is a legal api/conditions.py state."""
+    out: List[str] = []
+    for ev in trail.snapshot_events():
+        if ev.kind != "TPUJob" or ev.type == "DELETED":
+            continue
+        job = ev.obj
+        active = {c.type for c in job.status.conditions if c.status}
+        types = [c.type for c in job.status.conditions]
+        where = f"job {_job_key(job)}"
+        if "Running" in active and "Restarting" in active:
+            out.append(f"{where}: Running and Restarting both active")
+        if "Succeeded" in active and "Failed" in active:
+            out.append(f"{where}: Succeeded and Failed both active")
+        if ("Running" in active or active & set(_TERMINAL)) \
+                and "Created" not in types:
+            out.append(f"{where}: active {sorted(active)} without a Created "
+                       f"condition record")
+        dupes = {t for t in types if types.count(t) > 1}
+        if dupes:
+            out.append(f"{where}: duplicate condition types {sorted(dupes)}")
+    return out
+
+
+def restart_count_monotonic(trail: Trail) -> List[str]:
+    out: List[str] = []
+    seen: Dict[str, int] = {}
+    for ev in trail.snapshot_events():
+        if ev.kind != "TPUJob" or ev.type == "DELETED":
+            continue
+        uid = ev.obj.metadata.uid
+        rc = ev.obj.status.restart_count
+        if rc < seen.get(uid, 0):
+            out.append(
+                f"job {_job_key(ev.obj)}: restart_count went backwards "
+                f"{seen[uid]} -> {rc} (lost write / rewound store)"
+            )
+        seen[uid] = max(seen.get(uid, 0), rc)
+    return out
+
+
+def resource_versions_monotonic(trail: Trail) -> List[str]:
+    """Per object, rv never decreases across the trail — the durable-store
+    guarantee a crash/restart must preserve (relists may re-deliver the
+    SAME rv; going backwards means an acknowledged write was lost)."""
+    out: List[str] = []
+    seen: Dict[tuple, int] = {}
+    for ev in trail.snapshot_events():
+        m = ev.obj.metadata
+        key = (ev.kind, m.namespace, m.name)
+        rv = m.resource_version or 0
+        if rv < seen.get(key, 0):
+            out.append(
+                f"{ev.kind} {m.namespace}/{m.name}: resource_version went "
+                f"backwards {seen[key]} -> {rv}"
+            )
+        seen[key] = max(seen.get(key, 0), rv)
+    return out
+
+
+ALL_CHECKS = (
+    no_orphaned_dependents,
+    single_gang_generation,
+    terminal_write_once,
+    conditions_obey_state_machine,
+    restart_count_monotonic,
+    resource_versions_monotonic,
+)
+
+
+def violations(trail: Trail,
+               checks: Sequence = ALL_CHECKS) -> List[str]:
+    out: List[str] = []
+    for check in checks:
+        out.extend(check(trail))
+    return out
+
+
+def check_invariants(trail: Trail, checks: Sequence = ALL_CHECKS,
+                     detail: str = "") -> None:
+    """Assert every invariant, reporting ALL violations at once (a chaos
+    run that broke three things should say so in one failure)."""
+    found = violations(trail, checks)
+    assert not found, (
+        f"{len(found)} control-plane invariant violation(s):\n- "
+        + "\n- ".join(found)
+        + (f"\n{detail}" if detail else "")
+    )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-side sibling (orbax step dirs, sampled by scenario drivers)
+# ---------------------------------------------------------------------------
+
+
+def latest_checkpoint_step(ckpt_dir) -> Optional[int]:
+    """Newest saved step in an orbax checkpoint dir (None = none yet)."""
+    import os
+
+    if not os.path.isdir(str(ckpt_dir)):
+        return None
+    steps = [int(p) for p in os.listdir(str(ckpt_dir))
+             if str(p).isdigit()
+             and os.path.isdir(os.path.join(str(ckpt_dir), p))]
+    return max(steps) if steps else None
+
+
+def checkpoint_steps_monotonic(samples: Sequence[Optional[int]]) -> None:
+    """Assert a sequence of latest-step samples never regresses: training
+    progress carried across every restart (the crash-recovery promise)."""
+    last = None
+    for i, s in enumerate(samples):
+        if s is None:
+            continue
+        assert last is None or s >= last, (
+            f"checkpoint step went backwards at sample {i}: {last} -> {s} "
+            f"(full trail: {list(samples)})"
+        )
+        last = s

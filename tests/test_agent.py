@@ -406,7 +406,8 @@ def _proc_logs(tmp_path, tags):
 
 
 def _start_cluster(tmp_path, *, node_grace=None, heartbeat=0.5,
-                   ckpt_dir=None, preemption_grace=None, agent_chips=None):
+                   ckpt_dir=None, preemption_grace=None, agent_chips=None,
+                   eviction_grace=None):
     """store-serving operator (no local executor) + two agent processes.
     ``ckpt_dir`` emulates the shared checkpoint volume of a real cluster:
     both agents advertise the same path via --ckpt-dir (≙ one PVC mounted
@@ -443,6 +444,8 @@ def _start_cluster(tmp_path, *, node_grace=None, heartbeat=0.5,
             agent_flags += ["--ckpt-dir", str(ckpt_dir)]
         if agent_chips is not None:
             agent_flags += ["--chips", str(agent_chips)]
+        if eviction_grace is not None:
+            agent_flags += ["--eviction-grace", str(eviction_grace)]
         procs.append(_spawn(tmp_path, f"agent-{x}", agent_flags))
     return port, procs
 
@@ -1107,29 +1110,64 @@ def test_elastic_rescale_with_checkpoint_across_agents(tmp_path):
         _reap(procs)
 
 
-@pytest.mark.slow  # full stack / subprocess e2e
+@pytest.mark.slow  # full stack / subprocess e2e / jax compile
 def test_preemption_across_agents_end_to_end(tmp_path):
-    """Preemption composed with the node-agent plane: a low-priority
-    sleeper gang fills both agents' capacity; a critical job arrives,
-    waits out --preemption-grace, the scheduler evicts the sleeper off
-    BOTH agents (whole-gang), the critical job runs spread across them,
-    and the sleeper gang restarts afterwards — the Volcano reclaim
-    semantics (mpi_job_controller.go:1215-1237) on real node boundaries."""
+    """Preemption composed with the node-agent plane — and the victim is a
+    CHECKPOINTING TRAINER, not a sleeper (VERDICT carryover): a low-priority
+    llama gang fills both agents' capacity; a critical job arrives, waits
+    out --preemption-grace, and the scheduler evicts the trainer off BOTH
+    agents (whole-gang). Eviction is SIGTERM + grace (executor
+    eviction_grace), which the elastic loop folds into a gang-uniform
+    FORCE-CHECKPOINT before exiting — periodic saves are disabled
+    (LLAMA_SAVE_EVERY huge), so the second incarnation reporting
+    ``start_step > 0`` proves the SIGTERM checkpoint specifically landed.
+    The critical job runs spread across the freed agents, and the victim
+    then resumes from its saved step and completes — the Volcano reclaim
+    semantics (mpi_job_controller.go:1215-1237) with real work preserved."""
+    import json as _json
+
     from mpi_operator_tpu.api.client import TPUJobClient
     from mpi_operator_tpu.machinery.http_store import HttpStoreClient
 
     tags = ["operator", "agent-a", "agent-b"]
-    port, procs = _start_cluster(tmp_path, preemption_grace=2, agent_chips=1)
+    shared = tmp_path / "shared-ckpt"
+    shared.mkdir()
+    # eviction grace well above the save cost: the SIGTERM checkpoint
+    # (allgather sync + orbax save) must land even on a loaded CI host —
+    # a backstop SIGKILL mid-save is the one nondeterminism in this test
+    port, procs = _start_cluster(tmp_path, preemption_grace=2, agent_chips=1,
+                                 ckpt_dir=shared, eviction_grace=30)
     try:
         store = HttpStoreClient(f"http://127.0.0.1:{port}")
         _wait_nodes_registered(store, ["agent-a", "agent-b"])
         client = TPUJobClient(store)
         client.create(_job_manifest(
-            "sleeper", replicas=2, env={}, priority="low",
-            command=["python", "-c", "import time; time.sleep(300)"],
+            "victim", replicas=2, priority="low", restart="ExitCode",
+            backoff=6,
+            env={"LLAMA_CONFIG": "tiny", "LLAMA_BATCH": "2",
+                 "LLAMA_SEQ": "16", "LLAMA_STEPS": "150",
+                 "LLAMA_STEP_SLEEP": "0.05",
+                 # the ONLY checkpoint this job can ever write is the
+                 # SIGTERM-forced one: resumption proves the mechanism
+                 "LLAMA_SAVE_EVERY": "100000",
+                 "LLAMA_CHECK_EVERY": "2",
+                 "LLAMA_PROGRESS_EVERY": "5"},
         ))
-        pods = _wait_pods_running(store, "sleeper", 2, 90, tmp_path, tags)
+        pods = _wait_pods_running(store, "victim", 2, 240, tmp_path, tags)
         assert {p.spec.node_name for p in pods} == {"agent-a", "agent-b"}
+        # preempt only once the trainer is demonstrably STEPPING (past
+        # compile): a SIGTERM during compile would never reach the
+        # gang-synchronized checkpoint point inside the grace window
+        w0 = [p for p in pods if p.metadata.name.endswith("worker-0")][0]
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            with urllib.request.urlopen(w0.status.log_path, timeout=10) as r:
+                if b"progress: batch" in r.read():
+                    break
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("victim never started stepping\n"
+                               + _proc_logs(tmp_path, tags))
 
         client.create(_job_manifest(
             "crit-pi", replicas=2, env={}, priority="critical",
@@ -1139,7 +1177,7 @@ def test_preemption_across_agents_end_to_end(tmp_path):
         pods = [p for p in store.list("Pod")
                 if p.metadata.labels.get(LABEL_JOB_NAME) == "crit-pi"]
         # the critical gang ran spread across BOTH agents (the capacity the
-        # sleeper was evicted from), its SPMD gang seeing 2 hosts
+        # victim was evicted from), its SPMD gang seeing 2 hosts
         assert {p.spec.node_name for p in pods} == {"agent-a", "agent-b"}
         w0 = [p for p in pods if p.metadata.name.endswith("worker-0")]
         assert w0 and w0[0].status.log_path.startswith("http://"), (
@@ -1148,8 +1186,22 @@ def test_preemption_across_agents_end_to_end(tmp_path):
             assert "(2 hosts)" in r.read().decode()
         evs = [e for e in store.list("Event") if e.reason == "Preempted"]
         assert evs, "no Preempted event recorded"
-        # and the victim restarts once the capacity frees again
-        _wait_pods_running(store, "sleeper", 2, 120, tmp_path, tags)
+        # the SIGTERM force-checkpoint is on the shared volume
+        job_ckpt = shared / "default" / "victim"
+        assert job_ckpt.exists() and any(
+            p.is_dir() for p in job_ckpt.iterdir()
+        ), "no forced checkpoint appeared\n" + _proc_logs(tmp_path, tags)
+        # once capacity frees, the victim restarts and RESUMES: the second
+        # incarnation runs from the forced checkpoint to completion
+        final = _wait_job(store, "victim", 420, tmp_path, tags)
+        assert final.status.restart_count == 0  # preemption restarts are free
+        report, _ = _coordinator_report(store, "victim")
+        assert report["outcome"] == "done", report
+        assert report["step"] == 150, report
+        assert report["start_step"] > 0, (
+            "second incarnation started from scratch — the SIGTERM "
+            f"force-checkpoint was lost: {report}\n"
+            + _proc_logs(tmp_path, tags))
         store.close()
     finally:
         _reap(procs)

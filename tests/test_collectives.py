@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from mpi_operator_tpu.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi_operator_tpu.parallel import collectives as c

@@ -485,3 +485,26 @@ def test_mixed_crash_and_preemption_still_burns_backoff(f):
     f.set_pod_phase(job, 1, PodPhase.FAILED, reason="Preempted")
     f.sync(job)
     assert f.job(job).status.restart_count == 1  # counted, not free
+
+
+def test_status_write_never_cross_stamps_a_recreated_job(f):
+    """A reconcile computed for a DELETED incarnation must not stamp its
+    status onto a new same-name job: the old restart_count / Failed
+    conditions would pre-burn the fresh job's backoffLimit (and the
+    absorbed restart_count never self-heals). The write path early-outs on
+    uid mismatch and uid-pins the patch for the read-to-write race."""
+    job = make_job(name="reborn", replicas=1)
+    job.metadata.uid = ""  # store assigns a real uid per incarnation
+    old = f.create_job(job)
+    assert f.sync(old)
+    stale = f.job(old)  # the old incarnation's reconcile snapshot
+    stale.status.restart_count = 5
+    f.store.delete("TPUJob", "default", "reborn")
+    f.sync(old)  # cascade-reaps the old dependents
+    fresh = make_job(name="reborn", replicas=1)
+    fresh.metadata.uid = ""
+    f.store.create(fresh)
+    assert f.controller._write_status(stale) is True  # dropped, not applied
+    cur = f.store.get("TPUJob", "default", "reborn")
+    assert cur.status.restart_count == 0
+    assert cur.status.conditions == []

@@ -1,0 +1,443 @@
+"""Fast-tier tests for the chaos harness machinery itself: the invariant
+checker must catch planted violations (a checker that never fires proves
+nothing), the chaos script must fail fast on typos, the proxy's fault
+decisions must replay deterministically under one seed, and the job-deletion
+cascade (the mechanism behind the no-orphans invariant) must reap every
+dependent."""
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.types import ObjectMeta
+from mpi_operator_tpu.controller.controller import (
+    ControllerOptions,
+    LABEL_GENERATION,
+    LABEL_JOB_NAME,
+    TPUJobController,
+)
+from mpi_operator_tpu.machinery import EventRecorder, ObjectStore, PodPhase
+from mpi_operator_tpu.machinery.chaos import (
+    ChaosAction,
+    ChaosController,
+    ChaosProxy,
+    ChaosScript,
+    ChaosScriptError,
+)
+from mpi_operator_tpu.machinery.objects import Pod
+
+from tests.invariants import (
+    Trail,
+    check_invariants,
+    checkpoint_steps_monotonic,
+    violations,
+)
+from tests.test_api_types import make_job
+
+
+# ---------------------------------------------------------------------------
+# chaos script parsing
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_script_parses_and_sorts_actions():
+    s = ChaosScript.parse({
+        "seed": 7,
+        "actions": [
+            {"at": 5.0, "fault": "restart", "target": "store"},
+            {"at": 2.0, "fault": "kill", "target": "store"},
+            {"at": 1.0, "fault": "drop", "match": "mutation", "prob": 0.5,
+             "duration": 3.0},
+        ],
+    })
+    assert s.seed == 7
+    assert [a.fault for a in s.actions] == ["drop", "kill", "restart"]
+    assert s.actions[0].until == 4.0  # at + duration
+
+def test_chaos_script_blackhole_duration_expands_to_restore():
+    s = ChaosScript.parse({
+        "actions": [{"at": 1.0, "fault": "blackhole", "duration": 2.0}],
+    })
+    assert [(a.at, a.fault) for a in s.actions] == [
+        (1.0, "blackhole"), (3.0, "restore"),
+    ]
+
+
+@pytest.mark.parametrize("doc,hint", [
+    ({"actions": []}, "non-empty"),
+    ({"actions": [{"at": 1.0, "fault": "explode"}]}, "unknown fault"),
+    ({"actions": [{"fault": "kill", "target": "x"}]}, "required"),
+    ({"actions": [{"at": 1.0, "fault": "kill"}]}, "target"),
+    ({"actions": [{"at": 1.0, "fault": "drop", "prob": 2.0}]}, "prob"),
+    ({"actions": [{"at": 1.0, "fault": "drop", "typo": 1}]}, "unknown keys"),
+    ({"actions": [{"at": 1.0, "fault": "drop", "match": "pods"}]}, "match"),
+    ({"actions": [{"at": 1.0, "fault": "sever", "duration": 5.0}]},
+     "not apply"),
+    ({"actions": [{"at": 1.0, "fault": "kill", "target": "x", "prob": 0.5}]},
+     "not apply"),
+], ids=["empty", "bad-fault", "no-at", "no-target", "bad-prob",
+        "unknown-key", "bad-match", "inapplicable-duration",
+        "inapplicable-prob"])
+def test_chaos_script_rejects_malformed(doc, hint):
+    """Fail fast: a typo'd script silently injecting nothing would make a
+    'passing' chaos run meaningless."""
+    with pytest.raises(ChaosScriptError, match=hint):
+        ChaosScript.parse(doc)
+
+
+# ---------------------------------------------------------------------------
+# chaos proxy: faults on a real store seam
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def seam():
+    """backing ← StoreServer ← ChaosProxy ← HttpStoreClient."""
+    from mpi_operator_tpu.machinery.http_store import (
+        HttpStoreClient,
+        StoreServer,
+    )
+
+    backing = ObjectStore()
+    server = StoreServer(backing).start()
+    proxy = ChaosProxy(server.url, seed=42).start()
+    client = HttpStoreClient(proxy.url, timeout=5.0,
+                             conn_refused_retries=0)
+    yield backing, server, proxy, client
+    client.close()
+    proxy.stop()
+    server.stop()
+
+
+def _pod(name, **labels):
+    p = Pod(metadata=ObjectMeta(name=name, namespace="d"))
+    p.metadata.labels = dict(labels)
+    return p
+
+
+def test_proxy_forwards_and_drops_mutations_by_class(seam):
+    backing, server, proxy, client = seam
+    client.create(_pod("ok"))  # forwarded
+    assert backing.get("Pod", "d", "ok") is not None
+    proxy.add_rule("drop", match="mutation", prob=1.0)
+    with pytest.raises(OSError):
+        client.create(_pod("dropped"))
+    assert backing.try_get("Pod", "d", "dropped") is None  # never reached
+    # reads still pass: the rule is class-scoped
+    assert client.get("Pod", "d", "ok").metadata.name == "ok"
+    assert proxy.stats["dropped"] >= 1
+
+
+def test_proxy_duplicate_applies_verb_twice_client_sees_once(seam):
+    backing, server, proxy, client = seam
+    client.create(_pod("p"))
+    before = server.stats()["patch"]
+    proxy.add_rule("duplicate", match="mutation", prob=1.0)
+    out = client.patch("Pod", "d", "p", {"status": {"reason": "x"}},
+                       subresource="status")
+    # idempotent merge-patch: applied twice server-side, one response
+    assert server.stats()["patch"] - before == 2
+    assert out.status.reason == "x"
+    assert proxy.stats["duplicated"] == 1
+
+
+def test_proxy_blackhole_and_restore(seam):
+    backing, server, proxy, client = seam
+    client.create(_pod("before"))
+    proxy.set_blackhole(True)
+    with pytest.raises(OSError):
+        client.get("Pod", "d", "before")
+    proxy.set_blackhole(False)
+    assert client.get("Pod", "d", "before").metadata.name == "before"
+
+
+def test_proxy_sever_cuts_watch_but_client_recovers(seam):
+    backing, server, proxy, client = seam
+    q = client.watch("Pod")
+    time.sleep(0.3)  # the long-poll is in flight through the proxy
+    assert proxy.sever("watch") >= 1
+    backing.create(_pod("after-sever"))
+    ev = q.get(timeout=10)  # the poller retried and resumed/relisted
+    assert ev.obj.metadata.name == "after-sever"
+
+
+def test_seeded_drop_decisions_replay_identically():
+    """Same seed + same per-connection request sequence → the same fault
+    decisions, independent of wall clock (the determinism contract the
+    two-runs acceptance check rides)."""
+    import random
+
+    def decisions(seed):
+        proxy = ChaosProxy("http://127.0.0.1:9", seed=seed)  # never started
+        proxy.add_rule("drop", match="mutation", prob=0.5)
+        rng = random.Random(f"{seed}:0")  # what _ProxyConn builds for conn 0
+        return [bool(proxy._decide(rng, "mutation", "/v1/objects"))
+                for _ in range(64)]
+
+    a, b = decisions(42), decisions(42)
+    assert a == b
+    assert a != decisions(43)  # and the seed actually matters
+
+
+def test_chaos_controller_runs_timeline_against_targets():
+    class FakeTarget:
+        def __init__(self):
+            self.calls = []
+
+        def kill(self):
+            self.calls.append("kill")
+
+        def restart(self):
+            self.calls.append("restart")
+
+    target = FakeTarget()
+    script = ChaosScript.parse({"actions": [
+        {"at": 0.0, "fault": "kill", "target": "store"},
+        {"at": 0.05, "fault": "restart", "target": "store"},
+        {"at": 0.1, "fault": "kill", "target": "missing"},
+    ]})
+    ctl = ChaosController(script, targets={"store": target}).arm()
+    ctl.join(5.0)
+    assert target.calls == ["kill", "restart"]
+    assert len(ctl.executed) == 3
+    errs = [e for (_, a, e) in ctl.executed if e]
+    assert len(errs) == 1 and "missing" in errs[0]  # logged, not fatal
+
+
+# ---------------------------------------------------------------------------
+# invariant checker: planted violations must be caught
+# ---------------------------------------------------------------------------
+
+
+def _worker(store, job, idx, gen, uid=None, phase=PodPhase.RUNNING):
+    p = Pod(metadata=ObjectMeta(name=f"{job}-worker-{idx}", namespace="default"))
+    p.metadata.labels = {LABEL_JOB_NAME: job, LABEL_GENERATION: str(gen)}
+    if uid:
+        p.metadata.uid = uid
+    p.status.phase = phase
+    return store.create(p)
+
+
+def test_checker_passes_a_clean_lifecycle():
+    store = ObjectStore()
+    trail = Trail(store)
+    job = store.create(make_job(name="clean"))
+    a = _worker(store, "clean", 0, 0)
+    b = _worker(store, "clean", 1, 0)
+    for pod in (a, b):
+        pod.status.phase = PodPhase.SUCCEEDED
+        store.update(pod, force=True)
+    store.delete("Pod", "default", a.metadata.name)
+    store.delete("Pod", "default", b.metadata.name)
+    store.delete("TPUJob", "default", "clean")
+    time.sleep(0.3)
+    check_invariants(trail.stop())
+
+
+def test_checker_flags_concurrent_generations():
+    store = ObjectStore()
+    trail = Trail(store)
+    _worker(store, "j", 0, 0)
+    _worker(store, "j", 1, 1)  # second generation while gen 0 still live
+    time.sleep(0.3)
+    found = violations(trail.stop(snapshot=False))
+    assert any("generations [0, 1] live concurrently" in v for v in found)
+
+
+def test_checker_flags_terminal_phase_rewrite():
+    store = ObjectStore()
+    trail = Trail(store)
+    p = _worker(store, "j", 0, 0, phase=PodPhase.SUCCEEDED)
+    p.status.phase = PodPhase.RUNNING  # resurrect the same incarnation
+    store.update(p, force=True)
+    time.sleep(0.3)
+    found = violations(trail.stop(snapshot=False))
+    assert any("terminal phases are write-once" in v for v in found)
+
+
+def test_checker_flags_job_leaving_succeeded_and_restart_rewind():
+    from mpi_operator_tpu.api import ConditionType, conditions
+
+    store = ObjectStore()
+    trail = Trail(store)
+    job = make_job(name="undone")
+    conditions.update_job_conditions(
+        job.status, ConditionType.CREATED, "TPUJobCreated", "x")
+    conditions.update_job_conditions(
+        job.status, ConditionType.SUCCEEDED, "TPUJobSucceeded", "x")
+    job.status.restart_count = 2
+    job = store.create(job)
+    # a rewound store incarnation: Succeeded gone, restart_count rolled back
+    for c in job.status.conditions:
+        if c.type == ConditionType.SUCCEEDED:
+            c.status = False
+    job.status.restart_count = 0
+    store.update(job, force=True)
+    time.sleep(0.3)
+    found = violations(trail.stop(snapshot=False))
+    assert any("left Succeeded" in v for v in found)
+    assert any("restart_count went backwards" in v for v in found)
+
+
+def test_checker_flags_orphaned_dependents_and_illegal_conditions():
+    from mpi_operator_tpu.api import ConditionType, conditions
+
+    store = ObjectStore()
+    trail = Trail(store)
+    _worker(store, "ghost", 0, 0)  # pod with no owning job, ever
+    bad = make_job(name="bad")
+    conditions.update_job_conditions(
+        bad.status, ConditionType.RUNNING, "TPUJobRunning", "x")
+    bad.status.conditions[0].status = True
+    store.create(bad)  # Running active without a Created record
+    time.sleep(0.3)
+    found = violations(trail.stop())
+    assert any("orphaned Pod" in v for v in found)
+    assert any("without a Created" in v for v in found)
+
+
+def test_checkpoint_step_monotonicity_helper():
+    checkpoint_steps_monotonic([None, 2, 2, None, 6, 8])
+    with pytest.raises(AssertionError, match="went backwards"):
+        checkpoint_steps_monotonic([4, 6, 2])
+
+
+# ---------------------------------------------------------------------------
+# the mechanism behind no-orphans: job deletion cascades
+# ---------------------------------------------------------------------------
+
+
+def test_job_deletion_cascades_to_all_dependents():
+    """Deleting a live job reaps its pods, config, service and podgroup
+    (the kube GC role) — before this, `ctl delete` on a RUNNING job
+    stranded the gang forever."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    trail = Trail(store)
+    job = store.create(make_job(name="doomed", replicas=2))
+    key = job.metadata.key()
+    assert controller.sync_handler(key)
+    assert len(store.list("Pod", "default")) == 2
+    assert store.try_get("Service", "default", "doomed-worker") is not None
+    store.delete("TPUJob", "default", "doomed")
+    assert controller.sync_handler(key)
+    assert store.list("Pod", "default") == []
+    assert store.try_get("Service", "default", "doomed-worker") is None
+    assert store.try_get("ConfigMap", "default", "doomed-config") is None
+    assert store.try_get("PodGroup", "default", "doomed") is None
+    time.sleep(0.3)
+    check_invariants(trail.stop())
+
+
+def test_cascade_leaves_foreign_objects_alone():
+    """The GC must only reap CONTROLLER-OWNED dependents: a user object
+    that happens to wear the job-name label survives the cascade."""
+    from mpi_operator_tpu.machinery.objects import ConfigMap
+
+    store = ObjectStore()
+    controller = TPUJobController(store, EventRecorder(store))
+    job = store.create(make_job(name="gone"))
+    assert controller.sync_handler(job.metadata.key())
+    squatter = ConfigMap(metadata=ObjectMeta(
+        name="user-data", namespace="default",
+        labels={LABEL_JOB_NAME: "gone"},
+    ))
+    store.create(squatter)  # same label, NO owner reference
+    store.delete("TPUJob", "default", "gone")
+    assert controller.sync_handler(job.metadata.key())
+    assert store.try_get("ConfigMap", "default", "user-data") is not None
+    assert store.try_get("ConfigMap", "default", "gone-config") is None
+
+
+def test_workers_carry_generation_label():
+    """The generation stamp the single-generation invariant keys on: fresh
+    gangs are generation 0; a restarted generation is stamped with
+    status.restart_generation — which advances on EVERY executed restart,
+    free preemption restarts included (restart_count deliberately skips
+    those, so it cannot be the label's source)."""
+    store = ObjectStore()
+    controller = TPUJobController(store, EventRecorder(store))
+    job = store.create(make_job(name="gen", replicas=1))
+    controller.sync_handler(job.metadata.key())
+    pod = store.get("Pod", "default", "gen-worker-0")
+    assert pod.metadata.labels[LABEL_GENERATION] == "0"
+    cur = store.get("TPUJob", "default", "gen")
+    cur.status.restart_generation = 3  # e.g. three preemption restarts:
+    cur.status.restart_count = 0       # the backoff budget is untouched
+    store.update(cur, force=True)
+    store.delete("Pod", "default", "gen-worker-0")
+    controller.sync_handler(job.metadata.key())
+    pod = store.get("Pod", "default", "gen-worker-0")
+    assert pod.metadata.labels[LABEL_GENERATION] == "3"
+
+
+def test_relaunch_waits_for_draining_predecessor(tmp_path):
+    """The next restart generation must not launch while the previous
+    generation's evicted process is still inside its termination grace:
+    the job's coordinator port is stable across generations, so two live
+    generations would collide on the bind. The reaper level-triggers the
+    deferred launch once the predecessor exits."""
+    import time as _time
+
+    from mpi_operator_tpu.executor import LocalExecutor
+    from mpi_operator_tpu.machinery.objects import PodSpec
+
+    store = ObjectStore()
+    ex = LocalExecutor(store, logs_dir=str(tmp_path), eviction_grace=30.0)
+    pod = Pod(metadata=ObjectMeta(name="w", namespace="d"),
+              spec=PodSpec())
+    # a process that IGNORES SIGTERM until its sentinel file appears —
+    # the stand-in for a trainer spending its grace on a checkpoint
+    gate = tmp_path / "release"
+    ready = tmp_path / "ready"
+    pod.spec.container.command = [
+        "python", "-c",
+        "import os, signal, time, sys\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        f"open({str(ready)!r}, 'w').close()\n"
+        f"p = {str(gate)!r}\n"
+        "t = time.time() + 30\n"
+        "while time.time() < t and not os.path.exists(p):\n"
+        "    time.sleep(0.05)\n",
+    ]
+    gen1 = store.create(pod)
+    ex.start()
+    try:
+        # wait until the child has INSTALLED its SIGTERM-ignore (evicting
+        # before that would just kill it and prove nothing)
+        deadline = _time.time() + 15
+        while not ready.exists() and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert ready.exists(), "worker never came up"
+        old_proc = ex._procs["d/w"]
+        # evict (SIGTERM + grace), then delete + recreate the pod — the
+        # gang-restart sequence
+        cur = store.get("Pod", "d", "w")
+        cur.status.phase = PodPhase.FAILED
+        cur.status.reason = "Preempted"
+        store.update(cur, force=True)
+        deadline = _time.time() + 10
+        while "d/w" not in ex._terminating and _time.time() < deadline:
+            _time.sleep(0.05)
+        store.delete("Pod", "d", "w")
+        gen2 = Pod(metadata=ObjectMeta(name="w", namespace="d"),
+                   spec=PodSpec())
+        gen2.spec.container.command = ["python", "-c", "print('gen2')"]
+        store.create(gen2)
+        _time.sleep(1.0)  # give the watch loop time to (wrongly) launch
+        assert old_proc.poll() is None  # predecessor still draining
+        assert "d/w" not in ex._procs, (
+            "generation 2 launched while generation 1 was still draining")
+        gate.write_text("go")  # predecessor exits; reaper re-triggers
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            p2 = ex._procs.get("d/w")
+            if p2 is not None and p2 is not old_proc:
+                break
+            _time.sleep(0.05)
+        else:
+            raise TimeoutError("deferred generation 2 never launched")
+        assert old_proc.poll() is not None
+    finally:
+        ex.stop()

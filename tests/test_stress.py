@@ -219,6 +219,88 @@ def test_idle_cluster_does_zero_store_writes(tmp_path):
         controller.stop()
 
 
+def test_single_agent_failure_causes_exactly_one_restart_generation():
+    """Restart-storm tripwire: ONE injected node failure must cost exactly
+    ONE gang restart generation — observed on the job's restart_count AND
+    the tpu_operator_gang_restarts_total counter. A controller that
+    re-counts per failure observation (instead of per drained generation)
+    blows this immediately, and did historically in other operators: the
+    restart loop is the most storm-prone edge the chaos suite leans on.
+    Fully synchronous (no threads) so the count is deterministic."""
+    from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.opshell import metrics
+
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder, ControllerOptions())
+    monitor = NodeMonitor(store, recorder, grace=5.0)
+    client = TPUJobClient(store)
+
+    def make_node(name):
+        node = Node()
+        node.metadata.namespace = NODE_NAMESPACE
+        node.metadata.name = name
+        node.status.ready = True
+        node.status.last_heartbeat = time.time()
+        return store.create(node)
+
+    for n in ("node-a", "node-b"):
+        make_node(n)
+    m = _manifest(0)
+    del m["spec"]["run_policy"]
+    m["spec"]["worker"]["restart_policy"] = "OnFailure"
+    job = client.create(m)
+    key = job.metadata.key()
+    assert controller.sync_handler(key)
+    # fake scheduler + kubelet: bind one pod per node, both RUNNING
+    for i, node in enumerate(("node-a", "node-b")):
+        pod = store.get("Pod", "default", f"churn-000-worker-{i}")
+        pod.spec.node_name = node
+        pod.status.phase = "Running"
+        store.update(pod, force=True)
+    assert controller.sync_handler(key)
+    base_restarts = metrics.gang_restarts.get()
+
+    # the injected failure: node-b goes silent past the grace window
+    node_b = store.get("Node", NODE_NAMESPACE, "node-b")
+    node_b.status.last_heartbeat = time.time() - 60
+    store.update(node_b, force=True)
+    monitor.sync()  # marks not-ready, evicts node-b's pod
+    evicted = store.get("Pod", "default", "churn-000-worker-1")
+    assert evicted.is_evicted()
+
+    # drain: the survivor is still RUNNING — repeated reconciles and
+    # monitor ticks must NOT restart yet (the verdict waits for drain)
+    for _ in range(5):
+        monitor.sync()
+        assert controller.sync_handler(key)
+    assert store.get("TPUJob", "default", "churn-000").status.restart_count == 0
+
+    # the survivor's collateral crash drains the gang: NOW exactly one
+    # restart generation executes, however many reconciles observe it
+    survivor = store.get("Pod", "default", "churn-000-worker-0")
+    survivor.status.phase = "Failed"
+    survivor.status.exit_code = 1
+    store.update(survivor, force=True)
+    for _ in range(6):
+        monitor.sync()
+        assert controller.sync_handler(key)
+    cur = store.get("TPUJob", "default", "churn-000")
+    assert cur.status.restart_count == 1, cur.status.conditions
+    assert metrics.gang_restarts.get() - base_restarts == 1, (
+        "restart storm: one injected failure moved "
+        "tpu_operator_gang_restarts_total by "
+        f"{metrics.gang_restarts.get() - base_restarts}"
+    )
+    # the relaunched generation exists, PENDING, stamped generation 1
+    pods = store.list("Pod", "default")
+    assert len(pods) == 2
+    assert all(p.status.phase == "Pending" for p in pods)
+    assert all(p.metadata.labels["tpujob.dev/generation"] == "1" for p in pods)
+
+
 @pytest.mark.slow
 def test_idle_scheduler_does_no_list_traffic(tmp_path):
     """With nothing pending, the periodic resync is skipped entirely: an

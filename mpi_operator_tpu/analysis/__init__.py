@@ -1,0 +1,36 @@
+"""Correctness tooling for the control plane (≙ the reference's
+golangci-lint gate + `go test -race` CI split):
+
+- :mod:`oplint` — AST rules over this repo's own invariants (RMW001,
+  UID001, TERM001, BLK001, EXC001, SEC001), with per-line
+  ``# oplint: disable=RULE`` suppressions;
+- :mod:`racecheck` — runtime lock-order + unguarded-shared-state detector
+  (tracked lock factories + lockset/Eraser attribute monitoring), exposed
+  as the opt-in pytest plugin :mod:`pytest_racecheck`.
+
+CLI: ``python -m mpi_operator_tpu.analysis lint mpi_operator_tpu tests``
+and ``python -m mpi_operator_tpu.analysis racecheck --selftest``.
+"""
+
+from mpi_operator_tpu.analysis.oplint import (
+    RULES,
+    Finding,
+    Rule,
+    lint_paths,
+    lint_source,
+    rule_catalog,
+)
+from mpi_operator_tpu.analysis.racecheck import (
+    LockOrderFinding,
+    LockTracker,
+    Session,
+    SharedStateFinding,
+    SharedStateMonitor,
+    self_test,
+)
+
+__all__ = [
+    "RULES", "Rule", "Finding", "lint_paths", "lint_source", "rule_catalog",
+    "LockTracker", "LockOrderFinding", "SharedStateFinding",
+    "SharedStateMonitor", "Session", "self_test",
+]

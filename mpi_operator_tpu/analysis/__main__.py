@@ -1,0 +1,97 @@
+"""CLI for the correctness tooling.
+
+    python -m mpi_operator_tpu.analysis lint mpi_operator_tpu tests
+    python -m mpi_operator_tpu.analysis lint --format json path/to/file.py
+    python -m mpi_operator_tpu.analysis rules
+    python -m mpi_operator_tpu.analysis racecheck --selftest
+    python -m mpi_operator_tpu.analysis racecheck tests/test_cache.py ...
+
+``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
+rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
+``--selftest`` delegates to pytest with the plugin armed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from mpi_operator_tpu.analysis import oplint
+
+
+def _cmd_lint(args) -> int:
+    findings = oplint.lint_paths(args.paths)
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    if findings:
+        errors = sum(1 for f in findings if f.severity == "error")
+        print(
+            f"oplint: {len(findings)} finding(s) ({errors} error(s))",
+            file=sys.stderr,
+        )
+        # default gate: ANY finding fails (tier-1 pins the tree to zero);
+        # --errors-only is the laxer gate where the severity tier decides
+        return 1 if (errors or not args.errors_only) else 0
+    print("oplint: clean", file=sys.stderr)
+    return 0
+
+
+def _cmd_rules(args) -> int:
+    print(oplint.rule_catalog())
+    return 0
+
+
+def _cmd_racecheck(args) -> int:
+    from mpi_operator_tpu.analysis import racecheck
+
+    if args.selftest:
+        failures = racecheck.self_test()
+        for f in failures:
+            print(f"racecheck selftest FAILED: {f}", file=sys.stderr)
+        if not failures:
+            print("racecheck selftest: ok")
+        return 1 if failures else 0
+    if not args.pytest_args:
+        print("racecheck: pass --selftest or pytest paths/args", file=sys.stderr)
+        return 2
+    import pytest
+
+    return pytest.main(
+        ["-p", "mpi_operator_tpu.analysis.pytest_racecheck", "--racecheck"]
+        + args.pytest_args
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi_operator_tpu.analysis", description=__doc__
+    )
+    sub = ap.add_subparsers(dest="verb", required=True)
+    p = sub.add_parser("lint", help="run the oplint ruleset over paths")
+    p.add_argument("paths", nargs="+")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--errors-only", action="store_true",
+                   help="exit 0 when only warning-severity findings remain "
+                        "(default: any finding fails)")
+    p.set_defaults(fn=_cmd_lint)
+    p = sub.add_parser("rules", help="print the rule catalog")
+    p.set_defaults(fn=_cmd_rules)
+    p = sub.add_parser(
+        "racecheck", help="detector self-test, or pytest under the detector"
+    )
+    p.add_argument("--selftest", action="store_true")
+    # REMAINDER, not "*": pytest flags (-q, -m 'not slow', -x) must reach
+    # pytest.main instead of being rejected as unrecognized arguments
+    p.add_argument("pytest_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=_cmd_racecheck)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,578 @@
+"""oplint: an AST rule engine over this repo's own control-plane idioms.
+
+≙ the reference's golangci-lint gate (.github/workflows/golangci-lint.yml):
+the invariants PRs 1-3 fought for — patch-with-rv instead of GET+PUT
+read-modify-write, uid-pinned status writes, terminal write-once,
+stop-observing loops — lived only in reviewers' heads and in after-the-fact
+chaos tests. Each rule here is mined from a real past bug and catches the
+regression at diff time, not at chaos-replay time.
+
+Rule catalog (rationale → the PR that motivated each):
+
+- **RMW001** raw store ``get``+``update`` read-modify-write in one function.
+  PR 2 replaced every GET+PUT+409-retry loop with one server-side
+  merge-patch carrying an rv precondition; a new GET+PUT loop reintroduces
+  the clobber race AND the double round-trip. Blessed forms: ``.patch`` with
+  a precondition, or the ``optimistic_update`` helper.
+- **UID001** Pod/TPUJob status-subresource patch without a uid/rv pin.
+  PR 3's chaos suite proved a stale reconcile can cross-stamp a recreated
+  same-name object (pre-burning its backoffLimit); every status write on an
+  incarnation-sensitive kind must pin ``metadata.uid`` or ride an rv
+  precondition. Node heartbeats are exempt — their merge is incarnation-free
+  by design.
+- **TERM001** writes that can resurrect a terminal phase: a force-PUT
+  (``update(..., force=True)``), or assigning ``.status.phase`` and PUTing
+  the object back. PR 2 made terminal pod status write-once (the Evicted
+  marker must survive the reaper of the process the eviction killed);
+  the blessed path is ``patch_pod_status``/``evict_pod``.
+- **BLK001** blocking calls that cannot observe shutdown inside
+  reconcile/watch/handler loops: unbounded ``queue.get()``, un-timeouted
+  ``urlopen``/``create_connection``/``settimeout(None)``, ``time.sleep`` in
+  a run/sync/pump/handler loop body (use ``Event.wait``). PR 3's chaos
+  scenarios hang exactly here when a stop event cannot be observed.
+- **EXC001** bare ``except:`` anywhere, and broad ``except Exception``
+  whose handler neither logs nor re-raises in controller/agent loop code —
+  a swallowed fault in a reconcile loop is invisible until a chaos replay.
+- **SEC001** token/secret values interpolated into log output or URLs.
+  PR 3's VERDICT found ``ctl logs`` shipping the admin bearer token over
+  plain HTTP; secrets may be *presented* (Authorization headers) but never
+  *printed* or baked into a URL.
+
+Suppression: ``# oplint: disable=RULE[,RULE...]`` on the flagged line or the
+line directly above it silences that rule there. Policy: every suppression
+carries a reason in the same comment block — a bare disable is a review
+smell (README "Static analysis & race checking").
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# rule API
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One lint rule. ``scope`` is 'src' (package code only) or 'all'
+    (tests too) — test code legitimately pokes raw store verbs and swallows
+    exceptions in teardown, so most control-plane rules stay out of it.
+    ``autofixable`` is metadata for a future --fix mode (none of the first
+    ruleset is mechanically fixable without judgment)."""
+
+    id: str
+    severity: str  # "error" | "warning"
+    summary: str
+    rationale: str
+    scope: str = "src"
+    autofixable: bool = False
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "RMW001", "error",
+            "raw store get+update read-modify-write",
+            "PR 2: every GET+PUT+409 loop became one merge-patch with an rv "
+            "precondition; use .patch or optimistic_update",
+        ),
+        Rule(
+            "UID001", "error",
+            "Pod/TPUJob status write without a uid/rv pin",
+            "PR 3: a stale reconcile must never cross-stamp a recreated "
+            "same-name incarnation",
+        ),
+        Rule(
+            "TERM001", "error",
+            "write can resurrect a terminal phase",
+            "PR 2: terminal pod status is write-once (the Evicted marker "
+            "survives the reaper); use patch_pod_status/evict_pod",
+        ),
+        Rule(
+            "BLK001", "error",
+            "blocking call cannot observe shutdown",
+            "PR 3: chaos scenarios hang in loops that cannot see the stop "
+            "event; bound every wait",
+        ),
+        Rule(
+            "EXC001", "warning",
+            "swallowed broad exception in loop code",
+            "a fault swallowed in a reconcile/agent loop is invisible until "
+            "a chaos replay; log it, narrow it, or annotate why not",
+        ),
+        Rule(
+            "SEC001", "error",
+            "secret value reaches a log line or URL",
+            "PR 3 VERDICT: the admin bearer token crossed plain HTTP; "
+            "secrets are presented in headers, never printed or URL-baked",
+            scope="all",
+        ),
+    )
+}
+
+_DISABLE_RE = re.compile(r"#\s*oplint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# receivers that look like a store (write surface) vs read-only surfaces;
+# matching is on the LAST dotted component so `self.store`, `client.store`,
+# `self.backing` and plain `store` all resolve the same way
+_STORE_COMPONENTS = ("store", "backing")
+_READER_COMPONENTS = ("read", "client")
+_QUEUE_COMPONENTS = ("q", "queue")
+
+_SECRET_RE = re.compile(r"token|secret|passw|credential|bearer", re.I)
+_SECRET_EXEMPT_RE = re.compile(r"file|path|dir|name|kind|check|for|stats", re.I)
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
+}
+_HANDLER_NAME_RE = re.compile(
+    r"^(run|_run.*|sync.*|_sync.*|_pump.*|reconcile.*|_reconcile.*)$"
+    r"|.*(_loop|_worker|_handler)$"
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as 'a.b.c' (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_component(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _is_store_like(recv: Optional[str]) -> bool:
+    last = _last_component(recv)
+    return last in _STORE_COMPONENTS or last.endswith(_STORE_COMPONENTS)
+
+
+def _is_reader_like(recv: Optional[str]) -> bool:
+    last = _last_component(recv)
+    return _is_store_like(recv) or last in _READER_COMPONENTS or last.endswith("client")
+
+
+def _is_queue_like(recv: Optional[str]) -> bool:
+    last = _last_component(recv)
+    return last in _QUEUE_COMPONENTS or last.endswith(("_q", "_queue", "queue"))
+
+
+def _is_secretish(name: str) -> bool:
+    return bool(_SECRET_RE.search(name)) and not _SECRET_EXEMPT_RE.search(name)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _const(node: Optional[ast.AST]):
+    return node.value if isinstance(node, ast.Constant) else None
+
+
+def _dict_keys(d: ast.Dict) -> Set[str]:
+    return {k.value for k in d.keys if isinstance(k, ast.Constant)}
+
+
+def _dict_value(d: ast.Dict, key: str) -> Optional[ast.expr]:
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and k.value == key:
+            return v
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-file checker
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FileCtx:
+    path: str
+    is_test: bool
+    findings: List[Finding] = field(default_factory=list)
+
+    def report(self, rule_id: str, node: ast.AST, message: str) -> None:
+        rule = RULES[rule_id]
+        if rule.scope == "src" and self.is_test:
+            return
+        self.findings.append(
+            Finding(
+                rule_id, rule.severity, self.path,
+                getattr(node, "lineno", 1), getattr(node, "col_offset", 0),
+                message,
+            )
+        )
+
+
+def _iter_functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _function_calls(fn: ast.AST) -> Iterable[ast.Call]:
+    """Calls lexically inside ``fn``, excluding nested function bodies (a
+    closure's get does not pair with the enclosing function's update)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_rmw001(ctx: _FileCtx, fn: ast.AST) -> None:
+    reads: List[ast.Call] = []
+    updates: List[Tuple[ast.Call, str]] = []
+    for call in _function_calls(fn):
+        if not isinstance(call.func, ast.Attribute):
+            continue
+        recv = _dotted(call.func.value)
+        if call.func.attr in ("get", "try_get") and _is_reader_like(recv):
+            reads.append(call)
+        elif call.func.attr == "update" and _is_reader_like(recv):
+            updates.append((call, recv or "?"))
+    if reads and updates:
+        for call, recv in updates:
+            ctx.report(
+                "RMW001", call,
+                f"get+update read-modify-write through {recv!r}; use "
+                f".patch with an rv precondition (or optimistic_update)",
+            )
+
+
+def _check_uid001(ctx: _FileCtx, call: ast.Call) -> None:
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == "patch"):
+        return
+    if not _is_store_like(_dotted(call.func.value)):
+        return
+    kind = _const(call.args[0]) if call.args else None
+    if kind not in ("Pod", "TPUJob"):
+        return
+    if _const(_kwarg(call, "subresource")) != "status":
+        return
+    patch = call.args[3] if len(call.args) > 3 else _kwarg(call, "patch")
+    if not isinstance(patch, ast.Dict):
+        return  # can't prove shape; the fixture suite pins the dict form
+    meta = _dict_value(patch, "metadata")
+    pinned = isinstance(meta, ast.Dict) and (
+        _dict_keys(meta) & {"uid", "resource_version"}
+    )
+    if not pinned:
+        ctx.report(
+            "UID001", call,
+            f"status write on {kind} without a metadata.uid or "
+            f"resource_version precondition (a recreated same-name "
+            f"incarnation could absorb it)",
+        )
+
+
+def _check_term001(ctx: _FileCtx, fn: ast.AST) -> None:
+    phase_vars: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "phase"
+                    and isinstance(tgt.value, ast.Attribute)
+                    and tgt.value.attr == "status"
+                    and isinstance(tgt.value.value, ast.Name)
+                ):
+                    phase_vars.add(tgt.value.value.id)
+    for call in _function_calls(fn):
+        if not (isinstance(call.func, ast.Attribute) and call.func.attr == "update"):
+            continue
+        if not _is_reader_like(_dotted(call.func.value)):
+            continue
+        if _const(_kwarg(call, "force")) is True:
+            ctx.report(
+                "TERM001", call,
+                "force-PUT skips the rv check and can clobber a concurrent "
+                "terminal write; use an rv-guarded patch",
+            )
+        elif (
+            call.args
+            and isinstance(call.args[0], ast.Name)
+            and call.args[0].id in phase_vars
+        ):
+            ctx.report(
+                "TERM001", call,
+                f"writes {call.args[0].id}.status.phase via full-object PUT; "
+                f"patch_pod_status/evict_pod enforce write-once-terminal",
+            )
+
+
+def _enclosing_handler(fn_stack: List[str]) -> bool:
+    return bool(fn_stack) and bool(_HANDLER_NAME_RE.match(fn_stack[-1]))
+
+
+def _check_blk001(ctx: _FileCtx, call: ast.Call, fn_stack: List[str]) -> None:
+    func = call.func
+    dotted = _dotted(func)
+    if isinstance(func, ast.Attribute):
+        recv = _dotted(func.value)
+        if (
+            func.attr == "get"
+            and _is_queue_like(recv)
+            and not call.args
+            and _kwarg(call, "timeout") is None
+        ):
+            ctx.report(
+                "BLK001", call,
+                f"unbounded {recv}.get() can never observe shutdown; pass "
+                f"timeout= and loop on the stop event",
+            )
+            return
+        if (
+            func.attr == "settimeout"
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value is None
+        ):
+            ctx.report("BLK001", call, "settimeout(None) disables the socket bound")
+            return
+    if dotted == "time.sleep" and _enclosing_handler(fn_stack):
+        ctx.report(
+            "BLK001", call,
+            f"time.sleep in {fn_stack[-1]!r} cannot observe the stop event; "
+            f"use Event.wait(timeout)",
+        )
+    elif dotted and dotted.rsplit(".", 1)[-1] == "urlopen":
+        if _kwarg(call, "timeout") is None and len(call.args) < 3:
+            ctx.report("BLK001", call, "urlopen without timeout= can hang forever")
+    elif dotted and dotted.rsplit(".", 1)[-1] == "create_connection":
+        if _kwarg(call, "timeout") is None and len(call.args) < 2:
+            ctx.report(
+                "BLK001", call, "create_connection without timeout= can hang forever"
+            )
+
+
+def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in (
+                _LOG_METHODS | {"print_exc"}
+            ):
+                return True
+    return False
+
+
+def _check_exc001(ctx: _FileCtx, handler: ast.ExceptHandler) -> None:
+    if handler.type is None:
+        ctx.report("EXC001", handler, "bare except: names no exception at all")
+        return
+    names = set()
+    types = (
+        handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
+    )
+    for t in types:
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+    if names & {"Exception", "BaseException"} and not _handler_logs_or_raises(handler):
+        ctx.report(
+            "EXC001", handler,
+            "broad except swallows the fault without logging or re-raising",
+        )
+
+
+def _secret_in(node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _is_secretish(sub.id):
+            return sub.id
+        if isinstance(sub, ast.Attribute) and _is_secretish(sub.attr):
+            return sub.attr
+    return None
+
+
+def _check_sec001(ctx: _FileCtx, node: ast.AST) -> None:
+    if isinstance(node, ast.Call):
+        f = node.func
+        is_log = (isinstance(f, ast.Name) and f.id == "print") or (
+            isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS
+        )
+        if is_log:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                leaked = _secret_in(arg)
+                if leaked:
+                    ctx.report(
+                        "SEC001", arg,
+                        f"secret-bearing value {leaked!r} formatted into log "
+                        f"output; log the fact, never the value",
+                    )
+    elif isinstance(node, ast.JoinedStr):
+        literal = "".join(
+            v.value for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        )
+        if any(m in literal for m in ("http", "?", "&", "/v1/")):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    leaked = _secret_in(v.value)
+                    if leaked:
+                        ctx.report(
+                            "SEC001", v,
+                            f"secret-bearing value {leaked!r} interpolated "
+                            f"into a URL; it would land in server logs and "
+                            f"proxies",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def _disabled_lines(source: str) -> Dict[int, Set[str]]:
+    """line number → set of rule ids disabled there. A trailing disable
+    covers its own line ONLY; a disable inside a standalone comment block
+    covers the first CODE line after the block (so multi-line reason
+    comments — the suppression policy requires one — work naturally)."""
+    lines = source.splitlines()
+    out: Dict[int, Set[str]] = {}
+
+    def add(i: int, rules: Set[str]) -> None:
+        out.setdefault(i, set()).update(rules)
+
+    for i, line in enumerate(lines, 1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+        add(i, rules)
+        if line.lstrip().startswith("#"):
+            j = i  # comment-only: propagate past the rest of the block
+            while j < len(lines) and lines[j].lstrip().startswith("#"):
+                j += 1
+            add(j + 1, rules)
+    return out
+
+
+def is_test_path(path: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    base = os.path.basename(norm)
+    return (
+        "/tests/" in norm
+        or norm.startswith("tests/")
+        or base.startswith(("test_", "conftest"))
+    )
+
+
+def lint_source(
+    source: str, path: str = "<string>", *, is_test: Optional[bool] = None
+) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "E999", "error", path, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}",
+            )
+        ]
+    ctx = _FileCtx(path, is_test_path(path) if is_test is None else is_test)
+
+    for fn in _iter_functions(tree):
+        _check_rmw001(ctx, fn)
+        _check_term001(ctx, fn)
+
+    # walk with an enclosing-function-name stack for BLK001's sleep check
+    def visit(node: ast.AST, fn_stack: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_stack = fn_stack + [node.name]
+        if isinstance(node, ast.Call):
+            _check_uid001(ctx, node)
+            _check_blk001(ctx, node, fn_stack)
+        if isinstance(node, ast.ExceptHandler):
+            _check_exc001(ctx, node)
+        _check_sec001(ctx, node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_stack)
+
+    visit(tree, [])
+
+    disabled = _disabled_lines(source)
+    out = []
+    for f in ctx.findings:
+        if f.rule_id in disabled.get(f.line, set()):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return out
+
+
+# directories never linted: caches, plus the fixture corpus that is bad on
+# purpose. The data skip is SCOPED to a tests directory's data/ — a source
+# module living under some other directory named data must not silently
+# escape the gate this linter exists to provide.
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+def _skip_dir(root: str, name: str) -> bool:
+    if name in _SKIP_DIRS:
+        return True
+    return name == "data" and os.path.basename(root) == "tests"
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if not _skip_dir(root, d))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            findings.extend(lint_source(f.read(), path))
+    return findings
+
+
+def rule_catalog() -> str:
+    lines = []
+    for rule in RULES.values():
+        fix = " [autofixable]" if rule.autofixable else ""
+        lines.append(f"{rule.id} ({rule.severity}, scope={rule.scope}){fix}")
+        lines.append(f"  {rule.summary}")
+        lines.append(f"  why: {rule.rationale}")
+    return "\n".join(lines)

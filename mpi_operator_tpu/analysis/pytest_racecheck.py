@@ -1,0 +1,50 @@
+"""Opt-in pytest plugin running the racecheck detector over a test session.
+
+Usage (the slow-tier job; see README "Static analysis & race checking"):
+
+    python -m pytest tests/test_cache.py tests/test_stress.py -q \\
+        -p mpi_operator_tpu.analysis.pytest_racecheck --racecheck
+
+With ``--racecheck`` the tracked lock factories are installed for the whole
+session and the control-plane classes (racecheck.DEFAULT_TARGETS) are
+instrumented; at session end a summary is printed and ANY finding fails the
+run. Without the flag the plugin is inert, so it is always safe to load.
+"""
+
+from __future__ import annotations
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--racecheck", action="store_true", default=False,
+        help="run the whole session under the lock-order + shared-state "
+             "race detector (mpi_operator_tpu.analysis.racecheck)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "racecheck: tests exercising (or exercised under) the race detector",
+    )
+    if config.getoption("--racecheck"):
+        from mpi_operator_tpu.analysis import racecheck
+
+        config._racecheck_session = racecheck.Session().install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    sess = getattr(session.config, "_racecheck_session", None)
+    if sess is None:
+        return
+    sess.uninstall()
+    if sess.findings() and exitstatus == 0:
+        session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    sess = getattr(config, "_racecheck_session", None)
+    if sess is None:
+        return
+    terminalreporter.section("racecheck")
+    terminalreporter.write_line(sess.render_report())

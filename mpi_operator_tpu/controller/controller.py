@@ -28,6 +28,7 @@ TPU-first redesign (SURVEY.md §7.3-4):
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 import zlib
@@ -241,7 +242,7 @@ class TPUJobController:
         while not self._stop.is_set():
             try:
                 ev: WatchEvent = self._watch_q.get(timeout=0.2)
-            except Exception:
+            except queue.Empty:
                 continue
             if ev.kind == "Event":
                 continue
@@ -275,9 +276,18 @@ class TPUJobController:
         if not self._wait_cache_synced():
             return
         while True:
-            key = self.queue.get()
+            # bounded get (oplint BLK001): the old unbounded get() relied on
+            # shut_down()'s notify_all alone to ever unblock this thread —
+            # a stop() racing a worker BETWEEN its loop check and the wait
+            # was safe, but any future stop path that forgets shut_down()
+            # (or a queue bug swallowing the wake) parked the worker forever
+            # with no way to observe _stop. The watch pump at _pump already
+            # polls at 0.2s for exactly this reason.
+            key = self.queue.get(timeout=0.2)
             if key is None:
-                return
+                if self._stop.is_set() or self.queue.shutting_down:
+                    return
+                continue
             try:
                 # sync_handler owns the Conflict/AlreadyExists → requeue
                 # mapping (stale cached reads); only unexpected errors

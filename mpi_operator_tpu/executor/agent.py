@@ -570,7 +570,9 @@ class NodeAgent:
                     e["changes"], expected_rv=e["rv"], what="agent-drain",
                 )
             except Exception:
-                pass
+                log.debug("shutdown mirror of %s/%s failed; the monitor's "
+                          "eviction is the backstop", e["namespace"],
+                          e["name"], exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()
@@ -610,7 +612,8 @@ class NodeAgent:
                 {"status": {"ready": False}}, subresource="status",
             )
         except Exception:
-            pass  # best-effort drain mark; the monitor catches it anyway
+            # best-effort drain mark; the monitor catches it anyway
+            log.debug("final ready=False mark failed", exc_info=True)
         self.log_server.stop()
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import subprocess
 import tempfile
 import threading
@@ -54,6 +55,8 @@ try:
     _LIBC = _ctypes.CDLL("libc.so.6", use_errno=True)
     _LIBC.prctl  # resolve the symbol now, not after fork
     _SIGKILL = int(_signal.SIGKILL)
+# oplint: disable=EXC001 — non-Linux / no-glibc platform probe: _LIBC=None
+# IS the handled outcome (the hook degrades to a no-op), nothing to log
 except Exception:
     _LIBC = None
     _SIGKILL = 9
@@ -70,6 +73,9 @@ def _die_with_parent() -> None:
         return
     try:
         _LIBC.prctl(1, _SIGKILL)  # PR_SET_PDEATHSIG = 1
+    # oplint: disable=EXC001 — post-fork pre-exec hook: logging here can
+    # deadlock on the logging module's lock held by a vanished thread;
+    # only async-signal-safe-ish work is allowed (see _LIBC above)
     except Exception:
         pass
 
@@ -207,7 +213,7 @@ class LocalExecutor:
         while not self._stop.is_set():
             try:
                 ev = self._watch_q.get(timeout=0.2)
-            except Exception:
+            except queue.Empty:
                 continue
             try:
                 if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):

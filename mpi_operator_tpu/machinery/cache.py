@@ -37,6 +37,7 @@ observe their own updates through the watch, exactly like client-go.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -325,7 +326,7 @@ class InformerCache:
         while not self._stop.is_set():
             try:
                 item = self._q.get(timeout=0.2)
-            except Exception:
+            except queue.Empty:
                 continue
             if item is None:
                 continue  # stop() wake-up

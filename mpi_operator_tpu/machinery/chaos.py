@@ -383,6 +383,9 @@ class _ProxyConn(threading.Thread):
                     self.proxy._count("dropped")
                     break  # request never reaches the server
                 if faults.get("delay"):
+                    # oplint: disable=BLK001 — the sleep IS the injected
+                    # fault (ChaosScript delay_ms); bounding it would change
+                    # the failure being simulated
                     time.sleep(faults["delay"])
                     self.proxy._count("delayed")
                 copies = 2 if "duplicate" in faults else 1

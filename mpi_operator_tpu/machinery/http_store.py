@@ -40,6 +40,7 @@ from __future__ import annotations
 import argparse
 import hmac
 import json
+import logging
 import queue
 import threading
 import time
@@ -63,6 +64,8 @@ from mpi_operator_tpu.machinery.store import (
     WatchEvent,
     patch_batch_via_loop,
 )
+
+log = logging.getLogger("tpujob.store")
 
 _ERROR_CLASSES = {
     "NotFound": NotFound,
@@ -517,13 +520,18 @@ class StoreServer:
                             "message": f"Content-Length {e.size!r} rejected "
                                        f"(cap {_MAX_BODY_BYTES} bytes)",
                         })
+                    # oplint: disable=EXC001 — best-effort reject to a peer
+                    # that is gone; scanner noise must not reach the log
                     except Exception:
                         pass
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # surface, don't kill the thread
+                    log.debug("request handler error", exc_info=True)
                     try:
                         self._send(500, {"error": "Internal", "message": str(e)})
+                    # oplint: disable=EXC001 — the 500 above is the
+                    # surfacing; this guard only covers a vanished peer
                     except Exception:
                         pass
 
@@ -547,9 +555,7 @@ class StoreServer:
                 # port scanners / plain-HTTP probes against a TLS listener
                 # fail their deferred handshake in the handler thread; one
                 # bad connection is not worth a stderr traceback
-                import logging as _logging
-
-                _logging.getLogger("tpujob.store").debug(
+                log.debug(
                     "connection error from %s", client_address, exc_info=True
                 )
 
@@ -975,6 +981,9 @@ class StoreServer:
                     }
                 force = _force_requested(qs)
                 self._count("update")
+                # oplint: disable=RMW001 — HTTP router, not a RMW loop: the
+                # GET branch above and this PUT serve DISTINCT client verbs;
+                # the rv precondition travels inside the client's object
                 return 200, {"object": encode(self.backing.update(obj, force=force))}
             if method == "DELETE":
                 self._count("delete")
@@ -1193,8 +1202,8 @@ class HttpStoreClient:
                 payload = {}
                 try:
                     payload = json.loads(e.read())
-                except Exception:
-                    pass
+                except (ValueError, OSError):
+                    pass  # non-JSON error body (proxy page): generic raise below
                 cls = _ERROR_CLASSES.get(payload.get("error", ""))
                 if cls is not None:
                     raise cls(payload.get("message", str(e))) from None
@@ -1364,6 +1373,7 @@ class HttpStoreClient:
                 # anchor above lets a restarted server REPLAY the gap from
                 # its ring when provable — the relist is the fallback, not
                 # the first resort
+                log.debug("watch poll failed; retrying", exc_info=True)
                 if self._stop.wait(0.5):
                     return
                 continue
@@ -1383,7 +1393,10 @@ class HttpStoreClient:
                         try:
                             cb([o.deepcopy() for o in objs])
                         except Exception:
-                            pass  # a broken listener must not kill the poll
+                            # a broken listener must not kill the poll — but
+                            # a silently dead informer is a debugging black
+                            # hole (EXC001)
+                            log.exception("relist listener failed")
                     for obj in objs:
                         self._fan_out(watchers, MODIFIED, obj)
                     # cursor and instance move together, only after the
@@ -1416,6 +1429,7 @@ class HttpStoreClient:
                 # malformed response (proxy interposing, version skew): a
                 # dead poll thread would silently stall every watcher
                 # forever — back off and retry instead, same as unreachable
+                log.debug("malformed watch response; retrying", exc_info=True)
                 if self._stop.wait(0.5):
                     return
 
@@ -1424,8 +1438,10 @@ class HttpStoreClient:
         try:
             return decode(kind or data.get("kind"), data)
         except Exception:
-            return None  # unknown kind / skewed shape from a newer server —
-            # skip the object rather than abort the whole batch
+            # unknown kind / skewed shape from a newer server — skip the
+            # object rather than abort the whole batch
+            log.debug("skipping undecodable watch object", exc_info=True)
+            return None
 
     @staticmethod
     def _fan_out(watchers, etype: str, obj) -> None:
@@ -1483,13 +1499,13 @@ def main(argv=None) -> int:
     try:
         host, port = parse_listen(args.listen)
     except ValueError as e:
-        raise SystemExit(f"error: --listen: {e}")
+        raise SystemExit(f"error: --listen: {e}") from None
     try:
         token = read_token_file(args.token_file)
         read_token = read_token_file(args.read_token_file)
         agent_tokens = read_agent_tokens_file(args.agent_tokens_file)
     except (OSError, ValueError) as e:
-        raise SystemExit(f"error: token file: {e}")
+        raise SystemExit(f"error: token file: {e}") from None
     if args.auth_reads and token is None:
         raise SystemExit("error: --auth-reads requires --token-file")
     if (read_token is not None or agent_tokens) and token is None:

@@ -24,6 +24,7 @@ delete/list/watch.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import sqlite3
@@ -44,6 +45,8 @@ from mpi_operator_tpu.machinery.store import (
     apply_merge_patch_dict,
     patch_batch_via_loop,
 )
+
+log = logging.getLogger("tpujob.sqlite")
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS objects (
@@ -408,7 +411,9 @@ class SqliteStore:
                     try:
                         obj = self._load(kind, data)
                     except Exception:
-                        continue  # unknown kind written by a newer version
+                        log.debug("skipping undecodable %s row (newer "
+                                  "writer version?)", kind, exc_info=True)
+                        continue
                     for want, wq in watchers:
                         if want is None or want == kind:
                             wq.put(WatchEvent(etype, kind, obj.deepcopy()))
@@ -430,12 +435,16 @@ class SqliteStore:
             try:
                 objs.append(self._load(kind, data))
             except Exception:
+                log.debug("skipping undecodable %s row in relist", kind,
+                          exc_info=True)
                 continue
         for cb in listeners:
             try:
                 cb([o.deepcopy() for o in objs])
             except Exception:
-                pass  # a broken listener must not stall the watch pump
+                # a broken listener must not stall the watch pump — but a
+                # silently dead informer is a debugging black hole (EXC001)
+                log.exception("relist listener failed")
         for obj in objs:
             for want, wq in watchers:
                 if want is None or want == obj.kind:

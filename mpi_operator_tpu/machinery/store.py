@@ -251,6 +251,10 @@ def optimistic_update(store, kind, namespace, name, mutate, *,
         if not mutate(cur):
             return None
         try:
+            # oplint: disable=RMW001 — this helper IS the sanctioned
+            # read-modify-write: the one conflict-retried GET+PUT the rule
+            # points callers at when a merge-patch cannot express the write
+            # (multi-field transitions with read-side preconditions)
             return store.update(cur)
         except KeyError:
             return None

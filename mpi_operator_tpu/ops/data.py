@@ -102,12 +102,18 @@ def prefetch(
                     item = transform(item)
                 q.put(make_global_batch(mesh, item))
             q.put(done)
+        # oplint: disable=EXC001 — not swallowed: the exception VALUE rides
+        # the queue to the consumer below, which re-raises it
         except BaseException as e:  # propagate to the consumer, never hang it
             q.put(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
     while True:
+        # oplint: disable=BLK001 — bounded by the producer's contract: it
+        # ALWAYS delivers the `done` sentinel or its own exception (the
+        # BaseException relay above); a timeout here would abort legitimate
+        # long preprocessing stalls mid-epoch
         item = q.get()
         if item is done:
             return

@@ -237,7 +237,9 @@ def _mutate_spec(client: TPUJobClient, name: str, mutate, done_msg: str) -> int:
     """Optimistic read-mutate-update with conflict retry + backoff
     (≙ kubectl's RetryOnConflict: the controller may be writing status
     concurrently). Admission validation lives in TPUJobClient.update — one
-    admission path for create and mutate."""
+    admission path for create and mutate. Deliberately NOT a merge-patch:
+    admission (validate_tpujob) must see the whole mutated spec, and a
+    patch would bypass it server-side."""
     for attempt in range(5):
         try:
             job = client.get(name)
@@ -246,6 +248,8 @@ def _mutate_spec(client: TPUJobClient, name: str, mutate, done_msg: str) -> int:
             return 1
         mutate(job)
         try:
+            # oplint: disable=RMW001 — whole-spec admission validation is the
+            # point; the Conflict retry above is the blessed fallback shape
             client.update(job)
         except ValidationRejected as e:
             print(f"error: {e}", file=sys.stderr)
@@ -406,48 +410,41 @@ def cmd_nodes(client: TPUJobClient, args) -> int:
     return 0
 
 
-def _mutate_node(client: TPUJobClient, name: str, mutate) -> Optional[Any]:
-    """Optimistic read-mutate-update on a Node (no force: a concurrent agent
-    heartbeat must not be clobbered — retry instead)."""
+def _set_cordon(client: TPUJobClient, name: str, unschedulable: bool) -> bool:
+    """Flip the cordon flag with ONE status-subresource merge-patch (oplint
+    RMW001: this was the last GET+PUT+retry loop outside the patch seam —
+    ten read-mutate-update attempts racing the agent's heartbeat, for a
+    write that touches exactly one operator-owned key). A merge-patch of
+    just ``status.unschedulable`` cannot clobber a concurrent heartbeat by
+    construction (untouched keys are left alone), so no precondition and no
+    retry loop are needed — the exact argument of the agent's own
+    ``_heartbeat_status``."""
     from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
 
-    for attempt in range(10):
-        node = client.store.try_get("Node", NODE_NAMESPACE, name)
-        if node is None:
-            print(f"error: no node named {name!r} (see `ctl nodes`)",
-                  file=sys.stderr)
-            return None
-        mutate(node)
-        try:
-            return client.store.update(node)
-        except Conflict:
-            time.sleep(0.05 * (attempt + 1))
-        except NotFound:
-            print(f"error: node {name!r} was deleted", file=sys.stderr)
-            return None
-    print(f"error: persistent update conflict on node {name}", file=sys.stderr)
-    return None
+    try:
+        client.store.patch(
+            "Node", NODE_NAMESPACE, name,
+            {"status": {"unschedulable": unschedulable}}, subresource="status",
+        )
+        return True
+    except NotFound:
+        print(f"error: no node named {name!r} (see `ctl nodes`)",
+              file=sys.stderr)
+        return False
 
 
 def cmd_cordon(client: TPUJobClient, args) -> int:
     """≙ kubectl cordon: mark the node unschedulable. Running pods stay;
     new gangs bind elsewhere. The flag survives agent heartbeats and is
     cleared only by uncordon."""
-
-    def mutate(node):
-        node.status.unschedulable = True
-
-    if _mutate_node(client, args.name, mutate) is None:
+    if not _set_cordon(client, args.name, True):
         return 1
     print(f"node/{args.name} cordoned")
     return 0
 
 
 def cmd_uncordon(client: TPUJobClient, args) -> int:
-    def mutate(node):
-        node.status.unschedulable = False
-
-    if _mutate_node(client, args.name, mutate) is None:
+    if not _set_cordon(client, args.name, False):
         return 1
     print(f"node/{args.name} uncordoned")
     return 0

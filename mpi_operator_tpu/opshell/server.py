@@ -38,6 +38,8 @@ class OpsServer:
                     ok = False
                     try:
                         ok = healthy_ref()
+                    # oplint: disable=EXC001 — a throwing health predicate
+                    # means NOT healthy; the 500 below is the surfacing
                     except Exception:
                         ok = False
                     body = json.dumps({"healthy": ok}).encode()

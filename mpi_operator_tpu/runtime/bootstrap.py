@@ -180,6 +180,8 @@ def initialize(
                 jax.config.update(
                     "jax_cpu_collectives_implementation", "gloo"
                 )
+            # oplint: disable=EXC001 — newer jax removed the knob because
+            # gloo IS the default there; the no-op is the desired outcome
             except Exception:
                 pass
         jax.distributed.initialize(

@@ -33,6 +33,7 @@ the capacity gate in front of them.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from collections import defaultdict
@@ -253,7 +254,7 @@ class GangScheduler:
                 while True:
                     ev = self._watch_q.get_nowait()
                     need_sync = need_sync or _wakes(ev)
-            except Exception:
+            except queue.Empty:
                 pass
             if not need_sync and time.monotonic() - last_sync < 2.0:
                 continue

@@ -30,6 +30,8 @@ def git_sha() -> str:
             ).stdout.strip()
             or "unknown"
         )
+    # oplint: disable=EXC001 — version probe (no git, no repo, sandboxed
+    # subprocess): "unknown" IS the surfacing; it must never fail a CLI
     except Exception:
         return "unknown"
 

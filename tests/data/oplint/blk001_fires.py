@@ -1,0 +1,32 @@
+# oplint fixture: BLK001 — blocking calls that cannot observe shutdown.
+
+import socket
+import time
+import urllib.request
+
+
+def _run_worker(self):
+    while True:
+        key = self.queue.get()  # expect: BLK001
+        if key is None:
+            return
+
+
+def drain(q):
+    return q.get()  # expect: BLK001
+
+
+def sync_pause():
+    time.sleep(1.0)  # expect: BLK001
+
+
+def fetch(url):
+    return urllib.request.urlopen(url)  # expect: BLK001
+
+
+def connect(addr):
+    return socket.create_connection(addr)  # expect: BLK001
+
+
+def unbound(sock):
+    sock.settimeout(None)  # expect: BLK001

@@ -1,0 +1,45 @@
+# oplint fixture: bounded-wait shapes BLK001 must stay silent on.
+
+import socket
+import time
+import urllib.request
+
+
+def _run_worker(self):
+    while True:
+        key = self.queue.get(timeout=0.2)  # bounded: the stop event is seen
+        if key is None:
+            if self._stop.is_set():
+                return
+            continue
+
+
+def drain(q):
+    return q.get_nowait()  # non-blocking drain
+
+
+def backoff_helper():
+    time.sleep(0.1)  # not a run/sync/pump/handler loop: a CLI retry helper
+
+
+def _run_loop(self):
+    self._stop.wait(0.5)  # the blessed pause: observes shutdown
+
+
+def fetch(url):
+    return urllib.request.urlopen(url, timeout=10)
+
+
+def connect(addr):
+    return socket.create_connection(addr, timeout=10.0)
+
+
+def lookup(qs):
+    return qs.get("force", ["0"])[0]  # dict-style get: not a queue
+
+
+def suppressed(q):
+    # oplint: disable=BLK001 — the producer ALWAYS delivers a terminal
+    # sentinel or its own exception; a timeout would abort legitimate
+    # long preprocessing stalls
+    return q.get()

@@ -1,0 +1,23 @@
+# oplint fixture: EXC001 — swallowed broad exceptions in loop code.
+
+
+def bare(q):
+    try:
+        q.get_nowait()
+    except:  # expect: EXC001
+        pass
+
+
+def swallowed_broad(store):
+    try:
+        store.list("Pod")
+    except Exception:  # expect: EXC001
+        pass
+
+
+def swallowed_continue(items):
+    for it in items:
+        try:
+            it.apply()
+        except BaseException:  # expect: EXC001
+            continue

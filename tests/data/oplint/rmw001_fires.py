@@ -1,0 +1,22 @@
+# oplint fixture: RMW001 must fire on the raw GET+PUT read-modify-write.
+# Lines carrying the bad form are marked with an expect comment; the
+# harness (tests/test_analysis.py) asserts the rule fires on exactly them.
+
+
+def sync_status(store):
+    cur = store.get("Pod", "ns", "p0")
+    cur.status.message = "stamped"
+    return store.update(cur)  # expect: RMW001
+
+
+def retry_loop(client):
+    for _ in range(5):
+        job = client.try_get("TPUJob", "ns", "j")
+        job.spec.worker = 4
+        client.update(job)  # expect: RMW001
+
+
+def through_attribute(self):
+    node = self.store.get("Node", "nodes", "n0")
+    node.status.ready = False
+    self.store.update(node)  # expect: RMW001

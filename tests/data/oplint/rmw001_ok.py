@@ -1,0 +1,28 @@
+# oplint fixture: blessed write shapes RMW001 must stay silent on, plus a
+# suppressed deliberate exception (the lease-CAS shape).
+
+
+def patch_with_rv(store, rv):
+    # the PR 2 idiom: one merge-patch, rv precondition checked atomically
+    return store.patch(
+        "Pod", "ns", "p0",
+        {"metadata": {"resource_version": rv}, "status": {"message": "x"}},
+        subresource="status",
+    )
+
+
+def read_only(store):
+    return store.get("Pod", "ns", "p0")  # a get without a put-back is fine
+
+
+def write_only(store, pod):
+    return store.update(pod)  # an update of caller-owned state: no stale read
+
+
+def lease_cas(store):
+    cur = store.get("ConfigMap", "kube-system", "leader-lock")
+    cur.data["renewTime"] = "now"
+    # oplint: disable=RMW001 — lease acquisition IS a full-record
+    # compare-and-swap; the rv-guarded update is the point (kube's
+    # Endpoints-lock election does the same GET+PUT)
+    return store.update(cur)

@@ -1,0 +1,17 @@
+# oplint fixture: SEC001 — secret values reaching logs or URLs.
+
+import logging
+
+log = logging.getLogger("fixture")
+
+
+def log_leak(token):
+    log.warning(f"auth failed for token {token}")  # expect: SEC001
+
+
+def print_leak(api_secret):
+    print("rejected:", api_secret)  # expect: SEC001
+
+
+def url_leak(read_token):
+    return f"http://store:8475/v1/watch?token={read_token}"  # expect: SEC001

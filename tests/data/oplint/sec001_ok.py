@@ -1,0 +1,28 @@
+# oplint fixture: secret handling SEC001 must stay silent on.
+
+import logging
+
+log = logging.getLogger("fixture")
+
+
+def log_the_fact(token):
+    if token is None:
+        log.warning("auth failed: no bearer token presented")
+    return token
+
+
+def file_names_are_not_values(token_file):
+    # paths/filenames around secrets are loggable; the VALUE is not
+    log.warning("token file %s is empty; refusing to run", token_file)
+
+
+def present_in_header(token):
+    # presenting a secret where it belongs (an Authorization header) is
+    # not a leak — the f-string is neither logged nor a URL
+    return {"Authorization": f"Bearer {token}"}
+
+
+def suppressed(debug_token):
+    # oplint: disable=SEC001 — dev-only diagnostics endpoint behind
+    # localhost; the token here is the generated per-test throwaway
+    log.debug(f"test token in use: {debug_token}")

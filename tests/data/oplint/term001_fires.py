@@ -1,0 +1,12 @@
+# oplint fixture: TERM001 — writes able to resurrect a terminal phase.
+
+
+def force_put(store, pod):
+    # force skips the rv check: it can land OVER a concurrent terminal
+    # write (the Evicted marker) and resurrect the pod
+    return store.update(pod, force=True)  # expect: TERM001
+
+
+def phase_via_put(store, pod):
+    pod.status.phase = "Running"
+    return store.update(pod)  # expect: TERM001

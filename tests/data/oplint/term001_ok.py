@@ -1,0 +1,29 @@
+# oplint fixture: blessed terminal-safe shapes TERM001 must stay silent on.
+
+
+def blessed_helper(store, pod, patch_pod_status):
+    # patch_pod_status enforces the incarnation guard AND write-once
+    # terminal; this is THE pod phase write
+    return patch_pod_status(
+        store, pod.metadata.namespace, pod.metadata.name, pod.metadata.uid,
+        {"phase": "Running"}, expected_rv=pod.metadata.resource_version,
+    )
+
+
+def local_accounting(store, pod, evict_pod):
+    # assigning phase on a LOCAL copy for this pass's bookkeeping (the
+    # scheduler's healed-pod accounting) without PUTting it back is fine
+    if evict_pod(store, pod, "healed"):
+        pod.status.phase = "Failed"
+        pod.status.reason = "Evicted"
+    return pod
+
+
+def plain_update(store, pod):
+    return store.update(pod)  # rv-guarded non-force PUT: Conflict surfaces
+
+
+def suppressed_force(store, pod):
+    # oplint: disable=TERM001 — envtest-style fixture playing kubelet: the
+    # test harness is the only writer, force stands in for the kubelet
+    return store.update(pod, force=True)

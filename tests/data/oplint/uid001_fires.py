@@ -1,0 +1,24 @@
+# oplint fixture: UID001 — Pod/TPUJob status writes lacking a uid/rv pin.
+
+
+def unpinned_pod_mirror(store, changes):
+    return store.patch(  # expect: UID001
+        "Pod", "ns", "p0", {"status": dict(changes)}, subresource="status",
+    )
+
+
+def unpinned_job_status(store):
+    return store.patch(  # expect: UID001
+        "TPUJob", "ns", "j",
+        {"status": {"restart_count": 3}},
+        subresource="status",
+    )
+
+
+def metadata_without_pin(store):
+    # a metadata key that pins NOTHING (labels are not an incarnation guard)
+    return store.patch(  # expect: UID001
+        "Pod", "ns", "p0",
+        {"metadata": {"labels": {}}, "status": {"phase": "Running"}},
+        subresource="status",
+    )

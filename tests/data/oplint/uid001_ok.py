@@ -1,0 +1,41 @@
+# oplint fixture: pinned status writes UID001 must stay silent on.
+
+
+def uid_pinned(store, uid, patch):
+    return store.patch(
+        "TPUJob", "ns", "j",
+        {"status": patch, "metadata": {"uid": uid}},
+        subresource="status",
+    )
+
+
+def rv_pinned(store, rv, body):
+    return store.patch(
+        "Pod", "ns", "p0",
+        {"metadata": {"resource_version": rv}, "status": body},
+        subresource="status",
+    )
+
+
+def node_heartbeat(store, status):
+    # Node heartbeats are incarnation-free by design: merge-patch of the
+    # fields the agent owns, cordon untouched by construction
+    return store.patch(
+        "Node", "nodes", "n0", {"status": status}, subresource="status",
+    )
+
+
+def spec_patch(store, rv):
+    # not a status write: the binding patch carries its own rv precondition
+    return store.patch(
+        "Pod", "ns", "p0",
+        {"metadata": {"resource_version": rv}, "spec": {"node_name": "n0"}},
+    )
+
+
+def suppressed(store, changes):
+    # oplint: disable=UID001 — single-writer test fixture playing kubelet;
+    # no concurrent incarnation can exist in this harness
+    return store.patch(
+        "Pod", "ns", "p0", {"status": dict(changes)}, subresource="status",
+    )

@@ -1,0 +1,308 @@
+"""Tier-1 gate for the correctness tooling (ISSUE 4).
+
+≙ the reference's golangci-lint.yml + `go test -race` CI jobs, folded into
+the test suite so the gate rides the existing verify command:
+
+- the whole package AND the test tree lint clean under oplint (every rule
+  was made true before being enforced — the satellite fixes);
+- every rule both FIRES on its bad-form fixture and stays SILENT on the
+  blessed forms + suppressions (tests/data/oplint/);
+- racecheck's self-test proves the detector catches a seeded lock-order
+  cycle and a seeded unguarded shared write, and stays silent on the
+  guarded idioms;
+- the slow tier replays the cache + stress suites under the detector
+  (`-m racecheck`).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mpi_operator_tpu.analysis import RULES, oplint, racecheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data", "oplint")
+
+
+# ---------------------------------------------------------------------------
+# the gate: the real tree is clean
+# ---------------------------------------------------------------------------
+
+
+def test_oplint_package_and_tests_are_clean():
+    """The acceptance gate: `python -m mpi_operator_tpu.analysis lint
+    mpi_operator_tpu tests` exits 0 — equivalently, zero findings here.
+    A regression against any control-plane invariant fails tier-1."""
+    findings = oplint.lint_paths(
+        [os.path.join(REPO, "mpi_operator_tpu"), os.path.join(REPO, "tests")]
+    )
+    assert findings == [], "oplint findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_rule_catalog_is_complete():
+    ids = set(RULES)
+    assert ids == {"RMW001", "UID001", "TERM001", "BLK001", "EXC001", "SEC001"}
+    for rule in RULES.values():
+        assert rule.severity in ("error", "warning")
+        assert rule.scope in ("src", "all")
+        assert rule.rationale  # every rule traces to the PR that motivated it
+    assert "RMW001" in oplint.rule_catalog()
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: fires on the bad form, silent on the blessed form
+# ---------------------------------------------------------------------------
+
+
+def _read(name: str) -> str:
+    with open(os.path.join(FIXDIR, name), encoding="utf-8") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULES))
+def test_rule_fires_on_bad_form(rule_id):
+    src = _read(f"{rule_id.lower()}_fires.py")
+    expected = {
+        i
+        for i, line in enumerate(src.splitlines(), 1)
+        if f"# expect: {rule_id}" in line
+    }
+    assert expected, f"fixture for {rule_id} marks no expected findings"
+    findings = oplint.lint_source(src, f"{rule_id.lower()}_fires.py", is_test=False)
+    got = {f.line for f in findings if f.rule_id == rule_id}
+    assert got == expected, (
+        f"{rule_id}: expected findings at {sorted(expected)}, got "
+        f"{sorted(got)}:\n" + "\n".join(f.render() for f in findings)
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULES))
+def test_rule_silent_on_blessed_and_suppressed_forms(rule_id):
+    src = _read(f"{rule_id.lower()}_ok.py")
+    assert "# oplint: disable=" + rule_id in src, (
+        "every ok-fixture must also prove the suppression comment works"
+    )
+    findings = oplint.lint_source(src, f"{rule_id.lower()}_ok.py", is_test=False)
+    assert findings == [], (
+        f"{rule_id} ok-fixture should lint clean:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_src_scoped_rules_skip_test_files():
+    src = _read("blk001_fires.py")
+    assert oplint.lint_source(src, "tests/test_something.py") == []
+    # SEC001 is scope=all: a leak in test helper code still fires
+    leak = _read("sec001_fires.py")
+    assert any(
+        f.rule_id == "SEC001"
+        for f in oplint.lint_source(leak, "tests/test_something.py")
+    )
+
+
+def test_disable_comment_is_line_scoped():
+    src = (
+        "def a(q):\n"
+        "    q.get()  # oplint: disable=BLK001\n"
+        "    return q.get()\n"
+    )
+    findings = oplint.lint_source(src, "x.py", is_test=False)
+    assert [f.line for f in findings if f.rule_id == "BLK001"] == [3]
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = oplint.lint_source("def broken(:\n", "x.py")
+    assert findings and findings[0].rule_id == "E999"
+
+
+def test_data_dir_skip_is_scoped_to_tests(tmp_path):
+    """Only a tests directory's data/ (the fixture corpus) escapes the
+    walk; a source package directory that happens to be named data must
+    still be linted — otherwise the gate is silently bypassable."""
+    bad = "def _run(self):\n    return self.queue.get()\n"
+    src_data = tmp_path / "pkg" / "data"
+    src_data.mkdir(parents=True)
+    (src_data / "loaders.py").write_text(bad)
+    fixture_data = tmp_path / "pkg" / "tests" / "data"
+    fixture_data.mkdir(parents=True)
+    (fixture_data / "corpus.py").write_text(bad)
+    findings = oplint.lint_paths([str(tmp_path)])
+    hit_files = {os.path.basename(f.path) for f in findings}
+    assert hit_files == {"loaders.py"}
+
+
+# ---------------------------------------------------------------------------
+# racecheck: detector self-tests
+# ---------------------------------------------------------------------------
+
+
+def test_racecheck_selftest_catches_seeded_bugs_and_blesses_clean_code():
+    """Seeded lock-order cycle detected; seeded unguarded write detected;
+    consistent ordering and lock-guarded state stay silent. The detector's
+    own acceptance criterion (ISSUE 4)."""
+    assert racecheck.self_test() == []
+
+
+def test_racecheck_tracks_condition_wait_release():
+    """Condition.wait fully releases the underlying lock; the tracker's
+    held-set must follow, or every post-wait acquisition would fabricate
+    lock-order edges out of thin air (false cycles)."""
+    sess = racecheck.Session(targets={}).install()
+    try:
+        lk = threading.Lock()
+        cond = threading.Condition(lk)
+        other = threading.Lock()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=0.2)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(5.0)
+        with other:
+            with lk:  # other -> lk is the ONLY edge this test may create
+                pass
+        assert not sess.tracker.cycles()
+        # the waiter's lock must not linger in any held-set snapshot
+        assert sess.tracker.held_ids() == frozenset()
+    finally:
+        sess.uninstall()
+
+
+def test_racecheck_workqueue_under_contention_is_clean():
+    """The real RateLimitingQueue hammered from multiple threads reports
+    neither lock-order cycles nor unguarded writes — its state is guarded;
+    this is the in-process version of the slow-tier cache/stress replay."""
+    sess = racecheck.Session(
+        targets={
+            "mpi_operator_tpu.machinery.workqueue:RateLimitingQueue": (
+                "_queue", "_dirty", "_processing", "_failures", "_shutdown",
+            ),
+        }
+    ).install()
+    try:
+        from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
+
+        q = RateLimitingQueue()
+
+        def producer():
+            for i in range(50):
+                q.add(f"k{i % 7}")
+
+        def consumer():
+            while True:
+                key = q.get(timeout=0.5)
+                if key is None:
+                    return
+                q.forget(key)
+                q.done(key)
+
+        threads = [threading.Thread(target=producer) for _ in range(3)]
+        threads += [threading.Thread(target=consumer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads[:3]:
+            t.join(5.0)
+        q.shut_down()
+        for t in threads[3:]:
+            t.join(5.0)
+        findings = sess.findings()
+        assert findings == [], "\n".join(f.render() for f in findings)
+    finally:
+        sess.uninstall()
+
+
+def test_racecheck_uninstall_restores_factories():
+    sess = racecheck.Session(targets={}).install()
+    sess.uninstall()
+    assert threading.Lock is racecheck._REAL_LOCK
+    assert threading.RLock is racecheck._REAL_RLOCK
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_lint_flags_findings_and_exits_nonzero(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def _run(self):\n    return self.queue.get()\n")
+    r = _run_cli("lint", str(bad))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "BLK001" in r.stdout
+
+
+def test_cli_lint_clean_exits_zero(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("def _run(self):\n    return self.queue.get(timeout=1)\n")
+    r = _run_cli("lint", str(good))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_racecheck_selftest():
+    r = _run_cli("racecheck", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "selftest: ok" in r.stdout
+
+
+def test_ruff_config_widened_to_bugbear_and_pylint_errors():
+    """The satellite: ruff.toml selects B (bugbear) and PLE on top of the
+    seed's E9+F. Config is asserted always; the actual run only when ruff
+    exists in the environment (the CI image has it; this container may
+    not)."""
+    with open(os.path.join(REPO, "ruff.toml"), encoding="utf-8") as f:
+        cfg = f.read()
+    for code in ('"E9"', '"F"', '"B"', '"PLE"'):
+        assert code in cfg, f"ruff.toml must select {code}"
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this environment")
+    r = subprocess.run(
+        [ruff, "check", "mpi_operator_tpu", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the real suites under the detector
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.racecheck
+def test_cache_and_stress_suites_run_clean_under_racecheck():
+    """ISSUE 4 satellite: racecheck over tests/test_cache.py +
+    tests/test_stress.py finds no lock-order cycles and no unguarded
+    shared writes (the tree was already clean; the seeded self-test above
+    proves the detector is not just silent)."""
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_cache.py", "tests/test_stress.py",
+            "-q", "-m", "not slow",
+            "-p", "mpi_operator_tpu.analysis.pytest_racecheck", "--racecheck",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert "racecheck" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,15 +1,24 @@
 """Correctness tooling for the control plane (≙ the reference's
-golangci-lint gate + `go test -race` CI split):
+golangci-lint gate + `go test -race` CI split, now grown into a model-
+checking layer):
 
 - :mod:`oplint` — AST rules over this repo's own invariants (RMW001,
-  UID001, TERM001, BLK001, EXC001, SEC001), with per-line
-  ``# oplint: disable=RULE`` suppressions;
+  UID001, TERM001, BLK001, EXC001, SEC001, LCK001), with per-line
+  ``# oplint: disable=RULE`` suppressions and a stable
+  ``lint --format json`` finding schema;
 - :mod:`racecheck` — runtime lock-order + unguarded-shared-state detector
   (tracked lock factories + lockset/Eraser attribute monitoring), exposed
-  as the opt-in pytest plugin :mod:`pytest_racecheck`.
+  as the opt-in pytest plugin :mod:`pytest_racecheck`; deliberate
+  patterns are declared in ``.racecheck-allow`` with reasons;
+- :mod:`explore` — deterministic interleaving explorer (CHESS-style
+  bounded preemption over lock + store-op yield points); every failure
+  prints a schedule token and ``--replay`` re-executes it exactly;
+- :mod:`linearize` — store history recorder + sequential-spec model +
+  Porcupine-style linearizability checker, exposed as the opt-in pytest
+  plugin :mod:`pytest_linearize`.
 
-CLI: ``python -m mpi_operator_tpu.analysis lint mpi_operator_tpu tests``
-and ``python -m mpi_operator_tpu.analysis racecheck --selftest``.
+CLI: ``python -m mpi_operator_tpu.analysis
+{lint,rules,racecheck,explore,linearize}``.
 """
 
 from mpi_operator_tpu.analysis.oplint import (
@@ -21,11 +30,14 @@ from mpi_operator_tpu.analysis.oplint import (
     rule_catalog,
 )
 from mpi_operator_tpu.analysis.racecheck import (
+    AllowRule,
     LockOrderFinding,
     LockTracker,
     Session,
     SharedStateFinding,
     SharedStateMonitor,
+    load_allowlist,
+    parse_allowlist,
     self_test,
 )
 
@@ -33,4 +45,5 @@ __all__ = [
     "RULES", "Rule", "Finding", "lint_paths", "lint_source", "rule_catalog",
     "LockTracker", "LockOrderFinding", "SharedStateFinding",
     "SharedStateMonitor", "Session", "self_test",
+    "AllowRule", "load_allowlist", "parse_allowlist",
 ]

@@ -5,10 +5,18 @@
     python -m mpi_operator_tpu.analysis rules
     python -m mpi_operator_tpu.analysis racecheck --selftest
     python -m mpi_operator_tpu.analysis racecheck tests/test_cache.py ...
+    python -m mpi_operator_tpu.analysis explore --list
+    python -m mpi_operator_tpu.analysis explore dict-rmw --budget 200
+    python -m mpi_operator_tpu.analysis explore --replay 'v1:dict-rmw:2=1'
+    python -m mpi_operator_tpu.analysis linearize --selftest
+    python -m mpi_operator_tpu.analysis linearize history.json ...
 
 ``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
 rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
-``--selftest`` delegates to pytest with the plugin armed.
+``--selftest`` delegates to pytest with the plugin armed. ``explore``
+runs the deterministic interleaving explorer over a scenario (exit 1 on
+a violating schedule, printing its replay token); ``linearize`` checks
+recorded store histories against the sequential spec.
 """
 
 from __future__ import annotations
@@ -24,7 +32,7 @@ from mpi_operator_tpu.analysis import oplint
 def _cmd_lint(args) -> int:
     findings = oplint.lint_paths(args.paths)
     if args.format == "json":
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:
         for f in findings:
             print(f.render())
@@ -67,6 +75,73 @@ def _cmd_racecheck(args) -> int:
     )
 
 
+def _cmd_explore(args) -> int:
+    from mpi_operator_tpu.analysis import explore
+
+    if args.list:
+        for name in sorted(explore.SCENARIOS):
+            s = explore.SCENARIOS[name]
+            head = (s.doc or "").strip().splitlines()
+            tag = " [seeded-bug]" if s.seeded_bug else ""
+            print(f"{name}{tag}")
+            if head:
+                print(f"  {head[0].strip()}")
+        return 0
+    if args.replay:
+        result = explore.replay(args.replay)
+        print(result.message)
+        return 0 if result.ok else 1
+    names = args.scenario or sorted(explore.SCENARIOS)
+    budget = explore.ExploreBudget(
+        max_runs=args.budget, max_preemptions=args.preemptions
+    )
+    rc = 0
+    for name in names:
+        report = explore.explore(
+            name, budget, mode=args.mode, seed=args.seed
+        )
+        print(report.render())
+        seeded = explore.SCENARIOS[name].seeded_bug
+        if not report.ok and seeded:
+            print(f"  (expected: {name} is a seeded-bug scenario)")
+        elif not report.ok:
+            rc = 1
+        elif seeded:
+            # a seeded bug the explorer can no longer find is a DETECTOR
+            # regression, the exact inversion of this scenario's contract
+            print(
+                f"  REGRESSION: seeded-bug scenario {name} found no "
+                f"violation within budget",
+            )
+            rc = 1
+    return rc
+
+
+def _cmd_linearize(args) -> int:
+    from mpi_operator_tpu.analysis import linearize
+
+    if args.selftest:
+        failures = linearize.self_test()
+        for f in failures:
+            print(f"linearize selftest FAILED: {f}", file=sys.stderr)
+        if not failures:
+            print("linearize selftest: ok")
+        return 1 if failures else 0
+    if not args.histories:
+        print("linearize: pass --selftest or history JSON file(s)",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in args.histories:
+        with open(path, encoding="utf-8") as f:
+            history = linearize.History.from_json(f.read())
+        report = linearize.check(history)
+        print(f"{path}: {report.render()}")
+        if not report.ok:
+            rc = 1
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mpi_operator_tpu.analysis", description=__doc__
@@ -89,6 +164,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     # pytest.main instead of being rejected as unrecognized arguments
     p.add_argument("pytest_args", nargs=argparse.REMAINDER)
     p.set_defaults(fn=_cmd_racecheck)
+    p = sub.add_parser(
+        "explore",
+        help="deterministic interleaving exploration of a scenario "
+             "(exit 1 on a violating schedule; its token replays it)",
+    )
+    p.add_argument("scenario", nargs="*",
+                   help="scenario name(s); default: all")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    p.add_argument("--replay", metavar="TOKEN",
+                   help="re-execute the exact interleaving a token encodes")
+    p.add_argument("--budget", type=int, default=80,
+                   help="max schedule re-executions (default 80)")
+    p.add_argument("--preemptions", type=int, default=2,
+                   help="CHESS context bound: forced preemptions per "
+                        "schedule (default 2)")
+    p.add_argument("--mode", choices=["systematic", "random"],
+                   default="systematic")
+    p.add_argument("--seed", type=int, default=0,
+                   help="rng seed for --mode random")
+    p.set_defaults(fn=_cmd_explore)
+    p = sub.add_parser(
+        "linearize",
+        help="check recorded store histories against the sequential spec "
+             "(--selftest, or history JSON files)",
+    )
+    p.add_argument("--selftest", action="store_true")
+    p.add_argument("histories", nargs="*")
+    p.set_defaults(fn=_cmd_linearize)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,955 @@
+"""opcheck explorer: deterministic thread-interleaving exploration.
+
+racecheck (PR 4) observes ONE schedule per run — whatever the OS happened
+to produce — so a latent atomicity violation stays latent until a chaos
+replay trips it. This module takes the opposite stance, after CHESS
+(Musuvathi et al., iterative context bounding): a **cooperative scheduler**
+takes over every scheduling-relevant operation and runs exactly one thread
+at a time, so the interleaving IS data — enumerable, boundable, and
+replayable from a printed token.
+
+How control is seized:
+
+- the ``threading.Lock``/``RLock``/``Condition`` factories are patched (the
+  same seam racecheck uses) into **bookkeeping primitives**: because only
+  one managed thread ever runs, mutual exclusion needs no OS lock — an
+  acquire is a *scheduling request* (the thread becomes runnable only when
+  the lock is free), a blocked ``Condition.wait`` parks the thread until a
+  notify. ``queue.Queue`` built inside the window inherits these and turns
+  cooperative for free.
+- store/workqueue/cache ops announce themselves through
+  ``machinery.yieldpoints`` (get/put/patch/list/watch-deliver...), adding
+  the context-switch points where lost updates actually live — between a
+  read and the write built on it, where no lock operation happens.
+
+Exploration is stateless (re-execute per schedule) with **bounded
+preemption**: the default policy runs each thread until it blocks; a
+*deviation* ``{step: thread}`` forces a preemption at one choice point.
+Systematic mode enumerates deviation sets of size ≤ the preemption bound
+(CHESS's insight: most concurrency bugs need ≤ 2 preemptions); random mode
+samples seeded deviation sets. Every failing run prints a compact
+**schedule token** (``v1:<scenario>:<step>=<thread>,...``) and
+``--replay <token>`` re-executes that exact interleaving — concurrency
+bugs become reproducible-by-token instead of flaky.
+
+Failures the explorer reports: an invariant check raising, a thread dying
+on an exception, and **deadlock** (no thread runnable — a lost wakeup or a
+lock cycle actually interleaved into, not just potential like racecheck's
+edges).
+
+Scenario constraints (enforced by construction, documented here): scenario
+threads are spawned by the scheduler (not ``threading.Thread``), must not
+sleep on wall-clock time, and must not start background OS threads —
+an unmanaged thread blocking on a managed primitive raises ExploreError.
+"""
+
+from __future__ import annotations
+
+import _thread
+import random as _random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery import yieldpoints
+
+# thread states
+_RUNNABLE = "runnable"
+_DONE = "done"
+
+TOKEN_VERSION = "v1"
+
+
+class ExploreError(RuntimeError):
+    """The exploration machinery itself failed (bad token, unmanaged thread
+    blocked on a managed primitive, step budget exhausted) — distinct from
+    a scenario FAILURE, which is a finding."""
+
+
+class _Aborted(BaseException):
+    """Raised inside parked scenario threads when a run is being torn down
+    (deadlock finding / step-budget abort): BaseException so scenario
+    ``except Exception`` blocks cannot swallow the unwind."""
+
+
+@dataclass
+class ExploreBudget:
+    """Exploration bounds. ``max_preemptions`` is the CHESS context bound
+    (deviations per schedule); ``max_runs`` caps total re-executions;
+    ``max_steps`` guards a single run against wall-clock spin (a timed
+    wait polled in a loop)."""
+
+    max_runs: int = 80
+    max_preemptions: int = 2
+    max_steps: int = 20000
+
+
+FAST_BUDGET = ExploreBudget(max_runs=80, max_preemptions=2)
+# the slow-tier budget: enough runs to exhaust every ≤2-preemption schedule
+# of the shipped scenarios and a deeper bound on top
+EXHAUSTIVE_BUDGET = ExploreBudget(max_runs=4000, max_preemptions=3)
+
+
+class _Gate:
+    """Binary handoff on a raw ``_thread`` lock (deliberately below the
+    patched ``threading`` factories): starts closed; ``wait()`` blocks
+    until another thread ``open()``s it, consuming the open."""
+
+    __slots__ = ("_lk",)
+
+    def __init__(self):
+        self._lk = _thread.allocate_lock()
+        self._lk.acquire()
+
+    def wait(self) -> None:
+        self._lk.acquire()
+
+    def open(self) -> None:
+        self._lk.release()
+
+
+@dataclass
+class _MThread:
+    index: int
+    name: str
+    fn: Callable[[], None]
+    gate: _Gate = field(default_factory=_Gate)
+    ident: Optional[int] = None
+    state: str = _RUNNABLE
+    # scheduling constraints, set while parked at a yield point
+    wait_lock: Optional["ManagedLock"] = None
+    wait_cond: Optional["ManagedCondition"] = None
+    timed: bool = False
+    notified: bool = False
+    last_label: str = "start"
+    exc: Optional[BaseException] = None
+
+
+class ManagedLock:
+    """Lock under the cooperative scheduler: pure bookkeeping (owner +
+    recursion count). Acquire from a managed thread is a scheduling
+    request; from an unmanaged thread it succeeds only when free (an
+    unmanaged thread can never cooperatively block). Named from a
+    PER-SCHEDULER counter so a replayed run labels its locks identically
+    to the original — trace/failure equality across replays is part of
+    the determinism contract."""
+
+    def __init__(self, sched: "_Scheduler", reentrant: bool):
+        self._sched = sched
+        self._reentrant = reentrant
+        self.owner: Optional[int] = None  # _MThread.index
+        self.count = 0
+        sched._lock_seq += 1
+        self.name = f"{'RLock' if reentrant else 'Lock'}#{sched._lock_seq}"
+
+    # -- lock protocol ------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._sched.lock_acquire(self, blocking, timeout)
+
+    def release(self) -> None:
+        self._sched.lock_release(self)
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- Condition protocol (threading.Condition fallback relies on
+    # acquire/release when these are missing, but ManagedCondition calls
+    # them directly) --------------------------------------------------------
+
+    def _is_owned_by(self, mt: Optional[_MThread]) -> bool:
+        return mt is not None and self.owner == mt.index
+
+
+class ManagedCondition:
+    """Condition variable under the cooperative scheduler. ``wait`` parks
+    the thread (runnable again on notify, or — for timed waits — at the
+    scheduler's discretion, modelling 'the timeout may fire at any
+    moment')."""
+
+    def __init__(self, sched: "_Scheduler", lock: ManagedLock):
+        self._sched = sched
+        self._lock = lock
+        self._waiters: List[_MThread] = []
+
+    def __enter__(self):
+        return self._lock.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    def acquire(self, *a, **k):
+        return self._lock.acquire(*a, **k)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._sched.cond_wait(self, timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        result = predicate()
+        while not result:
+            if not self.wait(timeout):
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._sched.cond_notify(self, n)
+
+    def notify_all(self) -> None:
+        self._sched.cond_notify(self, 1 << 30)
+
+    notifyAll = notify_all
+
+
+@dataclass
+class RunResult:
+    ok: bool
+    message: str
+    # the executed schedule: (step, runnable thread indices, chosen index,
+    # chosen thread's parked label)
+    trace: List[Tuple[int, Tuple[int, ...], int, str]]
+    deviations: Dict[int, int]
+
+
+class _Scheduler:
+    """One cooperative execution of a scenario under forced deviations."""
+
+    def __init__(
+        self,
+        deviations: Dict[int, int],
+        rng: Optional[_random.Random] = None,
+        deviate_prob: float = 0.0,
+        max_steps: int = 20000,
+    ):
+        self._mu = _thread.allocate_lock()
+        self._sched_gate = _Gate()
+        self._threads: List[_MThread] = []
+        self._by_ident: Dict[int, _MThread] = {}
+        self._forced = dict(deviations)
+        self._rng = rng
+        self._deviate_prob = deviate_prob
+        self._max_steps = max_steps
+        self._sched_ident = _thread.get_ident()
+        self.trace: List[Tuple[int, Tuple[int, ...], int, str]] = []
+        self.effective_deviations: Dict[int, int] = {}
+        self._installed: Optional[Tuple[Any, Any, Any]] = None
+        self._prev_hook: Any = None
+        self._abort = False
+        self._closed = False
+        self._lock_seq = 0  # per-run lock naming: replays label identically
+
+    # -- factory patching ---------------------------------------------------
+
+    def install(self) -> None:
+        self._installed = (
+            threading.Lock, threading.RLock, threading.Condition,
+        )
+        real_lock, real_rlock, real_cond = self._installed
+        sched = self
+
+        def lock_factory():
+            if sched._is_scheduling_thread():
+                return ManagedLock(sched, reentrant=False)
+            return real_lock()
+
+        def rlock_factory():
+            if sched._is_scheduling_thread():
+                return ManagedLock(sched, reentrant=True)
+            return real_rlock()
+
+        def cond_factory(lock=None):
+            if isinstance(lock, ManagedLock):
+                return ManagedCondition(sched, lock)
+            if lock is None and sched._is_scheduling_thread():
+                return ManagedCondition(
+                    sched, ManagedLock(sched, reentrant=True)
+                )
+            return real_cond(lock)
+
+        threading.Lock = lock_factory  # type: ignore[assignment]
+        threading.RLock = rlock_factory  # type: ignore[assignment]
+        threading.Condition = cond_factory  # type: ignore[assignment]
+        self._prev_hook = yieldpoints.set_hook(self._on_yield_point)
+
+    def uninstall(self) -> None:
+        if self._installed is None:
+            return
+        threading.Lock, threading.RLock, threading.Condition = (  # type: ignore[assignment]
+            self._installed
+        )
+        self._installed = None
+        yieldpoints.set_hook(self._prev_hook)
+        # OS thread idents are recycled: a LATER unrelated thread reusing a
+        # dead scenario thread's ident must never be mistaken for managed
+        self._closed = True
+        self._by_ident.clear()
+
+    def _is_scheduling_thread(self) -> bool:
+        ident = _thread.get_ident()
+        return ident == self._sched_ident or ident in self._by_ident
+
+    def _current(self) -> Optional[_MThread]:
+        return self._by_ident.get(_thread.get_ident())
+
+    # -- spawning -----------------------------------------------------------
+
+    def spawn(self, fn: Callable[[], None], name: str) -> _MThread:
+        mt = _MThread(index=len(self._threads), name=name, fn=fn)
+        self._threads.append(mt)
+        _thread.start_new_thread(self._thread_main, (mt,))
+        return mt
+
+    def _thread_main(self, mt: _MThread) -> None:
+        with self._mu:
+            mt.ident = _thread.get_ident()
+            self._by_ident[mt.ident] = mt
+        mt.gate.wait()  # first grant
+        try:
+            if not self._abort:
+                mt.fn()
+        # oplint: disable=EXC001 — the catch IS the reporting channel: a
+        # dying scenario thread becomes a FINDING (run_scenario renders
+        # mt.exc), and _Aborted teardown unwinds must also land here
+        except BaseException as e:
+            mt.exc = e
+        mt.state = _DONE
+        self._sched_gate.open()
+
+    # -- yield protocol (called from managed threads) -----------------------
+
+    def _park(self, mt: _MThread, label: str) -> None:
+        if self._abort:
+            raise _Aborted()
+        mt.last_label = label
+        self._sched_gate.open()
+        mt.gate.wait()
+        if self._abort:
+            raise _Aborted()
+
+    def _on_yield_point(self, op: str, detail: str) -> None:
+        mt = self._current()
+        if mt is None:
+            return  # scheduler/unmanaged thread: not schedulable
+        self._park(mt, f"{op}({detail})" if detail else op)
+
+    def lock_acquire(self, lock: ManagedLock, blocking: bool, timeout: float) -> bool:
+        mt = self._current()
+        if mt is None:
+            # scheduler (setup/check) or foreign thread: take only if free
+            with self._mu:
+                if lock.owner is None or (
+                    lock._reentrant and lock.owner == -1
+                ):
+                    lock.owner = -1  # the scheduler pseudo-index
+                    lock.count += 1
+                    return True
+            if not blocking or timeout == 0:
+                return False
+            raise ExploreError(
+                f"unmanaged thread would block on managed {lock.name} "
+                f"(scenario code must not share managed locks with "
+                f"background OS threads)"
+            )
+        if lock._reentrant and lock.owner == mt.index:
+            lock.count += 1
+            return True
+        if self._abort:
+            # teardown unwind: mutual exclusion is moot (one thread runs);
+            # force-take so finally blocks can complete
+            lock.owner = mt.index
+            lock.count += 1
+            return True
+        timed = (not blocking) or timeout >= 0
+        mt.wait_lock = lock
+        mt.timed = timed
+        self._park(mt, f"acquire:{lock.name}")
+        mt.wait_lock = None
+        if lock.owner is None:
+            lock.owner = mt.index
+            lock.count += 1
+            return True
+        return False  # timed/non-blocking attempt lost
+
+    def lock_release(self, lock: ManagedLock) -> None:
+        mt = self._current()
+        holder = -1 if mt is None else mt.index
+        if lock.owner != holder:
+            if self._abort or self._closed:
+                lock.owner, lock.count = None, 0  # best-effort teardown
+                return
+            raise RuntimeError(
+                f"release of {lock.name} by non-owner "
+                f"(owner={lock.owner}, releaser={holder})"
+            )
+        lock.count -= 1
+        if lock.count == 0:
+            lock.owner = None
+
+    def cond_wait(self, cond: ManagedCondition, timeout: Optional[float]) -> bool:
+        mt = self._current()
+        lock = cond._lock
+        if mt is not None and self._abort:
+            return False  # teardown: report a spurious timeout and unwind
+        if mt is None:
+            # scheduler thread polling a managed condition: model the
+            # timeout as already expired; an untimed wait can never be
+            # satisfied (no managed thread will run again)
+            if timeout is not None:
+                return False
+            raise ExploreError(
+                "scheduler thread blocked on untimed managed Condition.wait"
+            )
+        if not lock._is_owned_by(mt):
+            raise RuntimeError("cannot wait on un-acquired condition")
+        saved = lock.count
+        lock.count = 0
+        lock.owner = None
+        mt.wait_cond = cond
+        mt.timed = timeout is not None
+        mt.notified = False
+        cond._waiters.append(mt)
+        self._park(mt, "cond.wait" if timeout is None else "cond.wait(timed)")
+        mt.wait_cond = None
+        if mt in cond._waiters:
+            cond._waiters.remove(mt)
+        notified = mt.notified
+        # re-acquire the lock cooperatively before returning
+        while lock.owner not in (None, mt.index):
+            mt.wait_lock = lock
+            mt.timed = False
+            self._park(mt, "cond.reacquire")
+            mt.wait_lock = None
+        lock.owner = mt.index
+        lock.count = saved
+        return notified
+
+    def cond_notify(self, cond: ManagedCondition, n: int) -> None:
+        with self._mu:
+            hit = 0
+            for waiter in cond._waiters:
+                if not waiter.notified:
+                    waiter.notified = True
+                    hit += 1
+                    if hit >= n:
+                        break
+
+    # -- the schedule loop (runs in the creating thread) --------------------
+
+    def _is_runnable(self, t: _MThread) -> bool:
+        if t.state == _DONE:
+            return False
+        if t.wait_lock is not None:
+            # owner == t.index means a non-reentrant self-acquire: a REAL
+            # deadlock, never runnable (reentrant re-acquire returns before
+            # parking and cannot reach here)
+            return t.timed or t.wait_lock.owner is None
+        if t.wait_cond is not None:
+            return t.notified or t.timed
+        return True
+
+    def run_all(self) -> None:
+        """Schedule until every managed thread is done. Raises _Failure on
+        deadlock; scenario exceptions are collected on the thread."""
+        step = 0
+        last: Optional[_MThread] = None
+        while True:
+            alive = [t for t in self._threads if t.state != _DONE]
+            if not alive:
+                return
+            runnable = [t for t in alive if self._is_runnable(t)]
+            if not runnable:
+                waits = "; ".join(
+                    f"t{t.index}({t.name}) at {t.last_label}" for t in alive
+                )
+                self._drain_abort()
+                raise _Failure(f"DEADLOCK: no thread runnable — {waits}")
+            if step >= self._max_steps:
+                self._drain_abort()
+                raise ExploreError(
+                    f"step budget {self._max_steps} exhausted (a timed wait "
+                    f"spinning on wall-clock time? bound scenario loops)"
+                )
+            default = last if last in runnable else runnable[0]
+            chosen = default
+            if step in self._forced:
+                want = self._forced[step]
+                by_index = {t.index: t for t in runnable}
+                if want not in by_index:
+                    # drain BEFORE raising, like the deadlock/step-budget
+                    # paths: the parked scenario threads would otherwise
+                    # leak blocked on their gates forever
+                    self._drain_abort()
+                    raise ExploreError(
+                        f"schedule token does not apply: step {step} wants "
+                        f"t{want}, runnable = "
+                        f"{sorted(by_index)} (code or scenario changed?)"
+                    )
+                chosen = by_index[want]
+            elif (
+                self._rng is not None
+                and len(runnable) > 1
+                and self._rng.random() < self._deviate_prob
+            ):
+                chosen = runnable[self._rng.randrange(len(runnable))]
+            self.trace.append(
+                (
+                    step,
+                    tuple(t.index for t in runnable),
+                    chosen.index,
+                    chosen.last_label,
+                )
+            )
+            if chosen is not default:
+                self.effective_deviations[step] = chosen.index
+            last = chosen
+            chosen.gate.open()
+            self._sched_gate.wait()
+            step += 1
+
+    def _drain_abort(self) -> None:
+        """Tear down parked threads after a deadlock/step-budget stop:
+        every grant now raises _Aborted at the thread's park point, so the
+        OS threads actually exit instead of leaking blocked forever."""
+        self._abort = True
+        while True:
+            alive = [t for t in self._threads if t.state != _DONE]
+            if not alive:
+                return
+            alive[0].gate.open()
+            self._sched_gate.wait()
+
+
+class _Failure(Exception):
+    """Internal: a scenario finding (invariant violation / deadlock)."""
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A small concurrent unit: ``build()`` returns (thread bodies, check).
+    ``build`` runs UNDER the cooperative window, so locks/stores it
+    constructs are managed; ``check`` runs on the scheduler thread after
+    every body finished and raises AssertionError on violation."""
+
+    name: str
+    doc: str
+    build: Callable[[], Tuple[List[Callable[[], None]], Callable[[], None]]]
+    # True when the scenario is EXPECTED to have a reachable violation
+    # (seeded-bug scenarios used to prove the explorer finds real bugs)
+    seeded_bug: bool = False
+
+
+class PlainKV:
+    """The smallest possible store: a dict with labeled yield points on
+    get/put — the two-writer get+update atomicity scenario rides this
+    (ISSUE 5 acceptance)."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self._d = dict(data or {})
+
+    def get(self, key: str) -> Any:
+        yieldpoints.yield_point("kv.get", key)
+        return self._d.get(key)
+
+    def put(self, key: str, value: Any) -> None:
+        yieldpoints.yield_point("kv.put", key)
+        self._d[key] = value
+
+
+def _scn_dict_rmw():
+    """Two writers get+update a plain dict-backed counter with no guard:
+    the classic atomicity violation. EXPECTED to fail under exploration —
+    the seeded bug that proves the explorer finds real interleavings."""
+    kv = PlainKV({"x": 0})
+
+    def writer():
+        v = kv.get("x")
+        kv.put("x", v + 1)
+
+    def check():
+        got = kv._d["x"]
+        assert got == 2, f"lost update: x == {got}, expected 2"
+
+    return [writer, writer], check
+
+
+def _scn_store_rmw_force():
+    """Two writers do the RMW001 anti-pattern against a real ObjectStore —
+    get, mutate, ``update(force=True)``: the force skips the rv check, so
+    an adversarial schedule silently drops one increment. EXPECTED to
+    fail; the runtime twin of oplint's RMW001/TERM001."""
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    store = ObjectStore()
+    store.create(Pod(metadata=ObjectMeta(name="p", labels={"n": "0"})))
+
+    def writer():
+        cur = store.get("Pod", "default", "p")
+        cur.metadata.labels["n"] = str(int(cur.metadata.labels["n"]) + 1)
+        # oplint: disable=RMW001,TERM001 — deliberately the anti-pattern
+        # both rules exist for: this scenario PROVES the force-PUT loses
+        # updates by having the explorer find the schedule that drops one
+        store.update(cur, force=True)
+
+    def check():
+        got = store.get("Pod", "default", "p").metadata.labels["n"]
+        assert got == "2", f"lost update: n == {got!r}, expected '2'"
+
+    return [writer, writer], check
+
+
+def _scn_store_optimistic():
+    """The blessed form of the same write: ``optimistic_update`` re-reads
+    on Conflict. Must survive EVERY schedule in budget — the proof the
+    sanctioned idiom is actually sound, not just lint-blessed."""
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore, optimistic_update
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    store = ObjectStore()
+    store.create(Pod(metadata=ObjectMeta(name="p", labels={"n": "0"})))
+
+    def writer():
+        def bump(cur):
+            cur.metadata.labels["n"] = str(int(cur.metadata.labels["n"]) + 1)
+            return True
+
+        optimistic_update(store, "Pod", "default", "p", bump)
+
+    def check():
+        got = store.get("Pod", "default", "p").metadata.labels["n"]
+        assert got == "2", f"optimistic_update lost a write: n == {got!r}"
+
+    return [writer, writer], check
+
+
+def _scn_store_patch():
+    """Two writers merge-patch DISJOINT status fields concurrently; the
+    server-side patch is atomic under the store lock, so both fields must
+    survive every schedule (the PR 2 write-path contract)."""
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    store = ObjectStore()
+    store.create(Pod(metadata=ObjectMeta(name="p")))
+
+    def patch_reason():
+        # oplint: disable=UID001 — single-incarnation scenario: no
+        # recreation can happen between build and check, and the point is
+        # the MERGE atomicity of two unpinned writers
+        store.patch("Pod", "default", "p",
+                    {"status": {"reason": "Evicted"}}, subresource="status")
+
+    def patch_message():
+        # oplint: disable=UID001 — same single-incarnation scenario
+        store.patch("Pod", "default", "p",
+                    {"status": {"message": "drained"}}, subresource="status")
+
+    def check():
+        got = store.get("Pod", "default", "p")
+        assert got.status.reason == "Evicted" and got.status.message == "drained", (
+            f"concurrent patches clobbered each other: "
+            f"reason={got.status.reason!r} message={got.status.message!r}"
+        )
+
+    return [patch_reason, patch_message], check
+
+
+def _scn_workqueue():
+    """Producers racing a consumer through RateLimitingQueue: every
+    distinct key must come out (dedup may collapse, never lose), and the
+    consumer's untimed get() must never deadlock — a lost cond wakeup
+    shows up here as a DEADLOCK finding."""
+    from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
+
+    q = RateLimitingQueue()
+    all_keys = {"k0", "k1", "k2", "k3"}
+    seen: set = set()
+
+    def producer_a():
+        for k in ("k0", "k1", "k2"):
+            q.add(k)
+
+    def producer_b():
+        for k in ("k1", "k2", "k3"):
+            q.add(k)
+
+    def consumer():
+        while True:
+            # oplint: disable=BLK001 — under the cooperative scheduler an
+            # unbounded get is exactly right: a lost wakeup surfaces as a
+            # DEADLOCK finding instead of hanging (and shut_down unblocks
+            # the normal path); a timed get would wall-clock-spin instead
+            key = q.get()
+            if key is None:
+                return
+            seen.add(key)
+            q.done(key)
+            if seen >= all_keys:
+                q.shut_down()
+                return
+
+    def check():
+        assert seen >= all_keys, f"workqueue lost keys: got only {sorted(seen)}"
+
+    return [producer_a, producer_b, consumer], check
+
+
+def _scn_cache_rv_guard():
+    """A Lister fed MODIFIED events out of order by two pump threads while
+    a reader lists: the rv guard must make the newest version win under
+    every interleaving (the informer staleness contract)."""
+    from mpi_operator_tpu.machinery.cache import Lister
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import MODIFIED
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    lister = Lister("Pod", index_labels=())
+
+    def _pod(rv: int) -> Any:
+        p = Pod(metadata=ObjectMeta(name="p", labels={"v": str(rv)}))
+        p.metadata.resource_version = rv
+        return p
+
+    def pump_new():
+        lister.apply(MODIFIED, _pod(2))
+        lister.apply(MODIFIED, _pod(3))
+
+    def pump_stale():
+        lister.apply(MODIFIED, _pod(1))
+
+    def reader():
+        lister.list()
+
+    def check():
+        got = lister.get("default", "p")
+        assert got.metadata.resource_version == 3, (
+            f"stale event regressed the cache to rv "
+            f"{got.metadata.resource_version}"
+        )
+
+    return [pump_new, pump_stale, reader], check
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario("dict-rmw", _scn_dict_rmw.__doc__ or "", _scn_dict_rmw,
+                 seeded_bug=True),
+        Scenario("store-rmw-force", _scn_store_rmw_force.__doc__ or "",
+                 _scn_store_rmw_force, seeded_bug=True),
+        Scenario("store-optimistic", _scn_store_optimistic.__doc__ or "",
+                 _scn_store_optimistic),
+        Scenario("store-patch", _scn_store_patch.__doc__ or "",
+                 _scn_store_patch),
+        Scenario("workqueue", _scn_workqueue.__doc__ or "", _scn_workqueue),
+        Scenario("cache-rv-guard", _scn_cache_rv_guard.__doc__ or "",
+                 _scn_cache_rv_guard),
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# running + exploring
+# ---------------------------------------------------------------------------
+
+
+def encode_token(scenario: str, deviations: Dict[int, int]) -> str:
+    body = ",".join(f"{s}={t}" for s, t in sorted(deviations.items())) or "-"
+    return f"{TOKEN_VERSION}:{scenario}:{body}"
+
+
+def decode_token(token: str) -> Tuple[str, Dict[int, int]]:
+    try:
+        version, scenario, body = token.split(":", 2)
+        if version != TOKEN_VERSION:
+            raise ValueError(f"unknown token version {version!r}")
+        if scenario not in SCENARIOS:
+            raise ValueError(f"unknown scenario {scenario!r}")
+        dev: Dict[int, int] = {}
+        if body != "-":
+            for part in body.split(","):
+                s, t = part.split("=")
+                dev[int(s)] = int(t)
+        return scenario, dev
+    except ValueError as e:
+        raise ExploreError(f"bad schedule token {token!r}: {e}") from None
+
+
+def run_scenario(
+    name: str,
+    deviations: Optional[Dict[int, int]] = None,
+    *,
+    rng: Optional[_random.Random] = None,
+    deviate_prob: float = 0.0,
+    max_steps: int = 20000,
+) -> RunResult:
+    """One cooperative execution. Deterministic given (scenario code,
+    deviations, rng state): the trace, the failure — everything."""
+    scenario = SCENARIOS[name]
+    sched = _Scheduler(deviations or {}, rng, deviate_prob, max_steps)
+    sched.install()
+    try:
+        bodies, check = scenario.build()
+        for i, fn in enumerate(bodies):
+            sched.spawn(fn, getattr(fn, "__name__", f"t{i}"))
+        failure: Optional[str] = None
+        try:
+            sched.run_all()
+            unreached = [s for s in sched._forced if s >= len(sched.trace)]
+            if unreached:
+                raise ExploreError(
+                    f"schedule token does not apply: step(s) "
+                    f"{sorted(unreached)} never reached (the run ended at "
+                    f"step {len(sched.trace)}; code or scenario changed?)"
+                )
+        except _Failure as f:
+            failure = str(f)
+        if failure is None:
+            for t in sched._threads:
+                if t.exc is not None and not isinstance(t.exc, _Aborted):
+                    failure = (
+                        f"t{t.index}({t.name}) died: "
+                        f"{type(t.exc).__name__}: {t.exc}"
+                    )
+                    break
+        if failure is None:
+            try:
+                check()
+            except AssertionError as e:
+                failure = f"invariant violated: {e}"
+        dev = dict(sched.effective_deviations)
+        if failure is not None:
+            token = encode_token(name, dev)
+            return RunResult(False, f"{failure}\n  schedule token: {token}",
+                             sched.trace, dev)
+        return RunResult(True, "ok", sched.trace, dev)
+    finally:
+        sched.uninstall()
+
+
+@dataclass
+class ExploreReport:
+    scenario: str
+    ok: bool
+    runs: int
+    schedules_seen: int
+    failure: Optional[RunResult] = None
+
+    def render(self) -> str:
+        if self.ok:
+            return (
+                f"explore {self.scenario}: ok — {self.runs} run(s), "
+                f"{self.schedules_seen} distinct schedule(s), no violation"
+            )
+        return (
+            f"explore {self.scenario}: FAILED after {self.runs} run(s)\n"
+            f"  {self.failure.message}"
+        )
+
+
+def explore(
+    name: str,
+    budget: ExploreBudget = FAST_BUDGET,
+    *,
+    mode: str = "systematic",
+    seed: int = 0,
+) -> ExploreReport:
+    """Explore a scenario's schedules within budget. ``systematic``
+    enumerates deviation sets up to the preemption bound (DFS over
+    observed choice points, CHESS-style); ``random`` samples seeded
+    deviations per run. Returns on the FIRST failing schedule — its token
+    replays the exact interleaving."""
+    if name not in SCENARIOS:
+        raise ExploreError(
+            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
+        )
+    runs = 0
+    if mode == "random":
+        rng = _random.Random(seed)
+        while runs < budget.max_runs:
+            result = run_scenario(
+                name, rng=rng, deviate_prob=0.35, max_steps=budget.max_steps
+            )
+            runs += 1
+            if not result.ok:
+                # re-encode as a forced run so the token is authoritative
+                return ExploreReport(name, False, runs, runs, result)
+        return ExploreReport(name, True, runs, runs)
+    if mode != "systematic":
+        raise ExploreError(f"unknown mode {mode!r} (systematic|random)")
+
+    tried: set = set()
+    # DFS frontier of deviation maps; {} = the unperturbed default schedule
+    frontier: List[Dict[int, int]] = [{}]
+    while frontier and runs < budget.max_runs:
+        dev = frontier.pop()
+        key = tuple(sorted(dev.items()))
+        if key in tried:
+            continue
+        tried.add(key)
+        result = run_scenario(name, dev, max_steps=budget.max_steps)
+        runs += 1
+        if not result.ok:
+            return ExploreReport(name, False, runs, len(tried), result)
+        if len(dev) >= budget.max_preemptions:
+            continue
+        start = (max(dev) + 1) if dev else 0
+        # append deepest-first so pop() explores the EARLIEST new choice
+        # point next — low preemption points find RMW windows fastest
+        for step, runnable, chosen, _label in reversed(result.trace):
+            if step < start:
+                break
+            for alt in runnable:
+                if alt != chosen:
+                    frontier.append({**dev, step: alt})
+    return ExploreReport(name, True, runs, len(tried))
+
+
+def replay(token: str, *, max_steps: int = 20000) -> RunResult:
+    """Re-execute the exact interleaving a token encodes."""
+    name, dev = decode_token(token)
+    return run_scenario(name, dev, max_steps=max_steps)
+
+
+def self_test() -> List[str]:
+    """The explorer's own acceptance gate (ISSUE 5): the seeded two-writer
+    atomicity violation is found deterministically, its token replays to
+    the IDENTICAL failure twice, and a clean scenario stays clean. Returns
+    failure strings (empty = pass)."""
+    failures: List[str] = []
+    report = explore("dict-rmw", ExploreBudget(max_runs=40, max_preemptions=1))
+    if report.ok:
+        failures.append("seeded dict-rmw atomicity violation was NOT found")
+        return failures
+    token = encode_token("dict-rmw", report.failure.deviations)
+    first = replay(token)
+    second = replay(token)
+    if first.ok or second.ok:
+        failures.append(f"token {token} did not replay to a failure")
+    elif first.message != second.message or first.trace != second.trace:
+        failures.append(f"token {token} replays diverged (nondeterminism)")
+    clean = explore(
+        "store-patch", ExploreBudget(max_runs=40, max_preemptions=1)
+    )
+    if not clean.ok:
+        failures.append(
+            "store-patch should survive every schedule: " + clean.failure.message
+        )
+    return failures

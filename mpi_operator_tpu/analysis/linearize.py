@@ -1,0 +1,740 @@
+"""opcheck linearizability: record store histories, check them against the
+sequential spec.
+
+The store contract PRs 1-4 grew — rv-preconditioned optimistic concurrency,
+uid-pinned incarnation writes, the frozen status subresource, write-once
+terminal phases, watch events in commit order — is a SEQUENTIAL
+specification. Whether the three backends actually provide it to
+*concurrent* callers is a linearizability question (Herlihy & Wing): does
+every recorded call/return history admit a total order of the operations,
+consistent with real time, under which each result matches the sequential
+model?
+
+Three pieces, after Jepsen/Porcupine:
+
+- **Recorder** (:class:`Recorder`): wraps the five store verbs
+  (get/update/patch/create/delete) at the CLASS level on all three
+  backends plus watch delivery (the consumer side of ``watch()`` queues),
+  stamping each op with a global call/return sequence. Installed for a
+  whole pytest session by :mod:`pytest_linearize`, so REAL suites
+  (test_patch, test_stress) produce checkable histories.
+- **Sequential model** (:class:`StoreModel`): per-key state (exists, rv,
+  uid, phase) and the legality of each op's recorded result against it —
+  Conflict iff the rv precondition misses, uid pins, AlreadyExists math,
+  and Pod status-subresource terminal write-once.
+- **Checker** (:func:`check`): Wing & Gong search for a valid
+  linearization, partitioned per object key (sound: the store serializes
+  per key and the global rv order is checked separately), with
+  memoization on the linearized-set (state is a function of the set —
+  every successful write records its resulting rv, so "latest applied
+  write" determines the state). Watch streams are checked per
+  (stream, key) for rv monotonicity — delivery must follow linearization
+  order. On violation the error carries the **minimal violating prefix**
+  (shortest return-ordered prefix that is itself non-linearizable), which
+  is what makes a flagged history debuggable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid as _uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+# results a store verb may legally resolve to; anything else recorded as an
+# error is treated as state-independent (a caller bug like BadPatch can
+# linearize anywhere without touching state)
+_STATE_ERRORS = ("NotFound", "Conflict", "AlreadyExists")
+
+
+@dataclass
+class OpRecord:
+    op_id: int
+    thread: int
+    store: str  # per-store-instance tag: histories never mix backends
+    op: str  # get | update | patch | create | delete
+    kind: str
+    namespace: str
+    name: str
+    call_seq: int
+    ret_seq: int
+    args: Dict[str, Any] = field(default_factory=dict)
+    result: Dict[str, Any] = field(default_factory=dict)
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.store, self.kind, self.namespace, self.name)
+
+    def render(self) -> str:
+        args = ", ".join(f"{k}={v!r}" for k, v in sorted(self.args.items()))
+        if "error" in self.result:
+            res = f"raise {self.result['error']}"
+        else:
+            res = f"rv={self.result.get('rv')}"
+        return (
+            f"[{self.op_id}] t{self.thread % 10000} "
+            f"{self.op}({self.kind} {self.namespace}/{self.name}"
+            f"{', ' + args if args else ''}) -> {res} "
+            f"[call={self.call_seq} ret={self.ret_seq}]"
+        )
+
+
+@dataclass
+class WatchRecord:
+    stream: str
+    seq: int
+    etype: str
+    kind: str
+    namespace: str
+    name: str
+    rv: int
+
+    def render(self) -> str:
+        return (
+            f"[{self.seq}] watch {self.stream}: {self.etype} "
+            f"{self.kind} {self.namespace}/{self.name} rv={self.rv}"
+        )
+
+
+@dataclass
+class History:
+    ops: List[OpRecord] = field(default_factory=list)
+    watch: List[WatchRecord] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "ops": [o.__dict__ for o in self.ops],
+                "watch": [w.__dict__ for w in self.watch],
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "History":
+        data = json.loads(text)
+        return cls(
+            ops=[OpRecord(**o) for o in data.get("ops", [])],
+            watch=[WatchRecord(**w) for w in data.get("watch", [])],
+        )
+
+
+# ---------------------------------------------------------------------------
+# the sequential model
+# ---------------------------------------------------------------------------
+
+
+# per-key model state: (exists, rv, uid, phase)
+_State = Tuple[bool, int, Optional[str], Optional[str]]
+_INITIAL: _State = (False, 0, None, None)
+
+
+class StoreModel:
+    """Legality of one op's recorded result against a per-key state.
+    ``apply`` returns the successor state, or None when the recorded
+    result is impossible in this state — the checker's branch-pruning
+    oracle."""
+
+    @staticmethod
+    def apply(state: _State, op: OpRecord) -> Optional[_State]:
+        exists, rv, uid, phase = state
+        err = op.result.get("error")
+        if err is not None:
+            if err == "NotFound":
+                return state if not exists else None
+            if err == "AlreadyExists":
+                return state if (op.op == "create" and exists) else None
+            if err == "Conflict":
+                if not exists:
+                    return None
+                if op.op == "update":
+                    ok = (not op.args.get("force")) and op.args.get("rv") != rv
+                    return state if ok else None
+                if op.op == "patch":
+                    p_rv = op.args.get("precond_rv")
+                    p_uid = op.args.get("precond_uid")
+                    ok = (p_rv is not None and p_rv != rv) or (
+                        p_uid is not None and p_uid != uid
+                    )
+                    return state if ok else None
+                return None
+            # BadPatch / Unauthorized / ... : state-independent caller bug
+            return state
+        new_rv = op.result.get("rv")
+        new_phase = op.result.get("phase", phase)
+        if op.op == "get":
+            return state if (exists and new_rv == rv) else None
+        if op.op == "create":
+            if exists:
+                return None
+            return (True, new_rv, op.result.get("uid"), new_phase)
+        if not exists or new_rv is None or new_rv <= rv:
+            return None  # writes need a live object and a fresh rv
+        if op.op == "update":
+            if not op.args.get("force") and op.args.get("rv") != rv:
+                return None
+            return (True, new_rv, uid, new_phase)
+        if op.op == "patch":
+            p_rv = op.args.get("precond_rv")
+            p_uid = op.args.get("precond_uid")
+            if p_rv is not None and p_rv != rv:
+                return None
+            if p_uid is not None and p_uid != uid:
+                return None
+            if (
+                op.kind == "Pod"
+                and op.args.get("subresource") == "status"
+                and phase in TERMINAL_PHASES
+                and new_phase != phase
+            ):
+                # terminal write-once: a status patch may never resurrect a
+                # finished pod (the PR 2 contract patch_pod_status enforces;
+                # full-object force-PUTs — test fixtures playing kubelet —
+                # are deliberately exempt)
+                return None
+            return (True, new_rv, uid, new_phase)
+        if op.op == "delete":
+            return (False, new_rv, None, None)
+        return state  # unknown verb: recorded for completeness, no model
+
+
+# ---------------------------------------------------------------------------
+# the checker (Wing & Gong per key)
+# ---------------------------------------------------------------------------
+
+
+_SEARCH_NODE_CAP = 500_000
+
+
+class Inconclusive(RuntimeError):
+    """Search exceeded the node cap — a pathological history, not a
+    verdict. Real control-plane histories are near-sequential and never
+    get close."""
+
+
+def _linearize_ops(ops: List[OpRecord]) -> bool:
+    """True iff ``ops`` (one key's complete call/return history) admits a
+    valid linearization. Iterative Wing & Gong: candidates are pending ops
+    whose call precedes every pending return; memoized on the pending
+    set (per-key state is a function of the applied set — each successful
+    write pins its resulting rv, so 'the applied write with max rv'
+    determines the state regardless of application order)."""
+    ops = sorted(ops, key=lambda o: o.call_seq)
+    n = len(ops)
+    if n == 0:
+        return True
+    seen: set = set()
+    nodes = 0
+
+    def candidates(pending: frozenset) -> List[int]:
+        m = min(ops[i].ret_seq for i in pending)
+        return [i for i in sorted(pending) if ops[i].call_seq < m]
+
+    start = frozenset(range(n))
+    stack: List[Tuple[frozenset, _State, List[int], int]] = [
+        (start, _INITIAL, candidates(start), 0)
+    ]
+    while stack:
+        pending, state, cands, ci = stack[-1]
+        if not pending:
+            return True
+        if ci >= len(cands):
+            stack.pop()
+            continue
+        stack[-1] = (pending, state, cands, ci + 1)
+        nodes += 1
+        if nodes > _SEARCH_NODE_CAP:
+            raise Inconclusive(
+                f"linearization search exceeded {_SEARCH_NODE_CAP} nodes "
+                f"over {n} ops"
+            )
+        i = cands[ci]
+        nxt = StoreModel.apply(state, ops[i])
+        if nxt is None:
+            continue
+        rest = pending - {i}
+        if rest in seen:
+            continue
+        seen.add(rest)
+        if not rest:
+            return True
+        stack.append((rest, nxt, candidates(rest), 0))
+    return False
+
+
+@dataclass
+class Violation:
+    key: Tuple[str, str, str, str]
+    message: str
+    prefix: List[str]  # rendered minimal violating prefix
+
+    def render(self) -> str:
+        store, kind, ns, name = self.key
+        head = f"{kind} {ns}/{name} (store {store}): {self.message}"
+        return head + "".join("\n    " + line for line in self.prefix)
+
+
+@dataclass
+class CheckReport:
+    ok: bool
+    violations: List[Violation]
+    keys: int
+    ops: int
+    watch_events: int
+
+    def render(self) -> str:
+        if self.ok:
+            return (
+                f"linearize: ok — {self.ops} op(s) over {self.keys} key(s), "
+                f"{self.watch_events} watch event(s), every history "
+                f"linearizable"
+            )
+        lines = [f"linearize: {len(self.violations)} violation(s)"]
+        lines += ["  " + v.render().replace("\n", "\n  ") for v in self.violations]
+        return "\n".join(lines)
+
+
+def _minimal_prefix(ops: List[OpRecord]) -> List[OpRecord]:
+    """Shortest return-ordered prefix of a non-linearizable key history
+    that is itself non-linearizable — the debuggable core of a flagged
+    history."""
+    by_ret = sorted(ops, key=lambda o: o.ret_seq)
+    for k in range(1, len(by_ret) + 1):
+        if not _linearize_ops(by_ret[:k]):
+            return by_ret[:k]
+    return by_ret  # unreachable if caller verified non-linearizability
+
+
+def check(history: History) -> CheckReport:
+    """Check a recorded history against the store spec. Per-key
+    linearizability + per-(stream, key) watch rv monotonicity."""
+    per_key: Dict[Tuple[str, str, str, str], List[OpRecord]] = {}
+    for op in history.ops:
+        per_key.setdefault(op.key(), []).append(op)
+    violations: List[Violation] = []
+    for key, ops in sorted(per_key.items()):
+        try:
+            if _linearize_ops(ops):
+                continue
+        except Inconclusive as e:
+            violations.append(Violation(key, f"INCONCLUSIVE: {e}", []))
+            continue
+        prefix = _minimal_prefix(ops)
+        violations.append(
+            Violation(
+                key,
+                f"no valid linearization; minimal violating prefix "
+                f"({len(prefix)} of {len(ops)} ops):",
+                [o.render() for o in prefix],
+            )
+        )
+    # watch order: per (stream, key), delivered rvs may never regress —
+    # delivery must follow linearization (= commit) order. Non-strict:
+    # relist recovery legally re-delivers the current version.
+    streams: Dict[Tuple[str, Tuple[str, str, str]], List[WatchRecord]] = {}
+    for w in history.watch:
+        streams.setdefault(
+            (w.stream, (w.kind, w.namespace, w.name)), []
+        ).append(w)
+    for (stream, (kind, ns, name)), events in sorted(streams.items()):
+        events = sorted(events, key=lambda w: w.seq)
+        high = 0
+        for idx, w in enumerate(events):
+            if w.rv < high:
+                prefix = [e.render() for e in events[: idx + 1]]
+                violations.append(
+                    Violation(
+                        (stream, kind, ns, name),
+                        f"watch delivered rv {w.rv} after rv {high} "
+                        f"(events out of linearization order); minimal "
+                        f"violating prefix ({idx + 1} events):",
+                        prefix,
+                    )
+                )
+                break
+            high = max(high, w.rv)
+    return CheckReport(
+        ok=not violations,
+        violations=violations,
+        keys=len(per_key),
+        ops=len(history.ops),
+        watch_events=len(history.watch),
+    )
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+
+def _obj_rv(obj: Any) -> Optional[int]:
+    try:
+        return obj.metadata.resource_version
+    except AttributeError:
+        return None
+
+
+def _obj_result(obj: Any) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"rv": _obj_rv(obj)}
+    try:
+        out["uid"] = obj.metadata.uid
+    except AttributeError:
+        pass
+    ph = getattr(getattr(obj, "status", None), "phase", None)
+    if ph is not None:
+        out["phase"] = str(ph)
+    return out
+
+
+class _RecordingQueue:
+    """Wraps a store watch queue: every event DELIVERED to the consumer is
+    stamped into the history (delivery, not enqueue, is the moment that
+    must respect linearization order from the consumer's view)."""
+
+    def __init__(self, inner: Any, recorder: "Recorder", stream: str):
+        self._inner = inner
+        self._recorder = recorder
+        self._stream = stream
+
+    def get(self, *a, **k):
+        ev = self._inner.get(*a, **k)
+        self._recorder.record_watch(self._stream, ev)
+        return ev
+
+    def get_nowait(self):
+        ev = self._inner.get_nowait()
+        self._recorder.record_watch(self._stream, ev)
+        return ev
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+_TAG_ATTR = "_opcheck_store_tag"
+
+
+class Recorder:
+    """Class-level instrumentation of the store verbs; one Recorder owns
+    one History spanning every store instance touched while installed
+    (ops carry a per-instance tag, so the checker never mixes them)."""
+
+    VERBS = ("get", "update", "patch", "create", "delete")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._seq = 0
+        self.history = History()
+        self._patched: List[Tuple[type, str, Any]] = []
+
+    # -- sequencing ---------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._mu:
+            self._seq += 1
+            return self._seq
+
+    def _tag(self, store: Any) -> str:
+        tag = getattr(store, _TAG_ATTR, None)
+        if tag is None:
+            tag = f"{type(store).__name__}-{_uuid.uuid4().hex[:6]}"
+            try:
+                setattr(store, _TAG_ATTR, tag)
+            except AttributeError:
+                tag = f"{type(store).__name__}-shared"
+        return tag
+
+    def record_op(
+        self,
+        store: Any,
+        op: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        args: Dict[str, Any],
+        fn,
+    ):
+        call_seq = self._next_seq()
+        try:
+            out = fn()
+        except Exception as e:
+            ret_seq = self._next_seq()
+            with self._mu:
+                self.history.ops.append(
+                    OpRecord(
+                        len(self.history.ops), threading.get_ident(),
+                        self._tag(store), op, kind, namespace, name,
+                        call_seq, ret_seq, args,
+                        {"error": type(e).__name__},
+                    )
+                )
+            raise
+        ret_seq = self._next_seq()
+        result = _obj_result(out) if out is not None else {}
+        with self._mu:
+            self.history.ops.append(
+                OpRecord(
+                    len(self.history.ops), threading.get_ident(),
+                    self._tag(store), op, kind, namespace, name,
+                    call_seq, ret_seq, args, result,
+                )
+            )
+        return out
+
+    def record_watch(self, stream: str, ev: Any) -> None:
+        obj = getattr(ev, "obj", None)
+        if obj is None:
+            return  # relist markers etc.: not a watch event
+        rv = _obj_rv(obj)
+        if rv is None:
+            return
+        m = obj.metadata
+        with self._mu:
+            self._seq += 1
+            self.history.watch.append(
+                WatchRecord(
+                    stream, self._seq, ev.type, ev.kind, m.namespace,
+                    m.name, rv,
+                )
+            )
+
+    # -- class patching -----------------------------------------------------
+
+    def _wrap_verb(self, cls: type, verb: str) -> None:
+        orig = cls.__dict__.get(verb)
+        if orig is None:
+            return
+        rec = self
+
+        if verb == "get":
+            def wrapped(self, kind, namespace, name):  # noqa: ANN001
+                return rec.record_op(
+                    self, "get", kind, namespace, name, {},
+                    lambda: orig(self, kind, namespace, name),
+                )
+        elif verb == "delete":
+            def wrapped(self, kind, namespace, name):  # noqa: ANN001
+                return rec.record_op(
+                    self, "delete", kind, namespace, name, {},
+                    lambda: orig(self, kind, namespace, name),
+                )
+        elif verb == "update":
+            def wrapped(self, obj, force=False):  # noqa: ANN001
+                m = obj.metadata
+                return rec.record_op(
+                    self, "update", obj.kind, m.namespace, m.name,
+                    {"rv": m.resource_version, "force": bool(force)},
+                    lambda: orig(self, obj, force),
+                )
+        elif verb == "create":
+            def wrapped(self, obj):  # noqa: ANN001
+                m = obj.metadata
+                return rec.record_op(
+                    self, "create", obj.kind, m.namespace, m.name, {},
+                    lambda: orig(self, obj),
+                )
+        else:  # patch
+            def wrapped(self, kind, namespace, name, patch,  # noqa: ANN001
+                        *, subresource=None):
+                meta = (
+                    patch.get("metadata") if isinstance(patch, dict) else None
+                )
+                args: Dict[str, Any] = {"subresource": subresource}
+                if isinstance(meta, dict):
+                    if meta.get("resource_version") is not None:
+                        args["precond_rv"] = meta["resource_version"]
+                    if meta.get("uid") is not None:
+                        args["precond_uid"] = meta["uid"]
+                return rec.record_op(
+                    self, "patch", kind, namespace, name, args,
+                    lambda: orig(self, kind, namespace, name, patch,
+                                 subresource=subresource),
+                )
+
+        wrapped.__name__ = verb
+        setattr(cls, verb, wrapped)
+        self._patched.append((cls, verb, orig))
+
+    def _wrap_patch_batch(self, cls: type) -> None:
+        """Only the HTTP client needs this: its patch_batch is ONE wire
+        request that never routes through the wrapped ``patch`` verb (the
+        in-process backends loop through ``self.patch`` and are already
+        recorded). Each item becomes an op sharing the batch's call/return
+        window — the checker may order them freely within it, which is
+        exactly the server's freedom too."""
+        orig = cls.__dict__.get("patch_batch")
+        if orig is None:
+            return
+        rec = self
+
+        def patch_batch(self, items):  # noqa: ANN001
+            call_seq = rec._next_seq()
+            out = orig(self, items)  # whole-batch failure: nothing committed
+            ret_seq = rec._next_seq()
+            tag = rec._tag(self)
+            ident = threading.get_ident()
+            with rec._mu:
+                for it, res in zip(items, out):
+                    patch = it.get("patch")
+                    meta = (
+                        patch.get("metadata")
+                        if isinstance(patch, dict) else None
+                    )
+                    args: Dict[str, Any] = {
+                        "subresource": it.get("subresource"),
+                    }
+                    if isinstance(meta, dict):
+                        if meta.get("resource_version") is not None:
+                            args["precond_rv"] = meta["resource_version"]
+                        if meta.get("uid") is not None:
+                            args["precond_uid"] = meta["uid"]
+                    result = (
+                        {"error": type(res).__name__}
+                        if isinstance(res, Exception) else _obj_result(res)
+                    )
+                    rec.history.ops.append(
+                        OpRecord(
+                            len(rec.history.ops), ident, tag, "patch",
+                            it["kind"], it["namespace"], it["name"],
+                            call_seq, ret_seq, args, result,
+                        )
+                    )
+            return out
+
+        patch_batch.__name__ = "patch_batch"
+        setattr(cls, "patch_batch", patch_batch)
+        self._patched.append((cls, "patch_batch", orig))
+
+    def _wrap_watch(self, cls: type) -> None:
+        orig_watch = cls.__dict__.get("watch")
+        orig_stop = cls.__dict__.get("stop_watch")
+        if orig_watch is None:
+            return
+        rec = self
+
+        def watch(self, kind=None):  # noqa: ANN001
+            q = orig_watch(self, kind)
+            stream = f"{rec._tag(self)}/w{rec._next_seq()}"
+            return _RecordingQueue(q, rec, stream)
+
+        def stop_watch(self, q):  # noqa: ANN001
+            if isinstance(q, _RecordingQueue):
+                q = q._inner
+            return orig_stop(self, q)
+
+        watch.__name__ = "watch"
+        setattr(cls, "watch", watch)
+        self._patched.append((cls, "watch", orig_watch))
+        if orig_stop is not None:
+            stop_watch.__name__ = "stop_watch"
+            setattr(cls, "stop_watch", stop_watch)
+            self._patched.append((cls, "stop_watch", orig_stop))
+
+    def install(self) -> "Recorder":
+        from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+        from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+        from mpi_operator_tpu.machinery.store import ObjectStore
+
+        for cls in (ObjectStore, SqliteStore, HttpStoreClient):
+            for verb in self.VERBS:
+                self._wrap_verb(cls, verb)
+            self._wrap_watch(cls)
+        self._wrap_patch_batch(HttpStoreClient)
+        return self
+
+    def uninstall(self) -> None:
+        while self._patched:
+            cls, name, orig = self._patched.pop()
+            setattr(cls, name, orig)
+
+
+# ---------------------------------------------------------------------------
+# seeded violation histories (the negative fixtures)
+# ---------------------------------------------------------------------------
+
+
+def _op(op_id, op, call, ret, args=None, result=None, *, thread=0,
+        kind="Pod", name="p") -> OpRecord:
+    return OpRecord(
+        op_id, thread, "seed", op, kind, "default", name, call, ret,
+        dict(args or {}), dict(result or {}),
+    )
+
+
+def seeded_violation_histories() -> Dict[str, History]:
+    """The three canonical bad histories (ISSUE 5 satellite). Each MUST be
+    flagged by :func:`check` — they are the checker's own acceptance
+    fixtures, also shipped as JSON under tests/data/linearize/."""
+    lost_update = History(ops=[
+        _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u1"}),
+        _op(1, "get", 3, 4, {}, {"rv": 1, "uid": "u1"}, thread=1),
+        _op(2, "get", 5, 6, {}, {"rv": 1, "uid": "u1"}, thread=2),
+        _op(3, "update", 7, 8, {"rv": 1, "force": False},
+            {"rv": 2, "uid": "u1"}, thread=1),
+        # the violation: this update's rv=1 precondition was consumed by
+        # op 3, yet the store reported SUCCESS — a lost update
+        _op(4, "update", 9, 10, {"rv": 1, "force": False},
+            {"rv": 3, "uid": "u1"}, thread=2),
+    ])
+    stale_read = History(ops=[
+        _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u1"}),
+        _op(1, "update", 3, 4, {"rv": 1, "force": False},
+            {"rv": 2, "uid": "u1"}),
+        # the violation: invoked AFTER the rv=2 write returned (acked),
+        # yet observed the overwritten rv=1 state
+        _op(2, "get", 5, 6, {}, {"rv": 1, "uid": "u1"}, thread=1),
+    ])
+    watch_reorder = History(
+        ops=[
+            _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u1"}),
+            _op(1, "update", 3, 4, {"rv": 1, "force": False}, {"rv": 2}),
+            _op(2, "update", 5, 6, {"rv": 2, "force": False}, {"rv": 3}),
+        ],
+        watch=[
+            WatchRecord("seed/w1", 7, "ADDED", "Pod", "default", "p", 1),
+            # the violation: rv 3 delivered before rv 2 on one stream
+            WatchRecord("seed/w1", 8, "MODIFIED", "Pod", "default", "p", 3),
+            WatchRecord("seed/w1", 9, "MODIFIED", "Pod", "default", "p", 2),
+        ],
+    )
+    return {
+        "lost-update": lost_update,
+        "stale-read-after-ack": stale_read,
+        "watch-event-reordering": watch_reorder,
+    }
+
+
+def self_test() -> List[str]:
+    """The checker's acceptance gate: every seeded violation history is
+    flagged (with a minimal violating prefix), and a legal concurrent
+    history — where the losing writer correctly Conflicts — checks clean."""
+    failures: List[str] = []
+    for name, hist in seeded_violation_histories().items():
+        report = check(hist)
+        if report.ok:
+            failures.append(f"seeded {name} history was NOT flagged")
+        elif not any(v.prefix for v in report.violations):
+            failures.append(
+                f"seeded {name} violation carries no minimal prefix"
+            )
+    clean = History(ops=[
+        _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u1"}),
+        _op(1, "get", 3, 5, {}, {"rv": 1, "uid": "u1"}, thread=1),
+        _op(2, "get", 4, 6, {}, {"rv": 1, "uid": "u1"}, thread=2),
+        _op(3, "update", 7, 10, {"rv": 1, "force": False},
+            {"rv": 2, "uid": "u1"}, thread=1),
+        # overlapping loser: correctly Conflicts — linearizable
+        _op(4, "update", 8, 11, {"rv": 1, "force": False},
+            {"error": "Conflict"}, thread=2),
+        _op(5, "patch", 12, 13,
+            {"subresource": "status", "precond_uid": "u1"},
+            {"rv": 3, "uid": "u1", "phase": "Running"}, thread=1),
+    ])
+    report = check(clean)
+    if not report.ok:
+        failures.append(
+            "legal concurrent history was falsely flagged: "
+            + report.render()
+        )
+    return failures

@@ -37,6 +37,15 @@ Rule catalog (rationale → the PR that motivated each):
   PR 3's VERDICT found ``ctl logs`` shipping the admin bearer token over
   plain HTTP; secrets may be *presented* (Authorization headers) but never
   *printed* or baked into a URL.
+- **LCK001** a blocking store/HTTP call made while holding a lock
+  (AST-approximated: a ``with self._lock:`` body containing
+  ``store.get/update/patch/list/...`` or ``urlopen``/``_request``).
+  ISSUE 5's explorer work surfaced two live instances: the http client's
+  watch bootstrap held ``self._lock`` across a network round-trip
+  (stalling stop_watch and the poll loop's fan-out snapshot behind the
+  request timeout), and the gang scheduler listed pods under the
+  scheduler lock. A lock held across a round-trip turns one slow backend
+  response into a control-plane-wide stall.
 
 Suppression: ``# oplint: disable=RULE[,RULE...]`` on the flagged line or the
 line directly above it silences that rule there. Policy: every suppression
@@ -85,6 +94,20 @@ class Finding:
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
 
+    def to_dict(self) -> Dict[str, object]:
+        """The STABLE machine-readable schema (``lint --format json``):
+        exactly these six keys, so CI diff-annotators can parse findings
+        without tracking internal field names. Renames here are breaking —
+        the CLI contract test pins the shape."""
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
 
 RULES: Dict[str, Rule] = {
     r.id: r
@@ -125,6 +148,14 @@ RULES: Dict[str, Rule] = {
             "PR 3 VERDICT: the admin bearer token crossed plain HTTP; "
             "secrets are presented in headers, never printed or URL-baked",
             scope="all",
+        ),
+        Rule(
+            "LCK001", "error",
+            "blocking store/HTTP call while holding a lock",
+            "ISSUE 5: the http watch bootstrap and the gang scheduler's "
+            "accounting both held a lock across a store round-trip — one "
+            "slow response stalls every contender; move the call outside "
+            "or annotate why the lock is uncontended",
         ),
     )
 }
@@ -376,6 +407,52 @@ def _check_blk001(ctx: _FileCtx, call: ast.Call, fn_stack: List[str]) -> None:
             )
 
 
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mu|mutex|cond)$")
+_STORE_VERBS = {
+    "get", "try_get", "update", "patch", "patch_batch", "list", "delete",
+    "try_delete", "create", "watch",
+}
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """Does a with-item context expression look like a lock? Matched on the
+    LAST dotted component (`self._lock`, `self._mu`, `cache.lock`,
+    `self._init_lock`, `self._cond` — a Condition holds its lock)."""
+    return bool(_LOCK_NAME_RE.search(_last_component(_dotted(expr))))
+
+
+def _check_lck001(ctx: _FileCtx, call: ast.Call) -> None:
+    """Called only for calls lexically inside a lock-holding ``with``: a
+    store verb on a store-like receiver, an ``urlopen``, or this repo's
+    ``_request`` transport all block on I/O — held across them, one slow
+    backend response stalls every contender on the lock."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv = _dotted(f.value)
+        if f.attr in _STORE_VERBS and _is_reader_like(recv):
+            ctx.report(
+                "LCK001", call,
+                f"store call {recv}.{f.attr}(...) while holding a lock; "
+                f"one slow backend response stalls every contender — move "
+                f"the call outside the lock",
+            )
+            return
+        if f.attr == "_request":
+            ctx.report(
+                "LCK001", call,
+                "HTTP transport call while holding a lock; the request "
+                "timeout becomes every contender's stall bound",
+            )
+            return
+    dotted = _dotted(f)
+    if dotted and dotted.rsplit(".", 1)[-1] == "urlopen":
+        ctx.report(
+            "LCK001", call,
+            "urlopen while holding a lock; the request timeout becomes "
+            "every contender's stall bound",
+        )
+
+
 def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
     for node in ast.walk(handler):
         if isinstance(node, ast.Raise):
@@ -510,19 +587,28 @@ def lint_source(
         _check_term001(ctx, fn)
 
     # walk with an enclosing-function-name stack for BLK001's sleep check
-    def visit(node: ast.AST, fn_stack: List[str]) -> None:
+    # and a held-lock depth for LCK001 (a nested def's body does not run
+    # under the enclosing with, so the depth resets at function boundaries)
+    def visit(node: ast.AST, fn_stack: List[str], lock_depth: int) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             fn_stack = fn_stack + [node.name]
+            lock_depth = 0
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+            _is_lock_expr(item.context_expr) for item in node.items
+        ):
+            lock_depth += 1
         if isinstance(node, ast.Call):
             _check_uid001(ctx, node)
             _check_blk001(ctx, node, fn_stack)
+            if lock_depth > 0:
+                _check_lck001(ctx, node)
         if isinstance(node, ast.ExceptHandler):
             _check_exc001(ctx, node)
         _check_sec001(ctx, node)
         for child in ast.iter_child_nodes(node):
-            visit(child, fn_stack)
+            visit(child, fn_stack, lock_depth)
 
-    visit(tree, [])
+    visit(tree, [], 0)
 
     disabled = _disabled_lines(source)
     out = []

@@ -1,0 +1,58 @@
+"""Opt-in pytest plugin recording store histories and checking them for
+linearizability at session end.
+
+Usage (the replay jobs; see README "Model checking the control plane"):
+
+    python -m pytest tests/test_patch.py -q \\
+        -p mpi_operator_tpu.analysis.pytest_linearize --linearize
+
+With ``--linearize`` the five store verbs on all three backends (plus
+watch delivery) are class-level instrumented for the whole session; at
+session end the recorded history is checked against the sequential store
+spec (mpi_operator_tpu.analysis.linearize) and ANY violation fails the
+run, printing its minimal violating prefix. Without the flag the plugin
+is inert, so it is always safe to load.
+"""
+
+from __future__ import annotations
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--linearize", action="store_true", default=False,
+        help="record every store op and check the session's history for "
+             "linearizability (mpi_operator_tpu.analysis.linearize)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "linearize: tests exercising (or exercised under) the history "
+        "recorder + linearizability checker",
+    )
+    if config.getoption("--linearize"):
+        from mpi_operator_tpu.analysis import linearize
+
+        config._linearize_recorder = linearize.Recorder().install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    rec = getattr(session.config, "_linearize_recorder", None)
+    if rec is None:
+        return
+    rec.uninstall()
+    from mpi_operator_tpu.analysis import linearize
+
+    report = linearize.check(rec.history)
+    session.config._linearize_report = report
+    if not report.ok and exitstatus == 0:
+        session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    report = getattr(config, "_linearize_report", None)
+    if report is None:
+        return
+    terminalreporter.section("linearize")
+    terminalreporter.write_line(report.render())

@@ -30,7 +30,16 @@ def pytest_configure(config):
     if config.getoption("--racecheck"):
         from mpi_operator_tpu.analysis import racecheck
 
-        config._racecheck_session = racecheck.Session().install()
+        # the nearest .racecheck-allow (rootdir-style resolution) names
+        # the deliberate patterns, each with a reason — file-side
+        # suppression, so exceptions stop hiding in code-side exemptions
+        allow_path = racecheck.find_allowlist(str(config.rootdir))
+        allowlist = (
+            racecheck.load_allowlist(allow_path) if allow_path else None
+        )
+        config._racecheck_session = racecheck.Session(
+            allowlist=allowlist
+        ).install()
 
 
 def pytest_sessionfinish(session, exitstatus):

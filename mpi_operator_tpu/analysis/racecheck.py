@@ -41,11 +41,14 @@ Opt-in pytest wiring: ``-p mpi_operator_tpu.analysis.pytest_racecheck
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+ALLOWLIST_FILENAME = ".racecheck-allow"
 
 # the REAL factories, captured at import: the wrappers build on these and
 # uninstall() restores them
@@ -422,6 +425,88 @@ class SharedStateMonitor:
 
 
 # ---------------------------------------------------------------------------
+# findings allowlist (.racecheck-allow)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AllowRule:
+    """One allowlist entry. ``kind`` selects the finding type
+    (``shared-state`` matched against ``Class.attr``; ``lock-cycle``
+    matched as a substring of any lock label in the cycle); ``reason`` is
+    MANDATORY — an unexplained suppression is exactly the review smell
+    this file exists to eliminate."""
+
+    kind: str
+    spec: str
+    reason: str
+
+    def matches(self, finding: Any) -> bool:
+        if self.kind == "shared-state" and isinstance(finding, SharedStateFinding):
+            return f"{finding.cls}.{finding.attr}" == self.spec
+        if self.kind == "lock-cycle" and isinstance(finding, LockOrderFinding):
+            return any(self.spec in label for label in finding.cycle)
+        return False
+
+
+def parse_allowlist(text: str, path: str = ALLOWLIST_FILENAME) -> List[AllowRule]:
+    """Parse allowlist lines: ``<kind>:<spec>  <reason...>``. Blank lines
+    and ``#`` comments are skipped; a rule without a reason, or with an
+    unknown kind, is a hard error — the file's contract is that every
+    deliberate pattern names WHY it is deliberate."""
+    rules: List[AllowRule] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, reason = line.partition(" ")
+        kind, sep, spec = head.partition(":")
+        if not sep or not spec:
+            raise ValueError(
+                f"{path}:{lineno}: expected '<kind>:<spec> <reason>', "
+                f"got {line!r}"
+            )
+        if kind not in ("shared-state", "lock-cycle"):
+            raise ValueError(
+                f"{path}:{lineno}: unknown finding kind {kind!r} "
+                f"(shared-state | lock-cycle)"
+            )
+        reason = reason.strip()
+        if not reason:
+            raise ValueError(
+                f"{path}:{lineno}: allowlist entry {head!r} carries no "
+                f"reason — every deliberate pattern must say why"
+            )
+        rules.append(AllowRule(kind, spec, reason))
+    return rules
+
+
+def load_allowlist(path: str) -> List[AllowRule]:
+    with open(path, encoding="utf-8") as f:
+        return parse_allowlist(f.read(), path)
+
+
+def find_allowlist(start_dir: str) -> Optional[str]:
+    """Walk up from ``start_dir`` to the nearest .racecheck-allow (the
+    same nearest-wins resolution as pytest's rootdir), but never PAST a
+    repository boundary (.git / pytest.ini): a stray allowlist in a home
+    directory above the checkout must not silently suppress findings."""
+    d = os.path.abspath(start_dir)
+    while True:
+        cand = os.path.join(d, ALLOWLIST_FILENAME)
+        if os.path.isfile(cand):
+            return cand
+        if os.path.exists(os.path.join(d, ".git")) or os.path.isfile(
+            os.path.join(d, "pytest.ini")
+        ):
+            return None  # repo root reached without an allowlist
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+# ---------------------------------------------------------------------------
 # session
 # ---------------------------------------------------------------------------
 
@@ -452,12 +537,21 @@ DEFAULT_TARGETS: Dict[str, Tuple[str, ...]] = {
 
 class Session:
     """One racecheck window: installs the tracked lock factories (and the
-    class instrumentation), collects, restores, reports."""
+    class instrumentation), collects, restores, reports. ``allowlist``
+    entries (see :func:`load_allowlist`) suppress matching findings —
+    the file-side channel for deliberate patterns, so they stop relying
+    on code-side weakref/threshold exemptions alone."""
 
-    def __init__(self, targets: Optional[Dict[str, Tuple[str, ...]]] = None):
+    def __init__(
+        self,
+        targets: Optional[Dict[str, Tuple[str, ...]]] = None,
+        allowlist: Optional[List[AllowRule]] = None,
+    ):
         self.tracker = LockTracker()
         self.monitor = SharedStateMonitor(self.tracker)
         self.targets = DEFAULT_TARGETS if targets is None else targets
+        self.allowlist = list(allowlist or ())
+        self.allowed: List[Tuple[Any, AllowRule]] = []
         self._installed = False
 
     def install(self) -> "Session":
@@ -489,18 +583,35 @@ class Session:
         self._installed = False
 
     def findings(self) -> List[Any]:
-        return list(self.tracker.cycles()) + list(self.monitor.findings)
+        """Findings surviving the allowlist; suppressed ones accumulate in
+        ``self.allowed`` (reported informationally, never failing)."""
+        out: List[Any] = []
+        self.allowed = []
+        for f in list(self.tracker.cycles()) + list(self.monitor.findings):
+            rule = next((r for r in self.allowlist if r.matches(f)), None)
+            if rule is not None:
+                self.allowed.append((f, rule))
+            else:
+                out.append(f)
+        return out
 
     def render_report(self) -> str:
         findings = self.findings()
+        lines: List[str] = []
         if not findings:
-            return (
+            lines.append(
                 f"racecheck: no lock-order cycles, no unguarded shared "
                 f"writes ({len(self.tracker.labels)} locks tracked, "
                 f"{len(self.tracker.edges)} order edges)"
             )
-        lines = [f"racecheck: {len(findings)} finding(s)"]
-        lines += ["  " + f.render().replace("\n", "\n  ") for f in findings]
+        else:
+            lines.append(f"racecheck: {len(findings)} finding(s)")
+            lines += ["  " + f.render().replace("\n", "\n  ") for f in findings]
+        for f, rule in self.allowed:
+            lines.append(
+                f"  allowed ({rule.kind}:{rule.spec} — {rule.reason}): "
+                + f.render().splitlines()[0]
+            )
         return "\n".join(lines)
 
 

@@ -490,9 +490,21 @@ class TPUJobController:
                 # pods already carry this port, so it must stick
                 job.status.coordinator_port = reserved
                 return reserved
+        # list OUTSIDE the lock (LCK001): self.read is a raw store when no
+        # cache is wired, and a network round-trip under _port_lock would
+        # serialize every concurrent reconcile behind it. Sound because a
+        # concurrent assignment ALWAYS lands in _ports_inflight under the
+        # lock before its status write — re-checked below — so a port
+        # missing from this (possibly stale) snapshot cannot be lost.
+        jobs = self.read.list("TPUJob")
+        with self._port_lock:
+            reserved = self._ports_inflight.get(key)
+            if reserved is not None:
+                job.status.coordinator_port = reserved
+                return reserved
             used = {
                 j.status.coordinator_port
-                for j in self.read.list("TPUJob")
+                for j in jobs
                 if j.status.coordinator_port
                 and j.metadata.uid != job.metadata.uid
                 and not cond.is_finished(j.status)

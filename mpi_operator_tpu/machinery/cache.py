@@ -48,6 +48,7 @@ from mpi_operator_tpu.machinery.store import (
     NotFound,
     WatchEvent,
 )
+from mpi_operator_tpu.machinery.yieldpoints import yield_point
 from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.cache")
@@ -114,6 +115,7 @@ class Lister:
         """Apply one watch event under the rv guard: a stale event (queued
         before a fresher LIST/relist merged) can never regress the cache."""
         key = (obj.metadata.namespace, obj.metadata.name)
+        yield_point("cache.apply", etype)
         with self._lock:
             cur = self._objects.get(key)
             if cur is not None and _rv(obj) < _rv(cur):
@@ -152,6 +154,7 @@ class Lister:
     # -- reads ---------------------------------------------------------------
 
     def get(self, namespace: str, name: str) -> Any:
+        yield_point("cache.get", name)
         with self._lock:
             obj = self._objects.get((namespace, name))
             if obj is None:
@@ -182,6 +185,7 @@ class Lister:
         the selector carries an indexed label the candidate set is a dict
         hit; the remaining selector pairs and the namespace filter apply on
         top."""
+        yield_point("cache.list", self.kind)
         with self._lock:
             candidates = None
             if selector:

@@ -64,6 +64,7 @@ from mpi_operator_tpu.machinery.store import (
     WatchEvent,
     patch_batch_via_loop,
 )
+from mpi_operator_tpu.machinery.yieldpoints import yield_point
 
 log = logging.getLogger("tpujob.store")
 
@@ -1164,6 +1165,9 @@ class HttpStoreClient:
 
             self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
         self._lock = threading.RLock()
+        # serializes watch() poller bootstrap only — see watch() for why the
+        # bootstrap request must not ride self._lock
+        self._init_lock = threading.Lock()
         self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
         self._relist_listeners: List = []
         self._poller: Optional[threading.Thread] = None
@@ -1227,12 +1231,14 @@ class HttpStoreClient:
     # -- CRUD (same contracts as ObjectStore) -------------------------------
 
     def create(self, obj: Any) -> Any:
+        yield_point("store.create", obj.kind)
         r = self._request(
             "POST", "/v1/objects", {"kind": obj.kind, "object": encode(obj)}
         )
         return decode(obj.kind, r["object"])
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.get", name)
         r = self._request(
             "GET", f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
         )
@@ -1245,6 +1251,7 @@ class HttpStoreClient:
             return None
 
     def update(self, obj: Any, force: bool = False) -> Any:
+        yield_point("store.put", obj.kind)
         m = obj.metadata
         r = self._request(
             "PUT",
@@ -1267,6 +1274,7 @@ class HttpStoreClient:
         optimistic loop needed two-plus (same contract as the other
         backends — rv precondition via metadata.resource_version in the
         patch, status subresource via ``subresource='status'``)."""
+        yield_point("store.patch", name)
         path = f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
         if subresource:
             path += f"/{_quote(subresource)}"
@@ -1294,6 +1302,7 @@ class HttpStoreClient:
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.delete", name)
         r = self._request(
             "DELETE", f"/v1/objects/{kind}/{_quote(namespace)}/{_quote(name)}"
         )
@@ -1311,6 +1320,7 @@ class HttpStoreClient:
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
+        yield_point("store.list", kind)
         qs = {}
         if namespace is not None:
             qs["namespace"] = namespace
@@ -1326,19 +1336,37 @@ class HttpStoreClient:
 
     def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
         q: "queue.Queue[WatchEvent]" = queue.Queue()
-        with self._lock:
-            if self._poller is None:
-                # register with the server BEFORE adding the local queue: if
-                # the request fails, the caller retries with nothing leaked
-                # (an early-appended queue would collect events forever)
-                r = self._request("GET", "/v1/watch?after=-1")
+        # bootstrap serialization is a SEPARATE lock: the cursor-registration
+        # request must never run under self._lock (LCK001 — stop_watch and
+        # the poll loop's fan-out snapshot would block behind the network for
+        # up to the full request timeout). _init_lock is uncontended once the
+        # poller exists and nothing else ever takes it, so holding it across
+        # the one bootstrap round-trip blocks no hot path.
+        with self._init_lock:
+            with self._lock:
+                if self._poller is not None:
+                    self._watchers.append((kind, q))
+                    return q
+            # register with the server BEFORE adding the local queue: if
+            # the request fails, the caller retries with nothing leaked
+            # (an early-appended queue would collect events forever)
+            # oplint: disable=LCK001 — _init_lock exists solely to
+            # serialize this one bootstrap round-trip; nothing else ever
+            # takes it, so no hot path can block behind the network here
+            r = self._request("GET", "/v1/watch?after=-1")
+            with self._lock:
                 self._cursor = r["next"]
                 self._instance = r.get("instance", "")
+                # append and start under ONE lock acquisition: the poller's
+                # first watcher snapshot must be guaranteed to include this
+                # queue, or an event landing during the gap would fan out to
+                # nobody while the cursor advances past it (a lost event)
+                self._watchers.append((kind, q))
                 self._poller = threading.Thread(
-                    target=self._poll_loop, name="http-store-watch", daemon=True
+                    target=self._poll_loop, name="http-store-watch",
+                    daemon=True,
                 )
                 self._poller.start()
-            self._watchers.append((kind, q))
         return q
 
     def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
@@ -1445,6 +1473,7 @@ class HttpStoreClient:
 
     @staticmethod
     def _fan_out(watchers, etype: str, obj) -> None:
+        yield_point("store.watch-deliver", obj.kind)
         for want, wq in watchers:
             if want is None or want == obj.kind:
                 wq.put(WatchEvent(etype, obj.kind, obj.deepcopy()))

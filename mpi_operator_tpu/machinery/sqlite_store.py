@@ -45,6 +45,7 @@ from mpi_operator_tpu.machinery.store import (
     apply_merge_patch_dict,
     patch_batch_via_loop,
 )
+from mpi_operator_tpu.machinery.yieldpoints import yield_point
 
 log = logging.getLogger("tpujob.sqlite")
 
@@ -143,6 +144,7 @@ class SqliteStore:
     # -- CRUD (same contracts as ObjectStore) --------------------------------
 
     def create(self, obj: Any) -> Any:
+        yield_point("store.create", obj.kind)
         obj = obj.deepcopy()
         m = obj.metadata
         with self._lock, self._conn:
@@ -173,6 +175,7 @@ class SqliteStore:
         return obj.deepcopy()
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.get", name)
         with self._lock:
             row = self._conn.execute(
                 "SELECT data FROM objects WHERE kind=? AND namespace=? AND name=?",
@@ -189,6 +192,7 @@ class SqliteStore:
             return None
 
     def update(self, obj: Any, force: bool = False) -> Any:
+        yield_point("store.put", obj.kind)
         obj = obj.deepcopy()
         m = obj.metadata
         with self._lock, self._conn:
@@ -231,6 +235,7 @@ class SqliteStore:
         apply_merge_patch_dict core, so semantics match ObjectStore
         exactly. The log row allocates the fresh global rv like any
         update."""
+        yield_point("store.patch", name)
         with self._lock, self._conn:
             cur = self._conn.cursor()
             row = cur.execute(
@@ -263,6 +268,7 @@ class SqliteStore:
         return patch_batch_via_loop(self, items)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.delete", name)
         with self._lock, self._conn:
             cur = self._conn.cursor()
             row = cur.execute(
@@ -315,6 +321,7 @@ class SqliteStore:
         if namespace is not None:
             q += " AND namespace=?"
             args.append(namespace)
+        yield_point("store.list", kind)
         sql_selector = bool(selector) and self._json1
         if sql_selector:
             for k, v in selector.items():
@@ -406,6 +413,8 @@ class SqliteStore:
                     # the fresh relist state)
                     self._last_seen_rv = rows[-1][0]
                     rows = []
+                if rows:
+                    yield_point("store.watch-deliver", str(len(rows)))
                 for rv, etype, kind, data in rows:
                     self._last_seen_rv = rv
                     try:

@@ -25,6 +25,8 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.machinery.yieldpoints import yield_point
+
 
 class NotFound(KeyError):
     pass
@@ -295,6 +297,7 @@ class ObjectStore:
         return self._rv
 
     def _notify(self, etype: str, kind: str, obj: Any) -> None:
+        yield_point("store.watch-deliver", kind)
         for want_kind, q in list(self._watchers):
             if want_kind is None or want_kind == kind:
                 q.put(WatchEvent(etype, kind, obj.deepcopy()))
@@ -306,6 +309,7 @@ class ObjectStore:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, obj: Any) -> Any:
+        yield_point("store.create", obj.kind)
         with self._lock:
             m = _meta(obj)
             k = self._key(obj.kind, m.namespace, m.name)
@@ -323,6 +327,7 @@ class ObjectStore:
             return obj.deepcopy()
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.get", name)
         with self._lock:
             k = self._key(kind, namespace, name)
             if k not in self._objects:
@@ -339,6 +344,7 @@ class ObjectStore:
         """Optimistic update; ``force=True`` skips the resource_version check
         (used by test fixtures playing kubelet, ≙ envtest's updatePodsToPhase,
         v2/test/integration/mpi_job_controller_test.go)."""
+        yield_point("store.put", obj.kind)
         with self._lock:
             m = _meta(obj)
             k = self._key(obj.kind, m.namespace, m.name)
@@ -374,6 +380,7 @@ class ObjectStore:
         update."""
         from mpi_operator_tpu.machinery.serialize import decode, encode
 
+        yield_point("store.patch", name)
         with self._lock:
             k = self._key(kind, namespace, name)
             if k not in self._objects:
@@ -399,6 +406,7 @@ class ObjectStore:
         return patch_batch_via_loop(self, items)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
+        yield_point("store.delete", name)
         with self._lock:
             k = self._key(kind, namespace, name)
             if k not in self._objects:
@@ -438,6 +446,7 @@ class ObjectStore:
         """List objects, optionally namespace-scoped and label-selected
         (selector semantics: all key=value pairs must match, ≙ labels.Set
         selectors used at mpi_job_controller.go:689-707)."""
+        yield_point("store.list", kind)
         with self._lock:
             out = []
             for (k, ns, _), obj in self._objects.items():

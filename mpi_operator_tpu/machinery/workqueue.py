@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from mpi_operator_tpu.machinery.yieldpoints import yield_point
+
 
 class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
@@ -35,6 +37,7 @@ class RateLimitingQueue:
     # -- core (client-go Type) ---------------------------------------------
 
     def add(self, key: str) -> None:
+        yield_point("wq.add", key)
         with self._cond:
             if self._shutdown or key in self._dirty:
                 return
@@ -46,6 +49,7 @@ class RateLimitingQueue:
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
         """Blocks until an item is available; returns None on shutdown or
         timeout. The caller must call done(key) when finished."""
+        yield_point("wq.get")
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
             while not self._queue and not self._shutdown:
@@ -61,6 +65,7 @@ class RateLimitingQueue:
             return key
 
     def done(self, key: str) -> None:
+        yield_point("wq.done", key)
         with self._cond:
             self._processing.discard(key)
             if key in self._dirty and key not in self._queue:

@@ -1,0 +1,48 @@
+"""Store-op yield points: the seam the deterministic explorer schedules on.
+
+racecheck (PR 4) already observes Lock/RLock acquire/release by patching the
+``threading`` factories; the interleaving explorer
+(:mod:`mpi_operator_tpu.analysis.explore`) needs MORE granularity — a
+context switch between a store read and the write built on it is exactly
+the window a lost update lives in, and no lock operation happens there.
+Every store verb (get/put/patch/list/delete), workqueue transition and
+cache apply therefore announces itself through :func:`yield_point` before
+touching state.
+
+Cost when no tool is attached (always, in production): one module-global
+load and a ``None`` check — no string formatting, no allocation. The
+``detail`` argument is a CALLABLE (or a plain string) so call sites can
+defer f-string work to the rare instrumented case.
+
+This module must not import anything from ``analysis`` (the dependency
+points the other way: tools attach here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+# the attached scheduler hook: callable(op: str, detail: str) -> None.
+# Written only from analysis tooling (explore.Session.install/uninstall);
+# read on every store op.
+_hook: Optional[Callable[[str, str], None]] = None
+
+
+def yield_point(op: str, detail: Union[str, Callable[[], str]] = "") -> None:
+    """Announce a schedulable operation. No-op unless a tool is attached."""
+    h = _hook
+    if h is not None:
+        h(op, detail() if callable(detail) else detail)
+
+
+def set_hook(h: Optional[Callable[[str, str], None]]) -> Optional[Any]:
+    """Attach (or with ``None`` detach) the scheduler hook; returns the
+    previous hook so nested tools can restore it."""
+    global _hook
+    prev = _hook
+    _hook = h
+    return prev
+
+
+def get_hook() -> Optional[Callable[[str, str], None]]:
+    return _hook

@@ -282,9 +282,15 @@ class GangScheduler:
         overlaid) snapshot inside a sync — a fresh cache read here could
         miss this scheduler's own un-echoed bindings and undercount."""
         if pods is None:
+            # list OUTSIDE the lock (LCK001): self.read may be a real store
+            # over HTTP, and a network round-trip under the scheduler lock
+            # would stall every concurrent sync/accounting caller; only the
+            # assumed-binding overlay needs the lock (read-only — this
+            # snapshot may be stale relative to a concurrent sync's fresh
+            # assumptions, so it must never retire them)
+            pods = self.read.list("Pod")
             with self._lock:
-                pods = self.read.list("Pod")
-                self._overlay_assumed(pods)
+                self._overlay_assumed(pods, retire=False)
         return sum(
             pod_cost(p)
             for p in pods
@@ -301,9 +307,12 @@ class GangScheduler:
         (recomputed each pass — nothing to drift; same snapshot rule as
         used_chips)."""
         if pods is None:
+            # same LCK001 discipline as used_chips: the read round-trip must
+            # not ride the scheduler lock, and the stale-snapshot overlay
+            # must not retire assumptions
+            pods = self.read.list("Pod")
             with self._lock:
-                pods = self.read.list("Pod")
-                self._overlay_assumed(pods)
+                self._overlay_assumed(pods, retire=False)
         occ: Dict[str, set] = {}
         for p in pods:
             if not p.spec.node_name or p.is_finished():
@@ -327,9 +336,15 @@ class GangScheduler:
         with self._lock:
             self._sync_locked()
 
-    def _overlay_assumed(self, pods: List[Pod]) -> None:
+    def _overlay_assumed(self, pods: List[Pod], retire: bool = True) -> None:
         """Apply not-yet-echoed bindings onto the cached pod snapshot and
-        retire assumptions the cache has caught up with."""
+        (when ``retire``) drop assumptions the cache has caught up with.
+        Accessor paths (used_chips/occupancy) pass ``retire=False``: their
+        snapshot is taken OUTSIDE the lock and may predate a concurrent
+        sync's fresh assumption — retiring from a stale snapshot would
+        re-open the capacity double-bind _assumed exists to prevent. Only
+        _sync_locked, whose snapshot is taken under the lock it holds,
+        may retire."""
         if not self._assumed:
             return
         present: Dict[Tuple[str, str], Pod] = {}
@@ -340,9 +355,11 @@ class GangScheduler:
             if cur is None or cur.metadata.uid != uid:
                 # pod gone or a new incarnation under the same name: the
                 # assumption must not shadow-bind an object it never bound
-                del self._assumed[key]
+                if retire:
+                    del self._assumed[key]
             elif cur.spec.node_name:
-                del self._assumed[key]  # echo landed
+                if retire:
+                    del self._assumed[key]  # echo landed
             else:
                 cur.spec.node_name = node  # still in flight: overlay
 

@@ -1,0 +1,35 @@
+# oplint fixture: LCK001 must fire on blocking store/HTTP calls made while
+# holding a lock. Lines carrying the bad form are marked with an expect
+# comment; the harness asserts the rule fires on exactly them.
+import urllib.request
+
+
+def accounting_under_lock(self):
+    with self._lock:
+        pods = self.read.list("Pod")  # expect: LCK001
+        return len(pods)
+
+
+def rmw_under_lock(self, pod):
+    with self._mu:
+        cur = self.store.get("Pod", "ns", "p0")  # expect: LCK001
+        cur.status.message = "x"
+        return self.store.update(cur)  # expect: LCK001
+
+
+def bootstrap_under_named_lock(self, req):
+    with self._init_lock:
+        with urllib.request.urlopen(req, timeout=5) as r:  # expect: LCK001
+            return r.read()
+
+
+def transport_under_condition(self):
+    # a Condition holds its lock: blocking inside is the same stall
+    with self._cond:
+        return self._request("GET", "/v1/watch?after=-1")  # expect: LCK001
+
+
+def nested_with_still_held(self, other):
+    with self._lock:
+        with other:
+            return self.client.patch("Pod", "ns", "p", {})  # expect: LCK001

@@ -1,0 +1,43 @@
+# oplint fixture: blessed shapes LCK001 must stay silent on, plus the
+# suppressed deliberate exception (an uncontended bootstrap-only lock).
+import urllib.request
+
+
+def snapshot_then_lock(self):
+    # the fix shape: take the round-trip OUTSIDE, mutate state under lock
+    pods = self.read.list("Pod")
+    with self._lock:
+        self._overlay_assumed(pods)
+    return pods
+
+
+def local_state_under_lock(self):
+    with self._lock:
+        # dict/list bookkeeping is fine — only store/HTTP calls block
+        self._entries.clear()
+        return list(self._committed.items())
+
+
+def deferred_closure_is_not_held(self, q):
+    with self._lock:
+        # the nested def's body runs LATER, when the lock is long released
+        def relist():
+            return self.store.list("Pod")
+
+        self._pending.append(relist)
+
+
+def call_outside_then_publish(self, req):
+    with urllib.request.urlopen(req, timeout=5) as r:
+        body = r.read()
+    with self._lock:
+        self._last = body
+    return body
+
+
+def bootstrap_only_lock(self):
+    with self._boot_lock:
+        # oplint: disable=LCK001 — this lock exists solely to serialize
+        # one bootstrap round-trip; nothing else ever takes it, so no hot
+        # path can block behind the network here
+        return self._request("GET", "/v1/watch?after=-1")

@@ -49,7 +49,9 @@ def test_oplint_package_and_tests_are_clean():
 
 def test_rule_catalog_is_complete():
     ids = set(RULES)
-    assert ids == {"RMW001", "UID001", "TERM001", "BLK001", "EXC001", "SEC001"}
+    assert ids == {
+        "RMW001", "UID001", "TERM001", "BLK001", "EXC001", "SEC001", "LCK001",
+    }
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")
         assert rule.scope in ("src", "all")
@@ -256,10 +258,135 @@ def test_cli_lint_clean_exits_zero(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_cli_lint_json_schema_is_stable(tmp_path):
+    """The satellite contract: ``lint --format json`` emits EXACTLY the
+    documented six-key finding schema (rule/severity/path/line/col/
+    message) so CI diff-annotators can parse without tracking internals."""
+    import json as jsonlib
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def helper(self):\n"
+        "    with self._lock:\n"
+        "        return self.store.list('Pod')\n"
+    )
+    r = _run_cli("lint", "--format", "json", str(bad))
+    assert r.returncode == 1, r.stdout + r.stderr
+    findings = jsonlib.loads(r.stdout)
+    assert isinstance(findings, list) and findings
+    f = findings[0]
+    assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+    assert f["rule"] == "LCK001"
+    assert f["severity"] == "error"
+    assert f["path"].endswith("bad.py")
+    assert f["line"] == 3 and isinstance(f["col"], int)
+    assert "lock" in f["message"]
+    # clean tree → empty JSON array, exit 0 (CI can always parse stdout)
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    r = _run_cli("lint", "--format", "json", str(good))
+    assert r.returncode == 0 and jsonlib.loads(r.stdout) == []
+
+
 def test_cli_racecheck_selftest():
     r = _run_cli("racecheck", "--selftest")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "selftest: ok" in r.stdout
+
+
+def test_cli_explore_and_linearize_contracts():
+    r = _run_cli("explore", "--list")
+    assert r.returncode == 0 and "dict-rmw [seeded-bug]" in r.stdout
+    r = _run_cli("explore", "dict-rmw", "--budget", "40", "--preemptions", "1")
+    assert r.returncode == 0, r.stdout + r.stderr  # seeded bug: expected
+    assert "schedule token: v1:dict-rmw:" in r.stdout
+    token = r.stdout.split("schedule token: ")[1].split()[0]
+    r = _run_cli("explore", "--replay", token)
+    assert r.returncode == 1 and "lost update" in r.stdout
+    r = _run_cli("linearize", "--selftest")
+    assert r.returncode == 0 and "selftest: ok" in r.stdout
+    fixture = os.path.join(REPO, "tests", "data", "linearize",
+                           "lost-update.json")
+    r = _run_cli("linearize", fixture)
+    assert r.returncode == 1 and "minimal violating prefix" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# racecheck allowlist (.racecheck-allow)
+# ---------------------------------------------------------------------------
+
+
+def test_allowlist_parses_and_requires_reasons():
+    rules = racecheck.parse_allowlist(
+        "# comment\n"
+        "\n"
+        "shared-state:Foo.bar  the handoff is one-way\n"
+        "lock-cycle:workqueue.py  ordered by construction\n"
+    )
+    assert [(r.kind, r.spec) for r in rules] == [
+        ("shared-state", "Foo.bar"), ("lock-cycle", "workqueue.py"),
+    ]
+    assert all(r.reason for r in rules)
+    with pytest.raises(ValueError, match="no.*reason"):
+        racecheck.parse_allowlist("shared-state:Foo.bar\n")
+    with pytest.raises(ValueError, match="unknown finding kind"):
+        racecheck.parse_allowlist("gremlins:Foo.bar  because\n")
+    with pytest.raises(ValueError, match="expected"):
+        racecheck.parse_allowlist("just-words without a colon head\n")
+
+
+def test_allowlist_suppresses_matching_findings_only():
+    """Precedence: a finding matching an allowlist entry is suppressed
+    (reported informationally with its reason), while a non-matching
+    finding of the same shape still fails — file-side allows are
+    per-pattern, never a blanket off-switch."""
+
+    class _Racy:
+        def __init__(self):
+            self.counter = 0
+            self.other = 0
+
+    allow = racecheck.parse_allowlist(
+        "shared-state:_Racy.counter  seeded: the test wants it silent\n"
+    )
+    sess = racecheck.Session(targets={}, allowlist=allow).install()
+    try:
+        sess.monitor.instrument_class(_Racy, {"counter", "other"})
+        obj = _Racy()
+
+        def writer():
+            for _ in range(3):
+                obj.counter = obj.counter + 1
+                obj.other = obj.other + 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join(5.0)
+        _ = obj.counter, obj.other
+        findings = sess.findings()
+        assert [f.attr for f in findings] == ["other"]
+        assert [(f.attr, rule.spec) for f, rule in sess.allowed] == [
+            ("counter", "_Racy.counter"),
+        ]
+        report = sess.render_report()
+        assert "allowed (shared-state:_Racy.counter" in report
+        assert "seeded: the test wants it silent" in report
+    finally:
+        sess.uninstall()
+
+
+def test_repo_allowlist_loads_and_resolves_nearest():
+    """The shipped .racecheck-allow parses clean, and find_allowlist walks
+    UP to the nearest file (the pytest-rootdir-style resolution the
+    plugin uses)."""
+    path = racecheck.find_allowlist(os.path.join(REPO, "tests"))
+    assert path == os.path.join(REPO, racecheck.ALLOWLIST_FILENAME)
+    rules = racecheck.load_allowlist(path)
+    assert any(
+        r.kind == "shared-state" and r.spec == "HttpStoreClient._cursor"
+        for r in rules
+    )
+    assert all(r.reason for r in rules)
 
 
 def test_ruff_config_widened_to_bugbear_and_pylint_errors():

@@ -1,0 +1,208 @@
+"""opcheck explorer gate (ISSUE 5 tentpole).
+
+The acceptance criteria, as tests:
+
+- the seeded two-writer get+update atomicity violation is found
+  DETERMINISTICALLY within the fast budget, and its printed schedule
+  token replays to the IDENTICAL failure twice;
+- blessed concurrency idioms (optimistic_update, server-side merge-patch,
+  the workqueue, the informer rv guard) survive every schedule in budget;
+- deadlocks are findings (with a replayable token), not hangs;
+- the cooperative window is hermetic: the real threading factories come
+  back, and runs are reproducible — same inputs, same trace.
+
+Fast-budget tests carry the ``explore`` marker and run in tier-1; the
+exhaustive sweep is ``slow`` + ``explore``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from mpi_operator_tpu.analysis import explore
+from mpi_operator_tpu.machinery import yieldpoints
+
+FAST = explore.ExploreBudget(max_runs=80, max_preemptions=2)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: seeded violation → token → identical replay twice
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.explore
+def test_seeded_atomicity_violation_found_and_token_replays_identically():
+    report = explore.explore(
+        "dict-rmw", explore.ExploreBudget(max_runs=40, max_preemptions=1)
+    )
+    assert not report.ok, "the seeded dict-rmw violation must be found"
+    assert "lost update" in report.failure.message
+    token = explore.encode_token("dict-rmw", report.failure.deviations)
+    assert f"schedule token: {token}" in report.failure.message
+    first = explore.replay(token)
+    second = explore.replay(token)
+    assert not first.ok and not second.ok
+    assert first.message == second.message, "replays must be identical"
+    assert first.trace == second.trace, "replays must take identical schedules"
+
+
+@pytest.mark.explore
+def test_store_rmw_force_lost_update_found_with_two_preemptions():
+    """The RMW001 anti-pattern demonstrated at runtime on a real
+    ObjectStore: a force-PUT RMW loses an update under an adversarial
+    schedule the explorer finds."""
+    report = explore.explore("store-rmw-force", FAST)
+    assert not report.ok
+    assert "lost update" in report.failure.message
+    assert not explore.replay(
+        explore.encode_token("store-rmw-force", report.failure.deviations)
+    ).ok
+
+
+@pytest.mark.explore
+@pytest.mark.parametrize(
+    "scenario", ["store-optimistic", "store-patch", "workqueue", "cache-rv-guard"]
+)
+def test_blessed_idioms_survive_fast_budget(scenario):
+    report = explore.explore(scenario, FAST)
+    assert report.ok, report.render()
+
+
+@pytest.mark.explore
+def test_explore_selftest():
+    assert explore.self_test() == []
+
+
+# ---------------------------------------------------------------------------
+# determinism + schedule mechanics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.explore
+def test_default_schedule_is_reproducible():
+    a = explore.run_scenario("dict-rmw")
+    b = explore.run_scenario("dict-rmw")
+    assert a.ok and b.ok
+    assert a.trace == b.trace
+
+
+@pytest.mark.explore
+def test_random_mode_is_deterministic_per_seed():
+    r1 = explore.explore("dict-rmw", FAST, mode="random", seed=7)
+    r2 = explore.explore("dict-rmw", FAST, mode="random", seed=7)
+    assert (not r1.ok) and (not r2.ok)
+    assert r1.failure.deviations == r2.failure.deviations
+    assert r1.runs == r2.runs
+
+
+@pytest.mark.explore
+def test_deadlock_is_a_finding_with_a_replayable_token():
+    """An AB/BA lock-order scenario actually interleaved into its deadlock:
+    the explorer reports it (racecheck only flags the POTENTIAL cycle) and
+    the token replays it."""
+
+    def build():
+        a, b = threading.Lock(), threading.Lock()
+
+        def ab():
+            with a:
+                yieldpoints.yield_point("between")
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                yieldpoints.yield_point("between")
+                with a:
+                    pass
+
+        return [ab, ba], lambda: None
+
+    explore.SCENARIOS["_test-deadlock"] = explore.Scenario(
+        "_test-deadlock", "AB/BA", build, seeded_bug=True
+    )
+    try:
+        report = explore.explore("_test-deadlock", FAST)
+        assert not report.ok
+        assert "DEADLOCK" in report.failure.message
+        token = explore.encode_token(
+            "_test-deadlock", report.failure.deviations
+        )
+        replayed = explore.replay(token)
+        assert not replayed.ok and "DEADLOCK" in replayed.message
+        # lock names are per-run: the replay's message (which embeds
+        # acquire:Lock#N labels) must match the original byte-for-byte
+        assert replayed.message == report.failure.message
+        assert replayed.trace == explore.replay(token).trace
+    finally:
+        del explore.SCENARIOS["_test-deadlock"]
+
+
+@pytest.mark.explore
+def test_thread_exception_is_a_finding():
+    def build():
+        def dies():
+            yieldpoints.yield_point("pre")
+            raise ValueError("boom")
+
+        return [dies], lambda: None
+
+    explore.SCENARIOS["_test-dies"] = explore.Scenario(
+        "_test-dies", "dies", build, seeded_bug=True
+    )
+    try:
+        result = explore.run_scenario("_test-dies")
+        assert not result.ok
+        assert "ValueError: boom" in result.message
+    finally:
+        del explore.SCENARIOS["_test-dies"]
+
+
+@pytest.mark.explore
+def test_bad_tokens_rejected():
+    with pytest.raises(explore.ExploreError):
+        explore.decode_token("v0:dict-rmw:-")
+    with pytest.raises(explore.ExploreError):
+        explore.decode_token("v1:no-such-scenario:-")
+    with pytest.raises(explore.ExploreError):
+        explore.decode_token("v1:dict-rmw:zz")
+    # a structurally valid token whose step never materializes must error,
+    # not silently diverge
+    with pytest.raises(explore.ExploreError):
+        explore.run_scenario("dict-rmw", {9999: 1})
+
+
+@pytest.mark.explore
+def test_token_roundtrip():
+    for dev in ({}, {2: 1}, {0: 1, 7: 0}):
+        token = explore.encode_token("dict-rmw", dev)
+        assert explore.decode_token(token) == ("dict-rmw", dev)
+
+
+@pytest.mark.explore
+def test_cooperative_window_restores_threading_factories():
+    real = (threading.Lock, threading.RLock, threading.Condition)
+    explore.run_scenario("dict-rmw")
+    assert (threading.Lock, threading.RLock, threading.Condition) == real
+    assert yieldpoints.get_hook() is None
+
+
+# ---------------------------------------------------------------------------
+# slow tier: exhaustive sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.explore
+def test_exhaustive_budget_over_all_scenarios():
+    """The deep gate: every shipped scenario under the exhaustive budget —
+    seeded-bug scenarios MUST fail (the explorer keeps finding them at
+    depth), everything else MUST survive every schedule explored."""
+    for name, scenario in sorted(explore.SCENARIOS.items()):
+        report = explore.explore(name, explore.EXHAUSTIVE_BUDGET)
+        if scenario.seeded_bug:
+            assert not report.ok, f"{name}: seeded bug not found exhaustively"
+        else:
+            assert report.ok, f"{name}: {report.render()}"

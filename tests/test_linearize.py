@@ -1,0 +1,271 @@
+"""opcheck linearizability gate (ISSUE 5 tentpole).
+
+- the sequential model enforces the store spec (rv preconditions, uid
+  pins, status-subresource freeze, Pod terminal write-once);
+- the three seeded violation histories (lost-update, stale-read-after-ack,
+  watch-event-reordering — shipped as JSON fixtures under
+  tests/data/linearize/) are each REJECTED with a minimal violating
+  prefix in the error;
+- a genuinely concurrent live recording against a real ObjectStore checks
+  clean, and so does a full replay of tests/test_patch.py under the
+  pytest_linearize plugin (the slow tier adds test_stress).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mpi_operator_tpu.analysis import linearize as L
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "linearize"
+)
+
+
+# ---------------------------------------------------------------------------
+# sequential model
+# ---------------------------------------------------------------------------
+
+
+def _op(op_id, op, call, ret, args=None, result=None, *, kind="Pod"):
+    return L.OpRecord(
+        op_id, 0, "s", op, kind, "default", "p", call, ret,
+        dict(args or {}), dict(result or {}),
+    )
+
+
+def test_model_rv_precondition():
+    st = L.StoreModel.apply(
+        L._INITIAL, _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u"})
+    )
+    assert st == (True, 1, "u", None)
+    # stale-rv update succeeding is impossible...
+    assert L.StoreModel.apply(
+        st, _op(1, "update", 3, 4, {"rv": 9, "force": False}, {"rv": 2})
+    ) is None
+    # ...but its Conflict is legal, and a force-PUT skips the check
+    assert L.StoreModel.apply(
+        st, _op(1, "update", 3, 4, {"rv": 9, "force": False},
+                {"error": "Conflict"})
+    ) == st
+    assert L.StoreModel.apply(
+        st, _op(1, "update", 3, 4, {"rv": 9, "force": True}, {"rv": 2})
+    ) == (True, 2, "u", None)
+
+
+def test_model_uid_pin_and_terminal_write_once():
+    st = (True, 5, "u1", "Succeeded")
+    # wrong-uid patch succeeding is impossible; its Conflict is legal
+    assert L.StoreModel.apply(
+        st, _op(0, "patch", 1, 2, {"precond_uid": "u0"}, {"rv": 6})
+    ) is None
+    assert L.StoreModel.apply(
+        st, _op(0, "patch", 1, 2, {"precond_uid": "u0"},
+                {"error": "Conflict"})
+    ) == st
+    # a status patch resurrecting a terminal Pod phase is spec-illegal
+    assert L.StoreModel.apply(
+        st, _op(0, "patch", 1, 2, {"subresource": "status"},
+                {"rv": 6, "phase": "Running"})
+    ) is None
+    # same-phase status patch (mirror refresh) is fine
+    assert L.StoreModel.apply(
+        st, _op(0, "patch", 1, 2, {"subresource": "status"},
+                {"rv": 6, "phase": "Succeeded"})
+    ) == (True, 6, "u1", "Succeeded")
+
+
+def test_model_get_and_delete():
+    assert L.StoreModel.apply(
+        L._INITIAL, _op(0, "get", 1, 2, {}, {"error": "NotFound"})
+    ) == L._INITIAL
+    st = (True, 3, "u", None)
+    assert L.StoreModel.apply(st, _op(0, "get", 1, 2, {}, {"rv": 3})) == st
+    assert L.StoreModel.apply(st, _op(0, "get", 1, 2, {"": ""}, {"rv": 2})) is None
+    assert L.StoreModel.apply(st, _op(0, "delete", 1, 2, {}, {"rv": 4})) == (
+        False, 4, None, None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# seeded negative fixtures (the satellite): rejected with a minimal prefix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name", ["lost-update", "stale-read-after-ack", "watch-event-reordering"]
+)
+def test_seeded_violation_fixture_rejected_with_minimal_prefix(name):
+    with open(os.path.join(FIXDIR, f"{name}.json"), encoding="utf-8") as f:
+        history = L.History.from_json(f.read())
+    report = L.check(history)
+    assert not report.ok, f"{name} must be flagged"
+    assert report.violations, name
+    v = report.violations[0]
+    assert "minimal violating prefix" in v.message
+    assert v.prefix, "the error must carry the violating prefix"
+    rendered = report.render()
+    assert "prefix" in rendered and "[" in rendered  # ops are listed
+
+
+def test_stale_read_minimal_prefix_is_the_whole_three_op_core():
+    hist = L.seeded_violation_histories()["stale-read-after-ack"]
+    report = L.check(hist)
+    # create, acked update, stale get — nothing shorter violates
+    assert len(report.violations[0].prefix) == 3
+
+
+def test_fixtures_match_programmatic_histories():
+    """The JSON fixtures are the serialized form of
+    seeded_violation_histories(): neither may drift."""
+    for name, hist in L.seeded_violation_histories().items():
+        with open(os.path.join(FIXDIR, f"{name}.json"), encoding="utf-8") as f:
+            on_disk = L.History.from_json(f.read())
+        assert on_disk == hist, name
+
+
+def test_history_json_roundtrip():
+    hist = L.seeded_violation_histories()["watch-event-reordering"]
+    assert L.History.from_json(hist.to_json()) == hist
+
+
+def test_selftest():
+    assert L.self_test() == []
+
+
+def test_legal_concurrent_overlap_checks_clean():
+    """Two overlapping updates where the loser Conflicts — linearizable in
+    the order the rvs force, whatever the wall-clock overlap."""
+    hist = L.History(ops=[
+        _op(0, "create", 1, 2, {}, {"rv": 1, "uid": "u"}),
+        _op(1, "update", 3, 6, {"rv": 1, "force": False}, {"rv": 2}),
+        _op(2, "update", 4, 7, {"rv": 1, "force": False},
+            {"error": "Conflict"}),
+        _op(3, "get", 8, 9, {}, {"rv": 2}),
+    ])
+    assert L.check(hist).ok
+
+
+# ---------------------------------------------------------------------------
+# live recording
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_objectstore_recording_checks_clean():
+    """The recorder over a REAL racy-but-correct workload: optimistic
+    writers and disjoint status patchers hammering one pod, plus a watch
+    consumer — the recorded history must be linearizable and complete
+    (every increment survives)."""
+    import queue as qmod
+
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore, optimistic_update
+
+    rec = L.Recorder().install()
+    try:
+        store = ObjectStore()
+        q = store.watch("Pod")
+        store.create(Pod(metadata=ObjectMeta(name="p", labels={"n": "0"})))
+
+        def writer():
+            for _ in range(5):
+                def bump(cur):
+                    cur.metadata.labels["n"] = str(
+                        int(cur.metadata.labels["n"]) + 1
+                    )
+                    return True
+
+                optimistic_update(store, "Pod", "default", "p", bump)
+
+        def patcher(field):
+            for i in range(5):
+                store.patch(
+                    "Pod", "default", "p",
+                    {"status": {field: f"v{i}"}}, subresource="status",
+                )
+
+        threads = [threading.Thread(target=writer) for _ in range(3)]
+        threads += [
+            threading.Thread(target=patcher, args=(f,))
+            for f in ("reason", "message")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        while True:
+            try:
+                q.get(timeout=0.05)
+            except qmod.Empty:
+                break
+        store.stop_watch(q)
+        final = store.get("Pod", "default", "p")
+    finally:
+        rec.uninstall()
+    assert final.metadata.labels["n"] == "15"
+    report = L.check(rec.history)
+    assert report.ok, report.render()
+    assert report.ops > 20 and report.watch_events > 10
+
+
+def test_recorder_uninstall_restores_store_classes():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    orig = ObjectStore.__dict__["patch"]
+    rec = L.Recorder().install()
+    assert ObjectStore.__dict__["patch"] is not orig
+    rec.uninstall()
+    assert ObjectStore.__dict__["patch"] is orig
+
+
+# ---------------------------------------------------------------------------
+# real-suite replays (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _replay(paths, timeout):
+    return subprocess.run(
+        [
+            sys.executable, "-m", "pytest", *paths,
+            "-q", "-m", "not slow",
+            "-p", "mpi_operator_tpu.analysis.pytest_linearize", "--linearize",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.linearize
+def test_patch_suite_records_a_linearizable_history():
+    """ISSUE 5 acceptance: a real replay of tests/test_patch.py (all three
+    backends) under the recorder checks clean."""
+    r = _replay(["tests/test_patch.py"], timeout=300)
+    assert "linearize: ok" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+@pytest.mark.linearize
+def test_patch_and_stress_suites_record_linearizable_histories():
+    """Slow tier: the full stress suite (100-job churn, agent batches,
+    thousands of ops) recorded and checked — the scale proof."""
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_patch.py", "tests/test_stress.py", "-q",
+            "-p", "mpi_operator_tpu.analysis.pytest_linearize", "--linearize",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert "linearize: ok" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -678,3 +678,24 @@ def test_preemption_does_not_livelock_with_aged_victim():
                 make_pod(store, "lowjob", i)
     assert len(bound_pods(store, "crit")) == 2, "preemptor starved (livelock)"
     assert evictions <= 1, f"victim evicted {evictions}x (admit/evict churn)"
+
+
+def test_accessor_overlay_never_retires_fresh_assumptions():
+    """used_chips/occupancy take their pod snapshot OUTSIDE the scheduler
+    lock (LCK001 fix): that snapshot may predate a concurrent sync's fresh
+    assumed binding, so the accessor overlay must be READ-ONLY — retiring
+    an assumption from a stale snapshot would let the next sync undercount
+    used capacity and double-bind the chips. Only the sync path (lock-
+    fresh snapshot) retires."""
+    store = ObjectStore()
+    sched = GangScheduler(store, chips=8)
+    # an in-flight assumption whose pod is absent from the (stale) store
+    # snapshot — exactly what an accessor racing a concurrent sync sees
+    sched._assumed[("default", "ghost-0")] = ("uid-g", "node-a")
+    assert sched.used_chips() == 0
+    assert ("default", "ghost-0") in sched._assumed, (
+        "accessor overlay retired an assumption from a stale snapshot"
+    )
+    # the sync pass, whose snapshot is taken under the lock, still retires
+    sched.sync()
+    assert ("default", "ghost-0") not in sched._assumed

@@ -3,7 +3,7 @@ golangci-lint gate + `go test -race` CI split, now grown into a model-
 checking layer):
 
 - :mod:`oplint` — AST rules over this repo's own invariants (RMW001,
-  UID001, TERM001, BLK001, EXC001, SEC001, LCK001), with per-line
+  UID001, TERM001, BLK001, EXC001, SEC001, LCK001, DUR001), with per-line
   ``# oplint: disable=RULE`` suppressions and a stable
   ``lint --format json`` finding schema;
 - :mod:`racecheck` — runtime lock-order + unguarded-shared-state detector
@@ -15,10 +15,23 @@ checking layer):
   prints a schedule token and ``--replay`` re-executes it exactly;
 - :mod:`linearize` — store history recorder + sequential-spec model +
   Porcupine-style linearizability checker, exposed as the opt-in pytest
-  plugin :mod:`pytest_linearize`.
+  plugin :mod:`pytest_linearize`;
+- :mod:`model` — the sequential store spec in both executable forms:
+  ``StoreModel`` (the validator the linearizability checker prunes on)
+  and ``ModelStore`` (the generator reference the differential fuzzer
+  diffs against), mechanically pinned to each other;
+- :mod:`storecheck` — model-differential fuzzer over all three store
+  backends (seeded symbolic op sequences, ddmin-shrunk divergences,
+  ``v1:fuzz:<seed>:<ops>`` replay tokens, seeded-mutant selftest,
+  pinned repro corpus under ``tests/data/storecheck/``); deliberate
+  exceptions are declared in ``.storecheck-allow`` with reasons;
+- :mod:`crashpoints` — ALICE-style crash-point explorer over the
+  SqliteStore ``_txn`` commit seam (exact + torn-WAL-tail snapshots,
+  acked-write durability at exact rv, rv monotonicity across reopen,
+  resume-or-410; oplint DUR001 keeps every mutation on the seam).
 
 CLI: ``python -m mpi_operator_tpu.analysis
-{lint,rules,racecheck,explore,linearize}``.
+{lint,rules,racecheck,explore,linearize,fuzz,crash}``.
 """
 
 from mpi_operator_tpu.analysis.oplint import (

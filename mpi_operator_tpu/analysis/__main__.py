@@ -10,19 +10,29 @@
     python -m mpi_operator_tpu.analysis explore --replay 'v1:dict-rmw:2=1'
     python -m mpi_operator_tpu.analysis linearize --selftest
     python -m mpi_operator_tpu.analysis linearize history.json ...
+    python -m mpi_operator_tpu.analysis fuzz --seed 0 --budget 8
+    python -m mpi_operator_tpu.analysis fuzz --replay 'v1:fuzz:5:38,43'
+    python -m mpi_operator_tpu.analysis fuzz --selftest
+    python -m mpi_operator_tpu.analysis crash --workload 16
+    python -m mpi_operator_tpu.analysis crash --list-points
+    python -m mpi_operator_tpu.analysis crash --selftest
 
 ``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
 rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
 ``--selftest`` delegates to pytest with the plugin armed. ``explore``
 runs the deterministic interleaving explorer over a scenario (exit 1 on
 a violating schedule, printing its replay token); ``linearize`` checks
-recorded store histories against the sequential spec.
+recorded store histories against the sequential spec. ``fuzz`` runs the
+model-differential store fuzzer over the three real backends (exit 1 on
+a divergence, printing its minimal repro + replay token); ``crash`` runs
+the ALICE-style crash-point explorer over the SqliteStore commit seam.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -142,6 +152,75 @@ def _cmd_linearize(args) -> int:
     return rc
 
 
+def _cmd_fuzz(args) -> int:
+    from mpi_operator_tpu.analysis import storecheck
+
+    if args.selftest:
+        failures = storecheck.self_test()
+        for f in failures:
+            print(f"storecheck selftest FAILED: {f}", file=sys.stderr)
+        if not failures:
+            print("storecheck selftest: ok")
+        return 1 if failures else 0
+    if args.replay:
+        factories = storecheck.REAL_BACKENDS
+        if args.backend:
+            factories = {args.backend: storecheck.REAL_BACKENDS[args.backend]}
+        rc = 0
+        for name, factory in factories.items():
+            finding = storecheck.replay(args.replay, factory)
+            if finding is None:
+                print(f"{name}: token {args.replay} runs clean")
+            else:
+                print(finding.render())
+                rc = 1
+        return rc
+    budget = storecheck.FuzzBudget(
+        sequences=(storecheck.DEFAULT_BUDGET.sequences
+                   if args.budget is None else args.budget),
+        ops=(storecheck.DEFAULT_BUDGET.ops
+             if args.ops is None else args.ops),
+    )
+    allow_path = storecheck.find_allowlist(os.getcwd())
+    allowlist = storecheck.load_allowlist(allow_path) if allow_path else None
+    report = storecheck.fuzz(seed=args.seed, budget=budget,
+                             allowlist=allowlist)
+    print(report.render())
+    return 0 if report.ok else 1
+
+
+def _cmd_crash(args) -> int:
+    from mpi_operator_tpu.analysis import crashpoints, storecheck
+
+    if args.selftest:
+        failures = crashpoints.self_test()
+        for f in failures:
+            print(f"crashpoints selftest FAILED: {f}", file=sys.stderr)
+        if not failures:
+            print("crashpoints selftest: ok")
+        return 1 if failures else 0
+    if args.list_points:
+        snaps, _timeline, _rvs = crashpoints.record(
+            crashpoints.commit_heavy_ops(args.workload)
+        )
+        points = crashpoints.crash_points(snaps, torn=not args.no_torn)
+        for pt in points:
+            tag = f" torn={pt.torn}" if pt.torn else ""
+            print(f"{pt.label}  acked={pt.acked} expected={pt.expected}{tag}")
+        print(f"{len(points)} crash point(s)", file=sys.stderr)
+        return 0
+    allowlist = None
+    allow_path = storecheck.find_allowlist(os.getcwd())
+    if allow_path:
+        allowlist = storecheck.load_allowlist(allow_path)
+    report = crashpoints.explore(
+        writes=args.workload, torn=not args.no_torn,
+        resume=not args.no_resume, allowlist=allowlist,
+    )
+    print(report.render())
+    return 0 if report.ok else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mpi_operator_tpu.analysis", description=__doc__
@@ -193,6 +272,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--selftest", action="store_true")
     p.add_argument("histories", nargs="*")
     p.set_defaults(fn=_cmd_linearize)
+    p = sub.add_parser(
+        "fuzz",
+        help="model-differential fuzz of the three store backends "
+             "(exit 1 on a divergence; --replay re-executes its token)",
+    )
+    p.add_argument("--selftest", action="store_true",
+                   help="every seeded mutant caught + real backends clean")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--budget", type=int, default=None,
+                   help="sequences per backend (default: "
+                        "storecheck.DEFAULT_BUDGET)")
+    p.add_argument("--ops", type=int, default=None,
+                   help="symbolic ops per sequence (default: "
+                        "storecheck.DEFAULT_BUDGET)")
+    p.add_argument("--replay", metavar="TOKEN",
+                   help="re-execute the exact op subsequence a "
+                        "v1:fuzz:<seed>:<ops> token encodes")
+    p.add_argument("--backend", choices=["memory", "sqlite", "http"],
+                   help="with --replay: restrict to one backend")
+    p.set_defaults(fn=_cmd_fuzz)
+    p = sub.add_parser(
+        "crash",
+        help="ALICE-style crash-point exploration of the SqliteStore "
+             "commit seam (exit 1 on a recovery violation)",
+    )
+    p.add_argument("--selftest", action="store_true",
+                   help="real store explores >=50 points clean + seeded "
+                        "split-transaction mutant caught")
+    p.add_argument("--workload", type=int, default=16, metavar="WRITES",
+                   help="committed writes in the commit-heavy workload")
+    p.add_argument("--list-points", action="store_true",
+                   help="enumerate crash points without checking recovery")
+    p.add_argument("--no-torn", action="store_true",
+                   help="skip torn-WAL-tail variants")
+    p.add_argument("--no-resume", action="store_true",
+                   help="skip the per-point ?resource_version= resume check")
+    p.set_defaults(fn=_cmd_crash)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,75 @@
+"""Shared allowlist grammar + nearest-file resolution.
+
+`.racecheck-allow` (racecheck) and `.storecheck-allow` (storecheck /
+crashpoints) carry the same contract — ``<kind>:<spec>  <reason>`` lines,
+reason MANDATORY, nearest file wins walking up from the start directory,
+and the walk NEVER crosses a repository boundary (``.git`` /
+``pytest.ini``): a stray allowlist in a home directory above the checkout
+must not silently suppress findings. One implementation here, so the
+grammar and the boundary rule cannot drift between the two tools; each
+keeps its own ``AllowRule`` dataclass (the ``matches`` semantics differ)
+and passes its constructor in as ``make_rule``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+R = TypeVar("R")
+
+
+def parse_rules(
+    text: str,
+    path: str,
+    kinds: Sequence[str],
+    make_rule: Callable[[str, str, str], R],
+) -> List[R]:
+    """Parse allowlist lines: ``<kind>:<spec>  <reason...>``. Blank lines
+    and ``#`` comments are skipped; a rule without a reason, or with a
+    kind outside ``kinds``, is a hard error — the file's contract is that
+    every deliberate exception names WHY it is deliberate."""
+    rules: List[R] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, reason = line.partition(" ")
+        kind, sep, spec = head.partition(":")
+        if not sep or not spec:
+            raise ValueError(
+                f"{path}:{lineno}: expected '<kind>:<spec> <reason>', "
+                f"got {line!r}"
+            )
+        if kind not in kinds:
+            raise ValueError(
+                f"{path}:{lineno}: unknown finding kind {kind!r} "
+                f"({' | '.join(kinds)})"
+            )
+        reason = reason.strip()
+        if not reason:
+            raise ValueError(
+                f"{path}:{lineno}: allowlist entry {head!r} carries no "
+                f"reason — every deliberate exception must say why"
+            )
+        rules.append(make_rule(kind, spec, reason))
+    return rules
+
+
+def find_nearest(start_dir: str, filename: str) -> Optional[str]:
+    """Walk up from ``start_dir`` to the nearest ``filename`` (the same
+    nearest-wins resolution as pytest's rootdir), but never PAST a
+    repository boundary (.git / pytest.ini)."""
+    d = os.path.abspath(start_dir)
+    while True:
+        cand = os.path.join(d, filename)
+        if os.path.isfile(cand):
+            return cand
+        if os.path.exists(os.path.join(d, ".git")) or os.path.isfile(
+            os.path.join(d, "pytest.ini")
+        ):
+            return None  # repo root reached without an allowlist
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent

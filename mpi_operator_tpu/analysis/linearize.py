@@ -42,12 +42,16 @@ import uuid as _uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-TERMINAL_PHASES = ("Succeeded", "Failed")
-
-# results a store verb may legally resolve to; anything else recorded as an
-# error is treated as state-independent (a caller bug like BadPatch can
-# linearize anywhere without touching state)
-_STATE_ERRORS = ("NotFound", "Conflict", "AlreadyExists")
+# the sequential spec lives in analysis/model.py (promoted there so the
+# differential fuzzer and this checker share ONE model); the old names
+# stay importable here — tests and tools address the spec through either
+from mpi_operator_tpu.analysis.model import (  # noqa: F401  (re-exports)
+    INITIAL as _INITIAL,
+    STATE_ERRORS as _STATE_ERRORS,
+    TERMINAL_PHASES,
+    State as _State,
+    StoreModel,
+)
 
 
 @dataclass
@@ -122,86 +126,8 @@ class History:
 
 
 # ---------------------------------------------------------------------------
-# the sequential model
-# ---------------------------------------------------------------------------
-
-
-# per-key model state: (exists, rv, uid, phase)
-_State = Tuple[bool, int, Optional[str], Optional[str]]
-_INITIAL: _State = (False, 0, None, None)
-
-
-class StoreModel:
-    """Legality of one op's recorded result against a per-key state.
-    ``apply`` returns the successor state, or None when the recorded
-    result is impossible in this state — the checker's branch-pruning
-    oracle."""
-
-    @staticmethod
-    def apply(state: _State, op: OpRecord) -> Optional[_State]:
-        exists, rv, uid, phase = state
-        err = op.result.get("error")
-        if err is not None:
-            if err == "NotFound":
-                return state if not exists else None
-            if err == "AlreadyExists":
-                return state if (op.op == "create" and exists) else None
-            if err == "Conflict":
-                if not exists:
-                    return None
-                if op.op == "update":
-                    ok = (not op.args.get("force")) and op.args.get("rv") != rv
-                    return state if ok else None
-                if op.op == "patch":
-                    p_rv = op.args.get("precond_rv")
-                    p_uid = op.args.get("precond_uid")
-                    ok = (p_rv is not None and p_rv != rv) or (
-                        p_uid is not None and p_uid != uid
-                    )
-                    return state if ok else None
-                return None
-            # BadPatch / Unauthorized / ... : state-independent caller bug
-            return state
-        new_rv = op.result.get("rv")
-        new_phase = op.result.get("phase", phase)
-        if op.op == "get":
-            return state if (exists and new_rv == rv) else None
-        if op.op == "create":
-            if exists:
-                return None
-            return (True, new_rv, op.result.get("uid"), new_phase)
-        if not exists or new_rv is None or new_rv <= rv:
-            return None  # writes need a live object and a fresh rv
-        if op.op == "update":
-            if not op.args.get("force") and op.args.get("rv") != rv:
-                return None
-            return (True, new_rv, uid, new_phase)
-        if op.op == "patch":
-            p_rv = op.args.get("precond_rv")
-            p_uid = op.args.get("precond_uid")
-            if p_rv is not None and p_rv != rv:
-                return None
-            if p_uid is not None and p_uid != uid:
-                return None
-            if (
-                op.kind == "Pod"
-                and op.args.get("subresource") == "status"
-                and phase in TERMINAL_PHASES
-                and new_phase != phase
-            ):
-                # terminal write-once: a status patch may never resurrect a
-                # finished pod (the PR 2 contract patch_pod_status enforces;
-                # full-object force-PUTs — test fixtures playing kubelet —
-                # are deliberately exempt)
-                return None
-            return (True, new_rv, uid, new_phase)
-        if op.op == "delete":
-            return (False, new_rv, None, None)
-        return state  # unknown verb: recorded for completeness, no model
-
-
-# ---------------------------------------------------------------------------
-# the checker (Wing & Gong per key)
+# the checker (Wing & Gong per key; the sequential model is
+# analysis.model.StoreModel, shared with the differential fuzzer)
 # ---------------------------------------------------------------------------
 
 

@@ -1,0 +1,423 @@
+"""The sequential store specification, in two executable forms.
+
+The store contract PRs 1-5 grew — rv-preconditioned optimistic concurrency,
+uid-pinned incarnation writes, the frozen status subresource, a global
+strictly-increasing resource_version sequence, watch events in commit
+order — is a SEQUENTIAL spec. Two tools check real backends against it and
+they must share ONE model or the spec itself forks:
+
+- :class:`StoreModel` (promoted here from ``analysis/linearize.py``) is the
+  *validator* form: given a per-key abstract state and one op's RECORDED
+  result, is that result possible? The linearizability checker's
+  branch-pruning oracle.
+- :class:`ModelStore` is the *generator* form: a complete sequential
+  reference implementation of the five verbs + status subresource +
+  ``patch_batch`` + watch event log, operating on plain encoded dicts. The
+  differential fuzzer (:mod:`analysis.storecheck`) executes every op
+  sequence against it and diffs the three real backends' return values,
+  error classes, final state and watch streams against its answers.
+
+``ModelStore`` deliberately reuses :func:`apply_merge_patch_dict` — the
+shared semantic core all three backends already ride — so the *merge*
+algebra cannot drift between model and subject (differential testing can
+never see a bug every implementation shares anyway); everything the
+backends implement separately (rv stamping, preconditions, existence,
+watch delivery, batch semantics) is modeled independently.
+
+``ModelStore`` also self-checks: every op it executes is replayed through
+``StoreModel.apply`` (:meth:`ModelStore.apply_op` raises
+:class:`ModelDrift` on disagreement), so the fuzzer's oracle and the
+linearizability checker's oracle are mechanically pinned to each other —
+the replicated-store acceptance bar (ROADMAP item 1) is one spec, not two.
+
+One deliberate asymmetry: StoreModel encodes the SYSTEM spec — clients
+write Pod phases through ``patch_pod_status``, which makes terminal phases
+write-once — while the raw store accepts a phase-resurrecting status patch
+(the guard lives in the helper, not the server). The fuzzer's generator
+therefore never emits that op class (it clamps phase writes at resolution
+time, storecheck._resolve), the same way real clients never do.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery.serialize import decode, encode
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    BadPatch,
+    Conflict,
+    NotFound,
+    apply_merge_patch_dict,
+)
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+# results a store verb may legally resolve to; anything else recorded as an
+# error is treated as state-independent (a caller bug like BadPatch can
+# linearize anywhere without touching state)
+STATE_ERRORS = ("NotFound", "Conflict", "AlreadyExists")
+
+# per-key model state: (exists, rv, uid, phase)
+State = Tuple[bool, int, Optional[str], Optional[str]]
+INITIAL: State = (False, 0, None, None)
+
+
+class StoreModel:
+    """Legality of one op's recorded result against a per-key state.
+    ``apply`` returns the successor state, or None when the recorded
+    result is impossible in this state — the checker's branch-pruning
+    oracle. Ops are ``linearize.OpRecord``-shaped (duck-typed: ``op``,
+    ``kind``, ``args``, ``result`` attributes)."""
+
+    @staticmethod
+    def apply(state: State, op: Any) -> Optional[State]:
+        exists, rv, uid, phase = state
+        err = op.result.get("error")
+        if err is not None:
+            if err == "NotFound":
+                return state if not exists else None
+            if err == "AlreadyExists":
+                return state if (op.op == "create" and exists) else None
+            if err == "Conflict":
+                if not exists:
+                    return None
+                if op.op == "update":
+                    ok = (not op.args.get("force")) and op.args.get("rv") != rv
+                    return state if ok else None
+                if op.op == "patch":
+                    p_rv = op.args.get("precond_rv")
+                    p_uid = op.args.get("precond_uid")
+                    ok = (p_rv is not None and p_rv != rv) or (
+                        p_uid is not None and p_uid != uid
+                    )
+                    return state if ok else None
+                return None
+            # BadPatch / Unauthorized / ... : state-independent caller bug
+            return state
+        new_rv = op.result.get("rv")
+        new_phase = op.result.get("phase", phase)
+        if op.op == "get":
+            return state if (exists and new_rv == rv) else None
+        if op.op == "create":
+            if exists:
+                return None
+            return (True, new_rv, op.result.get("uid"), new_phase)
+        if not exists or new_rv is None or new_rv <= rv:
+            return None  # writes need a live object and a fresh rv
+        if op.op == "update":
+            if not op.args.get("force") and op.args.get("rv") != rv:
+                return None
+            return (True, new_rv, uid, new_phase)
+        if op.op == "patch":
+            p_rv = op.args.get("precond_rv")
+            p_uid = op.args.get("precond_uid")
+            if p_rv is not None and p_rv != rv:
+                return None
+            if p_uid is not None and p_uid != uid:
+                return None
+            if (
+                op.kind == "Pod"
+                and op.args.get("subresource") == "status"
+                and phase in TERMINAL_PHASES
+                and new_phase != phase
+            ):
+                # terminal write-once: a status patch may never resurrect a
+                # finished pod (the PR 2 contract patch_pod_status enforces;
+                # full-object force-PUTs — test fixtures playing kubelet —
+                # are deliberately exempt)
+                return None
+            return (True, new_rv, uid, new_phase)
+        if op.op == "delete":
+            return (False, new_rv, None, None)
+        return state  # unknown verb: recorded for completeness, no model
+
+
+class ModelDrift(RuntimeError):
+    """ModelStore produced a result StoreModel.apply rejects: the two
+    forms of the sequential spec disagree — a tooling bug, never a backend
+    finding."""
+
+
+class _ModelOp:
+    """Duck-typed OpRecord stand-in for the StoreModel cross-check."""
+
+    __slots__ = ("op", "kind", "args", "result")
+
+    def __init__(self, op: str, kind: str, args: Dict[str, Any],
+                 result: Dict[str, Any]):
+        self.op = op
+        self.kind = kind
+        self.args = args
+        self.result = result
+
+
+def _normalize(kind: str, d: Dict[str, Any]) -> Dict[str, Any]:
+    """Round-trip an encoded dict through the kind's dataclass so the
+    model stores exactly the pruned shape the backends return (default
+    fields dropped, aliases resolved) — dict equality against a backend's
+    ``encode(obj)`` is then exact, not modulo pruning."""
+    return encode(decode(kind, d))
+
+
+class ModelStore:
+    """Sequential reference store over encoded dicts. Same verb surface
+    and error classes as the three real backends; results come back as the
+    committed encoded object (a write) or raise the store error class —
+    exactly what the fuzzer normalizes backend results to."""
+
+    def __init__(self):
+        self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self._rv = 0
+        # every committed write, in commit order: (etype, kind, ns, name,
+        # rv, encoded-object) — the reference watch stream AND the ring
+        # model watch_resume diffs against
+        self.events: List[Tuple[str, str, str, str, int, Dict[str, Any]]] = []
+        # per-key abstract state for the StoreModel cross-check
+        self._abstract: Dict[Tuple[str, str, str], State] = {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    @staticmethod
+    def _key(kind: str, ns: str, name: str) -> Tuple[str, str, str]:
+        return (kind, ns, name)
+
+    @staticmethod
+    def _meta(d: Dict[str, Any]) -> Dict[str, Any]:
+        return d.get("metadata") or {}
+
+    @staticmethod
+    def _phase(d: Dict[str, Any]) -> Optional[str]:
+        ph = (d.get("status") or {}).get("phase")
+        return str(ph) if ph is not None else None
+
+    def current_rv(self) -> int:
+        return self._rv
+
+    def _emit(self, etype: str, kind: str, ns: str, name: str, rv: int,
+              obj: Dict[str, Any]) -> None:
+        self.events.append((etype, kind, ns, name, rv, obj))
+
+    def _cross_check(self, op: str, kind: str, ns: str, name: str,
+                     args: Dict[str, Any], result: Dict[str, Any]) -> None:
+        """Replay the op through StoreModel.apply; the two spec forms must
+        agree or the tooling itself is broken (ModelDrift)."""
+        key = self._key(kind, ns, name)
+        state = self._abstract.get(key, INITIAL)
+        nxt = StoreModel.apply(state, _ModelOp(op, kind, args, result))
+        if nxt is None:
+            raise ModelDrift(
+                f"ModelStore result for {op}({kind} {ns}/{name}, "
+                f"args={args!r}) -> {result!r} is rejected by "
+                f"StoreModel.apply in state {state!r}"
+            )
+        self._abstract[key] = nxt
+
+    def _ok_result(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        m = self._meta(obj)
+        out: Dict[str, Any] = {"rv": m.get("resource_version"),
+                               "uid": m.get("uid")}
+        ph = self._phase(obj)
+        if ph is not None:
+            out["phase"] = ph
+        return out
+
+    # -- verbs ---------------------------------------------------------------
+
+    def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        obj = _normalize(kind, obj)
+        m = self._meta(obj)
+        ns, name = m.get("namespace", "default"), m.get("name", "")
+        key = self._key(kind, ns, name)
+        if key in self._objects:
+            self._cross_check("create", kind, ns, name, {},
+                              {"error": "AlreadyExists"})
+            raise AlreadyExists(f"{kind} {ns}/{name} already exists")
+        rv = self._next_rv()
+        obj.setdefault("metadata", {})["resource_version"] = rv
+        obj = _normalize(kind, obj)
+        self._objects[key] = obj
+        self._emit("ADDED", kind, ns, name, rv, obj)
+        self._cross_check("create", kind, ns, name, {}, self._ok_result(obj))
+        return obj
+
+    def get(self, kind: str, ns: str, name: str) -> Dict[str, Any]:
+        key = self._key(kind, ns, name)
+        if key not in self._objects:
+            self._cross_check("get", kind, ns, name, {},
+                              {"error": "NotFound"})
+            raise NotFound(f"{kind} {ns}/{name} not found")
+        obj = self._objects[key]
+        self._cross_check("get", kind, ns, name, {}, self._ok_result(obj))
+        return obj
+
+    def update(self, kind: str, obj: Dict[str, Any],
+               force: bool = False) -> Dict[str, Any]:
+        obj = _normalize(kind, obj)
+        m = self._meta(obj)
+        ns, name = m.get("namespace", "default"), m.get("name", "")
+        key = self._key(kind, ns, name)
+        args = {"rv": m.get("resource_version", 0), "force": bool(force)}
+        if key not in self._objects:
+            self._cross_check("update", kind, ns, name, args,
+                              {"error": "NotFound"})
+            raise NotFound(f"{kind} {ns}/{name} not found")
+        cur_rv = self._meta(self._objects[key]).get("resource_version", 0)
+        if not force and m.get("resource_version", 0) != cur_rv:
+            self._cross_check("update", kind, ns, name, args,
+                              {"error": "Conflict"})
+            raise Conflict(
+                f"{kind} {ns}/{name}: resource_version "
+                f"{m.get('resource_version')} != {cur_rv}"
+            )
+        rv = self._next_rv()
+        obj["metadata"]["resource_version"] = rv
+        obj = _normalize(kind, obj)
+        self._objects[key] = obj
+        self._emit("MODIFIED", kind, ns, name, rv, obj)
+        self._cross_check("update", kind, ns, name, args,
+                          self._ok_result(obj))
+        return obj
+
+    def patch(self, kind: str, ns: str, name: str, patch: Any, *,
+              subresource: Optional[str] = None) -> Dict[str, Any]:
+        meta_patch = patch.get("metadata") if isinstance(patch, dict) else None
+        args: Dict[str, Any] = {"subresource": subresource}
+        if isinstance(meta_patch, dict):
+            if meta_patch.get("resource_version") is not None:
+                args["precond_rv"] = meta_patch["resource_version"]
+            if meta_patch.get("uid") is not None:
+                args["precond_uid"] = meta_patch["uid"]
+        key = self._key(kind, ns, name)
+        if key not in self._objects:
+            self._cross_check("patch", kind, ns, name, args,
+                              {"error": "NotFound"})
+            raise NotFound(f"{kind} {ns}/{name} not found")
+        cur = self._objects[key]
+        try:
+            merged = apply_merge_patch_dict(
+                kind, cur, patch, subresource=subresource,
+                current_rv=self._meta(cur).get("resource_version", 0),
+            )
+        except (BadPatch, Conflict) as e:
+            self._cross_check("patch", kind, ns, name, args,
+                              {"error": type(e).__name__})
+            raise
+        rv = self._next_rv()
+        # apply_merge_patch_dict returns a SHALLOW copy (its metadata dict
+        # is the stored object's): stamp the rv on a fresh metadata dict,
+        # or a same-key patch later in one patch_batch would mutate the
+        # result an earlier item already returned (the real backends
+        # deepcopy at their verb boundary; the model must be as careful)
+        merged = dict(merged, metadata=dict(merged.get("metadata") or {}))
+        merged["metadata"]["resource_version"] = rv
+        merged = _normalize(kind, merged)
+        self._objects[key] = merged
+        self._emit("MODIFIED", kind, ns, name, rv, merged)
+        self._cross_check("patch", kind, ns, name, args,
+                          self._ok_result(merged))
+        return merged
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        """The shared patch_batch contract (store.patch_batch_via_loop):
+        items apply IN ORDER, each atomic on its own, per-item errors as
+        exception VALUES — a mid-batch failure leaves the prefix applied
+        and never blocks the suffix."""
+        out: List[Any] = []
+        for it in items:
+            try:
+                if not isinstance(it, dict):
+                    raise BadPatch("batch item must be an object")
+                out.append(
+                    self.patch(
+                        it["kind"], it["namespace"], it["name"],
+                        it.get("patch"), subresource=it.get("subresource"),
+                    )
+                )
+            except (NotFound, Conflict, BadPatch) as e:
+                out.append(e)
+            except KeyError as e:
+                out.append(BadPatch(f"batch item missing {e}"))
+        return out
+
+    def delete(self, kind: str, ns: str, name: str) -> Dict[str, Any]:
+        key = self._key(kind, ns, name)
+        if key not in self._objects:
+            self._cross_check("delete", kind, ns, name, {},
+                              {"error": "NotFound"})
+            raise NotFound(f"{kind} {ns}/{name} not found")
+        obj = self._objects.pop(key)
+        # deletion consumes a resource_version (every backend does): watch
+        # events carry strictly increasing rvs, the resume anchor
+        rv = self._next_rv()
+        obj = dict(obj)
+        obj.setdefault("metadata", {})
+        obj["metadata"] = dict(obj["metadata"], resource_version=rv)
+        obj = _normalize(kind, obj)
+        self._emit("DELETED", kind, ns, name, rv, obj)
+        self._cross_check("delete", kind, ns, name, {},
+                          self._ok_result(obj))
+        return obj
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None
+             ) -> List[Dict[str, Any]]:
+        out = []
+        for (k, ns, _name), obj in self._objects.items():
+            if k != kind:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if selector:
+                lbls = self._meta(obj).get("labels") or {}
+                if any(lbls.get(sk) != sv for sk, sv in selector.items()):
+                    continue
+            out.append(obj)
+        out.sort(key=lambda o: (self._meta(o).get("namespace", ""),
+                                self._meta(o).get("name", "")))
+        return out
+
+    # -- final-state / watch views ------------------------------------------
+
+    def snapshot(self) -> Dict[Tuple[str, str, str], Dict[str, Any]]:
+        """The complete live state, keyed by (kind, ns, name) — the
+        final-state side of the differential diff."""
+        return dict(self._objects)
+
+    def watch_stream(self) -> List[Tuple[str, str, str, str, int]]:
+        """(etype, kind, ns, name, rv) per committed write, in commit
+        order — what a watcher registered before the first op must
+        deliver."""
+        return [(e, k, ns, n, rv) for (e, k, ns, n, rv, _o) in self.events]
+
+    # -- the http event-ring model (watch_resume oracle) ---------------------
+
+    def ring_dropped_rv(self, capacity: int) -> int:
+        """Highest rv trimmed out of a ring of ``capacity`` fed every
+        event since rv 0 (mirrors http_store._EventLog._dropped_rv)."""
+        n = len(self.events)
+        if n <= capacity:
+            return 0
+        return max(e[4] for e in self.events[: n - capacity])
+
+    def resume_after_rv(
+        self, rv: int, capacity: int
+    ) -> Optional[List[Tuple[str, str, str, str, int]]]:
+        """The spec of ``_EventLog.resume_after_rv`` for a server whose
+        ring (capacity ``capacity``, base rv 0) saw every model event:
+        the tail with object rv > ``rv``, or None when completeness is
+        not provable (anchor below the trim horizon or above everything
+        vouched for) — the caller must relist."""
+        if rv < self.ring_dropped_rv(capacity):
+            return None
+        if rv > self._rv:
+            return None
+        return [
+            (e, k, ns, n, erv)
+            for (e, k, ns, n, erv, _o) in self.events
+            if erv > rv
+        ]

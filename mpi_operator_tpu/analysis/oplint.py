@@ -37,6 +37,15 @@ Rule catalog (rationale → the PR that motivated each):
   PR 3's VERDICT found ``ctl logs`` shipping the admin bearer token over
   plain HTTP; secrets may be *presented* (Authorization headers) but never
   *printed* or baked into a URL.
+- **DUR001** a direct sqlite mutation — write-SQL ``execute``,
+  ``executescript``, ``commit()``, or a ``with conn:`` transaction block —
+  on a store connection outside the sanctioned ``_txn`` helper. ISSUE 6's
+  crash-point explorer (analysis/crashpoints.py) interposes on the
+  ``sqlite.txn``/``sqlite.commit`` seam that helper announces through; a
+  mutation that bypasses it is invisible to the explorer AND can split one
+  logical write across transactions — a crash between them strands an rv
+  with no object (the seeded mutant crashpoints.self_test proves is
+  caught). Read-only ``execute`` (SELECT, PRAGMA queries) is fine.
 - **LCK001** a blocking store/HTTP call made while holding a lock
   (AST-approximated: a ``with self._lock:`` body containing
   ``store.get/update/patch/list/...`` or ``urlopen``/``_request``).
@@ -148,6 +157,15 @@ RULES: Dict[str, Rule] = {
             "PR 3 VERDICT: the admin bearer token crossed plain HTTP; "
             "secrets are presented in headers, never printed or URL-baked",
             scope="all",
+        ),
+        Rule(
+            "DUR001", "error",
+            "sqlite mutation bypasses the sanctioned transaction helper",
+            "ISSUE 6: the ALICE crash-point explorer interposes on the "
+            "_txn seam; a mutation outside it is invisible to crash "
+            "exploration and can split one logical write across "
+            "transactions — a crash between them strands an rv with no "
+            "object behind it",
         ),
         Rule(
             "LCK001", "error",
@@ -407,6 +425,75 @@ def _check_blk001(ctx: _FileCtx, call: ast.Call, fn_stack: List[str]) -> None:
             )
 
 
+_CONN_COMPONENTS = ("conn", "connection")
+_SQL_WRITE_RE = re.compile(
+    r"^\s*(insert|update|delete|replace|create|drop|alter|begin|commit|"
+    r"vacuum|reindex|attach|detach)\b",
+    re.I,
+)
+_PRAGMA_SET_RE = re.compile(r"^\s*pragma\b[^=]*=", re.I)
+
+
+def _is_conn_like(recv: Optional[str]) -> bool:
+    last = _last_component(recv)
+    return last in _CONN_COMPONENTS or last.endswith("conn")
+
+
+def _in_txn_helper(fn_stack: List[str]) -> bool:
+    """The sanctioned transaction helper itself (and helpers that ARE the
+    seam, like a subclass override) may touch the connection directly."""
+    return any(name == "_txn" or name.endswith("_txn") for name in fn_stack)
+
+
+def _check_dur001_with(ctx: _FileCtx, node: ast.AST,
+                       fn_stack: List[str]) -> None:
+    """``with conn:`` is sqlite's transaction-commit context manager — a
+    commit the ``_txn`` seam never announces."""
+    if _in_txn_helper(fn_stack):
+        return
+    for item in node.items:
+        expr = item.context_expr
+        if _is_conn_like(_dotted(expr)):
+            ctx.report(
+                "DUR001", expr,
+                f"`with {_dotted(expr)}:` commits a transaction outside "
+                f"the sanctioned _txn helper; the crash-point explorer "
+                f"cannot see this seam — route the write through _txn",
+            )
+
+
+def _check_dur001(ctx: _FileCtx, call: ast.Call,
+                  fn_stack: List[str]) -> None:
+    if _in_txn_helper(fn_stack):
+        return
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return
+    recv = _dotted(f.value)
+    if not _is_conn_like(recv):
+        return
+    if f.attr in ("commit", "executescript"):
+        ctx.report(
+            "DUR001", call,
+            f"{recv}.{f.attr}(...) mutates the store file outside the "
+            f"sanctioned _txn helper; route the write through _txn so "
+            f"the crash-point explorer sees its commit seam",
+        )
+        return
+    if f.attr in ("execute", "executemany") and call.args:
+        sql = _const(call.args[0])
+        if isinstance(sql, str) and (
+            _SQL_WRITE_RE.match(sql) or _PRAGMA_SET_RE.match(sql)
+        ):
+            ctx.report(
+                "DUR001", call,
+                f"write SQL through {recv}.{f.attr}(...) outside the "
+                f"sanctioned _txn helper; an un-announced mutation can "
+                f"split one logical write across transactions — a crash "
+                f"between them strands an rv with no object",
+            )
+
+
 _LOCK_NAME_RE = re.compile(r"(^|_)(lock|mu|mutex|cond)$")
 _STORE_VERBS = {
     "get", "try_get", "update", "patch", "patch_batch", "list", "delete",
@@ -593,13 +680,14 @@ def lint_source(
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             fn_stack = fn_stack + [node.name]
             lock_depth = 0
-        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
-            _is_lock_expr(item.context_expr) for item in node.items
-        ):
-            lock_depth += 1
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            _check_dur001_with(ctx, node, fn_stack)
+            if any(_is_lock_expr(item.context_expr) for item in node.items):
+                lock_depth += 1
         if isinstance(node, ast.Call):
             _check_uid001(ctx, node)
             _check_blk001(ctx, node, fn_stack)
+            _check_dur001(ctx, node, fn_stack)
             if lock_depth > 0:
                 _check_lck001(ctx, node)
         if isinstance(node, ast.ExceptHandler):

@@ -48,6 +48,8 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
 
+from mpi_operator_tpu.analysis import allowlist
+
 ALLOWLIST_FILENAME = ".racecheck-allow"
 
 # the REAL factories, captured at import: the wrappers build on these and
@@ -450,35 +452,13 @@ class AllowRule:
 
 
 def parse_allowlist(text: str, path: str = ALLOWLIST_FILENAME) -> List[AllowRule]:
-    """Parse allowlist lines: ``<kind>:<spec>  <reason...>``. Blank lines
-    and ``#`` comments are skipped; a rule without a reason, or with an
-    unknown kind, is a hard error — the file's contract is that every
-    deliberate pattern names WHY it is deliberate."""
-    rules: List[AllowRule] = []
-    for lineno, raw in enumerate(text.splitlines(), 1):
-        line = raw.strip()
-        if not line or line.startswith("#"):
-            continue
-        head, _, reason = line.partition(" ")
-        kind, sep, spec = head.partition(":")
-        if not sep or not spec:
-            raise ValueError(
-                f"{path}:{lineno}: expected '<kind>:<spec> <reason>', "
-                f"got {line!r}"
-            )
-        if kind not in ("shared-state", "lock-cycle"):
-            raise ValueError(
-                f"{path}:{lineno}: unknown finding kind {kind!r} "
-                f"(shared-state | lock-cycle)"
-            )
-        reason = reason.strip()
-        if not reason:
-            raise ValueError(
-                f"{path}:{lineno}: allowlist entry {head!r} carries no "
-                f"reason — every deliberate pattern must say why"
-            )
-        rules.append(AllowRule(kind, spec, reason))
-    return rules
+    """The shared allowlist grammar (analysis.allowlist, same core
+    storecheck rides): blank lines and ``#`` comments skipped; a rule
+    without a reason, or with an unknown kind, is a hard error — the
+    file's contract is that every deliberate pattern names WHY."""
+    return allowlist.parse_rules(
+        text, path, ("shared-state", "lock-cycle"), AllowRule
+    )
 
 
 def load_allowlist(path: str) -> List[AllowRule]:
@@ -487,23 +467,10 @@ def load_allowlist(path: str) -> List[AllowRule]:
 
 
 def find_allowlist(start_dir: str) -> Optional[str]:
-    """Walk up from ``start_dir`` to the nearest .racecheck-allow (the
-    same nearest-wins resolution as pytest's rootdir), but never PAST a
-    repository boundary (.git / pytest.ini): a stray allowlist in a home
-    directory above the checkout must not silently suppress findings."""
-    d = os.path.abspath(start_dir)
-    while True:
-        cand = os.path.join(d, ALLOWLIST_FILENAME)
-        if os.path.isfile(cand):
-            return cand
-        if os.path.exists(os.path.join(d, ".git")) or os.path.isfile(
-            os.path.join(d, "pytest.ini")
-        ):
-            return None  # repo root reached without an allowlist
-        parent = os.path.dirname(d)
-        if parent == d:
-            return None
-        d = parent
+    """Nearest .racecheck-allow walking up from ``start_dir`` (pytest
+    rootdir resolution), never crossing the repository boundary — shared
+    with storecheck via analysis.allowlist."""
+    return allowlist.find_nearest(start_dir, ALLOWLIST_FILENAME)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1102 @@
+"""storecheck: model-differential fuzzing of the store seam.
+
+opcheck (PR 5) checks the store against its sequential spec — but only on
+histories the existing suites happen to produce. This module generates the
+histories: a **seeded generator** draws op sequences over the five store
+verbs + the status subresource + ``patch_batch`` + watch-ring resumes
+(valid/invalid rv and uid preconditions, label-selected lists,
+ring-boundary resume anchors, interleaved deletes/recreates) and executes
+each sequence identically against all three backends —
+
+- ``ObjectStore`` (in-memory),
+- ``SqliteStore`` (the durable file backend),
+- ``HttpStoreClient`` → ``StoreServer`` (the wire seam, small event ring),
+
+diffing **return values, error classes, final state and delivered watch
+streams** op-by-op against :class:`analysis.model.ModelStore`, the
+executable sequential reference (which itself cross-checks every result
+through ``StoreModel.apply``, so the fuzzer's oracle and the
+linearizability checker's oracle can never fork).
+
+Ops are **symbolic** (``{"rv": "stale"}``, ``{"anchor": "dropped-1"}``) and
+resolved against the model's state at execution time, so ANY subsequence
+of a generated sequence is itself executable — that is what makes
+delta-debug shrinking sound. A divergence is ddmin-shrunk to a minimal op
+subsequence and printed as a deterministic replay token::
+
+    v1:fuzz:<seed>:<op-indices>
+
+in the explore.py style: ``--replay`` re-executes the exact subsequence
+(twice-identical is asserted by the selftest), and every seeded mutant's
+minimal repro is pinned as JSON under ``tests/data/storecheck/``.
+
+The detector's own acceptance gate (:func:`self_test`): each seeded
+**mutant backend** — delete without an rv bump, patch that drops the uid
+pin, update that ignores the rv precondition, a status subresource that
+leaks spec writes, an event ring that replays one event past
+``_dropped_rv``, a batch that aborts at the first error — MUST be caught
+within the default budget, shrunk, and replay twice-identical; the three
+real backends MUST fuzz clean at the same budget. This is the standing
+acceptance harness ROADMAP item 1's replicated store will be run against:
+a replica set plugs into the same duck-typed surface and must diff clean
+against the same model.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import queue
+import random
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.analysis import allowlist
+from mpi_operator_tpu.analysis.model import TERMINAL_PHASES, ModelStore
+from mpi_operator_tpu.machinery.serialize import decode, encode
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    BadPatch,
+    Conflict,
+    NotFound,
+)
+
+TOKEN_VERSION = "v1"
+
+# the store error classes a differential outcome may name; anything else
+# escaping a backend is a harness failure, not a diff
+_STORE_ERRORS = (NotFound, AlreadyExists, Conflict, BadPatch)
+
+# fuzz-harness ring capacity: small enough that a default-budget sequence
+# trims it (ring-boundary resume anchors become meaningful), large enough
+# that the lock-step watch drain keeps the client cursor inside it
+RING_CAPACITY = 8
+
+_KINDS = ("Pod", "TPUJob", "Node")
+_NS = {"Pod": "default", "TPUJob": "default", "Node": "nodes"}
+_NAMES = ("a", "b", "c")
+_PHASES = ("Pending", "Running", "Succeeded", "Failed")
+_ANCHORS = ("dropped", "dropped-1", "dropped+1", "mid", "newest", "future")
+
+
+class FuzzError(RuntimeError):
+    """The fuzz machinery itself failed (bad token, harness bug) —
+    distinct from a Divergence, which is a finding."""
+
+
+@dataclass(frozen=True)
+class FuzzBudget:
+    """``sequences`` seeds derived from the base seed, ``ops`` symbolic
+    ops per sequence."""
+
+    sequences: int = 8
+    ops: int = 48
+
+
+FAST_BUDGET = FuzzBudget(sequences=3, ops=40)
+DEFAULT_BUDGET = FuzzBudget()
+EXHAUSTIVE_BUDGET = FuzzBudget(sequences=40, ops=96)
+
+
+# ---------------------------------------------------------------------------
+# generation (pure function of the seed: a stream of symbolic ops)
+# ---------------------------------------------------------------------------
+
+
+def generate(seed: int, n_ops: int) -> List[Dict[str, Any]]:
+    """The first ``n_ops`` symbolic ops of seed ``seed``'s stream. Draws
+    happen strictly per-op, so ``generate(seed, k)`` is a prefix of
+    ``generate(seed, n)`` for k <= n — replay tokens only need the seed
+    and the highest index."""
+    rng = random.Random(seed)
+    uid_seq = 0
+    ops: List[Dict[str, Any]] = []
+    for _ in range(n_ops):
+        kind = rng.choices(_KINDS, weights=(6, 2, 2))[0]
+        name = rng.choice(_NAMES)
+        verb = rng.choices(
+            ("create", "patch", "update", "delete", "get", "list",
+             "patch_batch", "watch_resume"),
+            weights=(18, 24, 10, 10, 8, 8, 12, 10),
+        )[0]
+        if verb == "create":
+            uid_seq += 1
+            ops.append({
+                "op": "create", "kind": kind, "name": name,
+                "uid": f"u{seed}-{uid_seq}",
+                "labels": {"job": rng.choice(("j1", "j2"))},
+            })
+        elif verb == "patch":
+            ops.append(_gen_patch(rng, kind, name))
+        elif verb == "update":
+            ops.append({
+                "op": "update", "kind": kind, "name": name,
+                "rv": rng.choices(("current", "stale", "future"),
+                                  weights=(6, 3, 1))[0],
+                "force": rng.random() < 0.15,
+                "label": ["bump", str(rng.randrange(10))],
+            })
+        elif verb == "delete":
+            ops.append({"op": "delete", "kind": kind, "name": name})
+        elif verb == "get":
+            ops.append({"op": "get", "kind": kind, "name": name})
+        elif verb == "list":
+            ops.append({
+                "op": "list", "kind": kind,
+                "namespace": rng.choice((None, _NS[kind])),
+                "selector": rng.choice(
+                    (None, {"job": "j1"}, {"job": "j2"})
+                ),
+            })
+        elif verb == "patch_batch":
+            items = [
+                _gen_patch(rng, rng.choices(_KINDS, weights=(6, 2, 2))[0],
+                           rng.choice(_NAMES))
+                for _ in range(rng.randrange(2, 5))
+            ]
+            ops.append({"op": "patch_batch", "items": items})
+        else:  # watch_resume (ring-boundary anchors; http backend only)
+            ops.append({
+                "op": "watch_resume", "anchor": rng.choice(_ANCHORS),
+            })
+    return ops
+
+
+def _gen_patch(rng: random.Random, kind: str, name: str) -> Dict[str, Any]:
+    sub = rng.random() < 0.55
+    shape = rng.choices(
+        ("status", "labels", "bad-spec-via-status", "bad-identity",
+         "bad-non-dict"),
+        weights=(10, 6, 2, 1, 1),
+    )[0]
+    if shape == "status":
+        changes: Dict[str, Any] = rng.choice((
+            {"phase": rng.choice(_PHASES)},
+            {"reason": rng.choice(("", "Evicted", "x"))},
+            {"message": f"m{rng.randrange(5)}"},
+            {"ready": rng.random() < 0.5},
+        ))
+        body: Dict[str, Any] = {"status": changes}
+        sub = True if "phase" in changes else sub
+    elif shape == "labels":
+        body = {"metadata": {"labels": {
+            rng.choice(("job", "extra")): rng.choice(("j1", "j2", None)),
+        }}}
+        sub = False
+    elif shape == "bad-spec-via-status":
+        body = {"spec": {"node_name": "stolen"}}
+        sub = True  # → BadPatch: the subresource freezes spec
+    elif shape == "bad-identity":
+        body = {"metadata": {"name": "forged"}}
+        sub = False  # → BadPatch: identity freeze
+    else:
+        body = "not-a-dict"  # type: ignore[assignment]
+        sub = False  # → BadPatch: malformed patch
+    return {
+        "op": "patch", "kind": kind, "name": name,
+        "rv": rng.choices((None, "current", "stale"), weights=(5, 3, 2))[0],
+        "uid": rng.choices((None, "current", "wrong"), weights=(5, 3, 2))[0],
+        "subresource": "status" if sub else None,
+        "body": body,
+    }
+
+
+# ---------------------------------------------------------------------------
+# resolution (symbolic → concrete, against the model's current state)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_rv(choice, cur_rv: int) -> Optional[int]:
+    if choice is None:
+        return None
+    if choice == "current":
+        return cur_rv
+    if choice == "stale":
+        return max(cur_rv - 1, 0)
+    return cur_rv + 100  # "future"
+
+
+def _resolve_patch(op: Dict[str, Any], model: ModelStore) -> Dict[str, Any]:
+    kind, name = op["kind"], op["name"]
+    ns = _NS[kind]
+    key = (kind, ns, name)
+    cur = model.snapshot().get(key)
+    cur_meta = (cur or {}).get("metadata", {})
+    cur_rv = cur_meta.get("resource_version", 0)
+    body = copy.deepcopy(op["body"])
+    if isinstance(body, dict):
+        status = body.get("status")
+        if (
+            kind == "Pod"
+            and op.get("subresource") == "status"
+            and isinstance(status, dict)
+            and "phase" in status
+        ):
+            # terminal write-once clamp: the SYSTEM spec (StoreModel /
+            # patch_pod_status) forbids resurrecting a terminal Pod phase;
+            # real clients never emit that op, so neither does the fuzzer.
+            # Clamping at resolution (not generation) keeps every
+            # subsequence executable.
+            cur_phase = ((cur or {}).get("status") or {}).get("phase")
+            if cur_phase in TERMINAL_PHASES:
+                status["phase"] = cur_phase
+        meta: Dict[str, Any] = {}
+        rv = _resolve_rv(op.get("rv"), cur_rv)
+        if rv is not None and rv > 0:
+            meta["resource_version"] = rv
+        if op.get("uid") == "current" and cur_meta.get("uid"):
+            meta["uid"] = cur_meta["uid"]
+        elif op.get("uid") == "wrong":
+            meta["uid"] = "u-bogus"
+        if meta:
+            body = dict(body, metadata={**meta, **body.get("metadata", {})})
+    return {
+        "op": "patch", "kind": kind, "ns": ns, "name": name,
+        "patch": body, "subresource": op.get("subresource"),
+    }
+
+
+def resolve(op: Dict[str, Any], model: ModelStore,
+            capacity: int = RING_CAPACITY) -> Dict[str, Any]:
+    """Resolve one symbolic op against the model state into the concrete
+    call every backend will receive — identical for all of them, because
+    resolution only ever consults the MODEL (a backend that drifted from
+    the model diverges at the comparison, not at resolution)."""
+    verb = op["op"]
+    kind = op.get("kind", "Pod")
+    ns = _NS.get(kind, "default")
+    if verb == "create":
+        return {
+            "op": "create", "kind": kind,
+            "obj": {
+                "kind": kind,
+                "metadata": {
+                    "name": op["name"], "namespace": ns, "uid": op["uid"],
+                    "labels": dict(op.get("labels") or {}),
+                    # pre-stamped so no backend falls back to time.time()
+                    "creation_timestamp": 1000.0,
+                },
+            },
+        }
+    if verb == "get":
+        return {"op": "get", "kind": kind, "ns": ns, "name": op["name"]}
+    if verb == "delete":
+        return {"op": "delete", "kind": kind, "ns": ns, "name": op["name"]}
+    if verb == "list":
+        return {
+            "op": "list", "kind": kind, "namespace": op.get("namespace"),
+            "selector": op.get("selector"),
+        }
+    if verb == "update":
+        key = (kind, ns, op["name"])
+        cur = model.snapshot().get(key)
+        if cur is None:
+            obj = {
+                "kind": kind,
+                "metadata": {"name": op["name"], "namespace": ns,
+                             "uid": "u-ghost", "resource_version": 1,
+                             "creation_timestamp": 1000.0},
+            }
+        else:
+            obj = copy.deepcopy(cur)
+            labels = obj.setdefault("metadata", {}).setdefault("labels", {})
+            labels[op["label"][0]] = op["label"][1]
+            obj["metadata"]["resource_version"] = _resolve_rv(
+                op["rv"], obj["metadata"].get("resource_version", 0)
+            )
+        return {"op": "update", "kind": kind, "obj": obj,
+                "force": bool(op.get("force"))}
+    if verb == "patch":
+        return _resolve_patch(op, model)
+    if verb == "patch_batch":
+        # items resolve against the state as the PREFIX of the batch leaves
+        # it (the applied-prefix contract), via a scratch model clone
+        scratch = copy.deepcopy(model)
+        items = []
+        for item in op["items"]:
+            c = _resolve_patch(item, scratch)
+            items.append({
+                "kind": c["kind"], "namespace": c["ns"], "name": c["name"],
+                "patch": c["patch"], "subresource": c["subresource"],
+            })
+            try:
+                scratch.patch(c["kind"], c["ns"], c["name"], c["patch"],
+                              subresource=c["subresource"])
+            except _STORE_ERRORS:
+                pass
+        return {"op": "patch_batch", "items": items}
+    if verb == "watch_resume":
+        dropped = model.ring_dropped_rv(capacity)
+        newest = model.current_rv()
+        anchor = {
+            "dropped": dropped,
+            "dropped-1": max(dropped - 1, 0),
+            "dropped+1": min(dropped + 1, newest),
+            "mid": (dropped + newest) // 2,
+            "newest": newest,
+            "future": newest + 50,
+        }[op["anchor"]]
+        return {
+            "op": "watch_resume", "anchor": anchor, "capacity": capacity,
+            # ring catch-up target: every model event must be in the
+            # server log before the resume is meaningful
+            "expected_head": len(model.events),
+        }
+    raise FuzzError(f"unknown symbolic op {verb!r}")
+
+
+# ---------------------------------------------------------------------------
+# execution + outcome normalization
+# ---------------------------------------------------------------------------
+
+
+def _norm_exc(e: Exception) -> Dict[str, Any]:
+    return {"error": type(e).__name__}
+
+
+def _exec_model(model: ModelStore, c: Dict[str, Any]) -> Dict[str, Any]:
+    verb = c["op"]
+    try:
+        if verb == "create":
+            return {"ok": model.create(c["kind"], c["obj"])}
+        if verb == "get":
+            return {"ok": model.get(c["kind"], c["ns"], c["name"])}
+        if verb == "update":
+            return {"ok": model.update(c["kind"], c["obj"], c["force"])}
+        if verb == "patch":
+            return {"ok": model.patch(c["kind"], c["ns"], c["name"],
+                                      c["patch"],
+                                      subresource=c["subresource"])}
+        if verb == "delete":
+            return {"ok": model.delete(c["kind"], c["ns"], c["name"])}
+        if verb == "list":
+            return {"list": model.list(c["kind"], c["namespace"],
+                                       c["selector"])}
+        if verb == "patch_batch":
+            return {"batch": [
+                _norm_exc(r) if isinstance(r, Exception) else {"ok": r}
+                for r in model.patch_batch(c["items"])
+            ]}
+        if verb == "watch_resume":
+            tail = model.resume_after_rv(c["anchor"], c["capacity"])
+            if tail is None:
+                return {"relist": _relist_view(model.snapshot().values())}
+            return {"resume": [list(t) for t in tail]}
+    except _STORE_ERRORS as e:
+        return _norm_exc(e)
+    raise FuzzError(f"unknown concrete op {verb!r}")
+
+
+def _relist_view(objs) -> List[List[Any]]:
+    out = []
+    for o in objs:
+        m = o.get("metadata") or {}
+        out.append([o.get("kind"), m.get("namespace"), m.get("name"),
+                    m.get("resource_version")])
+    return sorted(out)
+
+
+@dataclass
+class Harness:
+    """One backend under test: the duck-typed store client, its watch
+    queue, and (HTTP only) the server whose event ring serves resumes.
+    The watch is LAZY (``start_watch``): shrink probes that only diff op
+    results skip it, which keeps ddmin from paying a watch-poller
+    bootstrap + teardown per probe."""
+
+    name: str
+    store: Any
+    server: Any = None
+    teardown: Callable[[], None] = lambda: None
+    watch_fn: Optional[Callable[[], Any]] = None
+    watch_q: Any = None
+    delivered: List[Tuple[str, str, str, str, int]] = field(
+        default_factory=list
+    )
+
+    def start_watch(self) -> None:
+        """Register the watch — must run BEFORE the first op so the
+        delivered stream covers every event."""
+        if self.watch_q is None and self.watch_fn is not None:
+            self.watch_q = self.watch_fn()
+
+    def drain_watch(self, expected: int, timeout: float = 5.0) -> None:
+        """Lock-step drain: pull delivered events until ``expected`` have
+        arrived (or the deadline passes — the comparison then surfaces the
+        shortfall). Keeping the client caught up after every op also keeps
+        its cursor inside the small fuzz ring, so the delivered stream
+        never legally relists mid-sequence."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while len(self.delivered) < expected:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                ev = self.watch_q.get(timeout=min(remaining, 0.25))
+            except queue.Empty:
+                continue
+            m = ev.obj.metadata
+            self.delivered.append(
+                (ev.type, ev.kind, m.namespace, m.name, m.resource_version)
+            )
+
+
+def _exec_backend(h: Harness, c: Dict[str, Any]) -> Dict[str, Any]:
+    verb = c["op"]
+    store = h.store
+    try:
+        if verb == "create":
+            return {"ok": encode(store.create(decode(c["kind"], c["obj"])))}
+        if verb == "get":
+            return {"ok": encode(store.get(c["kind"], c["ns"], c["name"]))}
+        if verb == "update":
+            # oplint: disable=RMW001 — the differential harness MUST
+            # drive the raw verbs (stale/forced updates included): the
+            # rv-precondition behavior under test IS the get+update race
+            # the rule bans in control-plane code
+            return {"ok": encode(store.update(decode(c["kind"], c["obj"]),
+                                              c["force"]))}
+        if verb == "patch":
+            return {"ok": encode(store.patch(
+                c["kind"], c["ns"], c["name"], c["patch"],
+                subresource=c["subresource"],
+            ))}
+        if verb == "delete":
+            return {"ok": encode(store.delete(c["kind"], c["ns"],
+                                              c["name"]))}
+        if verb == "list":
+            return {"list": [encode(o) for o in store.list(
+                c["kind"], c["namespace"], c["selector"])]}
+        if verb == "patch_batch":
+            return {"batch": [
+                _norm_exc(r) if isinstance(r, Exception)
+                else {"ok": encode(r)}
+                for r in store.patch_batch(c["items"])
+            ]}
+        if verb == "watch_resume":
+            if h.server is None:
+                return {"skipped": True}
+            return _exec_resume(h, c)
+    except _STORE_ERRORS as e:
+        return _norm_exc(e)
+    raise FuzzError(f"unknown concrete op {verb!r}")
+
+
+def probe_resume(url: str, anchor: int, *, wait: float = 0.05,
+                 timeout: float = 10.0) -> Dict[str, Any]:
+    """One raw rv-anchored watch (re)registration against a store server
+    — the ``?resource_version=`` wire probe, shared by the fuzzer, the
+    crash-point explorer and the boundary tests so the query contract
+    lives in ONE place. Returns the parsed payload: ``{"events": [...]}``
+    (a provably-complete tail) or ``{"relist": [...]}`` (410 Gone)."""
+    with urllib.request.urlopen(
+        f"{url}/v1/watch?after=-1&resource_version={anchor}"
+        f"&timeout={wait}",
+        timeout=timeout,
+    ) as r:
+        return json.loads(r.read())
+
+
+def _exec_resume(h: Harness, c: Dict[str, Any]) -> Dict[str, Any]:
+    """An rv-anchored (re)registration against the server's event ring —
+    the ?resource_version= contract: a provably-complete tail, or a
+    relist (410 Gone)."""
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
+    while h.server._log.head < c["expected_head"]:
+        if _time.monotonic() > deadline:
+            raise FuzzError("server event ring never caught up")
+        _time.sleep(0.002)
+    payload = probe_resume(h.server.url, c["anchor"])
+    if "relist" in payload:
+        return {"relist": _relist_view(payload["relist"])}
+    return {"resume": [
+        [e["type"], e["kind"],
+         (e["object"].get("metadata") or {}).get("namespace"),
+         (e["object"].get("metadata") or {}).get("name"), e["rv"]]
+        for e in payload["events"]
+    ]}
+
+
+# ---------------------------------------------------------------------------
+# backend factories (real + seeded mutants)
+# ---------------------------------------------------------------------------
+
+
+def _mk_memory() -> Harness:
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    s = ObjectStore()
+    return Harness("memory", s, watch_fn=lambda: s.watch(None))
+
+
+def _mk_sqlite() -> Harness:
+    import os
+    import tempfile
+
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    d = tempfile.mkdtemp(prefix="storecheck-")
+    s = SqliteStore(os.path.join(d, "fuzz.db"), poll_interval=0.01)
+
+    def teardown():
+        import shutil
+
+        s.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+    return Harness("sqlite", s, teardown=teardown,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_http() -> Harness:
+    from mpi_operator_tpu.machinery.http_store import (
+        HttpStoreClient,
+        StoreServer,
+    )
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    srv = StoreServer(ObjectStore(), "127.0.0.1", 0,
+                      log_capacity=RING_CAPACITY).start()
+    client = HttpStoreClient(srv.url, watch_poll_timeout=0.5)
+
+    def teardown():
+        client.close()
+        srv.stop()
+
+    return Harness("http", client, server=srv, teardown=teardown,
+                   watch_fn=lambda: client.watch(None))
+
+
+REAL_BACKENDS: Dict[str, Callable[[], Harness]] = {
+    "memory": _mk_memory,
+    "sqlite": _mk_sqlite,
+    "http": _mk_http,
+}
+
+
+def _mk_mutant_delete_no_rv_bump() -> Harness:
+    """Seeded bug: delete removes the object but reuses its LAST rv on
+    the DELETED event instead of consuming a fresh one — the exact
+    skippable-deletion bug the rv-bump-on-delete contract (PR 1) exists
+    to prevent."""
+    from mpi_operator_tpu.machinery.store import DELETED, ObjectStore
+
+    class Mutant(ObjectStore):
+        def delete(self, kind, namespace, name):
+            with self._lock:
+                k = self._key(kind, namespace, name)
+                if k not in self._objects:
+                    raise NotFound(f"{kind} {namespace}/{name} not found")
+                obj = self._objects.pop(k)
+                self._notify(DELETED, kind, obj)
+                return obj.deepcopy()
+
+    s = Mutant()
+    return Harness("mutant-delete-no-rv-bump", s,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_mutant_patch_drops_uid_pin() -> Harness:
+    """Seeded bug: the patch verb silently discards the metadata.uid
+    precondition — the incarnation guard every agent-tier status write
+    rides (PR 2's authz-to-apply pin)."""
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    class Mutant(ObjectStore):
+        def patch(self, kind, namespace, name, patch, *, subresource=None):
+            if isinstance(patch, dict) and isinstance(
+                patch.get("metadata"), dict
+            ):
+                patch = dict(patch)
+                patch["metadata"] = {
+                    k: v for k, v in patch["metadata"].items() if k != "uid"
+                }
+                if not patch["metadata"]:
+                    del patch["metadata"]
+            return super().patch(kind, namespace, name, patch,
+                                 subresource=subresource)
+
+    s = Mutant()
+    return Harness("mutant-patch-drops-uid-pin", s,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_mutant_update_ignores_rv() -> Harness:
+    """Seeded bug: every update is silently forced — the lost-update
+    clobber the rv precondition exists to prevent."""
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    class Mutant(ObjectStore):
+        def update(self, obj, force=False):
+            return super().update(obj, force=True)
+
+    s = Mutant()
+    return Harness("mutant-update-ignores-rv", s,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_mutant_status_leaks_spec() -> Harness:
+    """Seeded bug: the status subresource forgets to freeze spec/metadata
+    (applies the patch as a plain merge) — the NODE-tier containment
+    (patch-status-only) would silently stop containing."""
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    class Mutant(ObjectStore):
+        def patch(self, kind, namespace, name, patch, *, subresource=None):
+            return super().patch(kind, namespace, name, patch,
+                                 subresource=None)
+
+    s = Mutant()
+    return Harness("mutant-status-leaks-spec", s,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_mutant_batch_aborts_on_error() -> Harness:
+    """Seeded bug: patch_batch stops applying at the first per-item error
+    and fabricates NotFound for the suffix — breaking the applied-prefix
+    + per-item-results contract (one dead pod's mirror would take the
+    heartbeat riding behind it down with it)."""
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    class Mutant(ObjectStore):
+        def patch_batch(self, items):
+            out: List[Any] = []
+            failed = False
+            for it in items:
+                if failed:
+                    out.append(NotFound("batch aborted"))
+                    continue
+                try:
+                    out.append(self.patch(
+                        it["kind"], it["namespace"], it["name"],
+                        it.get("patch"), subresource=it.get("subresource"),
+                    ))
+                except _STORE_ERRORS as e:
+                    out.append(e)
+                    failed = True
+            return out
+
+    s = Mutant()
+    return Harness("mutant-batch-aborts-on-error", s,
+                   watch_fn=lambda: s.watch(None))
+
+
+def _mk_mutant_ring_replays_past_dropped() -> Harness:
+    """Seeded bug: the event ring serves an rv-anchored resume one event
+    PAST the trim horizon (``rv < _dropped_rv - 1`` instead of
+    ``rv < _dropped_rv``) — the replayed tail silently misses the trimmed
+    event, exactly the lost-deletion class the 410-relist contract
+    exists to prevent."""
+    h = _mk_http()
+    log = h.server._log
+    orig = type(log).resume_after_rv
+
+    def mutant_resume(rv):
+        with log._cond:
+            dropped = log._dropped_rv
+        if dropped and rv == dropped - 1:
+            # lie: pretend the ring still proves completeness here
+            log._dropped_rv = dropped - 1
+            try:
+                return orig(log, rv)
+            finally:
+                log._dropped_rv = dropped
+        return orig(log, rv)
+
+    log.resume_after_rv = mutant_resume
+    return Harness("mutant-ring-replays-past-dropped", h.store,
+                   server=h.server, teardown=h.teardown,
+                   watch_fn=h.watch_fn)
+
+
+MUTANTS: Dict[str, Callable[[], Harness]] = {
+    "delete-no-rv-bump": _mk_mutant_delete_no_rv_bump,
+    "patch-drops-uid-pin": _mk_mutant_patch_drops_uid_pin,
+    "update-ignores-rv": _mk_mutant_update_ignores_rv,
+    "status-leaks-spec": _mk_mutant_status_leaks_spec,
+    "batch-aborts-on-error": _mk_mutant_batch_aborts_on_error,
+    "ring-replays-past-dropped": _mk_mutant_ring_replays_past_dropped,
+}
+
+
+# ---------------------------------------------------------------------------
+# the differential run
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Divergence:
+    backend: str
+    op_index: int  # index into the EXECUTED subsequence (-1 = final state)
+    where: str  # "result" | "watch" | "final-state"
+    expected: str
+    actual: str
+
+    def render(self) -> str:
+        at = ("final state" if self.op_index < 0
+              else f"op[{self.op_index}] ({self.where})")
+        return (
+            f"{self.backend} diverged from the sequential model at {at}\n"
+            f"    model:   {self.expected}\n"
+            f"    backend: {self.actual}"
+        )
+
+
+def _short(v: Any, cap: int = 400) -> str:
+    s = json.dumps(v, sort_keys=True, default=str)
+    return s if len(s) <= cap else s[:cap] + "..."
+
+
+def run_ops(
+    factory: Callable[[], Harness],
+    ops: List[Dict[str, Any]],
+    *,
+    check_watch: bool = True,
+) -> Optional[Divergence]:
+    """Execute a (sub)sequence of symbolic ops against one backend and the
+    model in lockstep; return the FIRST divergence (or None). Fresh model
+    and fresh backend per call — re-execution is what makes shrinking and
+    replay sound."""
+    model = ModelStore()
+    h = factory()
+    try:
+        if check_watch:
+            h.start_watch()
+        for i, op in enumerate(ops):
+            c = resolve(op, model)
+            if c["op"] == "watch_resume" and h.server is None:
+                continue  # ring resumes only exist on the wire seam
+            want = _exec_model(model, c)
+            got = _exec_backend(h, c)
+            if want != got:
+                return Divergence(h.name, i, "result", _short(want),
+                                  _short(got))
+            if check_watch and h.watch_q is not None:
+                h.drain_watch(len(model.events))
+                want_w = model.watch_stream()
+                got_w = [list(t) for t in h.delivered]
+                if [list(t) for t in want_w] != got_w:
+                    return Divergence(
+                        h.name, i, "watch",
+                        _short([list(t) for t in want_w]), _short(got_w),
+                    )
+        # final state: every kind's full list must match the model exactly
+        for kind in _KINDS:
+            want_l = model.list(kind)
+            got_l = [encode(o) for o in h.store.list(kind)]
+            if want_l != got_l:
+                return Divergence(h.name, -1, "final-state",
+                                  _short(want_l), _short(got_l))
+        return None
+    finally:
+        h.teardown()
+
+
+# ---------------------------------------------------------------------------
+# shrinking + tokens
+# ---------------------------------------------------------------------------
+
+
+def encode_token(seed: int, indices: List[int]) -> str:
+    return f"{TOKEN_VERSION}:fuzz:{seed}:{','.join(map(str, indices))}"
+
+
+def decode_token(token: str) -> Tuple[int, List[int]]:
+    try:
+        version, tag, seed, body = token.split(":", 3)
+        if version != TOKEN_VERSION or tag != "fuzz":
+            raise ValueError(f"not a {TOKEN_VERSION}:fuzz token")
+        indices = [int(p) for p in body.split(",") if p]
+        if not indices or indices != sorted(set(indices)):
+            raise ValueError("indices must be strictly increasing")
+        return int(seed), indices
+    except ValueError as e:
+        raise FuzzError(f"bad replay token {token!r}: {e}") from None
+
+
+def ops_for_token(token: str) -> List[Dict[str, Any]]:
+    seed, indices = decode_token(token)
+    full = generate(seed, max(indices) + 1)
+    return [full[i] for i in indices]
+
+
+def shrink(
+    factory: Callable[[], Harness],
+    full: List[Dict[str, Any]],
+    indices: List[int],
+    *,
+    check_watch: bool = True,
+) -> List[int]:
+    """ddmin-lite: greedily remove chunks (halving granularity) of the
+    index set while the subsequence still diverges. Minimal in the 1-op
+    removal sense — removing ANY single remaining op loses the repro.
+    Probes skip the watch-stream diff unless the original divergence was
+    a watch divergence (an op-result repro doesn't need the watch, and a
+    probe without one skips the whole poller bootstrap/teardown)."""
+
+    def fails(idx: List[int]) -> bool:
+        return run_ops(
+            factory, [full[i] for i in idx], check_watch=check_watch
+        ) is not None
+
+    n = 2
+    while len(indices) >= 2:
+        chunk = max(1, (len(indices) + n - 1) // n)
+        reduced = False
+        for start in range(0, len(indices), chunk):
+            candidate = indices[:start] + indices[start + chunk:]
+            if candidate and fails(candidate):
+                indices = candidate
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if chunk <= 1:
+                break
+            n = min(n * 2, len(indices))
+    return indices
+
+
+# ---------------------------------------------------------------------------
+# findings allowlist (.storecheck-allow, racecheck-allow precedence rules)
+# ---------------------------------------------------------------------------
+
+
+ALLOWLIST_FILENAME = ".storecheck-allow"
+
+
+@dataclass(frozen=True)
+class AllowRule:
+    """One allowlist entry: ``<kind>:<spec>  <reason>``. ``kind`` is
+    ``fuzz`` (spec matched as a substring of a divergence's rendered
+    location, e.g. a backend name) or ``crash`` (``torn-tail`` gates the
+    synchronous=NORMAL acked-loss class). ``reason`` is MANDATORY — an
+    unexplained suppression is exactly the review smell this file exists
+    to eliminate (same contract as .racecheck-allow)."""
+
+    kind: str
+    spec: str
+    reason: str
+
+    def matches(self, finding: Any) -> bool:
+        if self.kind == "fuzz" and isinstance(finding, Divergence):
+            return self.spec in f"{finding.backend}:{finding.where}"
+        return False
+
+
+def parse_allowlist(text: str,
+                    path: str = ALLOWLIST_FILENAME) -> List[AllowRule]:
+    """The shared allowlist grammar (analysis.allowlist, same core
+    racecheck rides): blank lines and ``#`` comments skipped; a rule
+    without a reason, or with an unknown kind, is a hard error."""
+    return allowlist.parse_rules(text, path, ("fuzz", "crash"), AllowRule)
+
+
+def load_allowlist(path: str) -> List[AllowRule]:
+    with open(path, encoding="utf-8") as f:
+        return parse_allowlist(f.read(), path)
+
+
+def find_allowlist(start_dir: str) -> Optional[str]:
+    """Nearest .storecheck-allow walking up from ``start_dir``, stopping
+    at the repository boundary (shared resolution with racecheck: a stray
+    allowlist ABOVE the checkout must not gate the torn-tail class)."""
+    return allowlist.find_nearest(start_dir, ALLOWLIST_FILENAME)
+
+
+# ---------------------------------------------------------------------------
+# fuzz driver + reports
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuzzFinding:
+    backend: str
+    seed: int
+    token: str
+    ops: List[Dict[str, Any]]
+    divergence: Divergence
+
+    def render(self) -> str:
+        return (
+            f"storecheck fuzz: {self.divergence.render()}\n"
+            f"  minimal repro ({len(self.ops)} op(s)):\n"
+            + "".join(f"    {json.dumps(o, sort_keys=True)}\n"
+                      for o in self.ops)
+            + f"  replay token: {self.token}"
+        )
+
+
+@dataclass
+class FuzzReport:
+    ok: bool
+    sequences: int
+    backends: List[str]
+    finding: Optional[FuzzFinding] = None
+    # allowlisted divergences, skipped-and-continued (racecheck's
+    # "allowed findings print informationally" semantics): (seed,
+    # divergence, gating reason)
+    allowed: List[Tuple[int, Divergence, str]] = field(default_factory=list)
+
+    def render(self) -> str:
+        if self.ok:
+            lines = [
+                f"storecheck fuzz: ok — {self.sequences} sequence(s) over "
+                f"{', '.join(self.backends)}: no divergence from the "
+                f"sequential model"
+            ]
+        else:
+            lines = [f"storecheck fuzz: FAILED\n{self.finding.render()}"]
+        for s, div, reason in self.allowed:
+            lines.append(
+                f"  allowed (fuzz, seed {s}): {div.backend}:{div.where} "
+                f"— {reason}"
+            )
+        return "\n".join(lines)
+
+
+def fuzz(
+    factories: Optional[Dict[str, Callable[[], Harness]]] = None,
+    *,
+    seed: int = 0,
+    budget: FuzzBudget = DEFAULT_BUDGET,
+    allowlist: Optional[List[AllowRule]] = None,
+) -> FuzzReport:
+    """Fuzz every backend in ``factories`` (default: the three real ones)
+    within budget; on the first non-allowlisted divergence, shrink it,
+    mint the replay token, verify twice-identical re-execution, and stop.
+    A divergence an ``allowlist`` rule gates is recorded informationally
+    and that (sequence, backend) pair is skipped — the REST of the budget
+    still runs (a gated wire quirk must not hide a fresh sqlite bug later
+    in the budget)."""
+    factories = dict(factories or REAL_BACKENDS)
+    runs = 0
+    allowed: List[Tuple[int, Divergence, str]] = []
+    for s in range(seed, seed + budget.sequences):
+        full = generate(s, budget.ops)
+        all_indices = list(range(len(full)))
+        for name, factory in factories.items():
+            runs += 1
+            div = run_ops(factory, full)
+            if div is None:
+                continue
+            gate = next(
+                (r for r in (allowlist or [])
+                 if r.kind == "fuzz" and r.matches(div)),
+                None,
+            )
+            if gate is not None:
+                allowed.append((s, div, gate.reason))
+                continue
+            # everything after the diverging op is noise: truncate before
+            # ddmin (run_ops stops at the first divergence, so op_index
+            # names a prefix of the executed sequence)
+            prefix = (all_indices if div.op_index < 0
+                      else all_indices[: div.op_index + 1])
+            minimal = shrink(factory, full, prefix,
+                             check_watch=div.where == "watch")
+            token = encode_token(s, minimal)
+            finding = replay(token, factory)
+            if finding is None:
+                # `token` is a v1:fuzz replay token (seed + op indices),
+                # not a credential; printing it is the whole point of
+                # deterministic replay — hence the SEC001 disable.
+                raise FuzzError(
+                    f"shrunk token {token} no longer "  # oplint: disable=SEC001
+                    f"reproduces (nondeterministic divergence on {name}?)"
+                )
+            return FuzzReport(False, runs, sorted(factories),
+                              finding=finding, allowed=allowed)
+    return FuzzReport(True, runs, sorted(factories), allowed=allowed)
+
+
+def replay(
+    token: str,
+    factory: Callable[[], Harness],
+) -> Optional[FuzzFinding]:
+    """Re-execute the exact subsequence a token encodes against one
+    backend factory; returns the finding (or None when it runs clean)."""
+    seed, indices = decode_token(token)
+    ops = ops_for_token(token)
+    div = run_ops(factory, ops)
+    if div is None:
+        return None
+    return FuzzFinding(div.backend, seed, token, ops, div)
+
+
+def fixture_for_mutant(name: str,
+                       budget: FuzzBudget = DEFAULT_BUDGET) -> Dict[str, Any]:
+    """Fuzz one seeded mutant to its minimal pinned repro — the JSON shape
+    stored under tests/data/storecheck/ (regenerate a drifted corpus with
+    :func:`mint_mutant_fixtures`)."""
+    report = fuzz({name: MUTANTS[name]}, budget=budget)
+    if report.ok:
+        raise FuzzError(f"mutant {name} not caught within "
+                        f"{budget.sequences}x{budget.ops}")
+    f = report.finding
+    return {
+        "mutant": name,
+        "token": f.token,
+        "ops": f.ops,
+        "divergence": {
+            "backend": f.divergence.backend,
+            "op_index": f.divergence.op_index,
+            "where": f.divergence.where,
+        },
+    }
+
+
+def mint_mutant_fixtures(outdir: str) -> List[str]:
+    """(Re)write the pinned minimal-repro corpus: one JSON per seeded
+    mutant. Run after a deliberate generator/model change::
+
+        python -c "from mpi_operator_tpu.analysis.storecheck import \\
+            mint_mutant_fixtures; mint_mutant_fixtures('tests/data/storecheck')"
+    """
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for name in MUTANTS:
+        path = os.path.join(outdir, f"{name}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(fixture_for_mutant(name), f, indent=2, sort_keys=True)
+            f.write("\n")
+        written.append(path)
+    return written
+
+
+def self_test(budget: FuzzBudget = DEFAULT_BUDGET) -> List[str]:
+    """The fuzzer's acceptance gate: every seeded mutant is caught within
+    the budget, its minimal repro replays twice-identically from the
+    token, and the three real backends fuzz clean at the SAME budget —
+    the exact run `python -m ... fuzz` performs at defaults, so the gate
+    and the plain CLI can never disagree on what clean means."""
+    failures: List[str] = []
+    for name, factory in MUTANTS.items():
+        report = fuzz({name: factory}, budget=budget)
+        if report.ok:
+            failures.append(
+                f"seeded mutant {name} was NOT caught within budget "
+                f"({budget.sequences}x{budget.ops})"
+            )
+            continue
+        f = report.finding
+        first = replay(f.token, factory)
+        second = replay(f.token, factory)
+        if first is None or second is None:
+            failures.append(f"mutant {name}: token {f.token} did not "
+                            f"replay to a divergence")
+        elif first.divergence != second.divergence:
+            failures.append(f"mutant {name}: token {f.token} replays "
+                            f"diverged (nondeterminism)")
+    clean = fuzz(seed=0, budget=budget)
+    if not clean.ok:
+        failures.append(
+            "real backends must fuzz clean: " + clean.finding.render()
+        )
+    return failures

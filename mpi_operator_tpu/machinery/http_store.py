@@ -607,7 +607,12 @@ class StoreServer:
             target=self._drain_loop, name="http-store-drain", daemon=True
         )
         self._serve = threading.Thread(
-            target=self._httpd.serve_forever, name="http-store-serve", daemon=True
+            # tight shutdown poll: serve_forever's default 0.5s poll makes
+            # every stop() block half a second — felt by each failover
+            # restart and by harnesses (storecheck ddmin) that cycle
+            # hundreds of servers
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="http-store-serve", daemon=True,
         )
 
     # -- lifecycle ----------------------------------------------------------

@@ -23,6 +23,7 @@ delete/list/watch.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -100,10 +101,25 @@ class SqliteStore:
         self._conn = sqlite3.connect(
             self.path, check_same_thread=False, timeout=30.0
         )
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=NORMAL")
-        with self._lock, self._conn:
-            self._conn.executescript(_SCHEMA)
+        # durability stance (documented in README "Fuzzing the store
+        # seam"): WAL + synchronous=NORMAL. A PROCESS crash (SIGKILL —
+        # what the chaos plane injects) loses nothing: every commit's WAL
+        # frames are in the OS page cache. An OS/power crash may lose the
+        # newest commits (the WAL tail is not fsynced per commit) but
+        # never corrupts: recovery lands on a committed PREFIX. The
+        # crash-point explorer (analysis/crashpoints.py) pins both halves
+        # of this contract — exact snapshots must keep every acked write
+        # at its exact rv; torn-tail snapshots model the unsynced-tail
+        # loss and are the gated `crash:torn-tail` allowlist exception.
+        # Both pragmas are the init-time durability stance, set before
+        # any data exists and before a yieldpoints hook can be attached;
+        # not transactions the crash-point explorer needs to see (it
+        # snapshots AFTER open, when both have landed) — hence the
+        # per-line DUR001 disables.
+        self._conn.execute("PRAGMA journal_mode=WAL")  # oplint: disable=DUR001
+        self._conn.execute("PRAGMA synchronous=NORMAL")  # oplint: disable=DUR001
+        with self._txn("schema") as cur:
+            cur.executescript(_SCHEMA)
         # probe JSON1 exactly once, at init: selector lists compile to
         # json_each SQL only when the build has it. Probing here (not by
         # catching OperationalError in list()) matters because transient
@@ -126,6 +142,23 @@ class SqliteStore:
 
     # -- helpers -------------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _txn(self, what: str = ""):
+        """THE sanctioned write transaction: every mutation of the sqlite
+        file goes through this helper (oplint DUR001 enforces it) — one
+        lock-held ``with self._conn`` block yielding a cursor, announcing
+        the transaction boundary through :func:`yield_point` before entry
+        (``sqlite.txn``) and after the commit lands (``sqlite.commit``).
+        Those two announcements are the os-write/commit seam the ALICE
+        crash-point explorer (analysis/crashpoints.py) interposes on: at
+        each, the db/WAL bytes are a state a crash could strand on disk.
+        On an exception the transaction rolls back and the commit point
+        (correctly) never fires."""
+        yield_point("sqlite.txn", what)
+        with self._lock, self._conn:
+            yield self._conn.cursor()
+        yield_point("sqlite.commit", what)
+
     @staticmethod
     def _dump(obj: Any) -> str:
         return json.dumps(encode(obj), sort_keys=True)
@@ -147,8 +180,7 @@ class SqliteStore:
         yield_point("store.create", obj.kind)
         obj = obj.deepcopy()
         m = obj.metadata
-        with self._lock, self._conn:
-            cur = self._conn.cursor()
+        with self._txn("create") as cur:
             row = cur.execute(
                 "SELECT 1 FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (obj.kind, m.namespace, m.name),
@@ -195,8 +227,7 @@ class SqliteStore:
         yield_point("store.put", obj.kind)
         obj = obj.deepcopy()
         m = obj.metadata
-        with self._lock, self._conn:
-            cur = self._conn.cursor()
+        with self._txn("update") as cur:
             row = cur.execute(
                 "SELECT rv FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (obj.kind, m.namespace, m.name),
@@ -236,8 +267,7 @@ class SqliteStore:
         exactly. The log row allocates the fresh global rv like any
         update."""
         yield_point("store.patch", name)
-        with self._lock, self._conn:
-            cur = self._conn.cursor()
+        with self._txn("patch") as cur:
             row = cur.execute(
                 "SELECT rv, data FROM objects "
                 "WHERE kind=? AND namespace=? AND name=?",
@@ -269,8 +299,7 @@ class SqliteStore:
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         yield_point("store.delete", name)
-        with self._lock, self._conn:
-            cur = self._conn.cursor()
+        with self._txn("delete") as cur:
             row = cur.execute(
                 "SELECT data FROM objects WHERE kind=? AND namespace=? AND name=?",
                 (kind, namespace, name),
@@ -470,8 +499,7 @@ class SqliteStore:
         if now - self._last_trim < self._TRIM_EVERY:
             return
         self._last_trim = now
-        with self._lock, self._conn:
-            cur = self._conn.cursor()
+        with self._txn("trim") as cur:
             cur.execute(
                 "INSERT INTO watch_cursors (id, last_rv, updated) "
                 "VALUES (?, ?, ?) ON CONFLICT(id) DO UPDATE SET "
@@ -501,8 +529,8 @@ class SqliteStore:
             self._poller.join(timeout=2.0)
         with self._lock:
             try:
-                with self._conn:
-                    self._conn.execute(
+                with self._txn("close") as cur:
+                    cur.execute(
                         "DELETE FROM watch_cursors WHERE id=?",
                         (self._cursor_id,),
                     )

@@ -212,7 +212,23 @@ def patch_batch_via_loop(store, items: List[Dict[str, Any]]) -> List[Any]:
     can't hide the others' results. Each item is atomic on its own; the
     batch deliberately is not a transaction — it exists to collapse
     round-trips (the HTTP backend ships it as one request), not to couple
-    unrelated objects' fates."""
+    unrelated objects' fates.
+
+    The partial-failure contract, pinned across all three backends by
+    tests/test_patch.py and the differential fuzzer (the
+    ``batch-aborts-on-error`` seeded mutant proves a deviation is caught):
+
+    - **per-item results**: ``out[i]`` is item i's committed object or its
+      store error VALUE; ``len(out) == len(items)`` always — a mid-batch
+      error never swallows the suffix (one dead pod's mirror must not take
+      the heartbeat riding behind it down);
+    - **applied-prefix visibility**: items commit strictly in list order,
+      each visible to readers (and to later items in the SAME batch —
+      item j sees item i<j's rv bump) the moment it lands; a failed item
+      rolls back nothing;
+    - **watch ordering**: exactly the successful items emit MODIFIED
+      events, in list order, carrying strictly increasing rvs; failed
+      items emit nothing."""
     out: List[Any] = []
     for it in items:
         try:

@@ -1,0 +1,41 @@
+# oplint fixture: DUR001 must fire on direct sqlite mutations that bypass
+# the sanctioned _txn helper — the seam the crash-point explorer
+# interposes on. Lines carrying the bad form are marked with an expect
+# comment; the harness asserts the rule fires on exactly them.
+
+
+def insert_outside_helper(self, obj):
+    self._conn.execute(  # expect: DUR001
+        "INSERT INTO objects (kind, data) VALUES (?, ?)", ("Pod", obj)
+    )
+    self._conn.commit()  # expect: DUR001
+
+
+def schema_outside_helper(self):
+    self._conn.executescript("CREATE TABLE t (x)")  # expect: DUR001
+
+
+def raw_transaction_context(self, rows):
+    # `with conn:` IS sqlite's commit-on-exit transaction manager — a
+    # commit the yieldpoints seam never announces
+    with self._conn:  # expect: DUR001
+        self._conn.executemany(  # expect: DUR001
+            "UPDATE log SET data=? WHERE rv=?", rows
+        )
+
+
+def durability_pragma_set(self, conn):
+    conn.execute("PRAGMA synchronous=OFF")  # expect: DUR001
+
+
+def split_write_strands_an_rv(self, obj, rv):
+    # the exact bug class: one logical create split across two commits; a
+    # crash between them leaves an allocated rv with no object behind it
+    with self.connection:  # expect: DUR001
+        self.connection.execute(  # expect: DUR001
+            "INSERT INTO log (rv, data) VALUES (?, ?)", (rv, obj)
+        )
+    with self.connection:  # expect: DUR001
+        self.connection.execute(  # expect: DUR001
+            "INSERT INTO objects (rv, data) VALUES (?, ?)", (rv, obj)
+        )

@@ -181,6 +181,62 @@ def test_fallen_behind_watcher_recovers_by_relist():
         srv.stop()
 
 
+def test_ring_resume_boundaries_off_by_one():
+    """ISSUE 6 satellite: the ring's trim-horizon boundaries pinned
+    EXACTLY (the differential fuzzer generates these anchors too — the
+    ``ring-replays-past-dropped`` seeded mutant is the off-by-one this
+    test hardcodes): resuming at ``_dropped_rv`` itself is provable (every
+    event with rv > anchor is retained), one BELOW must relist (the
+    rv==_dropped_rv event is gone), and the newest ring rv is a complete
+    EMPTY resume, not a relist."""
+    from mpi_operator_tpu.machinery.http_store import _EventLog
+
+    log = _EventLog(capacity=4)
+    log.set_base_rv(0)
+    for rv in range(1, 11):  # retained tail: rvs 7..10; trimmed: 1..6
+        log.append("MODIFIED", "Pod", {"i": rv}, rv=rv)
+    assert log._dropped_rv == 6
+    # exactly AT the horizon: complete tail
+    assert [e[4] for e in log.resume_after_rv(6)] == [7, 8, 9, 10]
+    # one below: the rv-6 event was trimmed — completeness unprovable
+    assert log.resume_after_rv(5) is None
+    # one above: shorter tail, still provable
+    assert [e[4] for e in log.resume_after_rv(7)] == [8, 9, 10]
+    # the newest ring rv: the client missed nothing — empty resume
+    assert log.resume_after_rv(10) == []
+    # above everything vouched for (a different rv space): relist
+    assert log.resume_after_rv(11) is None
+
+
+def test_ring_resume_boundaries_through_the_wire():
+    """The same three boundaries through GET /v1/watch?resource_version=
+    on a live server with a 4-event ring."""
+    srv = StoreServer(ObjectStore(), "127.0.0.1", 0, log_capacity=4).start()
+    c = HttpStoreClient(srv.url)
+    try:
+        for i in range(10):
+            c.create(Pod(metadata=ObjectMeta(name=f"p{i}")))  # rvs 1..10
+        dropped = srv._log._dropped_rv
+        assert dropped == 6
+
+        from mpi_operator_tpu.analysis.storecheck import probe_resume
+
+        def probe(anchor):
+            return probe_resume(srv.url, anchor, timeout=5.0)
+
+        at = probe(dropped)
+        assert [e["rv"] for e in at["events"]] == [7, 8, 9, 10]
+        below = probe(dropped - 1)
+        assert "relist" in below and len(below["relist"]) == 10
+        above = probe(dropped + 1)
+        assert [e["rv"] for e in above["events"]] == [8, 9, 10]
+        newest = probe(10)
+        assert newest["events"] == []  # caught-up: empty resume, no relist
+    finally:
+        c.close()
+        srv.stop()
+
+
 def test_cursor_from_previous_server_incarnation_resumes():
     """A store-server restart resets the event-log seq space; a client
     reconnecting with its old (now meaningless) cursor must not silently

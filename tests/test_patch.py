@@ -10,6 +10,7 @@ contract if the backends can't drift.
 """
 
 import os
+import queue
 import time
 
 import pytest
@@ -187,6 +188,78 @@ def test_patch_batch_applies_in_order_with_per_item_errors(store):
     # later items still applied after earlier failures, in order
     assert res[3].status.phase == "Succeeded"
     assert store.get("Pod", "default", "a").status.phase == "Succeeded"
+
+
+def test_patch_batch_partial_failure_contract(store):
+    """The pinned partial-failure semantics (patch_batch_via_loop
+    docstring; ISSUE 6 satellite): a mid-batch conflict leaves the PREFIX
+    applied and visible, per-item results line up 1:1 with items, later
+    items in the same batch see earlier items' commits, and the watch
+    stream carries exactly the successful items, in order, at strictly
+    increasing rvs."""
+    a = store.create(_pod("a"))
+    store.create(_pod("b"))
+    q = store.watch("Pod")
+    res = store.patch_batch([
+        # 0: ok — and its rv bump must be visible to item 3's precondition
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"status": {"phase": "Running"}}, "subresource": "status"},
+        # 1: stale-rv conflict MID-batch
+        {"kind": "Pod", "namespace": "default", "name": "b",
+         "patch": {"metadata": {"resource_version":
+                                a.metadata.resource_version + 999},
+                   "status": {"phase": "Running"}},
+         "subresource": "status"},
+        # 2: missing object
+        {"kind": "Pod", "namespace": "default", "name": "ghost",
+         "patch": {"status": {}}, "subresource": "status"},
+        # 3: ok — lands after the failures without being blocked by them
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"status": {"message": "after-conflict"}},
+         "subresource": "status"},
+    ])
+    assert len(res) == 4  # per-item results, 1:1 with items
+    assert res[0].status.phase == "Running"
+    assert isinstance(res[1], Conflict)
+    assert isinstance(res[2], NotFound)
+    assert res[3].status.message == "after-conflict"
+    # applied-prefix visibility: the conflict rolled back nothing
+    final_a = store.get("Pod", "default", "a")
+    assert final_a.status.phase == "Running"
+    assert final_a.status.message == "after-conflict"
+    assert store.get("Pod", "default", "b").status.phase in (None, "Pending")
+    # watch ordering: exactly the successful items, in order, rv ascending
+    ev1 = q.get(timeout=5.0)
+    ev2 = q.get(timeout=5.0)
+    assert (ev1.obj.metadata.name, ev1.obj.status.phase) == ("a", "Running")
+    assert ev2.obj.status.message == "after-conflict"
+    assert ev1.obj.metadata.resource_version < ev2.obj.metadata.resource_version
+    with pytest.raises(queue.Empty):  # failed items emitted nothing
+        q.get(timeout=0.3)
+    store.stop_watch(q)
+
+
+def test_patch_batch_item3_rv_precondition_sees_item0_commit(store):
+    """Sharper applied-prefix probe: an item whose rv precondition names
+    the EXACT rv a preceding item committed succeeds — the prefix is
+    visible within the batch, not just after it."""
+    store.create(_pod("a"))
+    first = store.patch("Pod", "default", "a",
+                        {"status": {"phase": "Pending"}},
+                        subresource="status")
+    res = store.patch_batch([
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"status": {"phase": "Running"}}, "subresource": "status"},
+        {"kind": "Pod", "namespace": "default", "name": "a",
+         "patch": {"metadata": {"resource_version":
+                                first.metadata.resource_version + 1},
+                   "status": {"ready": True}},
+         "subresource": "status"},
+    ])
+    assert res[0].metadata.resource_version == (
+        first.metadata.resource_version + 1
+    )
+    assert res[1].status.ready is True
 
 
 def test_patch_every_kind_round_trips(store):

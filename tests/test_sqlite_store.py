@@ -277,6 +277,52 @@ def test_watch_gap_triggers_relist(tmp_path):
     s.close()
 
 
+def test_poll_gap_boundary_off_by_one(tmp_path):
+    """ISSUE 6 satellite: the poll-loop's gap detection pinned at its
+    exact boundaries (the sqlite analog of the http ring's _dropped_rv
+    off-by-one). A cursor parked EXACTLY at the trim horizon replays the
+    retained tail verbatim (original etypes, no relist); one rv below the
+    horizon is an unprovable gap and must relist; a cursor at the newest
+    rv sees nothing at all."""
+    import queue as _q
+
+    s = SqliteStore(str(tmp_path / "b.db"), poll_interval=0.01)
+    s._last_trim = float("inf")
+    q = s.watch(None)
+    for i in range(6):
+        s.create(Pod(metadata=ObjectMeta(name=f"p{i}")))  # rvs 1..6
+    for _ in range(6):
+        q.get(timeout=5)
+    # boundary 3 first (cursor == newest rv): nothing to deliver
+    with pytest.raises(_q.Empty):
+        q.get(timeout=0.3)
+    with s._conn:  # trim rvs 1..3: the horizon ("dropped rv") is 3
+        s._conn.execute("DELETE FROM log WHERE rv <= 3")
+    # boundary 1: parked EXACTLY at the horizon — rows are contiguous
+    # from rv 4, so the tail replays verbatim (ADDED, not a relist)
+    s._last_seen_rv = 3
+    got = [q.get(timeout=5) for _ in range(3)]
+    assert [ev.obj.metadata.name for ev in got] == ["p3", "p4", "p5"]
+    assert all(ev.type == "ADDED" for ev in got)  # replay, no relist
+    with pytest.raises(_q.Empty):
+        q.get(timeout=0.3)
+    # boundary 2: ONE rv below the horizon — the rv-3 row is gone, the
+    # gap is detected (rows start at 4 > 2+1) and recovery relists every
+    # live object as synthesized MODIFIED events
+    s._last_seen_rv = 2
+    seen = {}
+    deadline = time.time() + 5
+    while time.time() < deadline and len(seen) < 6:
+        try:
+            ev = q.get(timeout=0.5)
+        except _q.Empty:
+            continue
+        seen[ev.obj.metadata.name] = ev.type
+    assert set(seen) == {f"p{i}" for i in range(6)}
+    assert set(seen.values()) == {"MODIFIED"}  # the relist, not a replay
+    s.close()
+
+
 def test_sigkill_between_committed_patch_and_watch_delivery(tmp_path):
     """Crash durability (the chaos suite's store-level contract): a child
     process commits a merge-patch, registers a watcher whose poller will

@@ -1,0 +1,471 @@
+"""Tier-1 gate for storecheck + crashpoints (ISSUE 6).
+
+≙ etcd's model-based/differential functional tests + the ALICE crash-point
+methodology, folded into the suite so the gate rides the existing verify
+command:
+
+- the **differential fuzzer**'s acceptance contract: every seeded mutant
+  backend is caught within the default budget, ddmin-shrunk, and its
+  ``v1:fuzz:<seed>:<ops>`` token re-executes twice-identical; the three
+  real backends fuzz clean at the same budget;
+- the pinned minimal-repro corpus (tests/data/storecheck/) is re-checked
+  every run: the token still maps to the same symbolic ops (generator
+  drift), the mutant still diverges at the pinned op, and the REAL
+  backends run the same ops model-clean;
+- the **crash-point explorer** enumerates ≥ 50 points on the commit-heavy
+  workload and every one recovers (acked-write durability, rv
+  monotonicity, resume-or-relist), with the seeded split-transaction
+  mutant caught;
+- exhaustive sweeps ride the slow tier (`-m "fuzz and slow"` /
+  `-m "crash and slow"`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi_operator_tpu.analysis import crashpoints, storecheck
+from mpi_operator_tpu.analysis.model import ModelDrift, ModelStore
+from mpi_operator_tpu.machinery.store import Conflict, NotFound
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "storecheck"
+)
+
+
+# ---------------------------------------------------------------------------
+# the generator: deterministic, prefix-stable, boundary-complete
+# ---------------------------------------------------------------------------
+
+
+def test_generator_is_deterministic_and_prefix_stable():
+    """Replay tokens only carry (seed, indices): that is sound only if
+    generate(seed, k) is a strict prefix of generate(seed, n) for k<=n."""
+    full = storecheck.generate(7, 64)
+    assert storecheck.generate(7, 64) == full
+    for k in (1, 13, 48):
+        assert storecheck.generate(7, k) == full[:k]
+
+
+def test_generator_covers_ring_boundary_anchors():
+    """The satellite contract: the generator must include resumes at
+    exactly _dropped_rv, one below, one above, and the newest ring rv —
+    the off-by-one class the ring mutant embodies."""
+    assert {"dropped", "dropped-1", "dropped+1", "newest"} <= set(
+        storecheck._ANCHORS
+    )
+    # and they all actually occur within the default budget's op stream
+    seen = set()
+    for seed in range(storecheck.DEFAULT_BUDGET.sequences):
+        for op in storecheck.generate(seed, storecheck.DEFAULT_BUDGET.ops):
+            if op["op"] == "watch_resume":
+                seen.add(op["anchor"])
+    assert {"dropped", "dropped-1", "dropped+1", "newest"} <= seen
+
+
+def test_generator_covers_the_verb_surface():
+    """Five verbs + status subresource + patch_batch + watch resume +
+    delete/recreate interleavings, all within one default-budget stream."""
+    ops = []
+    for seed in range(storecheck.DEFAULT_BUDGET.sequences):
+        ops.extend(storecheck.generate(seed, storecheck.DEFAULT_BUDGET.ops))
+    verbs = {o["op"] for o in ops}
+    assert verbs >= {"create", "get", "update", "patch", "delete", "list",
+                     "patch_batch", "watch_resume"}
+    assert any(o.get("subresource") == "status" for o in ops
+               if o["op"] == "patch")
+    assert any(o.get("selector") for o in ops if o["op"] == "list")
+    # stale/invalid preconditions are generated, not just happy paths
+    patches = [o for o in ops if o["op"] == "patch"]
+    assert any(o.get("rv") == "stale" for o in patches)
+    assert any(o.get("uid") == "wrong" for o in patches)
+    # delete/recreate interleaving: some name is deleted then re-created
+    by_name = {}
+    for o in storecheck.generate(0, 48):
+        if o["op"] in ("create", "delete"):
+            by_name.setdefault((o["kind"], o["name"]), []).append(o["op"])
+    assert any(
+        "delete" in seq and "create" in seq[seq.index("delete"):]
+        for seq in by_name.values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# replay tokens
+# ---------------------------------------------------------------------------
+
+
+def test_token_roundtrip():
+    token = storecheck.encode_token(5, [3, 8, 21])
+    assert token == "v1:fuzz:5:3,8,21"
+    assert storecheck.decode_token(token) == (5, [3, 8, 21])
+    ops = storecheck.ops_for_token(token)
+    full = storecheck.generate(5, 22)
+    assert ops == [full[3], full[8], full[21]]
+
+
+@pytest.mark.parametrize("bad", [
+    "v2:fuzz:0:1",            # unknown version
+    "v1:explore:0:1",         # wrong tool
+    "v1:fuzz:0:",             # no indices
+    "v1:fuzz:0:3,1",          # not increasing
+    "v1:fuzz:0:1,1",          # duplicate
+    "v1:fuzz:x:1",            # non-int seed
+    "garbage",
+])
+def test_bad_tokens_rejected(bad):
+    with pytest.raises(storecheck.FuzzError):
+        storecheck.decode_token(bad)
+
+
+# ---------------------------------------------------------------------------
+# the sequential model (generator form) self-checks against the validator
+# ---------------------------------------------------------------------------
+
+
+def test_model_store_cross_checks_against_store_model():
+    """Every ModelStore result replays through StoreModel.apply — the two
+    forms of the spec are mechanically pinned to each other."""
+    m = ModelStore()
+    obj = {"kind": "Pod", "metadata": {"name": "p", "namespace": "default",
+                                       "uid": "u1",
+                                       "creation_timestamp": 1000.0}}
+    created = m.create("Pod", obj)
+    rv = created["metadata"]["resource_version"]
+    m.patch("Pod", "default", "p",
+            {"metadata": {"resource_version": rv},
+             "status": {"phase": "Running"}},
+            subresource="status")
+    with pytest.raises(Conflict):
+        m.patch("Pod", "default", "p",
+                {"metadata": {"resource_version": rv}, "status": {}},
+                subresource="status")
+    m.delete("Pod", "default", "p")
+    with pytest.raises(NotFound):
+        m.get("Pod", "default", "p")
+    assert [e[0] for e in m.events] == ["ADDED", "MODIFIED", "DELETED"]
+    assert [e[4] for e in m.events] == [1, 2, 3]  # global rv, commit order
+
+
+def test_model_drift_is_a_tooling_error():
+    """A ModelStore result StoreModel rejects must raise ModelDrift (a
+    broken spec can never masquerade as a backend finding)."""
+    m = ModelStore()
+    with pytest.raises(ModelDrift):
+        # an impossible recorded result: a successful get on an object
+        # that does not exist
+        m._cross_check("get", "Pod", "default", "p", {}, {"rv": 99})
+
+
+def test_model_ring_spec_matches_the_boundaries():
+    m = ModelStore()
+    for i in range(10):
+        m.create("Pod", {"kind": "Pod",
+                         "metadata": {"name": f"p{i}", "namespace": "default",
+                                      "uid": f"u{i}",
+                                      "creation_timestamp": 1000.0}})
+    cap = 4
+    dropped = m.ring_dropped_rv(cap)
+    assert dropped == 6
+    assert [e[4] for e in m.resume_after_rv(dropped, cap)] == [7, 8, 9, 10]
+    assert m.resume_after_rv(dropped - 1, cap) is None
+    assert m.resume_after_rv(10, cap) == []
+    assert m.resume_after_rv(11, cap) is None
+
+
+# ---------------------------------------------------------------------------
+# allowlist (.storecheck-allow, racecheck-allow grammar + precedence)
+# ---------------------------------------------------------------------------
+
+
+def test_allowlist_parses_and_requires_reasons():
+    rules = storecheck.parse_allowlist(
+        "# comment\n"
+        "\n"
+        "fuzz:http:watch known wire-seam lag, tracked in ISSUE 7\n"
+        "crash:torn-tail the documented synchronous=NORMAL stance\n"
+    )
+    assert [(r.kind, r.spec) for r in rules] == [
+        ("fuzz", "http:watch"), ("crash", "torn-tail"),
+    ]
+    assert all(r.reason for r in rules)
+
+
+@pytest.mark.parametrize("line", [
+    "fuzz:http:watch",               # bare suppression, no reason
+    "crash:torn-tail   ",            # whitespace-only reason
+    "lint:RMW001 wrong tool",        # unknown kind
+    "fuzz reasons-but-no-spec",      # malformed head
+])
+def test_allowlist_rejects_bad_entries(line):
+    with pytest.raises(ValueError):
+        storecheck.parse_allowlist(line)
+
+
+def test_allowlist_nearest_file_precedence(tmp_path):
+    """Same resolution racecheck uses: the nearest .storecheck-allow
+    walking UP from the start dir wins; a deeper file shadows the root."""
+    root = tmp_path
+    deep = tmp_path / "a" / "b"
+    deep.mkdir(parents=True)
+    (root / storecheck.ALLOWLIST_FILENAME).write_text(
+        "crash:torn-tail root says fine\n"
+    )
+    assert storecheck.find_allowlist(str(deep)) == str(
+        root / storecheck.ALLOWLIST_FILENAME
+    )
+    (deep / storecheck.ALLOWLIST_FILENAME).write_text(
+        "crash:torn-tail deeper file shadows the root\n"
+    )
+    assert storecheck.find_allowlist(str(deep)) == str(
+        deep / storecheck.ALLOWLIST_FILENAME
+    )
+    rules = storecheck.load_allowlist(storecheck.find_allowlist(str(deep)))
+    assert rules[0].reason == "deeper file shadows the root"
+
+
+def test_allowlist_walk_stops_at_repo_boundary(tmp_path):
+    """A stray allowlist ABOVE a checkout must never gate findings: the
+    walk stops at .git / pytest.ini (shared analysis.allowlist contract,
+    same as .racecheck-allow)."""
+    (tmp_path / storecheck.ALLOWLIST_FILENAME).write_text(
+        "crash:torn-tail stray file above the checkout\n"
+    )
+    repo = tmp_path / "checkout"
+    (repo / ".git").mkdir(parents=True)
+    inner = repo / "pkg"
+    inner.mkdir()
+    assert storecheck.find_allowlist(str(inner)) is None
+    # and an in-repo file still wins normally
+    (repo / storecheck.ALLOWLIST_FILENAME).write_text(
+        "crash:torn-tail the checkout's own stance\n"
+    )
+    assert storecheck.find_allowlist(str(inner)) == str(
+        repo / storecheck.ALLOWLIST_FILENAME
+    )
+
+
+def test_allow_rule_matches_divergence():
+    div = storecheck.Divergence("http", 3, "watch", "x", "y")
+    assert storecheck.AllowRule("fuzz", "http:watch", "r").matches(div)
+    assert not storecheck.AllowRule("fuzz", "sqlite", "r").matches(div)
+    assert not storecheck.AllowRule("crash", "torn-tail", "r").matches(div)
+
+
+@pytest.mark.fuzz
+def test_allowed_divergence_continues_the_budget():
+    """racecheck's allowed-findings semantics, not a short-circuit: a
+    gated divergence is recorded informationally and the REST of the
+    budget still runs — mixed with a real (ungated) mutant, the real one
+    must still be found and shrunk."""
+    gated = storecheck.AllowRule(
+        "fuzz", "mutant-update-ignores-rv", "known, tracked elsewhere"
+    )
+    # alone: every divergence gated → report ok, allowed entries recorded
+    report = storecheck.fuzz(
+        {"update-ignores-rv": storecheck.MUTANTS["update-ignores-rv"]},
+        allowlist=[gated],
+    )
+    assert report.ok
+    assert report.allowed, "gated divergences must be recorded"
+    assert "allowed (fuzz" in report.render()
+    # mixed with an ungated mutant: the gate must not mask it
+    report = storecheck.fuzz(
+        {
+            "update-ignores-rv": storecheck.MUTANTS["update-ignores-rv"],
+            "delete-no-rv-bump": storecheck.MUTANTS["delete-no-rv-bump"],
+        },
+        allowlist=[gated],
+    )
+    assert not report.ok
+    assert report.finding.divergence.backend == "mutant-delete-no-rv-bump"
+
+
+# ---------------------------------------------------------------------------
+# pinned minimal-repro corpus: drift-checked every tier-1 run
+# ---------------------------------------------------------------------------
+
+
+def _fixture(name: str):
+    with open(os.path.join(FIXDIR, f"{name}.json"), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_fixture_corpus_is_complete():
+    on_disk = {f[:-5] for f in os.listdir(FIXDIR) if f.endswith(".json")}
+    assert on_disk == set(storecheck.MUTANTS), (
+        "one pinned minimal repro per seeded mutant; regenerate with "
+        "storecheck.mint_mutant_fixtures('tests/data/storecheck')"
+    )
+
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("name", sorted(storecheck.MUTANTS))
+def test_pinned_repro_has_not_drifted(name):
+    """Three pins per fixture: the token still decodes to the SAME
+    symbolic ops (generator drift), the mutant still diverges on them at
+    the pinned op (detector drift), and the three REAL backends run the
+    exact same ops model-clean (the repro names a real bug, not a spec
+    gap)."""
+    fx = _fixture(name)
+    assert storecheck.ops_for_token(fx["token"]) == fx["ops"], (
+        f"generate() drifted: token {fx['token']} no longer maps to the "
+        f"pinned ops — regenerate the corpus deliberately with "
+        f"mint_mutant_fixtures()"
+    )
+    div = storecheck.run_ops(storecheck.MUTANTS[name], fx["ops"])
+    assert div is not None, f"mutant {name} no longer caught by its repro"
+    pinned = fx["divergence"]
+    assert div.op_index == pinned["op_index"]
+    assert div.where == pinned["where"]
+    assert div.backend == pinned["backend"]
+    for real_name, factory in storecheck.REAL_BACKENDS.items():
+        clean = storecheck.run_ops(factory, fx["ops"])
+        assert clean is None, (
+            f"real backend {real_name} diverges on {name}'s repro: "
+            f"{clean.render()}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the fuzz gate (tier-1 default budget; exhaustive on the slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fuzz
+def test_every_seeded_mutant_caught_and_replays_twice_identical():
+    """The acceptance criterion verbatim: self_test at the DEFAULT budget
+    — every mutant caught, shrunk, token replays twice-identical, real
+    backends clean."""
+    assert storecheck.self_test() == []
+
+
+@pytest.mark.fuzz
+def test_shrunk_repro_is_one_minimal():
+    """ddmin's guarantee on a live shrink: removing ANY single op from
+    the minimal index set loses the repro."""
+    name = "delete-no-rv-bump"
+    factory = storecheck.MUTANTS[name]
+    fx = _fixture(name)
+    seed, indices = storecheck.decode_token(fx["token"])
+    full = storecheck.generate(seed, max(indices) + 1)
+    assert storecheck.run_ops(factory, [full[i] for i in indices]) is not None
+    for drop in range(len(indices)):
+        sub = [full[i] for j, i in enumerate(indices) if j != drop]
+        assert storecheck.run_ops(factory, sub) is None, (
+            f"dropping op {drop} still reproduces: not minimal"
+        )
+
+
+@pytest.mark.fuzz
+@pytest.mark.slow
+def test_exhaustive_fuzz_sweep_real_backends_clean():
+    report = storecheck.fuzz(budget=storecheck.EXHAUSTIVE_BUDGET)
+    assert report.ok, report.render()
+
+
+# ---------------------------------------------------------------------------
+# the crash gate (tier-1 full workload; wider sweep on the slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.crash
+def test_crash_explorer_selftest():
+    """≥ 50 points on the commit-heavy workload, every one recovering
+    within the contract (torn acked losses gated), and the seeded
+    split-transaction mutant caught."""
+    assert crashpoints.self_test() == []
+
+
+@pytest.mark.crash
+def test_crash_points_enumerate_both_seams_and_torn_variants():
+    snaps, timeline, rvs = crashpoints.record(
+        crashpoints.commit_heavy_ops(8)
+    )
+    assert len(timeline) == len(rvs) == 9
+    seams = {s.seam for s in snaps}
+    assert seams == {"sqlite.txn", "sqlite.commit"}
+    points = crashpoints.crash_points(snaps)
+    exact = [p for p in points if p.torn == 0]
+    torn = [p for p in points if p.torn > 0]
+    assert len(exact) == len(snaps)
+    assert torn, "commit snapshots must spawn torn-tail variants"
+    by_label = {p.label: p for p in exact}
+    for p in torn:
+        base = by_label[p.label.rsplit(":torn-", 1)[0]]
+        assert len(p.wal) == len(base.wal) - p.torn  # an actual tear
+
+
+@pytest.mark.crash
+def test_torn_tail_without_gate_is_a_violation():
+    """The synchronous=NORMAL acked-loss window is allowed ONLY through a
+    reasoned crash:torn-tail allowlist entry; ungated it fails."""
+    report = crashpoints.explore(writes=12, allowlist=None, resume=False)
+    torn_losses = [v for v in report.violations
+                   if "ACKED write" in v.message]
+    gated = crashpoints.explore(
+        writes=12, resume=False,
+        allowlist=[storecheck.AllowRule(
+            "crash", "torn-tail", "documented synchronous=NORMAL stance"
+        )],
+    )
+    assert gated.ok, gated.render()
+    # the gate converts exactly the acked-loss class into informational
+    # entries; if sqlite ever recovers every torn tail fully (no losses),
+    # both lists are legitimately empty
+    assert len(gated.allowed) == len(torn_losses)
+
+
+@pytest.mark.crash
+@pytest.mark.slow
+def test_exhaustive_crash_sweep():
+    gate = [storecheck.AllowRule(
+        "crash", "torn-tail", "documented synchronous=NORMAL stance"
+    )]
+    report = crashpoints.explore(writes=32, allowlist=gate)
+    assert report.ok, report.render()
+    assert report.points >= 100
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.fuzz
+def test_cli_fuzz_clean_exit_zero():
+    r = _run_cli("fuzz", "--budget", "1", "--ops", "24")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no divergence" in r.stdout
+
+
+@pytest.mark.fuzz
+def test_cli_fuzz_replay_token_reports_divergence_on_mutant_fixture():
+    """--replay re-executes a pinned token; against the REAL backends it
+    runs clean (exit 0) — the repro only bites its mutant."""
+    fx = _fixture("delete-no-rv-bump")
+    r = _run_cli("fuzz", "--replay", fx["token"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "runs clean" in r.stdout
+
+
+@pytest.mark.crash
+def test_cli_crash_list_points():
+    r = _run_cli("crash", "--workload", "4", "--list-points")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "crash point(s)" in r.stderr
+    assert "commit@" in r.stdout

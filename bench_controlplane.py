@@ -379,17 +379,92 @@ def run_write_mode(jobs: int, pods: int, agents: int) -> dict:
         backing.close()
 
 
+def run_replica_mode(writes: int) -> dict:
+    """The HA cost as a number (BENCH_CP_MODES=replica): write p50/p99
+    at replication factor 1 (single node, no shipping) vs 3 (leased
+    leader + synchronous majority log-shipping), plus the
+    failover-to-first-successful-write time — SIGKILL the leader under
+    auto-failover and measure until a write acks on the new one."""
+    import shutil
+
+    from mpi_operator_tpu.api.types import ObjectMeta as _Meta
+    from mpi_operator_tpu.machinery.objects import Pod as _Pod
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    def _pod(name):
+        return _Pod(metadata=_Meta(name=name, namespace="bench"))
+
+    out: dict = {"metric": "controlplane_replica", "writes": writes}
+    for rf in (1, 3):
+        tmp = tempfile.mkdtemp(prefix=f"bench-replica-rf{rf}-")
+        rs = ReplicaSet(rf, dir=tmp)
+        try:
+            assert rs.elect("n0")
+            client = rs.client()
+            lat = []
+            for i in range(writes):
+                t = time.perf_counter()
+                client.create(_pod(f"w-{i:05d}"))
+                lat.append(time.perf_counter() - t)
+            for i in range(writes):
+                t = time.perf_counter()
+                client.patch(
+                    "Pod", "bench", f"w-{i:05d}",
+                    {"status": {"message": "bench"}}, subresource="status",
+                )
+                lat.append(time.perf_counter() - t)
+            lat.sort()
+            out[f"rf{rf}_write_p50_ms"] = round(
+                _percentile(lat, 0.50) * 1e3, 3)
+            out[f"rf{rf}_write_p99_ms"] = round(
+                _percentile(lat, 0.99) * 1e3, 3)
+        finally:
+            rs.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    out["rf3_over_rf1_p50"] = round(
+        out["rf3_write_p50_ms"] / max(1e-9, out["rf1_write_p50_ms"]), 2)
+
+    # failover: kill the leader mid-traffic, clock until the first write
+    # acks on the new leader (median of 3 trials)
+    trials = []
+    for trial in range(3):
+        tmp = tempfile.mkdtemp(prefix="bench-replica-failover-")
+        rs = ReplicaSet(3, dir=tmp, lease_duration=0.5, retry_period=0.05,
+                        seed=trial)
+        try:
+            assert rs.elect("n0")
+            rs.start()
+            client = rs.client()
+            client._attempts = 64
+            client.create(_pod("pre-failover"))
+            rs.crash("n0")
+            t0 = time.perf_counter()
+            client.create(_pod(f"post-failover-{trial}"))
+            trials.append(time.perf_counter() - t0)
+        finally:
+            rs.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    out["failover_first_write_ms"] = round(
+        sorted(trials)[len(trials) // 2] * 1e3, 1)
+    out["failover_trials_ms"] = [round(t * 1e3, 1) for t in trials]
+    out["lease_duration_s"] = 0.5
+    return out
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_CP_JOBS", "200"))
     pods = int(os.environ.get("BENCH_CP_PODS", "8"))
     rounds = int(os.environ.get("BENCH_CP_ROUNDS", "3"))
     agents = int(os.environ.get("BENCH_CP_AGENTS", "16"))
+    writes = int(os.environ.get("BENCH_CP_WRITES", "400"))
     modes = os.environ.get("BENCH_CP_MODES", "store,informer").split(",")
     results = {}
     for mode in modes:
         mode = mode.strip()
         if mode == "write":
             r = run_write_mode(jobs, pods, agents)
+        elif mode == "replica":
+            r = run_replica_mode(writes)
         else:
             r = run_mode(mode, jobs, pods, rounds)
         results[mode] = r

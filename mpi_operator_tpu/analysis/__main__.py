@@ -16,6 +16,7 @@
     python -m mpi_operator_tpu.analysis crash --workload 16
     python -m mpi_operator_tpu.analysis crash --list-points
     python -m mpi_operator_tpu.analysis crash --selftest
+    python -m mpi_operator_tpu.analysis crash --replica --workload 8
 
 ``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
 rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
@@ -199,6 +200,10 @@ def _cmd_crash(args) -> int:
         if not failures:
             print("crashpoints selftest: ok")
         return 1 if failures else 0
+    if args.replica:
+        report = crashpoints.explore_replica(writes=args.workload)
+        print(report.render())
+        return 0 if report.ok else 1
     if args.list_points:
         snaps, _timeline, _rvs = crashpoints.record(
             crashpoints.commit_heavy_ops(args.workload)
@@ -289,7 +294,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--replay", metavar="TOKEN",
                    help="re-execute the exact op subsequence a "
                         "v1:fuzz:<seed>:<ops> token encodes")
-    p.add_argument("--backend", choices=["memory", "sqlite", "http"],
+    p.add_argument("--backend",
+                   choices=["memory", "sqlite", "http", "replica"],
                    help="with --replay: restrict to one backend")
     p.set_defaults(fn=_cmd_fuzz)
     p = sub.add_parser(
@@ -308,6 +314,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="skip torn-WAL-tail variants")
     p.add_argument("--no-resume", action="store_true",
                    help="skip the per-point ?resource_version= resume check")
+    p.add_argument("--replica", action="store_true",
+                   help="explore leader-SIGKILL points of a 3-node replica "
+                        "set instead (kill-during-log-ship: failover must "
+                        "keep every acked write, truncate unacked suffixes)")
     p.set_defaults(fn=_cmd_crash)
     args = ap.parse_args(argv)
     return args.fn(args)

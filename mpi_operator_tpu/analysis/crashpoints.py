@@ -456,6 +456,205 @@ def explore(
 
 
 # ---------------------------------------------------------------------------
+# kill-during-log-ship: crash points of a replica-set LEADER (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _ReplicaPoint:
+    """One leader-crash state: all three nodes' file bytes captured at a
+    single ``sqlite.txn``/``sqlite.commit`` announcement. Leader-side
+    announcements fire BEFORE any ship (followers hold exactly the acked
+    prefix); follower-side ``replicate`` announcements fire mid-ship (the
+    in-flight entry is on some but maybe not all followers) — together
+    they enumerate every phase a leader SIGKILL can strand the set in."""
+
+    label: str
+    acked: int
+    files: Dict[str, Tuple[bytes, bytes]]  # node id -> (db, wal) bytes
+
+
+class _ReplicaHook:
+    def __init__(self, paths: Dict[str, str]):
+        self.paths = paths
+        self.acked = 0
+        self.points: List[_ReplicaPoint] = []
+        self._seq = 0
+
+    def __call__(self, op: str, detail: str) -> None:
+        if op not in _SEAMS:
+            return
+        self._seq += 1
+        self.points.append(_ReplicaPoint(
+            label=f"replica:{op.split('.')[1]}@{self._seq}:{detail}",
+            acked=self.acked,
+            files={
+                nid: (_read(p), _read(p + "-wal"))
+                for nid, p in self.paths.items()
+            },
+        ))
+
+
+def record_replica(
+    ops: List[Dict[str, Any]],
+) -> Tuple[List[_ReplicaPoint], List[Dict], List[int]]:
+    """Run the commit-heavy workload against a real 3-node replica set
+    (leader n0, reads from follower n1) in lockstep with the model,
+    snapshotting every node's file bytes at every sqlite seam
+    announcement — leader commits and follower applies both announce, so
+    the capture covers pre-ship, mid-ship and post-ship instants."""
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    model = ModelStore()
+    timeline = [copy.deepcopy(model.snapshot())]
+    rvs = [0]
+    d = tempfile.mkdtemp(prefix="crashpoints-replica-")
+    rset = ReplicaSet(3, dir=d)
+    prev = yieldpoints.set_hook(None)
+    try:  # rset.stop() rides the finally: a mid-workload divergence must
+        # not leak three sqlite handles + poller threads per call
+        if not rset.elect("n0"):
+            raise CrashExploreError("fresh replica set failed its election")
+        client = rset.client(read_from="n1")
+        hook = _ReplicaHook(
+            {nid: rset.nodes[nid].path for nid in rset.node_ids}
+        )
+        yieldpoints.set_hook(hook)
+        h = storecheck.Harness("replica-crash", client)
+        for op in ops:
+            c = storecheck.resolve(op, model)
+            want = storecheck._exec_model(model, c)
+            got = storecheck._exec_backend(h, c)
+            if want != got:
+                raise CrashExploreError(
+                    f"replica workload diverged from the model at {op!r}: "
+                    f"{want!r} != {got!r} (run the differential fuzzer)"
+                )
+            hook.acked += 1
+            timeline.append(copy.deepcopy(model.snapshot()))
+            rvs.append(model.current_rv())
+        return hook.points, timeline, rvs
+    finally:
+        # unhook BEFORE stop(): node close()s announce through the same
+        # seam and must not record phantom points (or leak into an outer
+        # hook restored too early)
+        yieldpoints.set_hook(None)
+        rset.stop()
+        yieldpoints.set_hook(prev)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def check_replica_point(pt: _ReplicaPoint, timeline,
+                        rvs: List[int]) -> Optional[Violation]:
+    """SIGKILL the leader at this instant and recover: reopen BOTH
+    followers from their captured bytes, elect among them, and assert
+
+    - the surviving quorum recovers to timeline[j] for j in
+      {acked, acked+1} at exactly rvs[j] — every ACKED write present
+      (j < acked is a lost ack), the in-flight op present only as a
+      whole committed entry (indeterminate, never partial);
+    - rv stays monotone across the failover (a probe write through the
+      new leader lands strictly above);
+    - the ex-leader rejoining from ITS bytes converges to the new
+      history — its locally-committed-but-unacked suffix is truncated,
+      never resurrected."""
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    d = tempfile.mkdtemp(prefix="crashpoint-replica-")
+    try:
+        for nid, (db, wal) in pt.files.items():
+            with open(os.path.join(d, f"{nid}.db"), "wb") as f:
+                f.write(db)
+            if wal:
+                with open(os.path.join(d, f"{nid}.db-wal"), "wb") as f:
+                    f.write(wal)
+        rset = ReplicaSet(3, dir=d)
+        try:
+            rset.crash("n0")  # the SIGKILLed leader stays dead for now
+            rset.expire_leases()
+            if not rset.elect("n1"):
+                return Violation(
+                    pt.label,
+                    "surviving majority could not elect a leader",
+                )
+            lead = rset.nodes["n1"]
+            state = _recovered_state(lead)
+            rv = lead.current_rv()
+            j = next(
+                (k for k in (pt.acked + 1, pt.acked)
+                 if k < len(timeline) and timeline[k] == state
+                 and rvs[k] == rv),
+                None,
+            )
+            if j is None:
+                lost = next(
+                    (k for k in range(pt.acked - 1, -1, -1)
+                     if timeline[k] == state), None,
+                )
+                what = (f"an ACKED write was lost (recovered to "
+                        f"timeline[{lost}] < acked {pt.acked})"
+                        if lost is not None else
+                        "invented or partial state")
+                return Violation(
+                    pt.label,
+                    f"survivors recovered to rv {rv}, matching neither "
+                    f"timeline[{pt.acked}] nor [{pt.acked + 1}]: {what}",
+                )
+            probe = lead.create(decode("Pod", {
+                "kind": "Pod",
+                "metadata": {"name": "crash-probe", "namespace": "default",
+                             "uid": "u-probe",
+                             "creation_timestamp": 1000.0},
+            }))
+            if probe.metadata.resource_version <= rv:
+                return Violation(
+                    pt.label,
+                    f"rv NOT monotone across failover: probe got rv "
+                    f"{probe.metadata.resource_version} <= recovered {rv}",
+                )
+            lead.delete("Pod", "default", "crash-probe")
+            # the ex-leader rejoins from its own crash-state bytes: its
+            # unacked suffix (if the quorum settled on j == acked) must
+            # truncate via resync, and all three histories converge
+            rset.restart("n0")
+            lead.renew()
+            ex = rset.nodes["n0"]
+            if (_recovered_state(ex) != _recovered_state(lead)
+                    or ex.current_rv() != lead.current_rv()):
+                return Violation(
+                    pt.label,
+                    f"rejoined ex-leader diverges from the new history "
+                    f"(rv {ex.current_rv()} vs {lead.current_rv()}): "
+                    f"unacked suffix resurrected or resync failed",
+                )
+            return None
+        finally:
+            rset.stop()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def explore_replica(*, writes: int = 8) -> CrashReport:
+    """The kill-during-log-ship pass: record the replicated workload,
+    then SIGKILL-the-leader at every captured instant and check the
+    failover recovery contract (no torn variants — the follower copies,
+    not the leader's WAL tail, are the durability story here)."""
+    points, timeline, rvs = record_replica(commit_heavy_ops(writes))
+    violations: List[Violation] = []
+    for pt in points:
+        v = check_replica_point(pt, timeline, rvs)
+        if v is not None:
+            violations.append(v)
+    return CrashReport(
+        ok=not violations,
+        points=len(points),
+        exact_points=len(points),
+        torn_points=0,
+        violations=violations,
+    )
+
+
+# ---------------------------------------------------------------------------
 # the seeded atomicity mutant (the explorer's own acceptance proof)
 # ---------------------------------------------------------------------------
 

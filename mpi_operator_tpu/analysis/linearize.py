@@ -557,16 +557,27 @@ class Recorder:
             setattr(cls, "stop_watch", stop_watch)
             self._patched.append((cls, "stop_watch", orig_stop))
 
-    def install(self) -> "Recorder":
+    def install(self, classes=None, batch_classes=None) -> "Recorder":
+        """Default: instrument the three store backends. ``classes``
+        restricts recording to other store-surfaced classes (e.g. the
+        replica set's ReplicaClient facade, so every node's ops share ONE
+        history tag); ``batch_classes`` names which of those own a
+        patch_batch that does NOT loop through their wrapped ``patch``
+        (the in-process backends' loop is already recorded per item —
+        wrapping both would double-record)."""
         from mpi_operator_tpu.machinery.http_store import HttpStoreClient
         from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
         from mpi_operator_tpu.machinery.store import ObjectStore
 
-        for cls in (ObjectStore, SqliteStore, HttpStoreClient):
+        if classes is None:
+            classes = (ObjectStore, SqliteStore, HttpStoreClient)
+            batch_classes = (HttpStoreClient,)
+        for cls in classes:
             for verb in self.VERBS:
                 self._wrap_verb(cls, verb)
             self._wrap_watch(cls)
-        self._wrap_patch_batch(HttpStoreClient)
+        for cls in (batch_classes or ()):
+            self._wrap_patch_batch(cls)
         return self
 
     def uninstall(self) -> None:

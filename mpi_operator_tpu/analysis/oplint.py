@@ -55,6 +55,15 @@ Rule catalog (rationale → the PR that motivated each):
   request timeout), and the gang scheduler listed pods under the
   scheduler lock. A lock held across a round-trip turns one slow backend
   response into a control-plane-wide stall.
+- **REP001** a mutation verb invoked directly on a follower/standby
+  handle (``follower.update(...)``, ``self.standby.store.delete(...)``).
+  ISSUE 8's replicated store routes every write through the leased
+  leader; a direct follower write forks the replicated history in a way
+  no election can reconcile (the divergence-hash resync would silently
+  truncate it — or worse, ship it). The sanctioned follower write path
+  is the replication apply seam (``apply_replicated``/``install_snapshot``
+  /``append_entries``/``load_snapshot``), which the checker exempts by
+  enclosing-function name.
 
 Suppression: ``# oplint: disable=RULE[,RULE...]`` on the flagged line or the
 line directly above it silences that rule there. Policy: every suppression
@@ -174,6 +183,16 @@ RULES: Dict[str, Rule] = {
             "accounting both held a lock across a store round-trip — one "
             "slow response stalls every contender; move the call outside "
             "or annotate why the lock is uncontended",
+        ),
+        Rule(
+            "REP001", "error",
+            "direct store write on a follower/standby handle",
+            "ISSUE 8: every mutation routes through the leased leader "
+            "seam; a write applied directly to a follower's store forks "
+            "the replica set's history (the fork no election can ever "
+            "reconcile). The sanctioned follower write path is the "
+            "replication apply seam (apply_replicated / install_snapshot "
+            "/ append_entries / load_snapshot)",
         ),
     )
 }
@@ -500,6 +519,51 @@ _STORE_VERBS = {
     "try_delete", "create", "watch",
 }
 
+# REP001: mutation verbs on a receiver whose dotted path names a
+# follower/standby handle (`follower.update(...)`, `self.standby.store.
+# delete(...)`). Matching is per-component so `follower.store.create`
+# resolves like `follower.create`.
+_MUTATION_VERBS = {
+    "create", "update", "patch", "patch_batch", "delete", "try_delete",
+}
+_FOLLOWER_COMPONENT_RE = re.compile(r"(^|_)(follower|standby|replica)s?$")
+# functions that ARE the replication apply seam (and subclass overrides
+# ending in these names): direct follower writes are their whole job
+_REPLICATION_APPLY_FNS = {
+    "apply_replicated", "install_snapshot", "append_entries",
+    "load_snapshot",
+}
+
+
+def _is_follower_like(recv: Optional[str]) -> bool:
+    if not recv:
+        return False
+    return any(
+        _FOLLOWER_COMPONENT_RE.search(part) for part in recv.split(".")
+    )
+
+
+def _in_replication_apply(fn_stack: List[str]) -> bool:
+    return any(name in _REPLICATION_APPLY_FNS for name in fn_stack)
+
+
+def _check_rep001(ctx: _FileCtx, call: ast.Call,
+                  fn_stack: List[str]) -> None:
+    if _in_replication_apply(fn_stack):
+        return
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _MUTATION_VERBS:
+        return
+    recv = _dotted(f.value)
+    if _is_follower_like(recv):
+        ctx.report(
+            "REP001", call,
+            f"store write {recv}.{f.attr}(...) on a follower handle "
+            f"bypasses the leader seam and forks the replicated history; "
+            f"route the mutation through the leader (followers only "
+            f"write via the replication apply path)",
+        )
+
 
 def _is_lock_expr(expr: ast.AST) -> bool:
     """Does a with-item context expression look like a lock? Matched on the
@@ -688,6 +752,7 @@ def lint_source(
             _check_uid001(ctx, node)
             _check_blk001(ctx, node, fn_stack)
             _check_dur001(ctx, node, fn_stack)
+            _check_rep001(ctx, node, fn_stack)
             if lock_depth > 0:
                 _check_lck001(ctx, node)
         if isinstance(node, ast.ExceptHandler):

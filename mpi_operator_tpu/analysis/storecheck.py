@@ -11,6 +11,8 @@ each sequence identically against all three backends —
 - ``ObjectStore`` (in-memory),
 - ``SqliteStore`` (the durable file backend),
 - ``HttpStoreClient`` → ``StoreServer`` (the wire seam, small event ring),
+- the 3-node replica set through its failover client (leader writes,
+  follower reads and watch — machinery/replicated_store.py, ISSUE 8),
 
 diffing **return values, error classes, final state and delivered watch
 streams** op-by-op against :class:`analysis.model.ModelStore`, the
@@ -573,10 +575,41 @@ def _mk_http() -> Harness:
                    watch_fn=lambda: client.watch(None))
 
 
+def _mk_replica_parts():
+    """A fresh manual-mode 3-node replica set: n0 elected leader, the
+    failover client reading (and watching) from follower n1 — the
+    replica set's OWN read contract is what the differential diff then
+    exercises: every acked write must be visible on a follower the
+    moment the ack returns (ship-to-all-reachable before ack)."""
+    import shutil
+    import tempfile
+
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    d = tempfile.mkdtemp(prefix="storecheck-replica-")
+    rset = ReplicaSet(3, dir=d, poll_interval=0.01)
+    if not rset.elect("n0"):
+        raise FuzzError("fresh replica set failed its first election")
+    client = rset.client(read_from="n1")
+
+    def teardown():
+        rset.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+    return rset, client, teardown
+
+
+def _mk_replica() -> Harness:
+    rset, client, teardown = _mk_replica_parts()
+    return Harness("replica", client, teardown=teardown,
+                   watch_fn=lambda: client.watch(None))
+
+
 REAL_BACKENDS: Dict[str, Callable[[], Harness]] = {
     "memory": _mk_memory,
     "sqlite": _mk_sqlite,
     "http": _mk_http,
+    "replica": _mk_replica,
 }
 
 
@@ -715,6 +748,54 @@ def _mk_mutant_ring_replays_past_dropped() -> Harness:
                    watch_fn=h.watch_fn)
 
 
+def _mk_mutant_replica_ack_before_majority() -> Harness:
+    """Seeded REPLICATION bug: the leader acks a mutation after its own
+    local commit without waiting for any follower to durably apply (the
+    ack-before-majority window at its widest — shipping never happens).
+    Reads ride followers, so the very first follower read (or the
+    final-state list) after an acked write sees a store that 'lost' it —
+    exactly what a leader crash inside that window would make permanent.
+    No watch harness: the catch is the read path, and the detector must
+    stay fast under ddmin re-execution."""
+    rset, client, teardown = _mk_replica_parts()
+    # the leader commits locally, ships nothing, acks
+    rset.nodes["n0"]._replicate = lambda epoch: None
+    return Harness("mutant-replica-ack-before-majority", client,
+                   teardown=teardown)
+
+
+def _mk_mutant_replica_follower_regressed_rv() -> Harness:
+    """Seeded REPLICATION bug: a follower serves a read from a stale
+    snapshot of an incarnation it has already shown newer — the
+    rv-REGRESSION the follower-read contract forbids (lag is legal,
+    going backwards is not; a lister fed this would un-observe a
+    committed transition)."""
+    rset, client, teardown = _mk_replica_parts()
+
+    class StickyReads:
+        """First-read-wins cache per live incarnation: after any later
+        write, get() still returns the old version at its old rv."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._cache: Dict[Any, Any] = {}
+
+        def get(self, kind, namespace, name):
+            obj = self._inner.get(kind, namespace, name)
+            key = (kind, namespace, name)
+            cached = self._cache.get(key)
+            if cached is not None and cached.metadata.uid == obj.metadata.uid:
+                return cached.deepcopy()
+            self._cache[key] = obj.deepcopy()
+            return obj
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    return Harness("mutant-replica-follower-regressed-rv",
+                   StickyReads(client), teardown=teardown)
+
+
 MUTANTS: Dict[str, Callable[[], Harness]] = {
     "delete-no-rv-bump": _mk_mutant_delete_no_rv_bump,
     "patch-drops-uid-pin": _mk_mutant_patch_drops_uid_pin,
@@ -722,6 +803,9 @@ MUTANTS: Dict[str, Callable[[], Harness]] = {
     "status-leaks-spec": _mk_mutant_status_leaks_spec,
     "batch-aborts-on-error": _mk_mutant_batch_aborts_on_error,
     "ring-replays-past-dropped": _mk_mutant_ring_replays_past_dropped,
+    "replica-ack-before-majority": _mk_mutant_replica_ack_before_majority,
+    "replica-follower-regressed-rv":
+        _mk_mutant_replica_follower_regressed_rv,
 }
 
 

@@ -36,6 +36,15 @@ Script format (YAML or JSON; times are seconds relative to ``arm()``)::
       - {at: 1.0, fault: drop, match: mutation, prob: 0.3, duration: 3.0}
       - {at: 1.0, fault: delay, seconds: 0.05, duration: 3.0}
       - {at: 4.0, fault: duplicate, match: mutation, prob: 1.0, duration: 1.0}
+      - {at: 2.0, fault: partition, a: n0, b: n1, duration: 1.5}  # symmetric cut
+
+``partition`` is the Jepsen verb: both directions between two NAMED
+endpoints blackholed at once, healed on schedule (a duration expands to
+an explicit ``heal`` edge). It targets a *fabric* — the in-process
+``replicated_store.PeerHub`` for replica-set schedules, or
+:class:`NamedProxyFabric` over per-directed-pair proxies for multi-process
+deployments — passed to :class:`ChaosController` as ``fabric=``. Like
+every other fault, knobs it ignores are rejected at parse time.
 
 Dropped requests are closed BEFORE being forwarded upstream, so the client
 observes a transport error for a request the server never saw — the same
@@ -65,6 +74,10 @@ PROCESS_FAULTS = ("kill", "term", "restart")
 # faults acting on the proxy seam
 PROXY_FAULTS = ("sever", "blackhole", "restore", "drop", "delay",
                 "duplicate", "clear")
+# faults acting on a fabric (a registry of named endpoints supporting
+# symmetric pairwise cuts: replicated_store.PeerHub in-process, or any
+# object with partition(a, b)/heal(a, b)) — the Jepsen partition verb
+FABRIC_FAULTS = ("partition", "heal")
 MATCHES = ("any", "watch", "mutation", "read")
 
 
@@ -88,6 +101,11 @@ _FAULT_KNOBS: Dict[str, frozenset] = {
     "drop": frozenset({"match", "prob", "duration"}),
     "delay": frozenset({"match", "prob", "seconds", "duration"}),
     "duplicate": frozenset({"match", "prob", "duration"}),
+    # partition is SYMMETRIC (both directions blackholed) between two
+    # NAMED endpoints; a duration expands into an explicit heal action so
+    # the executed log shows both edges (same treatment as blackhole)
+    "partition": frozenset({"a", "b", "duration"}),
+    "heal": frozenset({"a", "b"}),
 }
 
 
@@ -105,6 +123,8 @@ class ChaosAction:
     prob: float = 1.0              # drop/duplicate: per-request probability
     seconds: float = 0.0           # delay: added latency per request
     until: Optional[float] = None  # rule faults: deactivate at this offset
+    a: str = ""                    # fabric faults: the two endpoint names
+    b: str = ""
 
 
 class ChaosScript:
@@ -130,7 +150,7 @@ class ChaosScript:
             if not isinstance(a, dict):
                 raise ChaosScriptError(f"actions[{i}]: must be a mapping")
             unknown = set(a) - {"at", "fault", "target", "match", "prob",
-                                "seconds", "duration"}
+                                "seconds", "duration", "a", "b"}
             if unknown:
                 raise ChaosScriptError(
                     f"actions[{i}]: unknown keys {sorted(unknown)}"
@@ -144,10 +164,11 @@ class ChaosScript:
                 ) from None
             if at < 0:
                 raise ChaosScriptError(f"actions[{i}]: at must be >= 0")
-            if fault not in PROCESS_FAULTS + PROXY_FAULTS:
+            known = PROCESS_FAULTS + PROXY_FAULTS + FABRIC_FAULTS
+            if fault not in known:
                 raise ChaosScriptError(
                     f"actions[{i}]: unknown fault {fault!r} (known: "
-                    f"{', '.join(PROCESS_FAULTS + PROXY_FAULTS)})"
+                    f"{', '.join(known)})"
                 )
             inapplicable = set(a) - {"at", "fault"} - _FAULT_KNOBS[fault]
             if inapplicable:
@@ -161,6 +182,14 @@ class ChaosScript:
                 raise ChaosScriptError(
                     f"actions[{i}]: fault {fault!r} needs a 'target'"
                 )
+            end_a = str(a.get("a", ""))
+            end_b = str(a.get("b", ""))
+            if fault in FABRIC_FAULTS:
+                if not end_a or not end_b or end_a == end_b:
+                    raise ChaosScriptError(
+                        f"actions[{i}]: fault {fault!r} needs two distinct "
+                        f"endpoint names 'a' and 'b'"
+                    )
             match = str(a.get("match", "any"))
             if match not in MATCHES and not match.startswith("/"):
                 raise ChaosScriptError(
@@ -179,9 +208,15 @@ class ChaosScript:
                 actions.append(ChaosAction(at=at, fault="blackhole"))
                 actions.append(ChaosAction(at=until, fault="restore"))
                 continue
+            if fault == "partition" and until is not None:
+                actions.append(ChaosAction(at=at, fault="partition",
+                                           a=end_a, b=end_b))
+                actions.append(ChaosAction(at=until, fault="heal",
+                                           a=end_a, b=end_b))
+                continue
             actions.append(ChaosAction(
                 at=at, fault=fault, target=target, match=match, prob=prob,
-                seconds=seconds, until=until,
+                seconds=seconds, until=until, a=end_a, b=end_b,
             ))
         return cls(seed, actions)
 
@@ -592,6 +627,36 @@ class ChaosProxy:
         return out
 
 
+class NamedProxyFabric:
+    """Adapts per-directed-pair :class:`ChaosProxy` instances to the
+    partition fabric surface: register the proxy carrying a→b traffic
+    under ``"a->b"``; ``partition(a, b)`` then blackholes BOTH directions
+    (and severs their live connections), ``heal`` restores both — the
+    multi-process twin of ``replicated_store.PeerHub.partition``. Missing
+    links fail loudly: a partition that silently cut nothing would make a
+    'passing' chaos run meaningless (the ChaosScript fail-fast rule)."""
+
+    def __init__(self, links: Dict[str, ChaosProxy]):
+        self.links = dict(links)
+
+    def _pair(self, a: str, b: str) -> List[ChaosProxy]:
+        out = []
+        for key in (f"{a}->{b}", f"{b}->{a}"):
+            if key not in self.links:
+                raise KeyError(f"no proxy registered for link {key!r}")
+            out.append(self.links[key])
+        return out
+
+    def partition(self, a: str, b: str) -> None:
+        for proxy in self._pair(a, b):
+            proxy.set_blackhole(True)
+            proxy.sever("any")
+
+    def heal(self, a: str, b: str) -> None:
+        for proxy in self._pair(a, b):
+            proxy.set_blackhole(False)
+
+
 # ---------------------------------------------------------------------------
 # timeline driver
 # ---------------------------------------------------------------------------
@@ -605,9 +670,14 @@ class ChaosController:
 
     def __init__(self, script: ChaosScript, *,
                  proxy: Optional[ChaosProxy] = None,
-                 targets: Optional[Dict[str, Any]] = None):
+                 targets: Optional[Dict[str, Any]] = None,
+                 fabric: Any = None):
         self.script = script
         self.proxy = proxy
+        # the partition/heal surface: anything with partition(a, b) and
+        # heal(a, b) — replicated_store.PeerHub, or a NamedProxyFabric
+        # over per-directed-pair ChaosProxy instances
+        self.fabric = fabric
         self.targets = dict(targets or {})
         self.executed: List[Tuple[float, ChaosAction, Optional[str]]] = []
         self._stop = threading.Event()
@@ -658,6 +728,11 @@ class ChaosController:
                 raise KeyError(f"no process target {a.target!r} registered")
             getattr(target, {"kill": "kill", "term": "term",
                              "restart": "restart"}[a.fault])()
+            return
+        if a.fault in FABRIC_FAULTS:
+            if self.fabric is None:
+                raise RuntimeError(f"fault {a.fault!r} needs a fabric")
+            getattr(self.fabric, a.fault)(a.a, a.b)
             return
         if self.proxy is None:
             raise RuntimeError(f"fault {a.fault!r} needs a ChaosProxy")

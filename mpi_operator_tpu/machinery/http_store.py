@@ -42,6 +42,7 @@ import hmac
 import json
 import logging
 import queue
+import random
 import threading
 import time
 import urllib.error
@@ -60,6 +61,8 @@ from mpi_operator_tpu.machinery.store import (
     Conflict,
     Forbidden,
     NotFound,
+    NotLeader,
+    ReplicationUnavailable,
     Unauthorized,
     WatchEvent,
     patch_batch_via_loop,
@@ -75,6 +78,8 @@ _ERROR_CLASSES = {
     "Unauthorized": Unauthorized,
     "Forbidden": Forbidden,
     "BadPatch": BadPatch,
+    "NotLeader": NotLeader,
+    "ReplicationUnavailable": ReplicationUnavailable,
 }
 
 # Store objects are manifests and status records — O(KB). The cap keeps an
@@ -910,6 +915,13 @@ class StoreServer:
         try:
             if parts == ["healthz"]:
                 return 200, {"ok": True}
+            if parts == ["v1", "replica", "status"] and method == "GET":
+                # replica-role introspection (`ctl store status`); a
+                # non-replicated backing is an honest standalone
+                status_fn = getattr(self.backing, "replica_status", None)
+                if callable(status_fn):
+                    return 200, dict(status_fn(), endpoint=self.url)
+                return 200, {"role": "standalone", "endpoint": self.url}
             if parts == ["v1", "watch"] and method == "GET":
                 return self._handle_watch(qs)
             if parts == ["v1", "patch-batch"] and method == "POST":
@@ -924,6 +936,18 @@ class StoreServer:
         except Conflict as e:
             self._count("conflict")
             return 409, {"error": "Conflict", "message": str(e)}
+        except NotLeader as e:
+            # 421 Misdirected Request: this replica cannot serve the
+            # mutation; the payload carries the leader hint the client's
+            # failover path follows before backing off
+            return 421, {"error": "NotLeader", "message": str(e),
+                         "leader": e.leader}
+        except ReplicationUnavailable as e:
+            # 503: the write's outcome is INDETERMINATE (committed on a
+            # minority) — never retried automatically by the client, which
+            # must surface it so the caller can re-read first
+            return 503, {"error": "ReplicationUnavailable",
+                         "message": str(e)}
         except BadPatch as e:
             return 400, {"error": "BadPatch", "message": str(e)}
         except KeyError as e:  # unknown kind from serialize registry
@@ -1137,15 +1161,33 @@ class HttpStoreClient:
     single-poller pattern as SqliteStore). ≙ the generated clientset +
     shared informer factory pair of the reference
     (v2/pkg/client/, mpi_job_controller.go:300-339).
+
+    **Replica awareness**: ``url`` may be a list (or comma-joined string)
+    of replica endpoints. A connection-refused request rotates to the
+    next endpoint BEFORE backing off (one dead replica costs a
+    re-connect, not a backoff window), and a 421 NotLeader answer is
+    followed to the advertised leader (bounded redirects, learning the
+    endpoint if it was not in the list) — so follower reads spread over
+    the list while mutations find the leased leader on their own.
     """
 
-    def __init__(self, url: str, *, timeout: float = 10.0,
+    def __init__(self, url, *, timeout: float = 10.0,
                  watch_poll_timeout: float = 25.0,
                  token: Optional[str] = None,
                  ca_file: Optional[str] = None,
                  conn_refused_retries: int = 5,
-                 retry_base_delay: float = 0.1):
-        self.url = url.rstrip("/")
+                 retry_base_delay: float = 0.1,
+                 not_leader_redirects: int = 3):
+        urls = url.split(",") if isinstance(url, str) else list(url)
+        self._endpoints = [u.strip().rstrip("/") for u in urls if u.strip()]
+        if not self._endpoints:
+            raise ValueError("HttpStoreClient needs at least one endpoint")
+        self._ep_lock = threading.Lock()
+        self._ep_i = 0
+        # `url` stays an attribute (not a property) — the current active
+        # endpoint; rotation/redirect move it so the watch long-poll
+        # follows the same endpoint choice as the verbs
+        self.url = self._endpoints[0]
         self.token = token
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
@@ -1156,11 +1198,17 @@ class HttpStoreClient:
         # safe for every verb — rv-guarded PUT/PATCH would 409 on a
         # phantom duplicate anyway. Default 5 retries, 0.1s doubling to a
         # 2s cap (~3s window) rides out a quick restart without turning a
-        # hard outage into a hang. 0 disables.
+        # hard outage into a hang. 0 disables. The backoff is JITTERED
+        # (up to +25%) so a fleet of clients losing one replica does not
+        # re-dial the next in lockstep.
         self.conn_refused_retries = conn_refused_retries
         self.retry_base_delay = retry_base_delay
-        # observable by tests/benches: how often the backoff path fired
-        self.retry_stats = {"conn_refused_retries": 0}
+        self.not_leader_redirects = not_leader_redirects
+        self._retry_rng = random.Random(f"{id(self)}:{self._endpoints[0]}")
+        # observable by tests/benches: how often each failover path fired
+        self.retry_stats = {"conn_refused_retries": 0,
+                            "endpoint_rotations": 0,
+                            "not_leader_redirects": 0}
         # https:// store with a self-signed cert: pin it (or its CA) here —
         # certificate verification stays ON; we only change the trust root.
         # None = system trust store.
@@ -1185,6 +1233,37 @@ class HttpStoreClient:
 
     # -- transport ----------------------------------------------------------
 
+    def _rotate_endpoint(self) -> int:
+        """Move to the next endpoint in the list; returns the list
+        length so the caller can do per-REQUEST cycle accounting (the
+        shared cursor is advanced by every thread — comparing it against
+        a per-request start index would let concurrent requests corrupt
+        each other's wrap detection into a backoff-free hot spin)."""
+        with self._ep_lock:
+            n = len(self._endpoints)
+            if n > 1:
+                self._ep_i = (self._ep_i + 1) % n
+                self.url = self._endpoints[self._ep_i]
+                self.retry_stats["endpoint_rotations"] += 1
+            return n
+
+    def _follow_leader(self, leader: str) -> bool:
+        """Adopt a NotLeader hint as the active endpoint, learning it if
+        the replica list did not include it (leader discovery). Only a
+        dialable URL is adopted — an in-process replica set with no
+        advertise mapping hints bare node ids, and parking the client on
+        'n0' would poison every subsequent request."""
+        leader = leader.rstrip("/")
+        if not leader.startswith(("http://", "https://")):
+            return False
+        with self._ep_lock:
+            if leader not in self._endpoints:
+                self._endpoints.append(leader)
+            self._ep_i = self._endpoints.index(leader)
+            self.url = leader
+            self.retry_stats["not_leader_redirects"] += 1
+        return True
+
     def _request(
         self,
         method: str,
@@ -1196,12 +1275,14 @@ class HttpStoreClient:
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(
-            self.url + path, data=data, method=method, headers=headers,
-        )
         delay = self.retry_base_delay
         attempt = 0
+        redirects = 0
+        refused_in_cycle = 0
         while True:
+            req = urllib.request.Request(
+                self.url + path, data=data, method=method, headers=headers,
+            )
             try:
                 with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout, context=self._ssl_ctx
@@ -1214,24 +1295,75 @@ class HttpStoreClient:
                 except (ValueError, OSError):
                     pass  # non-JSON error body (proxy page): generic raise below
                 cls = _ERROR_CLASSES.get(payload.get("error", ""))
+                if cls is NotLeader:
+                    leader = payload.get("leader")
+                    if (
+                        leader
+                        and redirects < self.not_leader_redirects
+                        and self._follow_leader(leader)
+                    ):
+                        # DEFINITE rejection (nothing committed): follow
+                        # the hint immediately — the common failover case
+                        # of a client parked on a follower
+                        redirects += 1
+                        continue
+                    raise NotLeader(payload.get("message", str(e)),
+                                    leader=leader) from None
                 if cls is not None:
                     raise cls(payload.get("message", str(e))) from None
                 raise
             except urllib.error.URLError as e:
                 # connection refused = the request NEVER reached the server
                 # (unlike a reset mid-flight, there is nothing ambiguous to
-                # replay): bounded backoff so a store restart window does
-                # not kill heartbeating agents or drop a status mirror
-                if (
-                    attempt >= self.conn_refused_retries
-                    or not isinstance(e.reason, ConnectionRefusedError)
-                ):
+                # replay): rotate to the next replica FIRST — only once
+                # every endpoint refused does the bounded backoff fire, so
+                # a single dead replica never costs a backoff window. The
+                # retry budget counts BACKOFF CYCLES (full wraps of the
+                # endpoint list), not individual refusals — charging per
+                # refusal would shrink the documented ~3s outage ride-out
+                # window N-fold for an N-endpoint client, killing exactly
+                # the heartbeating agents the budget exists to protect.
+                if not isinstance(e.reason, ConnectionRefusedError):
                     raise
-                attempt += 1
-                self.retry_stats["conn_refused_retries"] += 1
-                if self._stop.wait(delay):
-                    raise  # closing: don't outlive the client
-                delay = min(delay * 2, 2.0)
+                refused_in_cycle += 1
+                if refused_in_cycle >= self._rotate_endpoint():
+                    # every endpoint refused within THIS request's cycle
+                    refused_in_cycle = 0
+                    if attempt >= self.conn_refused_retries:
+                        raise
+                    attempt += 1
+                    self.retry_stats["conn_refused_retries"] += 1
+                    jittered = delay * (1 + self._retry_rng.uniform(0, 0.25))
+                    if self._stop.wait(jittered):
+                        raise  # closing: don't outlive the client
+                    delay = min(delay * 2, 2.0)
+
+    def replica_status(self) -> List[Dict[str, Any]]:
+        """Per-endpoint /v1/replica/status (best-effort: an unreachable
+        replica reports as such instead of failing the survey) — the
+        `ctl store status` data source."""
+        out: List[Dict[str, Any]] = []
+        with self._ep_lock:
+            endpoints = list(self._endpoints)
+        for ep in endpoints:
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            req = urllib.request.Request(
+                ep + "/v1/replica/status", headers=headers,
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_ctx
+                ) as r:
+                    out.append(dict(json.loads(r.read()), endpoint=ep))
+            except Exception as e:
+                # the survey must render a dead replica, not die with it
+                log.debug("replica status probe failed for %s", ep,
+                          exc_info=True)
+                out.append({"endpoint": ep, "role": "unreachable",
+                            "error": str(e)})
+        return out
 
     # -- CRUD (same contracts as ObjectStore) -------------------------------
 

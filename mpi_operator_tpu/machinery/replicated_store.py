@@ -1,0 +1,1076 @@
+"""Replicated HA store: a 3-node log-shipping replica set over SqliteStore.
+
+The store was the control plane's last single point of failure (ROADMAP
+item 1): PR 3 proved single-node crash-recovery, nothing more. This module
+is the kube-apiserver/etcd split's missing half — a leased leader accepts
+all mutations and synchronously ships the committed-op WAL (every
+``SqliteStore._txn`` commit is already a log row carrying the object at
+its rv) to followers, **acking a write only after a majority has durably
+applied it**. Followers serve reads and watch fan-out from their own
+sqlite files (listers/informers may lag, never regress rv); a new leader
+is elected by quorum lease takeover with log-tail reconciliation.
+
+Protocol, in five rules:
+
+1. **Epochs are votes.** A node's durable ``epoch`` (replica_meta, via the
+   same ``_txn`` seam every write rides) only ever increases, and adopting
+   an epoch IS granting that epoch's single vote. Majorities intersect, so
+   **at most one leader exists per epoch** — the chaos e2e asserts exactly
+   that from the leadership log.
+2. **Leases fence.** A follower refuses votes while its current leader's
+   lease (refreshed by every append/heartbeat) is still running, so a
+   live leader cannot be deposed by a flaky candidate; a leader that
+   cannot renew against a majority steps down at its own (shorter) local
+   deadline before any grantor's lease can expire.
+3. **Commit = majority-durable.** The leader commits locally (its sqlite
+   IS one of the copies), ships the new log rows to every reachable
+   follower, and acks the client only when ``majority`` copies (itself
+   included) have applied. Shipping to ALL reachable followers before
+   returning is what makes follower reads read-your-writes on a healthy
+   set — the property the differential fuzzer leans on.
+4. **Election reconciles tails.** A winning candidate adopts the highest
+   applied rv among its granting quorum (pulling the missing tail, or a
+   full snapshot when the tail was trimmed). Any ACKED write is on a
+   majority; any quorum intersects that majority; therefore the new
+   leader's history contains every acked write — the no-acked-write-lost
+   invariant.
+5. **Divergent suffixes truncate.** Entries are shipped with the previous
+   entry's content hash; a follower whose same-rv history hashes
+   differently (it holds a dead epoch's unacked suffix — e.g. the old
+   leader's local commit that never reached a majority) resyncs from a
+   leader snapshot, wiping the suffix. A write the leader definitively
+   rejected is therefore never resurrected; a write that died
+   *indeterminately* (:class:`ReplicationUnavailable` — the leader lost
+   its majority mid-ship) may surface or vanish, exactly like an
+   apiserver timeout, and is documented as such.
+
+Deployment shape: each node's duck-typed surface can sit behind its own
+``StoreServer``; follower mutations raise :class:`NotLeader` (421 on the
+wire, with a leader hint) and ``HttpStoreClient`` rotates/redirects.
+In-process, :class:`ReplicaClient` is the same failover client without
+the sockets — it is what the analysis gates (storecheck / linearize /
+crashpoints) drive, the replica set being just another duck-typed
+backend to them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery.sqlite_store import (
+    LogTruncated,
+    SqliteStore,
+    entry_hash,
+)
+from mpi_operator_tpu.machinery.store import (
+    NotLeader,
+    ReplicationUnavailable,
+)
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.replica")
+
+LEADER = "leader"
+FOLLOWER = "follower"
+
+
+class PeerUnreachable(ConnectionError):
+    """The transport could not deliver (node down / link partitioned)."""
+
+
+class StaleEpoch(RuntimeError):
+    """An RPC arrived from a dead epoch: the sender has been superseded
+    and must step down (the fencing signal)."""
+
+    def __init__(self, current_epoch: int):
+        super().__init__(f"superseded by epoch {current_epoch}")
+        self.current_epoch = current_epoch
+
+
+class PeerHub:
+    """In-process replica transport with fault injection: per-node down
+    flags (SIGKILL semantics) and symmetric pairwise partitions — the
+    fabric seam ChaosScript ``partition`` actions drive. Calls are
+    synchronous method dispatch; an unreachable destination raises
+    :class:`PeerUnreachable` exactly where a socket would ECONNREFUSED."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, "ReplicaNode"] = {}
+        self._down: Dict[str, bool] = {}
+        self._cuts: set = set()  # frozenset({a, b}) pairs
+
+    def register(self, node: "ReplicaNode") -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+            self._down[node.node_id] = False
+
+    def set_down(self, node_id: str, down: bool) -> None:
+        with self._lock:
+            self._down[node_id] = down
+
+    # -- the chaos fabric surface (ChaosController(fabric=hub)) -------------
+
+    def partition(self, a: str, b: str) -> None:
+        """Blackhole BOTH directions between two named endpoints."""
+        with self._lock:
+            if a not in self._nodes or b not in self._nodes:
+                raise KeyError(f"unknown partition endpoint in ({a!r}, {b!r})")
+            self._cuts.add(frozenset((a, b)))
+
+    def heal(self, a: str, b: str) -> None:
+        with self._lock:
+            self._cuts.discard(frozenset((a, b)))
+
+    def heal_all(self) -> None:
+        with self._lock:
+            self._cuts.clear()
+
+    def call(self, src: str, dst: str, method: str, *args) -> Any:
+        with self._lock:
+            if self._down.get(dst, True) or self._down.get(src, False):
+                raise PeerUnreachable(f"{dst} is down")
+            if frozenset((src, dst)) in self._cuts:
+                raise PeerUnreachable(f"{src}<->{dst} partitioned")
+            node = self._nodes[dst]
+        # dispatch OUTSIDE the hub lock: a handler may itself call peers
+        return getattr(node, method)(*args)
+
+
+def _monotonic() -> float:
+    return time.monotonic()
+
+
+class ReplicaNode:
+    """One replica-set member: a SqliteStore plus the replication role.
+
+    Duck-typed store surface: reads/watches serve locally on any role;
+    mutations require the lease and run commit-then-ship-then-ack under
+    one gate so ship order equals rv order. RPC handler methods
+    (request_vote / append_entries / fetch_entries / install_snapshot /
+    replica_status) are invoked by peers through the hub.
+    """
+
+    def __init__(self, node_id: str, path: str, hub: PeerHub, rset:
+                 "ReplicaSet", *, lease_duration: float,
+                 poll_interval: float = 0.05):
+        self.node_id = node_id
+        self.path = path
+        self.hub = hub
+        self.rset = rset
+        self.lease_duration = lease_duration
+        self.poll_interval = poll_interval
+        self.backing = SqliteStore(path, poll_interval=poll_interval)
+        # durable election state: adopting an epoch IS this node's one
+        # vote in it (rule 1); survives crash/restart via replica_meta
+        self.epoch = int(self.backing.get_meta("epoch", "0"))
+        self._state_lock = threading.RLock()
+        self.role = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.crashed = False
+        # follower: how long the current leader's lease runs on MY clock;
+        # leader: my own (stricter) renew deadline
+        self._lease_until = 0.0
+        self._lease_deadline = 0.0
+        # leader ship cursor + per-peer applied rv (the lag metric)
+        self._ship_lock = threading.Lock()
+        self._shipped_rv = self.backing.current_rv()
+        self._peer_rv: Dict[str, int] = {}
+        # serializes the WHOLE fence-check→apply window of incoming
+        # append_entries/install_snapshot: without it a stale leader's
+        # delayed append could pass the epoch fence, stall, and then
+        # interleave its dead-epoch rows into a newer leader's apply
+        # (duplicate-rv IntegrityError or a gapped follower history)
+        self._apply_lock = threading.Lock()
+
+    # -- small helpers -------------------------------------------------------
+
+    @property
+    def peers(self) -> List[str]:
+        return [n for n in self.rset.node_ids if n != self.node_id]
+
+    @property
+    def majority(self) -> int:
+        return len(self.rset.node_ids) // 2 + 1
+
+    def _leader_hint(self) -> Optional[str]:
+        with self._state_lock:
+            lid = self.node_id if self.role == LEADER else self.leader_id
+        return self.rset.advertise.get(lid, lid) if lid else None
+
+    def _adopt_epoch(self, epoch: int) -> None:
+        """Durably advance to ``epoch`` (caller holds _state_lock)."""
+        self.backing.set_meta("epoch", str(epoch))
+        self.epoch = epoch
+
+    def _step_down(self, why: str) -> None:
+        with self._state_lock:
+            if self.role == LEADER:
+                log.info("%s: stepping down (%s)", self.node_id, why)
+            self.role = FOLLOWER
+            # hold off on campaigning for a full lease: peers granted the
+            # superseding/surviving side time to establish itself
+            self._lease_until = _monotonic() + self.lease_duration
+
+    def _require_leader(self) -> int:
+        """Validate leadership and return THE REIGN'S EPOCH, atomically:
+        everything shipped on behalf of this check must be stamped with
+        exactly this epoch — re-reading self.epoch at ship time would
+        let a leader deposed mid-write ship its entry as the NEW epoch's
+        traffic, sailing past the StaleEpoch fence."""
+        with self._state_lock:
+            if self.crashed:
+                raise PeerUnreachable(f"{self.node_id} is down")
+            if self.role != LEADER:
+                raise NotLeader(
+                    f"replica {self.node_id} is a follower; mutations go "
+                    f"to the leased leader",
+                    leader=self._leader_hint(),
+                )
+            if _monotonic() > self._lease_deadline:
+                raise NotLeader(
+                    f"replica {self.node_id}'s lease expired; awaiting "
+                    f"re-election",
+                    leader=None,
+                )
+            return self.epoch
+
+    # -- replication (leader side) ------------------------------------------
+
+    def _leader_write(self, fn: Callable[[], Any]) -> Any:
+        """Commit locally, ship the new log rows, ack on majority. One
+        gate serializes writers so the ship stream is exactly the commit
+        stream; store errors (Conflict/NotFound/...) raise before any
+        commit and ship nothing — they stay DEFINITE failures."""
+        with self._ship_lock:
+            epoch = self._require_leader()
+            result = fn()
+            self._replicate(epoch)
+            return result
+
+    def _replicate(self, epoch: int) -> None:
+        tail = self.backing.log_tail(self._shipped_rv)
+        if not tail:
+            # an empty tail after fn() is normally just an all-failure
+            # patch_batch (nothing committed). But if the REIGN advanced
+            # mid-write, a new leader's resync may have truncated the
+            # just-committed entry out of our local history before we
+            # could ship it — returning success would silently ack a
+            # write that exists nowhere. History rewrites always ride an
+            # epoch advance, so the reign check is the exact detector.
+            with self._state_lock:
+                if self.epoch != epoch:
+                    raise ReplicationUnavailable(
+                        f"superseded by epoch {self.epoch} mid-write: "
+                        f"the local commit may have been truncated by "
+                        f"the new leader's history — outcome "
+                        f"INDETERMINATE, re-read before retrying"
+                    )
+            return
+        acks = 1  # the local sqlite commit is copy #1
+        for peer in self.peers:
+            if self._ship_to(peer, epoch, self._shipped_rv, tail):
+                acks += 1
+        self._shipped_rv = tail[-1]["rv"]
+        self._update_lag()
+        if acks >= self.majority:
+            with self._state_lock:
+                # a majority-acked ship doubles as a lease renewal — but
+                # only for the reign that shipped it: a leader deposed
+                # mid-write must not resurrect its deadline
+                if self.role == LEADER and self.epoch == epoch:
+                    self._lease_deadline = max(
+                        self._lease_deadline,
+                        _monotonic() + self.lease_duration,
+                    )
+            return
+        self._step_down("write could not reach a majority")
+        raise ReplicationUnavailable(
+            f"write committed on {acks}/{len(self.rset.node_ids)} replicas "
+            f"(majority {self.majority} unreachable): outcome INDETERMINATE "
+            f"— re-read before retrying"
+        )
+
+    def _ship_to(self, peer: str, epoch: int, prev_rv: int,
+                 entries: List[Dict[str, Any]]) -> bool:
+        """Push a tail to one follower, walking it through lag catch-up
+        (``behind``) and divergent-suffix truncation (``divergent`` →
+        snapshot install). Returns True when the follower's applied rv
+        reaches the tail's end."""
+        target_rv = entries[-1]["rv"] if entries else prev_rv
+        try:
+            for _ in range(4):  # behind/divergent round-trips, bounded
+                res = self.hub.call(
+                    self.node_id, peer, "append_entries",
+                    epoch, self.node_id, prev_rv,
+                    self.backing.tail_hash(prev_rv), entries,
+                )
+                applied = res.get("applied")
+                if applied is not None and applied >= target_rv:
+                    self._peer_rv[peer] = applied
+                    return True
+                if "behind" in res:
+                    prev_rv = res["behind"]
+                elif res.get("divergent"):
+                    snap = self.backing.snapshot_state()
+                    res2 = self.hub.call(
+                        self.node_id, peer, "install_snapshot",
+                        epoch, self.node_id, snap,
+                    )
+                    self._peer_rv[peer] = prev_rv = res2["applied"]
+                    if prev_rv >= target_rv:
+                        return True
+                else:
+                    return False
+                try:
+                    entries = self.backing.log_tail(prev_rv)
+                except LogTruncated:
+                    prev_rv = -1  # force the snapshot path next loop
+                    entries = []
+                    continue
+            return False
+        except PeerUnreachable:
+            return False
+        except StaleEpoch as e:
+            self._step_down(f"fenced by epoch {e.current_epoch}")
+            raise ReplicationUnavailable(
+                f"superseded by epoch {e.current_epoch} mid-ship: outcome "
+                f"INDETERMINATE — re-read before retrying"
+            ) from None
+
+    def _update_lag(self) -> None:
+        head = self.backing.current_rv()
+        for peer, rv in self._peer_rv.items():
+            metrics.store_replication_lag.set(
+                max(0, head - rv), follower=peer,
+            )
+
+    def _heartbeat(self, epoch: int) -> int:
+        """Empty append to every peer: refreshes their leases, drags
+        laggards up to the ship cursor. Returns reachable copies (self
+        included). MUST run under _ship_lock: racing a concurrent
+        _replicate on the shared ship cursor would read it mid-advance
+        and misdiagnose a healthy follower as divergent (a spurious
+        snapshot resync) or double-apply the in-flight rows. ``epoch``
+        is the reign being renewed, captured atomically with the role
+        check — never re-read at ship time."""
+        acks = 1
+        for peer in self.peers:
+            try:
+                if self._ship_to(peer, epoch, self._shipped_rv, []):
+                    acks += 1
+            except ReplicationUnavailable:
+                return acks  # fenced mid-heartbeat: already stepped down
+        self._update_lag()
+        return acks
+
+    def renew(self) -> None:
+        """Leader tick: heartbeat; renew the local deadline on majority,
+        step down once it passes without one."""
+        with self._state_lock:
+            if self.role != LEADER or self.crashed:
+                return
+            epoch = self.epoch
+        with self._ship_lock:
+            acks = self._heartbeat(epoch)
+        now = _monotonic()
+        with self._state_lock:
+            if self.role != LEADER or self.epoch != epoch:
+                return
+            if acks >= self.majority:
+                self._lease_deadline = max(
+                    self._lease_deadline, now + self.lease_duration
+                )
+            elif now > self._lease_deadline:
+                self._step_down("lease renewal lost its majority")
+
+    # -- election ------------------------------------------------------------
+
+    def campaign(self) -> bool:
+        """Try to take the lease: adopt epoch+1 (the self-vote), gather
+        grants, reconcile the log tail to the quorum max (rule 4), then
+        lead. A refusal carries the refuser's epoch; a candidate whose
+        epoch lagged the quorum (a healed ex-minority node) adopts the
+        learned epoch and retries once ABOVE it — without this, a node
+        that slept through elections needs two external campaign calls
+        to even be eligible. Returns True on a won election."""
+        votes = 0
+        tails: Dict[str, int] = {}
+        for _attempt in (0, 1, 2):
+            with self._state_lock:
+                if self.crashed:
+                    return False
+                if self.role == LEADER:
+                    return True
+                target = self.epoch + 1
+            # PRE-VOTE (Raft §9.6): ask whether a majority WOULD grant
+            # before durably adopting the new epoch. Without it, a healed
+            # minority node's doomed campaign leaves a higher epoch
+            # behind, and the live leader's next ship to it gets
+            # StaleEpoch-fenced — an indeterminate write + a spurious
+            # failover on every partition heal, the exact disruption
+            # rule 2 promises cannot happen.
+            would, behind_by = 1, 0
+            for peer in self.peers:
+                try:
+                    res = self.hub.call(self.node_id, peer, "request_vote",
+                                        target, self.node_id, True)
+                except PeerUnreachable:
+                    continue
+                if res.get("granted"):
+                    would += 1
+                else:
+                    behind_by = max(behind_by, res.get("epoch", 0))
+            if would < self.majority:
+                if behind_by < target:
+                    return False  # refused on leases: genuinely doomed
+                with self._state_lock:
+                    if behind_by > self.epoch:
+                        # our epoch lagged the quorum (a healed minority
+                        # node): LEARN it — adopting an epoch that
+                        # already exists elsewhere fences nobody — and
+                        # retry above it
+                        self._adopt_epoch(behind_by)
+                continue
+            with self._state_lock:
+                if self.crashed or self.role == LEADER:
+                    return self.role == LEADER
+                target = self.epoch + 1
+                self._adopt_epoch(target)  # the durable self-vote
+                self.leader_id = None
+            votes, tails, behind_by = 1, {}, 0
+            for peer in self.peers:
+                try:
+                    res = self.hub.call(self.node_id, peer, "request_vote",
+                                        target, self.node_id)
+                except PeerUnreachable:
+                    continue
+                if res.get("granted"):
+                    votes += 1
+                    tails[peer] = res["rv"]
+                else:
+                    behind_by = max(behind_by, res.get("epoch", 0))
+            if votes >= self.majority:
+                break
+            if behind_by < target:
+                return False  # refused on leases, not on a stale epoch
+            with self._state_lock:
+                if behind_by > self.epoch:
+                    self._adopt_epoch(behind_by)  # learn, retry above it
+        if votes < self.majority:
+            return False
+        my_rv = self.backing.current_rv()
+        best = max(tails, key=tails.get, default=None)
+        if best is not None and (tails[best] > 0 or my_rv > 0):
+            # reconcile against the quorum max at the COMMON history
+            # point — behind, EQUAL, or even when this candidate is
+            # numerically AHEAD: rv comparison alone cannot distinguish
+            # the grantor's acked history from a same-or-higher-numbered
+            # dead-epoch suffix (an ex-leader's unacked local commits —
+            # a partitioned patch_batch leaves SEVERAL). The catch-up
+            # carries our hash at min(rv)s, so the grantor answers with
+            # entries (in sync / we're behind), or a snapshot that
+            # TRUNCATES our divergent suffix before we lead. Entries
+            # above the quorum max are provably unacked (an acked write
+            # is on a majority, which every quorum intersects), so
+            # truncating them is always legal; skipping the check would
+            # let the rejoining ex-leader win and then snapshot ACKED
+            # writes OFF the quorum — the exact inversion of rule 4.
+            self._catch_up_from(best, min(my_rv, tails[best]))
+        with self._ship_lock:
+            # reset the ship cursor BEFORE taking leadership: a client
+            # write slipping in between the role flip and a later reset
+            # would ship from a stale cursor
+            self._shipped_rv = self.backing.current_rv()
+            self._peer_rv = {}
+        with self._state_lock:
+            if self.epoch != target:
+                return False  # a higher epoch appeared mid-election
+            self.role = LEADER
+            self.leader_id = self.node_id
+            self._lease_deadline = _monotonic() + self.lease_duration
+        metrics.store_replication_failovers.inc()
+        self.rset._record_leader(target, self.node_id)
+        log.info("%s: leading epoch %d at rv %d", self.node_id, target,
+                 self._shipped_rv)
+        with self._ship_lock:
+            # establish leases + drag laggards up NOW, as the new reign
+            self._heartbeat(target)
+        return True
+
+    def _catch_up_from(self, peer: str, after_rv: int) -> None:
+        res = self.hub.call(
+            self.node_id, peer, "fetch_entries",
+            after_rv, self.backing.tail_hash(after_rv),
+        )
+        if "snapshot" in res:
+            self.backing.load_snapshot(res["snapshot"])
+        else:
+            self.backing.apply_replicated(res["entries"])
+
+    # -- RPC handlers (invoked through the hub) ------------------------------
+
+    def request_vote(self, epoch: int, candidate_id: str,
+                     prevote: bool = False) -> Dict[str, Any]:
+        """``prevote=True`` answers "WOULD you grant?" with zero durable
+        or volatile state change — the Raft pre-vote probe that keeps a
+        doomed campaign from leaving a leader-fencing epoch behind."""
+        with self._state_lock:
+            if self.crashed:
+                raise PeerUnreachable(f"{self.node_id} is down")
+            rv = self.backing.current_rv()
+            if epoch <= self.epoch:
+                return {"granted": False, "rv": rv, "epoch": self.epoch}
+            now = _monotonic()
+            if self.role == LEADER and now < self._lease_deadline:
+                # a live leader does not vote itself out under a flaky
+                # candidate (rule 2)
+                return {"granted": False, "rv": rv, "epoch": self.epoch}
+            if (
+                self.role == FOLLOWER
+                and self.leader_id is not None
+                and self.leader_id != candidate_id
+                and now < self._lease_until
+            ):
+                return {"granted": False, "rv": rv, "epoch": self.epoch}
+            if prevote:
+                return {"granted": True, "rv": rv, "epoch": self.epoch}
+            self._adopt_epoch(epoch)  # THE vote: durable, one per epoch
+            self.role = FOLLOWER
+            self.leader_id = None
+            return {"granted": True, "rv": rv, "epoch": epoch}
+
+    def append_entries(self, epoch: int, leader_id: str, prev_rv: int,
+                       prev_hash: Optional[str],
+                       entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+        with self._apply_lock:
+            return self._append_entries_locked(epoch, leader_id, prev_rv,
+                                               prev_hash, entries)
+
+    def _append_entries_locked(self, epoch: int, leader_id: str,
+                               prev_rv: int, prev_hash: Optional[str],
+                               entries: List[Dict[str, Any]]
+                               ) -> Dict[str, Any]:
+        with self._state_lock:
+            if self.crashed:
+                raise PeerUnreachable(f"{self.node_id} is down")
+            if epoch < self.epoch:
+                raise StaleEpoch(self.epoch)
+            if epoch > self.epoch:
+                self._adopt_epoch(epoch)
+            if self.role == LEADER and leader_id != self.node_id:
+                # same-epoch second leader is impossible (votes are
+                # durable + majorities intersect); this branch is a
+                # higher-epoch leader superseding us
+                self.role = FOLLOWER
+            self.leader_id = leader_id
+            self._lease_until = _monotonic() + self.lease_duration
+        my_rv = self.backing.current_rv()
+        if my_rv < prev_rv:
+            return {"behind": my_rv}
+        if my_rv > prev_rv:
+            if entries and my_rv >= entries[-1]["rv"] and (
+                self.backing.tail_hash(entries[-1]["rv"])
+                == entry_hash(entries[-1])
+            ):
+                return {"applied": my_rv}  # duplicate ship: already have it
+            return {"divergent": True}
+        if prev_rv > 0 and prev_hash is not None:
+            mine = self.backing.tail_hash(prev_rv)
+            if mine is not None and mine != prev_hash:
+                return {"divergent": True}  # dead-epoch suffix at my tail
+        if entries:
+            self.backing.apply_replicated(entries)
+        return {"applied": self.backing.current_rv()}
+
+    def fetch_entries(self, after_rv: int,
+                      after_hash: Optional[str]) -> Dict[str, Any]:
+        with self._state_lock:
+            if self.crashed:
+                raise PeerUnreachable(f"{self.node_id} is down")
+        if after_rv > 0 and after_hash is not None:
+            mine = self.backing.tail_hash(after_rv)
+            if mine is not None and mine != after_hash:
+                return {"snapshot": self.backing.snapshot_state()}
+        try:
+            return {"entries": self.backing.log_tail(after_rv)}
+        except LogTruncated:
+            return {"snapshot": self.backing.snapshot_state()}
+
+    def install_snapshot(self, epoch: int, leader_id: str,
+                         snap: Dict[str, Any]) -> Dict[str, Any]:
+        with self._apply_lock:
+            with self._state_lock:
+                if self.crashed:
+                    raise PeerUnreachable(f"{self.node_id} is down")
+                if epoch < self.epoch:
+                    raise StaleEpoch(self.epoch)
+                if epoch > self.epoch:
+                    self._adopt_epoch(epoch)
+                self.role = FOLLOWER
+                self.leader_id = leader_id
+                self._lease_until = _monotonic() + self.lease_duration
+            return {"applied": self.backing.load_snapshot(snap)}
+
+    def replica_status(self) -> Dict[str, Any]:
+        """The `ctl store status` / /v1/replica/status payload."""
+        with self._state_lock:
+            now = _monotonic()
+            lease = (self._lease_deadline if self.role == LEADER
+                     else self._lease_until) - now
+            out = {
+                "node": self.node_id,
+                "role": self.role if not self.crashed else "down",
+                "epoch": self.epoch,
+                "applied_rv": (0 if self.crashed
+                               else self.backing.current_rv()),
+                "lease_remaining_s": round(max(0.0, lease), 3),
+                "leader": self._leader_hint(),
+            }
+            if self.role == LEADER and not self.crashed:
+                head = self.backing.current_rv()
+                out["lag_entries"] = {
+                    p: max(0, head - rv) for p, rv in self._peer_rv.items()
+                }
+        return out
+
+    # -- duck-typed store surface --------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        return self._leader_write(lambda: self.backing.create(obj))
+
+    def update(self, obj: Any, force: bool = False) -> Any:
+        return self._leader_write(lambda: self.backing.update(obj, force))
+
+    def patch(self, kind: str, namespace: str, name: str, patch: Any, *,
+              subresource: Optional[str] = None) -> Any:
+        return self._leader_write(
+            lambda: self.backing.patch(kind, namespace, name, patch,
+                                       subresource=subresource)
+        )
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        """Per-item semantics come from the backing loop; the whole
+        batch's new log rows ship as one tail (per-item errors commit
+        nothing and ship nothing, exactly like the single verbs)."""
+        return self._leader_write(lambda: self.backing.patch_batch(items))
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        return self._leader_write(
+            lambda: self.backing.delete(kind, namespace, name)
+        )
+
+    def try_delete(self, kind: str, namespace: str, name: str
+                   ) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except KeyError:  # NotFound subclasses KeyError
+            return None
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return self.backing.get(kind, namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self.backing.try_get(kind, namespace, name)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        return self.backing.list(kind, namespace, selector)
+
+    def current_rv(self) -> int:
+        return self.backing.current_rv()
+
+    def watch(self, kind: Optional[str] = None):
+        return self.backing.watch(kind)
+
+    def stop_watch(self, q) -> None:
+        self.backing.stop_watch(q)
+
+    def add_relist_listener(self, cb) -> None:
+        self.backing.add_relist_listener(cb)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def crash(self) -> None:
+        """SIGKILL semantics: drop the node without any clean shutdown —
+        the WAL is left unCheckpointed on disk, exactly what a killed
+        process strands. The sqlite connection is ABANDONED, deliberately
+        not closed: sqlite3.Connection.close() racing another thread's
+        in-flight execute is a C-level crash (a real segfault, observed
+        under the auto-renew ticker), and a real SIGKILL runs no close()
+        either. A verb already past the crash check simply finishes its
+        local commit and then fails the majority ship (the hub is down
+        for this node) — the honest INDETERMINATE outcome. The handle
+        stays referenced on the dead backing so no GC close ever runs;
+        it leaks until process exit, which is the price of fidelity."""
+        with self._state_lock:
+            self.crashed = True
+            self.role = FOLLOWER
+        self.hub.set_down(self.node_id, True)
+        self.backing._stop.set()
+        self._abandoned = self.backing
+
+    def reopen(self) -> None:
+        """Restart after a crash: recover the sqlite file (WAL replay),
+        reload the durable epoch, rejoin as a follower."""
+        self.backing = SqliteStore(self.path,
+                                   poll_interval=self.poll_interval)
+        with self._state_lock:
+            self.epoch = int(self.backing.get_meta("epoch", "0"))
+            self.role = FOLLOWER
+            self.leader_id = None
+            self.crashed = False
+            self._lease_until = 0.0
+        self._shipped_rv = self.backing.current_rv()
+        self.hub.set_down(self.node_id, False)
+
+    def close(self) -> None:
+        if not self.crashed:
+            self.backing.close()
+
+
+class ReplicaSet:
+    """Assembles N :class:`ReplicaNode`\\ s over one :class:`PeerHub`.
+
+    Two drive modes:
+
+    - **manual** (default; the analysis harnesses): no background
+      threads; call :meth:`elect` to install a leader. The lease is long,
+      so leadership is stable until explicitly taken over or fenced.
+    - **auto** (``start()``; the chaos e2e): a seeded per-node ticker
+      renews the leader's lease and campaigns on expiry with node-skewed
+      jitter, so failover happens on its own within ~2 lease durations
+      and the first winner is deterministic for a seed.
+    """
+
+    def __init__(self, n: int = 3, *, dir: str, lease_duration: float = 30.0,
+                 retry_period: float = 0.1, poll_interval: float = 0.05,
+                 seed: int = 0):
+        self.hub = PeerHub()
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.node_ids = [f"n{i}" for i in range(n)]
+        self.advertise: Dict[str, str] = {}
+        self.leadership_log: List[Tuple[int, str]] = []
+        self._log_lock = threading.Lock()
+        self._seed = seed
+        self._stop = threading.Event()
+        self._tickers: List[threading.Thread] = []
+        self.nodes: Dict[str, ReplicaNode] = {}
+        for nid in self.node_ids:
+            node = ReplicaNode(
+                nid, os.path.join(dir, f"{nid}.db"), self.hub, self,
+                lease_duration=lease_duration, poll_interval=poll_interval,
+            )
+            self.nodes[nid] = node
+            self.hub.register(node)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _record_leader(self, epoch: int, node_id: str) -> None:
+        with self._log_lock:
+            self.leadership_log.append((epoch, node_id))
+
+    def set_advertise(self, mapping: Dict[str, str]) -> None:
+        """node id → advertised URL, once the HTTP servers know their
+        ports; NotLeader hints then carry an address a client can dial."""
+        self.advertise.update(mapping)
+
+    # -- election ------------------------------------------------------------
+
+    def elect(self, node_id: str) -> bool:
+        """Manual, synchronous lease takeover by ``node_id``."""
+        return self.nodes[node_id].campaign()
+
+    def expire_leases(self) -> None:
+        """Zero every live node's follower lease — the operator's forced-
+        failover hand (≙ deleting the kube Lease object), and the manual-
+        mode harnesses' fast-forward past the lease wait that auto mode
+        serves out in real time. Votes stay epoch-gated, so safety (one
+        leader per epoch) is untouched; only the liveness delay is
+        skipped."""
+        for node in self.nodes.values():
+            with node._state_lock:
+                node._lease_until = 0.0
+
+    def leader(self) -> Optional[ReplicaNode]:
+        best = None
+        for node in self.nodes.values():
+            with node._state_lock:
+                if node.role == LEADER and not node.crashed:
+                    if best is None or node.epoch > best.epoch:
+                        best = node
+        return best
+
+    def wait_for_leader(self, timeout: float = 10.0
+                        ) -> Optional[ReplicaNode]:
+        deadline = _monotonic() + timeout
+        while _monotonic() < deadline:
+            node = self.leader()
+            if node is not None:
+                return node
+            if self._stop.wait(0.02):
+                return None
+        return None
+
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        """Wait until every live node has applied the leader's head rv
+        (a leader heartbeat drags laggards); the deterministic read
+        barrier harnesses use before diffing follower state."""
+        deadline = _monotonic() + timeout
+        while _monotonic() < deadline:
+            lead = self.leader()
+            if lead is not None:
+                lead.renew()
+                head = lead.backing.current_rv()
+                live = [n for n in self.nodes.values() if not n.crashed]
+                if all(n.backing.current_rv() == head for n in live):
+                    return True
+            if self._stop.wait(0.02):
+                return False
+        return False
+
+    # -- auto mode -----------------------------------------------------------
+
+    def start(self) -> "ReplicaSet":
+        for i, nid in enumerate(self.node_ids):
+            t = threading.Thread(
+                target=self._tick_loop,
+                args=(self.nodes[nid],
+                      random.Random(f"{self._seed}:{nid}"), i),
+                name=f"replica-tick-{nid}", daemon=True,
+            )
+            self._tickers.append(t)
+            t.start()
+        return self
+
+    def _tick_loop(self, node: ReplicaNode, rng: random.Random,
+                   index: int) -> None:
+        while not self._stop.wait(self.retry_period):
+            try:
+                with node._state_lock:
+                    crashed, role = node.crashed, node.role
+                    expired = _monotonic() > node._lease_until
+                if crashed:
+                    continue
+                if role == LEADER:
+                    node.renew()
+                elif expired:
+                    # node-skewed jittered wait before campaigning keeps
+                    # concurrent candidates from split-voting forever and
+                    # makes the FIRST winner deterministic per seed
+                    delay = index * self.retry_period / 2 + rng.uniform(
+                        0, self.retry_period / 2
+                    )
+                    if self._stop.wait(delay):
+                        return
+                    with node._state_lock:
+                        still = (not node.crashed
+                                 and node.role == FOLLOWER
+                                 and _monotonic() > node._lease_until)
+                    if still:
+                        node.campaign()
+            except Exception:
+                # a ticker must survive transient errors (a peer crashing
+                # mid-RPC); a dead ticker would silently end failover
+                log.debug("replica ticker error", exc_info=True)
+
+    # -- fault surface -------------------------------------------------------
+
+    def crash(self, node_id: str) -> None:
+        self.nodes[node_id].crash()
+
+    def restart(self, node_id: str) -> None:
+        self.nodes[node_id].reopen()
+
+    # -- status / lifecycle --------------------------------------------------
+
+    def status(self) -> List[Dict[str, Any]]:
+        return [self.nodes[nid].replica_status() for nid in self.node_ids]
+
+    def client(self, read_from: Optional[str] = None) -> "ReplicaClient":
+        return ReplicaClient(self, read_from=read_from)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._tickers:
+            t.join(timeout=2.0)
+        for node in self.nodes.values():
+            node.close()
+
+
+class NodeTarget:
+    """ChaosController process-target adapter for an in-process replica
+    node: ``kill`` is the SIGKILL-equivalent hard crash, ``restart``
+    reopens from the same files. ``node_id=None`` resolves 'the current
+    leader' at fire time — the scripted leader-kill."""
+
+    def __init__(self, rset: ReplicaSet, node_id: Optional[str] = None):
+        self.rset = rset
+        self.node_id = node_id
+        self.killed: Optional[str] = None
+
+    def _resolve(self) -> str:
+        if self.node_id is not None:
+            return self.node_id
+        lead = self.rset.leader()
+        if lead is None:
+            raise RuntimeError("no leader to target")
+        return lead.node_id
+
+    def kill(self) -> None:
+        self.killed = self._resolve()
+        self.rset.crash(self.killed)
+
+    def term(self) -> None:
+        self.kill()  # a store node has no graceful-drain distinction
+
+    def restart(self) -> None:
+        target = self.killed or self._resolve()
+        self.rset.restart(target)
+
+
+class ReplicaClient:
+    """The in-process failover client: same duck-typed store surface,
+    mutations routed to the leased leader (following NotLeader hints with
+    bounded jittered backoff — the socketless twin of HttpStoreClient's
+    multi-endpoint rotation), reads and watch fan-out served by a
+    follower, which is exactly the replica set's read contract: lag is
+    legal, rv regression is not."""
+
+    def __init__(self, rset: ReplicaSet, *, read_from: Optional[str] = None,
+                 mutation_attempts: int = 12, backoff: float = 0.05):
+        self._set = rset
+        self._read_from = read_from
+        self._attempts = mutation_attempts
+        self._backoff = backoff
+        self._rng = random.Random(f"client:{rset._seed}")
+        self._guess: Optional[ReplicaNode] = None
+        # per-queue owner node: stop_watch must unregister a queue from
+        # the node that issued it, not whichever node served the LATEST
+        # watch() call (a silently un-stopped queue fills forever)
+        self._watch_nodes: Dict[int, ReplicaNode] = {}
+        self._stop = threading.Event()
+
+    # -- routing -------------------------------------------------------------
+
+    def _read_node(self) -> ReplicaNode:
+        if self._read_from is not None:
+            node = self._set.nodes[self._read_from]
+            if not node.crashed:
+                return node
+        # failover reads pick the MOST CAUGHT-UP live node (leader
+        # included), not merely the first live follower: falling back
+        # from a crashed pinned node to a lagging follower could
+        # un-observe an acked write this client already read — the rv
+        # regression the follower-read contract forbids (per-node reads
+        # stay monotone; cross-node failover must not go backwards
+        # through the acked history)
+        live = [n for n in self._set.nodes.values() if not n.crashed]
+        if not live:
+            raise PeerUnreachable("no live replica to read from")
+        # ties (the healthy steady state) still prefer a follower —
+        # spreading reads off the leader is the replica set's point
+        return max(live, key=lambda n: (n.backing.current_rv(),
+                                        n.role != LEADER))
+
+    def _mutate(self, fn: Callable[[ReplicaNode], Any]) -> Any:
+        """Route a mutation to the leader, re-resolving on NotLeader /
+        unreachable with bounded jittered backoff. Only DEFINITE
+        failures are retried; ReplicationUnavailable (indeterminate)
+        propagates — the caller owns the re-read."""
+        delay = self._backoff
+        last: Optional[Exception] = None
+        for _ in range(self._attempts):
+            node = self._guess
+            if node is None or node.crashed:
+                node = self._set.leader()
+            if node is not None and not node.crashed:
+                try:
+                    out = fn(node)
+                    self._guess = node
+                    return out
+                except NotLeader as e:
+                    last = e
+                    hint = e.leader
+                    self._guess = next(
+                        (n for n in self._set.nodes.values()
+                         if n.node_id == hint and not n.crashed),
+                        None,
+                    )
+                except PeerUnreachable as e:
+                    last = e
+                    self._guess = None
+            jittered = delay * (1 + self._rng.uniform(0, 0.25))
+            if self._stop.wait(jittered):
+                break
+            delay = min(delay * 2, 1.0)
+        raise last if last is not None else PeerUnreachable(
+            "no replica leader reachable"
+        )
+
+    def replica_status(self) -> List[Dict[str, Any]]:
+        return self._set.status()
+
+    # -- duck-typed store surface --------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        return self._mutate(lambda n: n.create(obj))
+
+    def update(self, obj: Any, force: bool = False) -> Any:
+        return self._mutate(lambda n: n.update(obj, force))
+
+    def patch(self, kind: str, namespace: str, name: str, patch: Any, *,
+              subresource: Optional[str] = None) -> Any:
+        return self._mutate(
+            lambda n: n.patch(kind, namespace, name, patch,
+                              subresource=subresource)
+        )
+
+    def patch_batch(self, items: List[Dict[str, Any]]) -> List[Any]:
+        return self._mutate(lambda n: n.patch_batch(items))
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        return self._mutate(lambda n: n.delete(kind, namespace, name))
+
+    def try_delete(self, kind: str, namespace: str, name: str
+                   ) -> Optional[Any]:
+        try:
+            return self.delete(kind, namespace, name)
+        except KeyError:  # NotFound subclasses KeyError
+            return None
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        return self._read_node().get(kind, namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
+        return self._read_node().try_get(kind, namespace, name)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        return self._read_node().list(kind, namespace, selector)
+
+    def current_rv(self) -> int:
+        return self._read_node().current_rv()
+
+    def watch(self, kind: Optional[str] = None):
+        node = self._read_node()
+        q = node.watch(kind)
+        self._watch_nodes[id(q)] = node
+        return q
+
+    def stop_watch(self, q) -> None:
+        node = self._watch_nodes.pop(id(q), None)
+        if node is not None:
+            node.stop_watch(q)
+
+    def add_relist_listener(self, cb) -> None:
+        self._read_node().add_relist_listener(cb)
+
+    def close(self) -> None:
+        self._stop.set()

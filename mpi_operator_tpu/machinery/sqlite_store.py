@@ -24,6 +24,7 @@ delete/list/watch.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import logging
 import os
@@ -70,7 +71,27 @@ CREATE TABLE IF NOT EXISTS watch_cursors (
     last_rv INTEGER NOT NULL,
     updated REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS replica_meta (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
 """
+
+
+class LogTruncated(RuntimeError):
+    """A requested log tail starts past the retention horizon: the rows
+    were trimmed, so the caller cannot ship an incremental tail and must
+    fall back to a full snapshot transfer (replicated_store resync)."""
+
+
+def entry_hash(entry: Dict[str, Any]) -> str:
+    """Content fingerprint of one replication log entry. Rv equality
+    alone cannot detect a divergent history (an unacked suffix from a
+    dead leader reuses the same rv numbers); the hash can."""
+    h = hashlib.sha256()
+    h.update(f"{entry['rv']}|{entry['etype']}|{entry['kind']}|".encode())
+    h.update(entry["data"].encode())
+    return h.hexdigest()[:16]
 
 
 class SqliteStore:
@@ -487,6 +508,171 @@ class SqliteStore:
             for want, wq in watchers:
                 if want is None or want == obj.kind:
                     wq.put(WatchEvent(MODIFIED, obj.kind, obj.deepcopy()))
+
+    # -- replication seam (machinery/replicated_store.py) --------------------
+    #
+    # The log table IS the replication WAL: every mutation's _txn commit
+    # leaves one log row carrying the committed object at its rv, in
+    # global commit order. A leader ships those rows verbatim; a follower
+    # applies them at their EXACT rvs through apply_replicated, so leader
+    # and follower stores are byte-for-byte the same history. Durable
+    # election state (epoch) rides replica_meta via the same _txn seam.
+
+    def log_tail(self, after_rv: int) -> List[Dict[str, Any]]:
+        """Committed log rows with rv > ``after_rv``, in commit order —
+        the shippable tail. Raises :class:`LogTruncated` when retention
+        already trimmed rows the caller needs (the follower must resync
+        from a snapshot instead; an incomplete tail silently shipped
+        would be a gapped follower history)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT rv, etype, kind, data FROM log WHERE rv>? "
+                "ORDER BY rv",
+                (after_rv,),
+            ).fetchall()
+        if rows and rows[0][0] != after_rv + 1:
+            raise LogTruncated(
+                f"log tail after rv {after_rv} starts at {rows[0][0]} "
+                f"(rows trimmed; snapshot transfer required)"
+            )
+        return [
+            {"rv": rv, "etype": etype, "kind": kind, "data": data}
+            for (rv, etype, kind, data) in rows
+        ]
+
+    def tail_hash(self, rv: int) -> Optional[str]:
+        """Content fingerprint of the log row at ``rv`` (None when absent
+        or rv <= 0). Shipping carries the sender's hash of the entry
+        preceding the tail; a mismatch on the receiver is DIVERGENCE — a
+        same-rv row from a dead epoch (an unacked suffix) that must be
+        truncated by snapshot resync, which a bare rv compare can never
+        see."""
+        if rv <= 0:
+            return None
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT etype, kind, data FROM log WHERE rv=?", (rv,)
+            ).fetchone()
+        if row is None:
+            return None
+        return entry_hash({"rv": rv, "etype": row[0], "kind": row[1],
+                           "data": row[2]})
+
+    def apply_replicated(self, entries: List[Dict[str, Any]]) -> int:
+        """THE follower write path: apply shipped log entries at their
+        exact rvs, atomically as one transaction (a crash mid-batch loses
+        the whole batch; the leader re-ships — a partially applied batch
+        would be a history no leader ever committed). The watch poller
+        picks the new rows up like any local commit, so follower watch
+        fan-out needs no extra plumbing. Returns the new applied rv."""
+        if not entries:
+            return self.current_rv()
+        with self._txn("replicate") as cur:
+            for e in entries:
+                cur.execute(
+                    "INSERT INTO log (rv, etype, kind, data) "
+                    "VALUES (?, ?, ?, ?)",
+                    (e["rv"], e["etype"], e["kind"], e["data"]),
+                )
+                obj = json.loads(e["data"])
+                m = obj.get("metadata") or {}
+                if e["etype"] == DELETED:
+                    cur.execute(
+                        "DELETE FROM objects WHERE kind=? AND namespace=? "
+                        "AND name=?",
+                        (e["kind"], m.get("namespace"), m.get("name")),
+                    )
+                else:
+                    cur.execute(
+                        "INSERT OR REPLACE INTO objects "
+                        "(kind, namespace, name, rv, data) "
+                        "VALUES (?, ?, ?, ?, ?)",
+                        (e["kind"], m.get("namespace"), m.get("name"),
+                         e["rv"], e["data"]),
+                    )
+        return self.current_rv()
+
+    def snapshot_state(self, log_rows: int = 256) -> Dict[str, Any]:
+        """Full-state transfer payload for follower resync: every live
+        object row plus the newest ``log_rows`` log rows (enough tail for
+        the receiver to serve hash checks and watch resumes afterwards)."""
+        with self._lock:
+            objects = self._conn.execute(
+                "SELECT kind, namespace, name, rv, data FROM objects"
+            ).fetchall()
+            tail = self._conn.execute(
+                "SELECT rv, etype, kind, data FROM log "
+                "ORDER BY rv DESC LIMIT ?",
+                (log_rows,),
+            ).fetchall()
+        return {
+            "rv": self.current_rv(),
+            "objects": [list(r) for r in objects],
+            "log": [list(r) for r in sorted(tail)],
+        }
+
+    def load_snapshot(self, snap: Dict[str, Any]) -> int:
+        """Replace this store's history with a snapshot (divergent-suffix
+        truncation + lag catch-up in one move). The log's AUTOINCREMENT
+        sequence is CLAMPED to the snapshot head: left alone, a wiped
+        suffix whose rvs were numerically higher would make this node's
+        next local commit skip rv numbers — a permanent gap its own
+        ``log_tail`` would then reject as truncated, wedging every write
+        the moment it becomes leader. Re-numbering over the wiped suffix
+        is exactly right: the new history REPLACED those rvs. Watchers
+        are force-relisted afterwards: their per-event stream cannot
+        express a history swap, the full-state replacement can."""
+        head = int(snap.get("rv", 0))
+        with self._txn("load-snapshot") as cur:
+            cur.execute("DELETE FROM objects")
+            cur.execute("DELETE FROM log")
+            for kind, ns, name, rv, data in snap.get("objects", ()):
+                cur.execute(
+                    "INSERT INTO objects (kind, namespace, name, rv, data) "
+                    "VALUES (?, ?, ?, ?, ?)",
+                    (kind, ns, name, rv, data),
+                )
+            for rv, etype, kind, data in snap.get("log", ()):
+                cur.execute(
+                    "INSERT INTO log (rv, etype, kind, data) "
+                    "VALUES (?, ?, ?, ?)",
+                    (rv, etype, kind, data),
+                )
+            cur.execute(
+                "UPDATE sqlite_sequence SET seq=? WHERE name='log'",
+                (head,),
+            )
+        self.force_relist()
+        return self.current_rv()
+
+    def force_relist(self) -> None:
+        """Re-deliver the full live state to every watcher as a relist
+        (listener world-replacement + MODIFIED replay) and park the poll
+        cursor at the new head — the recovery event after load_snapshot
+        rewrote history out from under the per-row watch stream."""
+        with self._lock:
+            watchers = list(self._watchers)
+            row = self._conn.execute("SELECT MAX(rv) FROM log").fetchone()
+            self._last_seen_rv = row[0] or 0
+        self._relist_to(watchers)
+
+    def get_meta(self, key: str, default: Optional[str] = None
+                 ) -> Optional[str]:
+        """Durable replica metadata (election epoch). Reads are plain
+        SELECTs; writes ride set_meta's _txn."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM replica_meta WHERE key=?", (key,)
+            ).fetchone()
+        return default if row is None else row[0]
+
+    def set_meta(self, key: str, value: str) -> None:
+        with self._txn("meta") as cur:
+            cur.execute(
+                "INSERT INTO replica_meta (key, value) VALUES (?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, value),
+            )
 
     # -- log retention -------------------------------------------------------
 

@@ -60,6 +60,28 @@ class BadPatch(ValueError):
     retryable condition."""
 
 
+class NotLeader(RuntimeError):
+    """A mutation reached a replica that is not the leased leader
+    (machinery/replicated_store.py). DEFINITE: nothing was staged or
+    committed anywhere, so callers retry against the leader freely.
+    ``leader`` carries the rejecting replica's best leader hint (an
+    advertised URL on the HTTP seam, a node id in-process) — 421 on the
+    wire, and HttpStoreClient follows the hint before backing off."""
+
+    def __init__(self, message: str, *, leader: Optional[str] = None):
+        super().__init__(message)
+        self.leader = leader
+
+
+class ReplicationUnavailable(RuntimeError):
+    """The leader could not confirm a majority durably applied a write it
+    already committed locally — the INDETERMINATE outcome class (≙ a kube
+    apiserver timeout): the write may surface later (it is durable on a
+    minority) or never (a new leader's history may truncate it). Callers
+    must re-read before retrying non-idempotent verbs; blind retry of a
+    create can legally land AlreadyExists."""
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"

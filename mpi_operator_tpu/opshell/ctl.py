@@ -20,7 +20,9 @@ spec.node_name), scale (live worker-replica change — the elastic entry
 point), suspend/resume (runPolicy.suspend), watch (stream condition
 transitions until the job finishes, riding the store watch protocol),
 nodes (the registered agent fleet, ≙ kubectl get nodes), cordon/uncordon/
-drain (node lifecycle: hold new bindings; evict for maintenance).
+drain (node lifecycle: hold new bindings; evict for maintenance), store
+status (replica-set roles/lease/lag, ≙ etcdctl endpoint status; exits
+nonzero when the set has no leader).
 """
 
 from __future__ import annotations
@@ -568,6 +570,50 @@ def _follow_logs(client: TPUJobClient, pod, path: str,
         return 130
 
 
+def cmd_store(client: TPUJobClient, args) -> int:
+    """`ctl store status`: replica-set roles, lease time, applied rv and
+    per-follower lag — the day-2 view of the HA store (≙ `etcdctl
+    endpoint status`). Works against any store: non-replicated backends
+    report one honest 'standalone' row."""
+    store = client.store
+    status_fn = getattr(store, "replica_status", None)
+    if callable(status_fn):
+        rows_raw = status_fn()
+    else:
+        rows_raw = [{"endpoint": getattr(store, "path", type(store).__name__),
+                     "role": "standalone"}]
+    # exit 1 when the set has no live leader: scripts probe HA health
+    # with this verb (the runbook's first triage command) — in EITHER
+    # output format, or a monitor parsing json would miss leader loss
+    rc = 0 if any(s.get("role") in ("leader", "standalone")
+                  for s in rows_raw) else 1
+    if args.output == "json":
+        print(json.dumps(rows_raw, indent=2, sort_keys=True))
+        return rc
+    rows = []
+    worst_lag = {}
+    for s in rows_raw:
+        if s.get("role") == "leader":
+            worst_lag = s.get("lag_entries") or {}
+        rows.append([
+            s.get("endpoint") or s.get("node", "-"),
+            s.get("role", "?"),
+            s.get("epoch", "-"),
+            s.get("applied_rv", "-"),
+            (f"{s['lease_remaining_s']}s"
+             if "lease_remaining_s" in s else "-"),
+            s.get("leader") or "-",
+        ])
+    print(_table(rows, ["ENDPOINT", "ROLE", "EPOCH", "APPLIED-RV",
+                        "LEASE", "LEADER"]))
+    if worst_lag:
+        lagging = {k: v for k, v in worst_lag.items() if v}
+        print("replication lag: "
+              + (", ".join(f"{k}={v}" for k, v in sorted(lagging.items()))
+                 if lagging else "0 entries (all followers caught up)"))
+    return rc
+
+
 def cmd_watch(client: TPUJobClient, args) -> int:
     """Stream state transitions until the job finishes (≙ kubectl get -w —
     which rides the watch API, so this does too: the store's watch queue
@@ -691,6 +737,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("drain", help="cordon a node and evict its pods "
                                      "(gangs restart on schedulable nodes)")
     p.add_argument("name")
+    p = sub.add_parser("store", help="store backend introspection "
+                                     "(replica roles, lease, lag)")
+    p.add_argument("action", choices=["status"])
+    p.add_argument("-o", "--output", choices=["table", "json"],
+                   default="table")
     return ap
 
 
@@ -739,6 +790,7 @@ def main(argv=None) -> int:
             "cordon": cmd_cordon,
             "uncordon": cmd_uncordon,
             "drain": cmd_drain,
+            "store": cmd_store,
         }[args.verb](client, args)
     except Forbidden as e:
         # read-tier token on a mutating verb: authenticated but not

@@ -140,6 +140,19 @@ store_write_conflicts = REGISTRY.counter(
     "each one was a wasted write round-trip plus a client re-read; the "
     "merge-patch write path exists to drive this to ~zero",
 )
+store_replication_lag = REGISTRY.gauge(
+    "tpu_operator_store_replication_lag_entries",
+    "Per-follower replication lag in log entries (leader head rv minus "
+    "the follower's applied rv, labeled by follower) — 0 on a healthy "
+    "set since the leader ships synchronously; a persistently lagging "
+    "follower is one partition away from a lossy quorum",
+)
+store_replication_failovers = REGISTRY.counter(
+    "tpu_operator_store_replication_failovers_total",
+    "Counts won replica-set elections (lease takeovers). Steady state "
+    "is exactly 1 (the initial election); every increment after that is "
+    "a leader loss the runbook's 'leader loss' row explains",
+)
 store_writes_elided = REGISTRY.counter(
     "tpu_operator_store_writes_elided_total",
     "Writes skipped because the intended object matched the lister's copy "

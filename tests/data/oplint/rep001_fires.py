@@ -1,0 +1,22 @@
+"""REP001 bad forms: mutations applied directly to follower handles —
+each forks the replicated history past the leader seam."""
+
+
+def poke_follower(follower, obj):
+    follower.update(obj)  # expect: REP001
+
+
+def poke_nested_handle(self, obj):
+    self.standby.store.create(obj)  # expect: REP001
+
+
+def poke_plural(read_replica, patch):
+    read_replica.patch("Pod", "default", "p", patch)  # expect: REP001
+
+
+def drop_via_follower(self):
+    self.follower.delete("Pod", "default", "p")  # expect: REP001
+
+
+def batch_on_standby(node_standby, items):
+    node_standby.patch_batch(items)  # expect: REP001

@@ -1,0 +1,30 @@
+"""REP001 blessed forms: follower reads, leader-routed writes, the
+replication apply seam, and the reasoned-suppression escape hatch."""
+
+
+def read_follower(follower):
+    # reads anywhere are the replica set's whole point
+    return follower.get("Pod", "default", "p"), follower.list("Pod")
+
+
+def write_through_leader(leader, obj):
+    # mutations route to the leased leader handle
+    return leader.update(obj)
+
+
+def apply_replicated(self, follower, entries):
+    # inside the replication apply seam, follower writes ARE the job —
+    # the enclosing-function-name exemption covers them
+    for e in entries:
+        follower.update(e)
+
+
+def install_snapshot(self, follower, snap):
+    follower.create(snap)
+
+
+def repair_tool(follower, obj):
+    # a break-glass repair writing a follower directly must say why
+    # oplint: disable=REP001 — offline fsck utility: the node is
+    # detached from the set and will full-resync before rejoining
+    follower.update(obj)

@@ -1,0 +1,155 @@
+"""Partition + leader-kill chaos e2e for the replicated store (ISSUE 8).
+
+The Jepsen shape: a seeded ChaosScript partitions the leader from one
+follower, then SIGKILLs the leader mid-traffic while a writer keeps
+submitting through the failover client. The trail (watched from the
+follower that stays healthy) plus the final state must prove, on BOTH
+runs of the same seed:
+
+- **no acked write lost** — every create the client saw succeed is in
+  the final state at exactly its acked rv;
+- **rv monotone across failover** — per object, the watch stream never
+  regresses (tests/invariants.py's durable-store checker);
+- **exactly one leader per lease epoch** — the leadership log never
+  shows an epoch won twice (majorities intersect + durable votes);
+- **liveness** — the set elects a new leader and acks fresh writes
+  after losing the old one.
+
+Indeterminate outcomes (ReplicationUnavailable, a crash mid-call) are
+legal per the documented contract — the writer skips those names; only
+DEFINITE acks join the must-survive set.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from mpi_operator_tpu.machinery.chaos import ChaosController, ChaosScript
+from mpi_operator_tpu.machinery.replicated_store import NodeTarget, ReplicaSet
+from mpi_operator_tpu.machinery.serialize import decode
+
+from tests.invariants import Trail, resource_versions_monotonic, violations
+
+pytestmark = pytest.mark.slow
+
+SEED = 1108
+
+
+def _pod(name: str, uid: str):
+    return decode("Pod", {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "creation_timestamp": 1000.0},
+    })
+
+
+def _run_partition_leader_kill(tmp_dir: str, seed: int):
+    """One seeded run; returns everything the invariant asserts need."""
+    rs = ReplicaSet(3, dir=str(tmp_dir), lease_duration=0.5,
+                    retry_period=0.05, poll_interval=0.01, seed=seed)
+    acked = {}  # name -> rv the client saw acknowledged
+    stop_writer = threading.Event()
+    try:
+        assert rs.elect("n0")
+        rs.start()  # auto tickers own renewal + failover from here
+        # n2 stays on the healthy side of every fault: the trail's
+        # vantage point (a watcher must never see rv regress even while
+        # its peers churn)
+        trail = Trail(rs.nodes["n2"])
+        client = rs.client(read_from="n2")
+        client._attempts = 24  # ride out the leaderless window
+
+        def writer():
+            i = 0
+            while not stop_writer.is_set():
+                name = f"w{i:03d}"
+                i += 1
+                try:
+                    obj = client.create(_pod(name, f"u-{name}"))
+                    acked[name] = obj.metadata.resource_version
+                except Exception:
+                    # indeterminate (leader died mid-call / minority
+                    # window): the name is burned, never retried — only
+                    # definite acks join the must-survive set
+                    pass
+                stop_writer.wait(0.01)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+        script = ChaosScript.parse({
+            "seed": seed,
+            "actions": [
+                # cut the leader off one follower (majority holds: the
+                # set keeps acking through the other follower) ...
+                {"at": 0.3, "fault": "partition", "a": "n0", "b": "n1",
+                 "duration": 1.5},
+                # ... then SIGKILL the leader mid-partition
+                {"at": 0.6, "fault": "kill", "target": "leader"},
+            ],
+        })
+        controller = ChaosController(
+            script, targets={"leader": NodeTarget(rs)}, fabric=rs.hub,
+        ).arm()
+        controller.join(10.0)
+        assert [e for _, _, e in controller.executed] == [None, None, None], (
+            controller.executed
+        )
+
+        # liveness: a survivor takes over and acks fresh writes
+        pre_kill = len(acked)
+        deadline = threading.Event()
+        for _ in range(200):  # up to 10s
+            lead = rs.leader()
+            if lead is not None and lead.node_id != "n0" \
+                    and len(acked) >= pre_kill + 5:
+                break
+            deadline.wait(0.05)
+        stop_writer.set()
+        wt.join(timeout=5.0)
+        lead = rs.leader()
+        assert lead is not None and lead.node_id != "n0", \
+            "no failover happened"
+        assert rs.quiesce(10.0)
+        trail.stop()
+        return {
+            "acked": dict(acked),
+            "final": {o.metadata.name: o.metadata.resource_version
+                      for o in lead.list("Pod")},
+            "trail": trail,
+            "leadership": list(rs.leadership_log),
+            "new_leader": lead.node_id,
+        }
+    finally:
+        stop_writer.set()
+        rs.stop()
+
+
+@pytest.mark.parametrize("run", [1, 2], ids=["run1", "run2"])
+def test_partition_plus_leader_kill_keeps_every_acked_write(
+    tmp_path, run
+):
+    """The acceptance scenario, executed twice on ONE seed (the chaos
+    suite's determinism contract): same schedule, same invariants."""
+    out = _run_partition_leader_kill(tmp_path, SEED)
+    # progress actually happened on both sides of the kill
+    assert len(out["acked"]) >= 10, out["acked"]
+    # no acked write lost: present in the final state at its acked rv
+    for name, rv in out["acked"].items():
+        assert name in out["final"], \
+            f"ACKED write {name} (rv {rv}) lost across failover"
+        assert out["final"][name] == rv, (
+            f"{name}: acked at rv {rv}, final state shows "
+            f"{out['final'][name]}"
+        )
+    # rv monotone across failover, from the surviving follower's watch
+    bad = violations(out["trail"], checks=(resource_versions_monotonic,))
+    assert bad == [], bad
+    # exactly one leader per lease epoch, across the whole run
+    epochs = [e for e, _ in out["leadership"]]
+    assert len(set(epochs)) == len(epochs), out["leadership"]
+    # and the kill really changed leadership
+    assert out["leadership"][0][1] == "n0"
+    assert out["new_leader"] != "n0"

@@ -1258,6 +1258,153 @@ def test_outage_longer_than_backoff_window_still_raises(tmp_path):
     client.close()
 
 
+def test_endpoint_rotation_tries_next_replica_before_backoff():
+    """Multi-endpoint failover (ISSUE 8 satellite): with a replica list,
+    a connection-refused rotates to the next endpoint IMMEDIATELY — the
+    backoff delay only fires once the whole list refused, so one dead
+    replica costs a re-dial, not a backoff window."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    live = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    client = HttpStoreClient([dead_url, live.url], retry_base_delay=5.0)
+    try:
+        t0 = time.monotonic()
+        client.create(Pod(metadata=ObjectMeta(name="p")))
+        elapsed = time.monotonic() - t0
+        assert client.retry_stats["endpoint_rotations"] >= 1
+        # a 5s base delay would be unmissable had the client backed off
+        # between the dead endpoint and the live one
+        assert elapsed < 2.0, f"rotated write took {elapsed:.2f}s"
+        assert client.get("Pod", "default", "p").metadata.name == "p"
+    finally:
+        client.close()
+        live.stop()
+
+
+def test_multi_endpoint_outage_window_matches_single_endpoint():
+    """Review-found regression guard: the conn-refused budget counts
+    BACKOFF CYCLES (full wraps of the endpoint list), not individual
+    refusals — otherwise an N-endpoint client's full-outage ride-out
+    window shrinks N-fold versus the documented single-endpoint one."""
+    import socket
+    import urllib.error
+
+    dead = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+        s.close()
+    client = HttpStoreClient(dead, conn_refused_retries=2,
+                             retry_base_delay=0.05)
+    try:
+        with pytest.raises(urllib.error.URLError):
+            client.get("Pod", "default", "p")
+        # exactly the single-endpoint budget: 2 backoff cycles, even
+        # though 3 endpoints each refused multiple times
+        assert client.retry_stats["conn_refused_retries"] == 2
+        assert client.retry_stats["endpoint_rotations"] >= 6
+    finally:
+        client.close()
+
+
+def test_leader_died_mid_request_fails_over_to_new_leader(tmp_path):
+    """The replica failover path end-to-end on the wire: a client whose
+    active endpoint's server just died rotates to a surviving replica,
+    is bounced with 421 NotLeader + hint, follows the hint, and lands
+    the write on the new leader — without exhausting its refused-retry
+    budget on the dead endpoint."""
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+    servers = {nid: StoreServer(rs.nodes[nid], "127.0.0.1", 0).start()
+               for nid in rs.node_ids}
+    rs.set_advertise({nid: s.url for nid, s in servers.items()})
+    assert rs.elect("n0")
+    client = HttpStoreClient(
+        [servers[n].url for n in rs.node_ids], retry_base_delay=0.05,
+    )
+    try:
+        client.create(Pod(metadata=ObjectMeta(name="before")))
+        # the leader dies: server down AND node crashed, then a survivor
+        # takes the lease over
+        servers["n0"].stop()
+        rs.crash("n0")
+        rs.expire_leases()
+        assert rs.elect("n1")
+        obj = client.create(Pod(metadata=ObjectMeta(name="after")))
+        assert obj.metadata.resource_version == 2
+        assert client.retry_stats["endpoint_rotations"] >= 1
+        # both survivors agree; nothing acked was lost
+        for nid in ("n1", "n2"):
+            names = {o.metadata.name for o in rs.nodes[nid].list("Pod")}
+            assert names == {"before", "after"}
+    finally:
+        client.close()
+        for nid in ("n1", "n2"):
+            servers[nid].stop()
+        rs.stop()
+
+
+def test_undialable_not_leader_hint_is_surfaced_not_adopted(tmp_path):
+    """Review-found client-poisoning guard: a replica set with no
+    advertise mapping hints bare node ids; the client must surface
+    NotLeader instead of parking itself on an un-dialable 'n0' URL
+    (which would break every subsequent request)."""
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+    from mpi_operator_tpu.machinery.store import NotLeader
+
+    rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+    servers = {nid: StoreServer(rs.nodes[nid], "127.0.0.1", 0).start()
+               for nid in rs.node_ids}
+    # deliberately NO set_advertise: hints are bare node ids
+    assert rs.elect("n0")
+    client = HttpStoreClient(servers["n1"].url)
+    try:
+        with pytest.raises(NotLeader) as ei:
+            client.create(Pod(metadata=ObjectMeta(name="p")))
+        assert ei.value.leader == "n0"
+        # the client is NOT poisoned: reads still work on its endpoint
+        assert client.list("Pod") == []
+        assert client.url.startswith("http://")
+    finally:
+        client.close()
+        for s in servers.values():
+            s.stop()
+        rs.stop()
+
+
+def test_not_leader_redirect_learns_unlisted_leader(tmp_path):
+    """A client configured with ONLY a follower endpoint discovers the
+    leader through the 421 hint and completes the mutation (leader
+    discovery, bounded by not_leader_redirects)."""
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaSet
+
+    rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+    servers = {nid: StoreServer(rs.nodes[nid], "127.0.0.1", 0).start()
+               for nid in rs.node_ids}
+    rs.set_advertise({nid: s.url for nid, s in servers.items()})
+    assert rs.elect("n0")
+    client = HttpStoreClient(servers["n1"].url)
+    try:
+        obj = client.create(Pod(metadata=ObjectMeta(name="p")))
+        assert obj.metadata.resource_version == 1
+        assert client.retry_stats["not_leader_redirects"] == 1
+        # follower reads keep working wherever the client is parked
+        assert client.get("Pod", "default", "p").metadata.name == "p"
+        statuses = {s["role"] for s in client.replica_status()}
+        assert statuses == {"leader", "follower"}
+    finally:
+        client.close()
+        for s in servers.values():
+            s.stop()
+        rs.stop()
+
+
 def test_agent_batch_with_deleted_pod_still_lands_heartbeat():
     """Gang cleanup deletes a pod between the executor enqueueing its
     mirror and the agent's flush: the batch item must come back as an

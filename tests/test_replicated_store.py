@@ -1,0 +1,697 @@
+"""Replicated HA store (ISSUE 8): protocol safety, the four standing
+analysis gates against the replica set, and the ops surface.
+
+The replica set must EARN its way in through the acceptance bar PRs 4-6
+built: storecheck differential-fuzzes it as just another duck-typed
+backend (with two seeded replication mutants the detector MUST catch),
+linearize checks a recorded concurrent history, crashpoints explores
+leader-SIGKILL points of the kill-during-log-ship workload, and the
+partition+leader-kill chaos e2e rides tests/test_chaos_replica.py.
+Protocol tests here pin the invariants the design doc names: majority
+ack, lease fencing, exactly-one-leader-per-epoch, acked-write survival,
+unacked-suffix truncation, rv monotonicity across failover.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from mpi_operator_tpu.machinery.replicated_store import (
+    NodeTarget,
+    PeerUnreachable,
+    ReplicaSet,
+)
+from mpi_operator_tpu.machinery.serialize import decode
+from mpi_operator_tpu.machinery.store import (
+    Conflict,
+    NotLeader,
+    ReplicationUnavailable,
+)
+from mpi_operator_tpu.opshell import metrics
+
+
+def _pod(name: str, uid: str, ns: str = "default"):
+    return decode("Pod", {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "uid": uid,
+                     "creation_timestamp": 1000.0},
+    })
+
+
+@pytest.fixture
+def rset(tmp_path):
+    rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+    assert rs.elect("n0")
+    yield rs
+    rs.stop()
+
+
+# ---------------------------------------------------------------------------
+# basic surface: leader writes, follower reads + watch, NotLeader
+# ---------------------------------------------------------------------------
+
+
+def test_leader_writes_follower_reads_and_watches(rset):
+    leader = rset.nodes["n0"]
+    follower = rset.nodes["n1"]
+    q = follower.watch(None)
+    created = leader.create(_pod("a", "u1"))
+    # ship-to-all before ack: the follower read needs no settling sleep
+    assert follower.get("Pod", "default", "a").metadata.resource_version \
+        == created.metadata.resource_version
+    ev = q.get(timeout=2.0)
+    assert (ev.type, ev.obj.metadata.name) == ("ADDED", "a")
+    patched = leader.patch("Pod", "default", "a",
+                           {"status": {"phase": "Running"}},
+                           subresource="status")
+    assert rset.nodes["n2"].get("Pod", "default", "a").status.phase \
+        == "Running"
+    ev = q.get(timeout=2.0)
+    assert ev.obj.metadata.resource_version \
+        == patched.metadata.resource_version
+    follower.stop_watch(q)
+
+
+def test_follower_mutations_raise_not_leader_with_hint(rset):
+    follower = rset.nodes["n1"]
+    with pytest.raises(NotLeader) as ei:
+        follower.create(_pod("x", "ux"))
+    assert ei.value.leader == "n0"
+    # store errors stay DEFINITE and identical to a plain backend's
+    rset.nodes["n0"].create(_pod("a", "u1"))
+    stale = rset.nodes["n0"].get("Pod", "default", "a")
+    rset.nodes["n0"].patch("Pod", "default", "a",
+                           {"metadata": {"labels": {"x": "1"}}})
+    stale.metadata.labels["y"] = "2"
+    with pytest.raises(Conflict):
+        rset.nodes["n0"].update(stale)
+
+
+def test_ack_requires_majority_and_minority_leader_steps_down(rset):
+    leader = rset.nodes["n0"]
+    leader.create(_pod("acked", "u1"))
+    rset.hub.partition("n0", "n1")
+    rset.hub.partition("n0", "n2")
+    with pytest.raises(ReplicationUnavailable):
+        leader.create(_pod("unacked", "u2"))
+    # the failed leader fenced itself: even before any new election it
+    # refuses further mutations instead of forking history
+    assert leader.role == "follower"
+    with pytest.raises(NotLeader):
+        leader.create(_pod("more", "u3"))
+    # the unacked write is durable locally (indeterminate), on no quorum
+    assert leader.backing.try_get("Pod", "default", "unacked") is not None
+    assert rset.nodes["n1"].try_get("Pod", "default", "unacked") is None
+
+
+def test_acked_survives_failover_unacked_never_resurrected(rset):
+    """The Jepsen core: after a partition + failover, every acked write
+    is in the new history at its rv; the old leader's locally-committed
+    unacked write is truncated when it rejoins — not resurrected."""
+    n0, n1, n2 = (rset.nodes[n] for n in ("n0", "n1", "n2"))
+    acked = n0.create(_pod("acked", "u1"))
+    rset.hub.partition("n0", "n1")
+    rset.hub.partition("n0", "n2")
+    with pytest.raises(ReplicationUnavailable):
+        n0.create(_pod("unacked", "u2"))
+    rset.expire_leases()
+    assert rset.elect("n1")
+    # acked write present on the new leader at its exact rv
+    assert n1.get("Pod", "default", "acked").metadata.resource_version \
+        == acked.metadata.resource_version
+    # the new history reuses the unacked write's rv for fresh work
+    fresh = n1.create(_pod("fresh", "u3"))
+    assert fresh.metadata.resource_version == 2
+    rset.hub.heal_all()
+    n1.renew()  # drags the ex-leader in; divergence hash -> snapshot resync
+    assert n0.backing.try_get("Pod", "default", "unacked") is None
+    assert n0.backing.try_get("Pod", "default", "fresh") is not None
+    assert n0.current_rv() == n1.current_rv() == n2.current_rv()
+
+
+def test_stale_leader_is_fenced_after_heal(rset):
+    """A deposed leader that never noticed the new epoch gets fenced by
+    the first follower it ships to, steps down, and the write stays
+    indeterminate — it cannot silently fork history."""
+    n0 = rset.nodes["n0"]
+    rset.hub.partition("n0", "n1")
+    rset.hub.partition("n0", "n2")
+    rset.expire_leases()
+    assert rset.elect("n2")
+    rset.hub.heal_all()
+    # n0 still believes it leads (nobody could tell it otherwise), but
+    # its next ship hits followers on epoch 2
+    assert n0.role == "leader"
+    with pytest.raises(ReplicationUnavailable):
+        n0.create(_pod("forked", "uf"))
+    assert n0.role == "follower"
+    # the fork is cleaned up on the next heartbeat from the real leader
+    rset.nodes["n2"].renew()
+    assert n0.backing.try_get("Pod", "default", "forked") is None
+
+
+def test_ex_leader_campaigning_at_equal_rv_cannot_erase_acked_history(rset):
+    """Review-found hole: rv numbers alone cannot distinguish a
+    candidate's dead-epoch unacked suffix from the quorum's ACKED
+    history at the same rv. The winning candidate must hash-reconcile
+    against the quorum max EVEN AT EQUAL rv, truncating its own suffix —
+    otherwise it would lead and snapshot the acked write off the
+    survivors (acked-write loss, the protocol's cardinal sin)."""
+    n0, n1, n2 = (rset.nodes[n] for n in ("n0", "n1", "n2"))
+    n0.create(_pod("base", "u0"))           # rv 1, acked everywhere
+    rset.hub.partition("n0", "n1")
+    rset.hub.partition("n0", "n2")
+    with pytest.raises(ReplicationUnavailable):
+        n0.create(_pod("unacked", "u1"))    # rv 2 on n0 only
+    rset.expire_leases()
+    assert rset.elect("n1")
+    n1.create(_pod("real", "u2"))           # rv 2, ACKED on n1+n2
+    # the epoch-2 leader dies; the stale ex-leader heals and campaigns
+    # with the SAME rv (2) as the surviving grantor n2
+    rset.crash("n1")
+    rset.hub.heal_all()
+    rset.expire_leases()
+    assert n0.current_rv() == n2.current_rv() == 2
+    assert rset.elect("n0")
+    # the acked write survives on every live node; the dead-epoch
+    # suffix is truncated, not shipped as truth
+    for node in (n0, n2):
+        assert node.try_get("Pod", "default", "real") is not None, \
+            f"{node.node_id} lost the ACKED epoch-2 write"
+        assert node.try_get("Pod", "default", "unacked") is None, \
+            f"{node.node_id} resurrected the dead-epoch suffix"
+    # and the new reign keeps working on the reconciled history
+    n0.create(_pod("after", "u3"))
+    assert n2.get("Pod", "default", "after").metadata.resource_version == 3
+
+
+def test_healed_minority_candidate_does_not_fence_the_live_leader(rset):
+    """Review-found disruption: without pre-vote, a partitioned node's
+    doomed campaign durably bumps its epoch, and the live leader's
+    first post-heal ship gets StaleEpoch-fenced — one indeterminate
+    write plus a spurious failover per partition heal. With pre-vote
+    the doomed campaign changes NOTHING durable."""
+    n0, n2 = rset.nodes["n0"], rset.nodes["n2"]
+    n0.create(_pod("a", "u1"))
+    rset.hub.partition("n0", "n2")
+    rset.hub.partition("n1", "n2")  # n2 fully isolated, lease expires
+    with n2._state_lock:
+        n2._lease_until = 0.0
+    assert not n2.campaign()  # pre-vote: no reachable majority
+    assert n2.epoch == 1, "a doomed campaign must not burn an epoch"
+    rset.hub.heal_all()
+    # the live leader keeps its reign and the next write acks cleanly
+    # (pre-fix this raised ReplicationUnavailable and stepped n0 down)
+    n0.create(_pod("b", "u2"))
+    assert rset.leader().node_id == "n0"
+    assert n0.epoch == 1
+    n0.renew()
+    assert rset.quiesce(5.0)
+    assert n2.try_get("Pod", "default", "b") is not None
+
+
+def test_ahead_candidate_reconciles_and_can_keep_writing(rset):
+    """Review-found hole pair: (a) a partitioned leader's patch_batch
+    strands SEVERAL unacked entries, so a rejoining candidate can be
+    numerically AHEAD of the quorum max — election must still
+    hash-reconcile at the common point and truncate the suffix, or its
+    first reign heartbeat snapshots an ACKED write off the survivors;
+    (b) after that truncation, the node's next local commit must be
+    CONTIGUOUS with the adopted history (the sqlite AUTOINCREMENT
+    sequence is clamped) — unclamped, its own log_tail rejects the gap
+    and every write it ever leads again wedges."""
+    n0, n1, n2 = (rset.nodes[n] for n in ("n0", "n1", "n2"))
+    n0.create(_pod("base", "u0"))            # rv 1, acked everywhere
+    rset.hub.partition("n0", "n1")
+    rset.hub.partition("n0", "n2")
+    with pytest.raises(ReplicationUnavailable):
+        # TWO local commits in one write window: rv 2 and 3, unacked
+        n0.patch_batch([
+            {"kind": "Pod", "namespace": "default", "name": "base",
+             "subresource": "status",
+             "patch": {"status": {"phase": "Running"}}},
+            {"kind": "Pod", "namespace": "default", "name": "base",
+             "subresource": "status",
+             "patch": {"status": {"message": "m"}}},
+        ])
+    assert n0.current_rv() == 3
+    rset.expire_leases()
+    assert rset.elect("n1")
+    n1.create(_pod("real", "u1"))            # rv 2, ACKED on n1+n2
+    rset.crash("n1")
+    rset.hub.heal_all()
+    rset.expire_leases()
+    # the AHEAD ex-leader (rv 3 > n2's rv 2) campaigns: it must adopt
+    # the quorum history, not lead on its dead-epoch suffix
+    assert rset.elect("n0")
+    for node in (n0, n2):
+        got = node.try_get("Pod", "default", "real")
+        assert got is not None, f"{node.node_id} lost the ACKED write"
+        assert got.metadata.resource_version == 2
+        base = node.get("Pod", "default", "base")
+        assert base.status.phase != "Running", "unacked batch resurrected"
+    # (b) the truncated node LEADS and keeps writing contiguously
+    after = n0.create(_pod("after", "u2"))
+    assert after.metadata.resource_version == 3
+    assert n2.get("Pod", "default", "after").metadata.resource_version == 3
+
+
+def test_write_ships_with_the_epoch_its_lease_check_validated(rset):
+    """Review-found fencing hole: a leader deposed between its lease
+    check and its ship must be fenced by StaleEpoch — re-reading
+    self.epoch at ship time would stamp the dead reign's entry as the
+    NEW epoch's traffic and sail past the fence."""
+    n0 = rset.nodes["n0"]
+    # simulate the depose landing inside the write window: epoch 1 was
+    # captured by _require_leader, then — before fn() commits — the
+    # stalled leader's own deadline lapses (GC pause / clock stall), it
+    # GRANTS epoch 2 to n1 and even acknowledges n1's first heartbeat;
+    # only then does its local commit land. Without the captured-epoch
+    # fix, the ship re-reads self.epoch == 2 and stamps the dead
+    # reign's entry as epoch-2 traffic, which BOTH followers accept —
+    # a majority-acked write from a node that is not the leader.
+    orig_create = n0.backing.create
+    deposed = {}
+
+    def depose_then_create(obj):
+        if not deposed:
+            deposed["done"] = True
+            with n0._state_lock:
+                n0._lease_deadline = 0.0
+            rset.expire_leases()
+            assert rset.nodes["n1"].campaign()  # epoch 2, all 3 voted
+            assert n0.epoch == 2 and n0.role == "follower"
+        return orig_create(obj)
+
+    n0.backing.create = depose_then_create
+    try:
+        with pytest.raises(ReplicationUnavailable):
+            n0.create(_pod("fenced", "u1"))
+    finally:
+        n0.backing.create = orig_create
+    assert n0.role == "follower"
+    # the fenced write never reached the epoch-2 majority...
+    assert rset.nodes["n1"].try_get("Pod", "default", "fenced") is None
+    assert rset.nodes["n2"].try_get("Pod", "default", "fenced") is None
+    # ...and the new reign truncates it off the ex-leader too
+    rset.nodes["n1"].renew()
+    assert n0.backing.try_get("Pod", "default", "fenced") is None
+    # the epoch-2 reign is healthy and exclusive
+    epochs = [e for e, _ in rset.leadership_log]
+    assert len(set(epochs)) == len(epochs)
+
+
+def test_live_leader_lease_blocks_takeover(rset):
+    """Vote fencing (rule 2): while the leader's lease is fresh on the
+    grantors, a campaign cannot depose it."""
+    rset.nodes["n0"].create(_pod("a", "u1"))  # refreshes follower leases
+    assert not rset.nodes["n1"].campaign()
+    assert rset.leader().node_id == "n0"
+    # but the failed candidate burned an epoch, never a second leader
+    epochs = [e for e, _ in rset.leadership_log]
+    assert len(set(epochs)) == len(epochs)
+
+
+def test_concurrent_campaigns_elect_at_most_one_leader_per_epoch(rset):
+    """Safety under split votes: two candidates campaigning at once may
+    BOTH lose a round (each self-votes its epoch away — the classic
+    split vote), but can never both win, and staggered retries (what
+    auto mode's jitter provides) converge on one leader."""
+    rset.crash("n0")
+    rset.expire_leases()
+    for round_no in range(10):
+        results = {}
+
+        def run(nid, delay):
+            threading.Event().wait(delay)
+            results[nid] = rset.nodes[nid].campaign()
+
+        ts = [
+            threading.Thread(target=run, args=("n1", 0.0)),
+            # round 0 races head-on; later rounds stagger like the
+            # auto-mode jitter does
+            threading.Thread(target=run, args=("n2", 0.02 * round_no)),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sum(results.values()) <= 1, "two winners in one round"
+        if any(results.values()):
+            break
+        rset.expire_leases()
+    if rset.leader() is None:
+        # pathological thread timing can split-vote every staggered
+        # round; the SAFETY property above is what this test pins —
+        # converge deterministically so the epoch audit below runs on a
+        # settled set (auto mode's jitter provides this in production)
+        rset.expire_leases()
+        assert rset.elect("n1") or rset.elect("n2")
+    epochs = [e for e, _ in rset.leadership_log]
+    assert len(set(epochs)) == len(epochs), rset.leadership_log
+
+
+def test_crash_restart_recovers_wal_and_catches_up(rset):
+    leader = rset.nodes["n0"]
+    leader.create(_pod("before", "u1"))
+    rset.crash("n1")  # abrupt: WAL left unsynced on disk
+    leader.create(_pod("during", "u2"))  # acked by n0+n2 majority
+    rset.restart("n1")
+    leader.renew()  # heartbeat walks n1 through the behind path
+    assert rset.quiesce(5.0)
+    n1 = rset.nodes["n1"]
+    assert n1.try_get("Pod", "default", "before") is not None
+    assert n1.try_get("Pod", "default", "during") is not None
+    assert n1.current_rv() == leader.current_rv()
+
+
+def test_partitioned_follower_lags_then_catches_up(rset):
+    leader = rset.nodes["n0"]
+    rset.hub.partition("n0", "n2")
+    for i in range(3):
+        leader.create(_pod(f"p{i}", f"u{i}"))  # n0+n1 majority acks
+    assert rset.nodes["n2"].current_rv() == 0  # lagging, never regressing
+    rset.hub.heal("n0", "n2")
+    leader.renew()
+    assert rset.quiesce(5.0)
+    assert rset.nodes["n2"].current_rv() == leader.current_rv()
+    # the lag gauge saw the partition window and the recovery
+    assert metrics.store_replication_lag.get(follower="n2") == 0
+
+
+def test_replica_client_fails_over_between_leaders(rset, tmp_path):
+    client = rset.client(read_from="n1")
+    c1 = client.create(_pod("a", "u1"))
+    rset.crash("n0")
+    rset.expire_leases()
+    assert rset.elect("n2")
+    c2 = client.create(_pod("b", "u2"))
+    assert c2.metadata.resource_version > c1.metadata.resource_version
+    assert {o.metadata.name for o in client.list("Pod")} == {"a", "b"}
+
+
+def test_failover_metrics_count_elections(tmp_path):
+    before = metrics.store_replication_failovers.get()
+    rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+    try:
+        assert rs.elect("n0")
+        rs.crash("n0")
+        rs.expire_leases()
+        assert rs.elect("n1")
+        assert metrics.store_replication_failovers.get() == before + 2
+    finally:
+        rs.stop()
+
+
+def test_node_target_resolves_leader_at_fire_time(rset):
+    target = NodeTarget(rset)
+    target.kill()
+    assert target.killed == "n0"
+    assert rset.nodes["n0"].crashed
+    rset.expire_leases()
+    assert rset.elect("n2")
+    target.restart()
+    assert not rset.nodes["n0"].crashed
+    assert rset.leader().node_id == "n2"
+
+
+def test_replica_status_shape(rset):
+    rset.nodes["n0"].create(_pod("a", "u1"))
+    status = {s["node"]: s for s in rset.status()}
+    assert status["n0"]["role"] == "leader"
+    assert status["n0"]["lag_entries"] == {"n1": 0, "n2": 0}
+    assert status["n1"]["role"] == "follower"
+    assert status["n1"]["leader"] == "n0"
+    assert all(s["epoch"] == 1 for s in status.values())
+    assert all(s["applied_rv"] == 1 for s in status.values())
+
+
+# ---------------------------------------------------------------------------
+# auto mode: unattended failover
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_auto_mode_elects_and_fails_over_unattended(tmp_path):
+    rs = ReplicaSet(3, dir=str(tmp_path), lease_duration=0.5,
+                    retry_period=0.05, poll_interval=0.01, seed=7)
+    try:
+        rs.start()
+        first = rs.wait_for_leader(5.0)
+        assert first is not None
+        client = rs.client()
+        client.create(_pod("a", "u1"))
+        rs.crash(first.node_id)
+        # a new leader must take over within ~2 lease durations
+        deadline = threading.Event()
+        second = None
+        for _ in range(100):
+            second = rs.leader()
+            if second is not None and second.node_id != first.node_id:
+                break
+            deadline.wait(0.05)
+        assert second is not None and second.node_id != first.node_id
+        client.create(_pod("b", "u2"))
+        assert {o.metadata.name for o in client.list("Pod")} == {"a", "b"}
+        epochs = [e for e, _ in rs.leadership_log]
+        assert len(set(epochs)) == len(epochs)
+    finally:
+        rs.stop()
+
+
+# ---------------------------------------------------------------------------
+# the standing analysis gates, pointed at the replica set
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fuzz
+def test_storecheck_fuzz_replica_backend_fast_budget():
+    """Tier-1 half of the acceptance bar: the replica set diffs clean
+    against the shared sequential model at the fast budget (the default
+    budget rides storecheck.self_test, the exhaustive sweep the slow
+    tier — the replica set is in REAL_BACKENDS like any other)."""
+    from mpi_operator_tpu.analysis import storecheck
+
+    report = storecheck.fuzz(
+        {"replica": storecheck.REAL_BACKENDS["replica"]},
+        budget=storecheck.FAST_BUDGET,
+    )
+    assert report.ok, report.render()
+
+
+@pytest.mark.fuzz
+@pytest.mark.parametrize("name", ["replica-ack-before-majority",
+                                  "replica-follower-regressed-rv"])
+def test_seeded_replication_mutants_are_caught(name):
+    """The two new seeded replication bugs MUST be caught, shrunk, and
+    replay twice-identically — otherwise the gate the replica set just
+    passed proves nothing about replication."""
+    from mpi_operator_tpu.analysis import storecheck
+
+    factory = storecheck.MUTANTS[name]
+    report = storecheck.fuzz({name: factory})
+    assert not report.ok, f"mutant {name} fuzzed clean"
+    token = report.finding.token
+    first = storecheck.replay(token, factory)
+    second = storecheck.replay(token, factory)
+    assert first is not None and second is not None
+    assert first.divergence == second.divergence
+
+
+@pytest.mark.linearize
+def test_linearize_clean_on_recorded_replica_history(tmp_path):
+    """Record a concurrent workload through the failover client (leader
+    writes, follower reads and watch) and check it linearizes against
+    the sequential spec — the same Wing&Gong pass every other backend's
+    histories ride."""
+    from mpi_operator_tpu.analysis import linearize
+    from mpi_operator_tpu.machinery.replicated_store import ReplicaClient
+
+    rec = linearize.Recorder().install(
+        classes=(ReplicaClient,), batch_classes=(ReplicaClient,),
+    )
+    try:
+        rs = ReplicaSet(3, dir=str(tmp_path), poll_interval=0.01)
+        assert rs.elect("n0")
+        client = rs.client(read_from="n1")
+        q = client.watch(None)
+        client.create(_pod("shared", "u0"))
+
+        def writer(wid: int):
+            for i in range(6):
+                client.create(_pod(f"w{wid}-{i}", f"u{wid}-{i}"))
+                try:
+                    cur = client.get("Pod", "default", "shared")
+                    client.patch(
+                        "Pod", "default", "shared",
+                        {"metadata": {
+                            "resource_version":
+                                cur.metadata.resource_version,
+                            "labels": {"writer": str(wid)},
+                        }},
+                    )
+                except Conflict:
+                    pass  # the losing writer's legal outcome
+                client.patch_batch([{
+                    "kind": "Pod", "namespace": "default",
+                    "name": f"w{wid}-{i}", "subresource": "status",
+                    "patch": {"status": {"phase": "Running"}},
+                }])
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # drain the watch through the recording queue so the history
+        # carries the follower's delivery order too
+        import queue as _queue
+
+        while True:
+            try:
+                q.get(timeout=0.3)
+            except _queue.Empty:
+                break
+        client.stop_watch(q)
+        rs.stop()
+    finally:
+        rec.uninstall()
+    report = linearize.check(rec.history)
+    assert report.ok, report.render()
+    assert report.ops > 50
+    assert report.watch_events > 0
+
+
+@pytest.mark.crash
+def test_crashpoints_replica_kill_during_log_ship_fast():
+    """Tier-1 slice of the kill-during-log-ship workload: every leader
+    SIGKILL point recovers — acked prefix intact, rv monotone through
+    failover, the ex-leader's unacked suffix truncated on rejoin."""
+    from mpi_operator_tpu.analysis import crashpoints
+
+    report = crashpoints.explore_replica(writes=4)
+    assert report.ok, report.render()
+    assert report.points >= 20
+
+
+@pytest.mark.crash
+@pytest.mark.slow
+def test_crashpoints_replica_exhaustive():
+    from mpi_operator_tpu.analysis import crashpoints
+
+    report = crashpoints.explore_replica(writes=16)
+    assert report.ok, report.render()
+    assert report.points >= 90
+
+
+# ---------------------------------------------------------------------------
+# chaos partition action (satellite: ChaosScript fabric faults)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_partition_action_parses_and_expands():
+    from mpi_operator_tpu.machinery.chaos import ChaosScript, ChaosScriptError
+
+    script = ChaosScript.parse({
+        "seed": 1,
+        "actions": [
+            {"at": 0.5, "fault": "partition", "a": "n0", "b": "n1",
+             "duration": 1.0},
+        ],
+    })
+    assert [(a.fault, a.at, a.a, a.b) for a in script.actions] == [
+        ("partition", 0.5, "n0", "n1"), ("heal", 1.5, "n0", "n1"),
+    ]
+    # both endpoints are mandatory and distinct
+    with pytest.raises(ChaosScriptError):
+        ChaosScript.parse({"seed": 0, "actions": [
+            {"at": 0, "fault": "partition", "a": "n0"}]})
+    with pytest.raises(ChaosScriptError):
+        ChaosScript.parse({"seed": 0, "actions": [
+            {"at": 0, "fault": "heal", "a": "n0", "b": "n0"}]})
+    # PR 3 policy: knobs the fault ignores are rejected, not ignored
+    with pytest.raises(ChaosScriptError):
+        ChaosScript.parse({"seed": 0, "actions": [
+            {"at": 0, "fault": "partition", "a": "x", "b": "y",
+             "prob": 0.5}]})
+    with pytest.raises(ChaosScriptError):
+        ChaosScript.parse({"seed": 0, "actions": [
+            {"at": 0, "fault": "sever", "a": "x", "b": "y"}]})
+
+
+def test_chaos_partition_executes_against_the_hub(rset):
+    from mpi_operator_tpu.machinery.chaos import ChaosController, ChaosScript
+
+    script = ChaosScript.parse({
+        "seed": 3,
+        "actions": [
+            {"at": 0.0, "fault": "partition", "a": "n0", "b": "n1"},
+            {"at": 0.15, "fault": "heal", "a": "n0", "b": "n1"},
+        ],
+    })
+    ctl = ChaosController(script, fabric=rset.hub).arm()
+    ctl.join(5.0)
+    assert [err for _, _, err in ctl.executed] == [None, None]
+    with pytest.raises(PeerUnreachable):
+        # executed log shows both edges fired; verify the heal really
+        # restored the link by cutting it again manually first
+        rset.hub.partition("n0", "n1")
+        rset.hub.call("n0", "n1", "replica_status")
+    rset.hub.heal("n0", "n1")
+    assert rset.hub.call("n0", "n1", "replica_status")["node"] == "n1"
+
+
+def test_chaos_partition_without_fabric_fails_loudly():
+    from mpi_operator_tpu.machinery.chaos import ChaosController, ChaosScript
+
+    script = ChaosScript.parse({"seed": 0, "actions": [
+        {"at": 0.0, "fault": "partition", "a": "n0", "b": "n1"}]})
+    ctl = ChaosController(script).arm()
+    ctl.join(5.0)
+    (_, _, err), = ctl.executed
+    assert err is not None and "fabric" in err
+
+
+# ---------------------------------------------------------------------------
+# ops surface: ctl store status
+# ---------------------------------------------------------------------------
+
+
+def test_ctl_store_status_over_http(rset, capsys):
+    from mpi_operator_tpu.machinery.http_store import StoreServer
+    from mpi_operator_tpu.opshell import ctl
+
+    servers = {nid: StoreServer(rset.nodes[nid], "127.0.0.1", 0).start()
+               for nid in rset.node_ids}
+    rset.set_advertise({nid: s.url for nid, s in servers.items()})
+    try:
+        import json as _json
+
+        urls = ",".join(servers[n].url for n in rset.node_ids)
+        rc = ctl.main(["--store", urls, "store", "status", "-o", "json"])
+        payload = _json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert sorted(p["role"] for p in payload) == [
+            "follower", "follower", "leader",
+        ]
+        leader_row = next(p for p in payload if p["role"] == "leader")
+        assert leader_row["lag_entries"] == {"n1": 0, "n2": 0}
+        # the human table renders too (header + lag line)
+        rc = ctl.main(["--store", urls, "store", "status"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "ENDPOINT" in out and "replication lag" in out
+        # a leaderless set exits nonzero: the runbook's triage probe —
+        # in BOTH output formats (a json-parsing monitor must not be
+        # told the set is healthy)
+        rset.crash("n0")
+        rc = ctl.main(["--store", urls, "store", "status"])
+        assert rc == 1
+        capsys.readouterr()
+        rc = ctl.main(["--store", urls, "store", "status", "-o", "json"])
+        assert rc == 1
+    finally:
+        for s in servers.values():
+            s.stop()

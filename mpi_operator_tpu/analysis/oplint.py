@@ -185,6 +185,15 @@ RULES: Dict[str, Rule] = {
             "or annotate why the lock is uncontended",
         ),
         Rule(
+            "OBS001", "error",
+            "bare start_span() outside a with statement",
+            "ISSUE 9: a span opened without the context-manager form stays "
+            "on the thread's span stack when the exception path skips its "
+            "finish() — every later span silently re-parents under the "
+            "leaked one and the causal timeline lies; use "
+            "`with start_span(...)`",
+        ),
+        Rule(
             "REP001", "error",
             "direct store write on a follower/standby handle",
             "ISSUE 8: every mutation routes through the leased leader "
@@ -565,6 +574,29 @@ def _check_rep001(ctx: _FileCtx, call: ast.Call,
         )
 
 
+def _check_obs001(ctx: _FileCtx, call: ast.Call,
+                  with_context_calls: Set[int]) -> None:
+    """A ``start_span(...)`` call (any receiver — the module function,
+    ``TRACER.start_span``, ``tr.start_span``) must BE the context
+    expression of a ``with`` item. Assign-then-with still fires: the
+    window between the call and the with is an exception path that leaks
+    the open span."""
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "start_span":
+        return
+    if id(call) in with_context_calls:
+        return
+    ctx.report(
+        "OBS001", call,
+        "start_span() outside a with statement leaks the open span on "
+        "the exception path (every later span re-parents under it); "
+        "use `with start_span(...) as sp:`",
+    )
+
+
 def _is_lock_expr(expr: ast.AST) -> bool:
     """Does a with-item context expression look like a lock? Matched on the
     LAST dotted component (`self._lock`, `self._mu`, `cache.lock`,
@@ -737,6 +769,15 @@ def lint_source(
         _check_rmw001(ctx, fn)
         _check_term001(ctx, fn)
 
+    # pre-pass for OBS001: the set of Call nodes that ARE a with item's
+    # context expression (the blessed span shape)
+    with_context_calls: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_context_calls.add(id(item.context_expr))
+
     # walk with an enclosing-function-name stack for BLK001's sleep check
     # and a held-lock depth for LCK001 (a nested def's body does not run
     # under the enclosing with, so the depth resets at function boundaries)
@@ -753,6 +794,7 @@ def lint_source(
             _check_blk001(ctx, node, fn_stack)
             _check_dur001(ctx, node, fn_stack)
             _check_rep001(ctx, node, fn_stack)
+            _check_obs001(ctx, node, with_context_calls)
             if lock_depth > 0:
                 _check_lck001(ctx, node)
         if isinstance(node, ast.ExceptHandler):

@@ -54,15 +54,30 @@ class TPUJobClient:
         return parse_tpujob(manifest)
 
     def create(self, manifest: Union[TPUJob, Dict[str, Any]]) -> TPUJob:
+        from mpi_operator_tpu.machinery import trace
+
         job = self.load(manifest).deepcopy()
         if not job.metadata.namespace or job.metadata.namespace == "default":
             job.metadata.namespace = self.namespace
+        # trace anchor, stamped at ADMISSION (machinery/trace.py): every
+        # span any component ever opens for this job's lifecycle groups
+        # under this id — `ctl trace <job>` starts here. setdefault, so a
+        # caller-provided id (a CI pipeline threading its own trace
+        # through) is honored.
+        job.metadata.annotations.setdefault(
+            trace.ANNOTATION_TRACE_ID, trace.new_trace_id()
+        )
         # admission: validate a defaulted copy (the controller re-defaults at
         # reconcile; stored spec stays exactly what the user wrote)
         errors = validate_tpujob(set_defaults(job.deepcopy()))
         if errors:
             raise ValidationRejected(errors)
-        return self.store.create(job)
+        with trace.start_span(
+            "client.submit",
+            trace_id=job.metadata.annotations[trace.ANNOTATION_TRACE_ID],
+            attrs={"job": f"{job.metadata.namespace}/{job.metadata.name}"},
+        ):
+            return self.store.create(job)
 
     def update(self, job: TPUJob) -> TPUJob:
         """Admission-validated spec update (scale, suspend, …): the same

@@ -54,6 +54,7 @@ from mpi_operator_tpu.controller.placement import (
     SlicePlacement,
     place_workers,
 )
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
     ConfigMap,
@@ -130,6 +131,13 @@ class ControllerOptions:
     threadiness: int = 2
     coordinator_port: int = DEFAULT_COORDINATOR_PORT
     gang_scheduling: bool = True
+    # Event TTL sweep (the controller's housekeeping pass): Events older
+    # than this are pruned — kube's apiserver does the same (default 1h),
+    # and without it the append-only audit stream grows the store without
+    # bound. None disables (embedded/test controllers keep full trails);
+    # the operator CLI turns it on by default.
+    event_ttl: Optional[float] = None
+    event_gc_interval: float = 60.0
 
 
 class TPUJobController:
@@ -171,6 +179,15 @@ class TPUJobController:
         # persists (cleared when the job disappears)
         self._port_lock = threading.Lock()
         self._ports_inflight: Dict[str, int] = {}
+        # job key → span context of the latest watch write that enqueued
+        # it: the reconcile span's causal parent ("why did this reconcile
+        # run"). Last-writer-wins per key matches the workqueue's own
+        # coalescing; popped at reconcile start, bounded by live keys.
+        self._trace_lock = threading.Lock()
+        self._trace_links: Dict[str, object] = {}
+        # job uid → trace id this controller stamped (bounded memo; see
+        # _ensure_trace_id)
+        self._stamped_traces: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     # run loop (≙ Run + runWorker + processNextWorkItem :347-438)
@@ -208,6 +225,13 @@ class TPUJobController:
         prime = threading.Thread(target=self._prime, name="tpujob-prime", daemon=True)
         prime.start()
         self._threads.append(prime)
+        if self.options.event_ttl is not None:
+            hk = threading.Thread(
+                target=self._housekeeping_loop, name="tpujob-housekeeping",
+                daemon=True,
+            )
+            hk.start()
+            self._threads.append(hk)
 
     def _wait_cache_synced(self) -> bool:
         """Block until the informer cache (if any) has its initial snapshot,
@@ -246,7 +270,13 @@ class TPUJobController:
                 continue
             if ev.kind == "Event":
                 continue
-            self._pump_obj(ev.obj)
+            # same delivery-context contract the informer path gets from
+            # the cache drain: the handler sees the event's origin span
+            trace.set_delivery(getattr(ev, "trace", None))
+            try:
+                self._pump_obj(ev.obj)
+            finally:
+                trace.clear_delivery()
 
     def _pump_obj(self, obj) -> None:
         """One object observation → the TPUJob key to reconcile (job events
@@ -256,11 +286,21 @@ class TPUJobController:
         if self.options.namespace is not None and ns != self.options.namespace:
             return
         if obj.kind == "TPUJob":
+            self._note_trigger(obj.metadata.key())
             self.enqueue(obj.metadata.key())
             return
         owner = self._controller_owner(obj)
         if owner is not None:
+            self._note_trigger(f"{ns}/{owner.name}")
             self.enqueue(f"{ns}/{owner.name}")
+
+    def _note_trigger(self, key: str) -> None:
+        """Remember the delivering watch event's origin span (if any) as
+        the causal parent of the reconcile this enqueue wakes."""
+        link = trace.get_delivery()
+        if link is not None:
+            with self._trace_lock:
+                self._trace_links[key] = link
 
     @staticmethod
     def _controller_owner(obj) -> Optional[OwnerReference]:
@@ -309,10 +349,20 @@ class TPUJobController:
     def sync_handler(self, key: str) -> bool:
         """One reconcile. Returns True on success (forget), False to requeue
         (≙ syncHandler returning err → AddRateLimited in processNextWorkItem
-        :381-438; Conflicts and ownership errors both requeue)."""
-        t0 = time.time()
+        :381-438; Conflicts and ownership errors both requeue).
+
+        The reconcile runs under a ``controller.reconcile`` span parented
+        on the watch write that enqueued this key (the causal "why"), and
+        its wall time lands in the reconcile-latency histogram where the
+        span closes."""
+        with self._trace_lock:
+            link = self._trace_links.pop(key, None)
+        t0 = time.perf_counter()
         try:
-            return self._sync(key)
+            with trace.start_span(
+                "controller.reconcile", parent=link, attrs={"job": key}
+            ):
+                return self._sync(key)
         except (Conflict, AlreadyExists):
             # Conflict: stale read lost an update race. AlreadyExists: the
             # cache had not yet observed a dependent this controller created
@@ -324,7 +374,9 @@ class TPUJobController:
             log.warning("sync %s: %s", key, e)
             return False
         finally:
-            log.debug("sync %s took %.1fms", key, (time.time() - t0) * 1e3)
+            dt = time.perf_counter() - t0
+            metrics.reconcile_latency.observe(dt)
+            log.debug("sync %s took %.1fms", key, dt * 1e3)
 
     def _sync(self, key: str) -> bool:
         namespace, name = key.split("/", 1)
@@ -346,6 +398,9 @@ class TPUJobController:
             # invalid specs are dropped, not requeued (≙ :482-487)
             self.recorder.event(job, WARNING, EVENT_VALIDATION_ERROR, "; ".join(errs))
             return True
+
+        if not cond.is_finished(job.status):
+            self._ensure_trace_id(job)
 
         workers = self._list_workers(job)
 
@@ -405,6 +460,45 @@ class TPUJobController:
         # --- status mirror (≙ updateMPIJobStatus call :602) ---
         self._update_status(job, workers)
         return self._write_status(job)
+
+    def _ensure_trace_id(self, job: TPUJob) -> None:
+        """The job's trace anchor: admission (api/client.py) stamps the
+        ``tpujob.dev/trace-id`` annotation; this backstop covers jobs
+        created straight through the store (tests, benches, old clients).
+        Either way, the current reconcile span re-homes into the job's
+        trace so everything this pass causes groups under it."""
+        tid = job.metadata.annotations.get(trace.ANNOTATION_TRACE_ID)
+        if not tid:
+            # memo by uid: a cached read lagging our own stamp must reuse
+            # the minted id, not write a fresh one per reconcile until the
+            # informer echo lands (under _trace_lock — worker threads
+            # trimming the bounded memo concurrently must not double-pop)
+            with self._trace_lock:
+                tid = self._stamped_traces.get(job.metadata.uid)
+        if not tid:
+            tid = trace.new_trace_id()
+            try:
+                self.store.patch(
+                    "TPUJob", job.namespace, job.name,
+                    # uid-pinned like every identity-sensitive write: a
+                    # recreated same-name job must mint its own trace
+                    {"metadata": {
+                        "uid": job.metadata.uid,
+                        "annotations": {trace.ANNOTATION_TRACE_ID: tid},
+                    }},
+                )
+            except (NotFound, Conflict):
+                return  # deleted/recreated under us; next reconcile retries
+            with self._trace_lock:
+                self._stamped_traces[job.metadata.uid] = tid
+                while len(self._stamped_traces) > 4096:
+                    self._stamped_traces.pop(
+                        next(iter(self._stamped_traces))
+                    )
+        job.metadata.annotations[trace.ANNOTATION_TRACE_ID] = tid
+        sp = trace.TRACER.current_span()
+        if sp is not None:
+            sp.adopt_trace(tid)
 
     # ------------------------------------------------------------------
     # dependents
@@ -649,6 +743,13 @@ class TPUJobController:
         labels[LABEL_GENERATION] = str(job.status.restart_generation)
         annotations = dict(tmpl.annotations)
         annotations.update(placement.annotations_for(index))
+        # trace propagation: the pod carries its job's trace id, so every
+        # component holding the pod (scheduler bind, agent launch, monitor
+        # eviction) can open spans in the job's trace with no live header
+        # chain — robust across the process crashes chaos injects
+        tid = job.metadata.annotations.get(trace.ANNOTATION_TRACE_ID)
+        if tid:
+            annotations[trace.ANNOTATION_TRACE_ID] = tid
         # ExitCode policy is controller-owned: the pod itself never restarts
         # (≙ setRestartPolicy :1394-1400)
         pod_restart = (
@@ -850,12 +951,31 @@ class TPUJobController:
                 self._drain_noted.discard(
                     (job.metadata.uid, job.status.restart_count)
                 )
-                # delete every terminal pod — a succeeded non-coordinator
-                # must re-run too, or the relaunched gang waits on a member
-                # that never comes back; next reconcile recreates the gang
-                # at the (possibly rescaled) size
-                for p in all_pods:
-                    self.store.try_delete("Pod", p.metadata.namespace, p.metadata.name)
+                # the gang-restart span (an `ctl trace --last-incident`
+                # anchor): child of this reconcile — whose parent is the
+                # eviction/failure write that triggered it — and parent of
+                # the teardown deletes below, so the relaunch chain the
+                # deletes cause links back to the restart that caused THEM
+                first_fail = failed[0]
+                with trace.start_span(
+                    "controller.gang_restart",
+                    attrs={
+                        "job": job.metadata.key(),
+                        "generation": job.status.restart_generation,
+                        "free": preempted,
+                        "first_failed": first_fail.metadata.name,
+                        "reason": first_fail.status.reason or "Error",
+                    },
+                ):
+                    # delete every terminal pod — a succeeded
+                    # non-coordinator must re-run too, or the relaunched
+                    # gang waits on a member that never comes back; next
+                    # reconcile recreates the gang at the (possibly
+                    # rescaled) size
+                    for p in all_pods:
+                        self.store.try_delete(
+                            "Pod", p.metadata.namespace, p.metadata.name
+                        )
                 return
             first = failed[0]
             reason = cond.REASON_EVICTED if first.is_evicted() else cond.REASON_FAILED
@@ -964,6 +1084,42 @@ class TPUJobController:
                 self.store.try_delete("TPUJob", job.namespace, job.name)
             else:
                 self.queue.add_after(job.metadata.key(), ttl - age + 0.01)
+
+    # ------------------------------------------------------------------
+    # housekeeping: Event TTL sweep (≙ the apiserver's event TTL — kube
+    # prunes its events after 1h; without this the append-only audit
+    # stream grows the store without bound)
+    # ------------------------------------------------------------------
+
+    def _housekeeping_loop(self) -> None:
+        while not self._stop.wait(self.options.event_gc_interval):
+            try:
+                self.prune_events()
+            except Exception:
+                log.exception("event TTL sweep failed")  # next pass retries
+
+    def prune_events(self, now: Optional[float] = None) -> int:
+        """Delete Events older than ``options.event_ttl``; returns the
+        pruned count (also exported as tpu_operator_events_pruned_total).
+        Recent events — the trail `ctl describe`/`ctl events` renders —
+        survive untouched; reads go straight to the store because Events
+        are deliberately not informer-cached (cache.DEFAULT_KINDS)."""
+        ttl = self.options.event_ttl
+        if ttl is None:
+            return 0
+        cutoff = (time.time() if now is None else now) - ttl
+        pruned = 0
+        for ev in self.store.list("Event", self.options.namespace):
+            if ev.timestamp and ev.timestamp < cutoff:
+                if self.store.try_delete(
+                    "Event", ev.metadata.namespace, ev.metadata.name
+                ) is not None:
+                    pruned += 1
+        if pruned:
+            metrics.events_pruned.inc(pruned)
+            log.info("event TTL sweep pruned %d events (ttl %.0fs)",
+                     pruned, ttl)
+        return pruned
 
     # ------------------------------------------------------------------
     # status write (injectable; ≙ updateStatusHandler :243-244)

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Optional
 
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, evict_pod
 from mpi_operator_tpu.machinery.store import NotFound
@@ -81,6 +82,14 @@ class NodeMonitor:
             return  # cold cache = empty world; next tick retries
         now = time.time()
         stale = []
+        # PER-NODE span contexts of this tick's fresh NodeLost detections:
+        # each evict span below parents on the span of the node ITS pod
+        # was bound to, which is how `ctl trace` attributes a gang restart
+        # to the node loss that caused it (the cross-trace causal edge —
+        # one lost node can hit many jobs' traces). Per node, not a single
+        # last-one-wins context: two nodes dying in one tick must not
+        # cross-attribute each other's evictions.
+        lost_ctx = {}
         for node in self.read.list("Node", NODE_NAMESPACE):
             hb = node.status.last_heartbeat
             if not hb:
@@ -89,12 +98,19 @@ class NodeMonitor:
                 continue
             stale.append(node.metadata.name)
             if node.status.ready:
-                self._mark_not_ready(node.metadata.name)
-                self.recorder.event(
-                    node, WARNING, EVENT_NODE_LOST,
-                    f"node {node.metadata.name} stopped heartbeating "
-                    f"({now - hb:.1f}s > {self.grace:.1f}s grace)",
-                )
+                with trace.start_span(
+                    "monitor.node_lost",
+                    attrs={"node": node.metadata.name,
+                           "silent_s": round(now - hb, 1),
+                           "grace_s": self.grace},
+                ) as sp:
+                    lost_ctx[node.metadata.name] = sp.context()
+                    self._mark_not_ready(node.metadata.name)
+                    self.recorder.event(
+                        node, WARNING, EVENT_NODE_LOST,
+                        f"node {node.metadata.name} stopped heartbeating "
+                        f"({now - hb:.1f}s > {self.grace:.1f}s grace)",
+                    )
                 metrics.nodes_lost.inc()
                 log.warning("node %s lost; evicting its pods", node.metadata.name)
         if stale:
@@ -102,7 +118,7 @@ class NodeMonitor:
             # permanently dead nodes must not mean 2 full list round-trips
             # per second forever); level-triggered so a pod re-bound to a
             # still-dead node is caught on the next tick
-            self._evict_pods(set(stale))
+            self._evict_pods(set(stale), lost_ctx)
 
     def _mark_not_ready(self, name: str) -> None:
         """One status-subresource merge-patch touching ONLY ``ready``: a
@@ -120,17 +136,33 @@ class NodeMonitor:
         except NotFound:
             pass  # node deleted between the scan and the mark
 
-    def _evict_pods(self, stale_nodes: set) -> None:
+    def _evict_pods(self, stale_nodes: set, lost_ctx=None) -> None:
+        lost_ctx = lost_ctx or {}
         for pod in self.read.list("Pod"):
             if pod.spec.node_name not in stale_nodes or pod.is_finished():
                 continue
             node_name = pod.spec.node_name
-            if not evict_pod(
-                self.store, pod, f"node {node_name} lost (heartbeat timeout)"
+            # the evict span lives in the POD's job trace (its trace-id
+            # annotation) but parents on the node_lost span of the node
+            # THIS pod was bound to (absent for level-triggered re-evicts
+            # off a long-dead node) — that edge is the "restart generation
+            # attributed to the NodeLost that caused it" `ctl trace`
+            # renders
+            with trace.start_span(
+                "monitor.evict",
+                parent=lost_ctx.get(node_name),
+                trace_id=pod.metadata.annotations.get(
+                    trace.ANNOTATION_TRACE_ID
+                ),
+                attrs={"pod": pod.metadata.key(), "node": node_name},
             ):
-                continue
-            metrics.pods_evicted.inc()
-            self.recorder.event(
-                pod, WARNING, EVENT_NODE_LOST,
-                f"evicted: node {node_name} stopped heartbeating",
-            )
+                if not evict_pod(
+                    self.store, pod,
+                    f"node {node_name} lost (heartbeat timeout)",
+                ):
+                    continue
+                metrics.pods_evicted.inc()
+                self.recorder.event(
+                    pod, WARNING, EVENT_NODE_LOST,
+                    f"evicted: node {node_name} stopped heartbeating",
+                )

@@ -47,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 from mpi_operator_tpu.executor.local import LocalExecutor
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.objects import (
     NODE_NAMESPACE,
     Node,
@@ -61,6 +62,7 @@ from mpi_operator_tpu.machinery.store import (
     NotFound,
     json_merge_patch,
 )
+from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.agent")
 
@@ -520,7 +522,19 @@ class NodeAgent:
             if self._stop.is_set():
                 return
             try:
-                self._tick()
+                # agent.tick spans are per-tick roots — parent=ROOT, not
+                # the default None, which would inherit any span a bug
+                # ever leaked open on this thread (a tick batches many
+                # jobs' mirrors; job-scoped causality lives in the
+                # executor launch/evict spans). The tick round-trip time
+                # lands in the agent-tick histogram where the span closes.
+                t0 = time.perf_counter()
+                with trace.start_span(
+                    "agent.tick", parent=trace.ROOT,
+                    attrs={"node": self.node_name},
+                ):
+                    self._tick()
+                metrics.agent_tick_latency.observe(time.perf_counter() - t0)
             except Exception:
                 # store briefly unreachable past the client's own
                 # retry/backoff window: keep trying — the monitor's grace
@@ -669,6 +683,7 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    trace.configure_from_env("agent")
     from mpi_operator_tpu.machinery.http_store import read_token_file
     from mpi_operator_tpu.opshell.__main__ import build_store
 

@@ -30,6 +30,7 @@ import threading
 import uuid
 from typing import Dict, Optional
 
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
 from mpi_operator_tpu.machinery.store import (
     ADDED,
@@ -82,6 +83,11 @@ def _die_with_parent() -> None:
 ENV_COORDINATOR = "TPUJOB_COORDINATOR_ADDRESS"
 ENV_CONFIG_DIR = "TPUJOB_CONFIG_DIR"
 LABEL_JOB_NAME = "tpujob.dev/job-name"
+# restart generation the pod was launched for (duplicated from
+# controller/controller.py, same as LABEL_JOB_NAME: the executor must not
+# import the controller) — launch spans carry it so `ctl trace` can tell
+# the checkpoint-resume relaunch from the original generation
+LABEL_GENERATION = "tpujob.dev/generation"
 
 
 class LocalExecutor:
@@ -216,13 +222,19 @@ class LocalExecutor:
             except queue.Empty:
                 continue
             try:
-                if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):
-                    self._project_config(ev.obj)
-                elif ev.kind == "Pod" and ev.type in (ADDED, MODIFIED):
-                    self._kill_if_evicted(ev.obj)
-                    self._maybe_launch(ev.obj)
-                elif ev.kind == "Pod" and ev.type == DELETED:
-                    self._forget(ev.obj)
+                # the delivering event's origin span (the binding patch,
+                # the eviction write) parents the launch/evict spans below
+                trace.set_delivery(getattr(ev, "trace", None))
+                try:
+                    if ev.kind == "ConfigMap" and ev.type in (ADDED, MODIFIED):
+                        self._project_config(ev.obj)
+                    elif ev.kind == "Pod" and ev.type in (ADDED, MODIFIED):
+                        self._kill_if_evicted(ev.obj)
+                        self._maybe_launch(ev.obj)
+                    elif ev.kind == "Pod" and ev.type == DELETED:
+                        self._forget(ev.obj)
+                finally:
+                    trace.clear_delivery()
             except Exception:
                 # this thread is the PDEATHSIG parent of every pod process:
                 # if it dies, the kernel SIGKILLs all of them. A bad event
@@ -268,41 +280,65 @@ class LocalExecutor:
         key = self._pod_key(pod)
         with self._lock:
             proc = self._procs.get(key)
+            already_terminating = key in self._terminating
+        if already_terminating:
+            # the grace sequence already ran (re-delivered event / relist
+            # replay): _kill_externally_finished would return immediately
+            # — don't mint a duplicate evict span for it (same noise rule
+            # as the launch path's _procs pre-check); the locked re-check
+            # inside still guards the real race
+            return
         if proc is not None and proc.poll() is None:
+            # the kill/grace sequence below is job-scoped work caused by
+            # the eviction write delivering right now: span it so `ctl
+            # trace` shows WHERE the eviction landed on the node
+            with trace.start_span(
+                "executor.evict",
+                parent=trace.get_delivery(),
+                trace_id=pod.metadata.annotations.get(
+                    trace.ANNOTATION_TRACE_ID
+                ),
+                attrs={"pod": key,
+                       "reason": pod.status.reason or pod.status.phase,
+                       "grace": self.eviction_grace},
+            ):
+                self._kill_externally_finished(pod, key, proc)
+
+    def _kill_externally_finished(self, pod: Pod, key: str, proc) -> None:
+        with self._lock:
+            if key in self._terminating:
+                # the grace sequence already ran for this process; a
+                # re-delivered event (watch-gap relists replay every
+                # live object as MODIFIED) must not SIGTERM it again —
+                # workloads may treat a second SIGTERM as abort-now,
+                # forfeiting the force-checkpoint the grace granted —
+                # nor leak the armed backstop timer by overwriting it
+                return
+        if self.eviction_grace > 0:
+            # SIGTERM-then-SIGKILL (≙ the kubelet's graceful pod
+            # termination): a preempted checkpointing trainer uses the
+            # grace window to force-save at a gang-uniform step, so the
+            # relaunched gang resumes instead of replaying from the
+            # last periodic save. The backstop timer makes the grace a
+            # bound, not a trust: a wedged process still dies.
+            log.info(
+                "pod %s externally finished (%s); SIGTERM with %.1fs "
+                "grace", key, pod.status.reason or pod.status.phase,
+                self.eviction_grace,
+            )
+            proc.terminate()
+            timer = threading.Timer(
+                self.eviction_grace,
+                lambda: proc.poll() is None and proc.kill(),
+            )
+            timer.daemon = True
             with self._lock:
-                if key in self._terminating:
-                    # the grace sequence already ran for this process; a
-                    # re-delivered event (watch-gap relists replay every
-                    # live object as MODIFIED) must not SIGTERM it again —
-                    # workloads may treat a second SIGTERM as abort-now,
-                    # forfeiting the force-checkpoint the grace granted —
-                    # nor leak the armed backstop timer by overwriting it
-                    return
-            if self.eviction_grace > 0:
-                # SIGTERM-then-SIGKILL (≙ the kubelet's graceful pod
-                # termination): a preempted checkpointing trainer uses the
-                # grace window to force-save at a gang-uniform step, so the
-                # relaunched gang resumes instead of replaying from the
-                # last periodic save. The backstop timer makes the grace a
-                # bound, not a trust: a wedged process still dies.
-                log.info(
-                    "pod %s externally finished (%s); SIGTERM with %.1fs "
-                    "grace", key, pod.status.reason or pod.status.phase,
-                    self.eviction_grace,
-                )
-                proc.terminate()
-                timer = threading.Timer(
-                    self.eviction_grace,
-                    lambda: proc.poll() is None and proc.kill(),
-                )
-                timer.daemon = True
-                with self._lock:
-                    self._terminating[key] = timer
-                timer.start()
-            else:
-                log.info("pod %s externally finished (%s); killing its "
-                         "process", key, pod.status.reason or pod.status.phase)
-                proc.kill()
+                self._terminating[key] = timer
+            timer.start()
+        else:
+            log.info("pod %s externally finished (%s); killing its "
+                     "process", key, pod.status.reason or pod.status.phase)
+            proc.kill()
 
     def _forget(self, pod: Pod) -> None:
         """Pod deleted (controller restart path / cleanup policy): kill any
@@ -336,6 +372,29 @@ class LocalExecutor:
         if self.node_name is not None and pod.spec.node_name != self.node_name:
             return  # bound to another node — its agent claims it
         key = self._pod_key(pod)
+        if key in self._procs:
+            # racy pre-check (re-checked under the lock in _launch): a
+            # duplicate delivery / relist replay of a running pod must not
+            # mint a noise span
+            return
+        # the launch span lives in the job's trace (the pod annotation),
+        # parented on the event that triggered it — the scheduler's
+        # binding patch on generation 0, the recreation after a gang
+        # restart on later ones (the checkpoint-resume relaunch `ctl
+        # trace` must attribute)
+        with trace.start_span(
+            "executor.launch",
+            parent=trace.get_delivery(),
+            trace_id=pod.metadata.annotations.get(trace.ANNOTATION_TRACE_ID),
+            attrs={
+                "pod": key,
+                "node": pod.spec.node_name or "local",
+                "generation": pod.metadata.labels.get(LABEL_GENERATION, ""),
+            },
+        ):
+            self._launch(pod, key)
+
+    def _launch(self, pod: Pod, key: str) -> None:
         with self._lock:
             if key in self._procs:
                 return

@@ -41,6 +41,9 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+import time
+
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.store import (
     ADDED,
     DELETED,
@@ -352,7 +355,22 @@ class InformerCache:
             if lister is not None and ev.type in (ADDED, MODIFIED, DELETED):
                 lister.apply(ev.type, ev.obj)
                 metrics.informer_objects.set(len(lister), kind=ev.kind)
-                self._fire(ev.type, ev.obj)
+                ts = getattr(ev, "ts", 0.0)
+                if ts:
+                    # commit-to-delivery lag: how stale a lister read can
+                    # be (clamped — a skewed remote clock must not observe
+                    # a negative latency)
+                    metrics.watch_delivery_lag.observe(
+                        max(0.0, time.time() - ts)
+                    )
+                # expose the originating write's span to the handlers
+                # (controller enqueue, scheduler wake) for the duration of
+                # this delivery: the work the event causes parents on it
+                trace.set_delivery(getattr(ev, "trace", None))
+                try:
+                    self._fire(ev.type, ev.obj)
+                finally:
+                    trace.clear_delivery()
 
     # -- read surface (duck-typed like a store, reads only) ------------------
 

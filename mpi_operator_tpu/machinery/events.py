@@ -12,18 +12,17 @@ from __future__ import annotations
 
 import itertools
 import time
+import uuid
 from typing import Any, List
 
 from mpi_operator_tpu.api.types import ObjectMeta
 from mpi_operator_tpu.machinery.objects import Event, ObjectRef
-from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.machinery.store import AlreadyExists, ObjectStore
 
 MAX_MESSAGE_LEN = 1024  # ≙ truncateMessage (mpi_job_controller.go:1524-1530)
 
 NORMAL = "Normal"
 WARNING = "Warning"
-
-_counter = itertools.count()
 
 
 def truncate_message(message: str) -> str:
@@ -37,22 +36,45 @@ class EventRecorder:
     def __init__(self, store: ObjectStore, component: str = "tpujob-controller"):
         self._store = store
         self._component = component
+        # per-RECORDER nonce in the event name: the old process-local
+        # itertools.count() collided the moment two processes (leader +
+        # standby, controller + node monitor) recorded against the same
+        # object — both minted "<obj>.N" and the second create failed
+        # AlreadyExists, silently dropping audit entries (≙ kube events,
+        # which are named with a hashed suffix for exactly this reason)
+        self._nonce = uuid.uuid4().hex[:8]
+        self._counter = itertools.count()
 
     def event(self, obj: Any, etype: str, reason: str, message: str) -> Event:
         m = obj.metadata
-        ev = Event(
-            metadata=ObjectMeta(
-                name=f"{m.name}.{next(_counter)}",
-                namespace=m.namespace,
-                labels={"component": self._component},
-            ),
-            involved=ObjectRef(kind=obj.kind, namespace=m.namespace, name=m.name, uid=m.uid),
-            type=etype,
-            reason=reason,
-            message=truncate_message(message),
-            timestamp=time.time(),
+        for _ in range(3):
+            ev = Event(
+                metadata=ObjectMeta(
+                    name=f"{m.name}.{self._nonce}.{next(self._counter)}",
+                    namespace=m.namespace,
+                    labels={"component": self._component},
+                ),
+                involved=ObjectRef(
+                    kind=obj.kind, namespace=m.namespace, name=m.name,
+                    uid=m.uid,
+                ),
+                type=etype,
+                reason=reason,
+                message=truncate_message(message),
+                timestamp=time.time(),
+            )
+            try:
+                return self._store.create(ev)
+            except AlreadyExists:
+                # astronomically unlikely (a nonce collision with another
+                # recorder at the same count); the counter advanced, so
+                # the retry mints a fresh name instead of dropping the
+                # audit entry
+                continue
+        raise AlreadyExists(
+            f"event name collision persisted for {m.name!r} "
+            f"(recorder nonce {self._nonce})"
         )
-        return self._store.create(ev)
 
     # -- test helpers (≙ eventChecker) --------------------------------------
 

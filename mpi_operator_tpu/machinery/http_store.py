@@ -52,6 +52,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.serialize import decode, encode
 from mpi_operator_tpu.opshell import metrics
 from mpi_operator_tpu.machinery.store import (
@@ -241,7 +242,11 @@ class _EventLog:
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self._cond = threading.Condition()
-        self._events: List[Tuple[int, str, str, Dict[str, Any], int]] = []
+        # (seq, etype, kind, data, rv, origin, ts): origin is the writing
+        # span's (trace_id, span_id) or None, ts the commit time — both
+        # ride the wire so a remote informer can link the work an event
+        # causes back to the write that produced it (machinery/trace.py)
+        self._events: List[Tuple] = []
         self._next_seq = 1
         # rv completeness bounds for resume_after_rv: events with
         # rv <= _base_rv predate this server incarnation (unknown history);
@@ -267,9 +272,11 @@ class _EventLog:
             return max(self._base_rv or 0, self._max_rv)
 
     def append(self, etype: str, kind: str, data: Dict[str, Any],
-               rv: int = 0) -> None:
+               rv: int = 0, origin: Any = None, ts: float = 0.0) -> None:
         with self._cond:
-            self._events.append((self._next_seq, etype, kind, data, rv))
+            self._events.append(
+                (self._next_seq, etype, kind, data, rv, origin, ts)
+            )
             self._next_seq += 1
             self._max_rv = max(self._max_rv, rv)
             if len(self._events) > self.capacity:
@@ -280,9 +287,7 @@ class _EventLog:
                 del self._events[:drop]
             self._cond.notify_all()
 
-    def resume_after_rv(
-        self, rv: int
-    ) -> Optional[List[Tuple[int, str, str, Dict[str, Any], int]]]:
+    def resume_after_rv(self, rv: int) -> Optional[List[Tuple]]:
         """Events with object rv > ``rv``, oldest first — or None when the
         ring cannot PROVE it retains every such event (rv predates this
         incarnation's base, or needed events were trimmed): the caller must
@@ -304,7 +309,7 @@ class _EventLog:
 
     def read_after(
         self, after: int, timeout: float
-    ) -> Tuple[Optional[List[Tuple[int, str, str, Dict[str, Any]]]], int]:
+    ) -> Tuple[Optional[List[Tuple]], int]:
         """Events with seq > after, blocking up to ``timeout`` for the first.
 
         Returns (events, head). events is None when ``after`` predates the
@@ -505,8 +510,9 @@ class StoreServer:
                             "message": msg,
                         })
                         return
-                    code, payload = server._handle(
+                    code, payload = server._handle_traced(
                         method, self.path,
+                        self.headers.get(trace.TRACEPARENT_HEADER, ""),
                         body() if method in ("POST", "PUT", "PATCH") else {},
                     )
                     self._send(code, payload)
@@ -592,6 +598,9 @@ class StoreServer:
                 do_handshake_on_connect=False,
             )
         self.host, self.port = self._httpd.server_address[:2]
+        # histogram label naming the backing class (verb×backend store
+        # request latency: SqliteStore vs ObjectStore vs ReplicaClient)
+        self._backend_label = type(backing).__name__
         # request counters (read by bench_controlplane.py to measure the
         # store-side read load informer caches remove); plain dict under a
         # lock — snapshot with stats()
@@ -651,6 +660,7 @@ class StoreServer:
             self._log.append(
                 ev.type, ev.kind, encode(ev.obj),
                 ev.obj.metadata.resource_version or 0,
+                getattr(ev, "trace", None), getattr(ev, "ts", 0.0),
             )
 
     # verbs that mirror into the tpu_operator_store_write_requests_total
@@ -904,6 +914,59 @@ class StoreServer:
 
     # -- request handling ---------------------------------------------------
 
+    # routes whose latency lands in the store-request histogram (watch
+    # long-polls park by design — 25s of wait is not 25s of work — and
+    # healthz/replica-status are probes, not store traffic)
+    _TIMED_VERBS = ("create", "get", "list", "update", "delete", "patch",
+                    "patch_batch")
+
+    @staticmethod
+    def _route_verb(method: str, path: str) -> Optional[str]:
+        """The store verb a request resolves to (same _route_parts parse
+        the router uses, so the two can never disagree); None = untimed."""
+        parts = _route_parts(path)
+        if parts == ["v1", "patch-batch"] and method == "POST":
+            return "patch_batch"
+        if parts[:2] == ["v1", "objects"]:
+            if method == "POST":
+                return "create"
+            if method == "GET":
+                return "list" if len(parts) == 3 else "get"
+            if method == "PUT":
+                return "update"
+            if method == "DELETE":
+                return "delete"
+            if method == "PATCH":
+                return "patch"
+        return None
+
+    def _handle_traced(
+        self, method: str, path: str, traceparent: str, body: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Dispatch wrapper adding the server-side observability: a
+        ``store.request`` span (parented on the client's traceparent when
+        one rode in — the cross-process hop) held current across the
+        backing call, so the backing's watch event captures THIS span as
+        the write's origin; the request latency lands in the verb×backend
+        histogram where the span closes."""
+        verb = self._route_verb(method, path)
+        if verb is None:
+            return self._handle(method, path, body)
+        parent = trace.parse_traceparent(traceparent)
+        t0 = time.perf_counter()
+        with trace.start_span(
+            "store.request", parent=parent,
+            attrs={"verb": verb, "backend": self._backend_label},
+        ) as sp:
+            code, payload = self._handle(method, path, body)
+            if code >= 400:
+                sp.set_attr("status", code)
+        metrics.store_request_latency.observe(
+            time.perf_counter() - t0,
+            verb=verb, backend=self._backend_label,
+        )
+        return code, payload
+
     def _handle(
         self, method: str, path: str, body: Dict[str, Any]
     ) -> Tuple[int, Dict[str, Any]]:
@@ -1101,10 +1164,7 @@ class StoreServer:
             # cursor fell off the window → rv resume or relist ('rv too old')
             return 200, self._resume_or_relist(resume_rv)
         return 200, {
-            "events": [
-                {"seq": s, "type": t, "kind": k, "object": d, "rv": rv}
-                for (s, t, k, d, rv) in events
-            ],
+            "events": [_event_wire(e) for e in events],
             "next": head,
             "instance": self.instance,
         }
@@ -1117,10 +1177,7 @@ class StoreServer:
             events = self._log.resume_after_rv(resume_rv)
             if events is not None:
                 return {
-                    "events": [
-                        {"seq": s, "type": t, "kind": k, "object": d, "rv": rv}
-                        for (s, t, k, d, rv) in events
-                    ],
+                    "events": [_event_wire(e) for e in events],
                     "next": events[-1][0] if events else self._log.head,
                     "instance": self.instance,
                 }
@@ -1147,6 +1204,21 @@ def _all_kinds() -> List[str]:
     from mpi_operator_tpu.machinery.serialize import KIND_CLASSES
 
     return list(KIND_CLASSES)
+
+
+def _event_wire(e: Tuple) -> Dict[str, Any]:
+    """One _EventLog entry as its wire dict. ``trace``/``ts`` ship only
+    when the originating write was traced — old clients ignore the keys,
+    new clients against old servers read their absence as 'no link'."""
+    s, t, k, d, rv = e[0], e[1], e[2], e[3], e[4]
+    out = {"seq": s, "type": t, "kind": k, "object": d, "rv": rv}
+    origin = e[5] if len(e) > 5 else None
+    ts = e[6] if len(e) > 6 else 0.0
+    if origin:
+        out["trace"] = list(origin)
+    if ts:
+        out["ts"] = ts
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1275,6 +1347,12 @@ class HttpStoreClient:
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        traceparent = trace.inject()
+        if traceparent:
+            # propagate the calling span across the wire (W3C shape); the
+            # server's store.request span parents on it, stitching the
+            # cross-process hop into one trace
+            headers[trace.TRACEPARENT_HEADER] = traceparent
         delay = self.retry_base_delay
         attempt = 0
         redirects = 0
@@ -1583,7 +1661,8 @@ class HttpStoreClient:
                     self._max_rv = max(self._max_rv, ev.get("rv", 0))
                     obj = self._decode_event(ev["object"], ev["kind"])
                     if obj is not None:
-                        self._fan_out(watchers, ev["type"], obj)
+                        self._fan_out(watchers, ev["type"], obj,
+                                      ev.get("trace"), ev.get("ts", 0.0))
                 # adopt the response's cursor/instance only once the whole
                 # batch landed: an empty rv-anchored resume from a restarted
                 # server moves the seq cursor into the NEW incarnation's
@@ -1609,11 +1688,15 @@ class HttpStoreClient:
             return None
 
     @staticmethod
-    def _fan_out(watchers, etype: str, obj) -> None:
+    def _fan_out(watchers, etype: str, obj, origin=None, ts: float = 0.0
+                 ) -> None:
         yield_point("store.watch-deliver", obj.kind)
+        if isinstance(origin, list):
+            origin = tuple(origin)  # wire shape → the (tid, sid) tuple
         for want, wq in watchers:
             if want is None or want == obj.kind:
-                wq.put(WatchEvent(etype, obj.kind, obj.deepcopy()))
+                wq.put(WatchEvent(etype, obj.kind, obj.deepcopy(),
+                                  origin, ts))
 
     def close(self) -> None:
         self._stop.set()
@@ -1659,6 +1742,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.tls_key and not args.tls_cert:
         raise SystemExit("error: --tls-key requires --tls-cert")
+    trace.configure_from_env("store")
     from mpi_operator_tpu.opshell.__main__ import build_store
 
     backing = build_store(args.store)

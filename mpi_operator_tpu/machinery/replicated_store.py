@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.sqlite_store import (
     LogTruncated,
     SqliteStore,
@@ -250,7 +251,20 @@ class ReplicaNode:
         with self._ship_lock:
             epoch = self._require_leader()
             result = fn()
-            self._replicate(epoch)
+            # the ship span covers commit-to-majority-ack (the HA write
+            # tax); its duration lands in the ship-latency histogram at
+            # close. Nested under whatever span the writer holds (e.g.
+            # the store server's request span), so `ctl trace` shows the
+            # replication hop inside the write that paid for it.
+            t0 = time.perf_counter()
+            with trace.start_span(
+                "replica.ship",
+                attrs={"node": self.node_id, "epoch": epoch},
+            ):
+                self._replicate(epoch)
+            metrics.replication_ship_latency.observe(
+                time.perf_counter() - t0
+            )
             return result
 
     def _replicate(self, epoch: int) -> None:
@@ -392,6 +406,23 @@ class ReplicaNode:
     # -- election ------------------------------------------------------------
 
     def campaign(self) -> bool:
+        """Traced wrapper over :meth:`_campaign`: a WON election's
+        campaign-start-to-leadership time is the failover duration PERF
+        round 8 clocked by hand — now a histogram + a ``replica.election``
+        span (`ctl trace --last-incident` anchors on it)."""
+        t0 = _monotonic()
+        with trace.start_span(
+            "replica.election", attrs={"node": self.node_id}
+        ) as sp:
+            won = self._campaign()
+            sp.set_attr("won", won)
+            if won:
+                sp.set_attr("epoch", self.epoch)
+        if won:
+            metrics.failover_duration.observe(_monotonic() - t0)
+        return won
+
+    def _campaign(self) -> bool:
         """Try to take the lease: adopt epoch+1 (the self-vote), gather
         grants, reconcile the log tail to the quorum max (rule 4), then
         lead. A refusal carries the refuser's epoch; a candidate whose

@@ -35,6 +35,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.serialize import decode, encode
 from mpi_operator_tpu.machinery.store import (
     ADDED,
@@ -160,6 +161,15 @@ class SqliteStore:
         with self._lock:
             row = self._conn.execute("SELECT MAX(rv) FROM log").fetchone()
         self._last_seen_rv = row[0] or 0
+        # rv → ((trace_id, span_id) | None, commit ts): the causal origin
+        # of each committed write, consulted by the poll loop when it emits
+        # the corresponding watch event. In-process only (the poller and
+        # the writers share this instance; a SEPARATE process polling the
+        # same file sees untraced events, which degrades to 'no link', not
+        # an error). Bounded FIFO — the poller runs at 50ms, so 4096 rvs of
+        # slack is minutes of burst headroom.
+        self._origin_lock = threading.Lock()
+        self._origins: Dict[int, Tuple[Any, float]] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -193,7 +203,18 @@ class SqliteStore:
             "INSERT INTO log (etype, kind, data) VALUES (?, ?, ?)",
             (etype, obj.kind, self._dump(obj)),
         )
-        return cur.lastrowid
+        rv = cur.lastrowid
+        # remember the writing span (trace seam) so the poll loop can stamp
+        # the watch event this row becomes; None-cheap when tracing is off
+        with self._origin_lock:
+            self._origins[rv] = (trace.current_ids(), time.time())
+            while len(self._origins) > 4096:
+                self._origins.pop(next(iter(self._origins)))
+        return rv
+
+    def _origin_for(self, rv: int) -> Tuple[Any, float]:
+        with self._origin_lock:
+            return self._origins.get(rv, (None, 0.0))
 
     # -- CRUD (same contracts as ObjectStore) --------------------------------
 
@@ -473,9 +494,11 @@ class SqliteStore:
                         log.debug("skipping undecodable %s row (newer "
                                   "writer version?)", kind, exc_info=True)
                         continue
+                    origin, ts = self._origin_for(rv)
                     for want, wq in watchers:
                         if want is None or want == kind:
-                            wq.put(WatchEvent(etype, kind, obj.deepcopy()))
+                            wq.put(WatchEvent(etype, kind, obj.deepcopy(),
+                                              origin, ts))
                 self._heartbeat_and_trim()
             except sqlite3.Error:
                 pass  # transient lock contention; retry next tick

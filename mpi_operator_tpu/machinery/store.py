@@ -25,6 +25,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from mpi_operator_tpu.machinery import trace as _trace
 from mpi_operator_tpu.machinery.yieldpoints import yield_point
 
 
@@ -312,6 +313,15 @@ class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
     kind: str
     obj: Any
+    # causal origin of the write that produced this event: a plain
+    # (trace_id, span_id) tuple (or None when the writer was untraced) —
+    # consumers parent the work the event causes on it (machinery/trace.py
+    # set_delivery/get_delivery), which is what lets `ctl trace` link a
+    # reconcile back to the write that triggered it
+    trace: Any = None
+    # commit timestamp (0.0 = unknown): the informer cache observes
+    # now - ts as the watch delivery lag histogram
+    ts: float = 0.0
 
 
 def _meta(obj: Any):
@@ -336,9 +346,14 @@ class ObjectStore:
 
     def _notify(self, etype: str, kind: str, obj: Any) -> None:
         yield_point("store.watch-deliver", kind)
+        # stamp the writing span's context (and the commit time) onto the
+        # event so consumers can link the work it triggers back to this
+        # write; current_ids() is None-cheap when tracing is off
+        origin = _trace.current_ids()
+        ts = self._now()
         for want_kind, q in list(self._watchers):
             if want_kind is None or want_kind == kind:
-                q.put(WatchEvent(etype, kind, obj.deepcopy()))
+                q.put(WatchEvent(etype, kind, obj.deepcopy(), origin, ts))
 
     @staticmethod
     def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:

@@ -1,0 +1,742 @@
+"""Distributed tracing for the control plane — the causal observability seam.
+
+The reference operator's observability stops at four promauto counters and
+an event recorder; its roadmap punts tracing to "Horovod Timeline someday"
+(PAPER.md §1). This module is the missing piece for THIS control plane,
+whose interesting behavior is causal and cross-process: a `ctl create`
+lands a store write, the watch carries it to the controller's informer,
+the reconcile creates pods, the scheduler binds them, a node agent's
+executor launches processes, failures ripple back as evictions and gang
+restarts. Answering "why did job X restart, and where did the time go?"
+requires stitching those hops together — which is exactly what spans with
+parent links do.
+
+Design (deliberately dependency-free — stdlib only, like everything else
+in machinery/):
+
+- **Trace = a job's lifetime.** Every TPUJob is stamped with a
+  ``tpujob.dev/trace-id`` annotation at admission (api/client.py; the
+  controller backstops direct store creates). The controller propagates
+  the annotation onto the worker pods it creates, so ANY component holding
+  a job-scoped object can open spans in the job's trace without a live
+  header chain — robust across process crashes, which is the point.
+- **Spans** are context managers (``with start_span(...)``): open →
+  children parent to it via a thread-local stack → close → export. A bare
+  ``start_span()`` call leaks an open span on the exception path, so the
+  with-form is enforced by oplint rule OBS001.
+- **Cross-process propagation** rides the store seam: HttpStoreClient
+  injects a W3C-style ``traceparent`` header, StoreServer extracts it and
+  opens a server-side span for the request, and every committed write's
+  span context is remembered by resource_version so the watch event it
+  produced carries ``(trace_id, span_id)`` to consumers. A reconcile
+  triggered by a watch event therefore links back to the write that
+  caused it (see ``set_delivery``/``get_delivery``).
+- **Export** is a bounded in-process ring plus per-component JSONL files
+  (``TPUJOB_TRACE_DIR``): each process appends finished spans to
+  ``<component>-<pid>.jsonl``, flushed per line so a SIGKILLed process
+  (the chaos suite's favorite) loses at most its open spans. The
+  collector (``load_spans`` + ``render_timeline``) merges the files and
+  renders the causal timeline ``ctl trace <job>`` prints.
+- **Off by default, ~zero cost when off**: ``start_span`` returns a
+  shared no-op span after one flag check. The ≤5% reconcile-overhead
+  budget (PERF round 9) is measured with it ON.
+
+Span-close sites double as the histogram instrumentation points
+(opshell/metrics.py): reconcile latency, store request latency by
+verb×backend, watch delivery lag, scheduler bind latency, replication
+ship latency, failover duration — so the numbers PERF.md claims are the
+numbers ``/metrics`` exports.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+log = logging.getLogger("tpujob.trace")
+
+# the job annotation that names the trace (stamped at admission, propagated
+# onto worker pods by the controller so every job-scoped component can join)
+ANNOTATION_TRACE_ID = "tpujob.dev/trace-id"
+
+# W3C trace-context header carried on the HTTP store seam
+TRACEPARENT_HEADER = "traceparent"
+ENV_TRACE_DIR = "TPUJOB_TRACE_DIR"
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+
+# Ids are minted per span on the reconcile hot path: uuid.uuid4 costs
+# ~8 µs and even os.urandom is a ~7 µs syscall per call — a per-thread
+# PRNG seeded once from urandom gets the same collision odds for ~0.5 µs.
+# The pid check re-seeds after a fork so two processes can never share a
+# generator state (span ids are identifiers, not secrets).
+
+_ids = threading.local()
+
+# os.getpid() is a syscall (microseconds on sandboxed kernels) and the
+# span path needs the pid three times per span — cache it, refreshed via
+# the at-fork hook so a forked child can never reuse the parent's id
+# generator state or stamp the parent's pid on its spans
+_PID = os.getpid()
+
+
+def _after_fork() -> None:
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_after_fork)
+
+
+def _id_rng():
+    rng = getattr(_ids, "rng", None)
+    if rng is None or getattr(_ids, "pid", None) != _PID:
+        import random
+
+        _ids.rng = rng = random.Random(os.urandom(16))
+        _ids.pid = _PID
+    return rng
+
+
+def new_trace_id() -> str:
+    return f"{_id_rng().getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_id_rng().getrandbits(64):016x}"
+
+
+class SpanContext(tuple):
+    """(trace_id, span_id) — the propagatable identity of a span. A plain
+    tuple subclass so watch events can carry it (or a bare 2-tuple) over
+    process boundaries without this module on the wire."""
+
+    __slots__ = ()
+
+    def __new__(cls, trace_id: str, span_id: str):
+        return super().__new__(cls, (trace_id, span_id))
+
+    @property
+    def trace_id(self) -> str:
+        return self[0]
+
+    @property
+    def span_id(self) -> str:
+        return self[1]
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return f"00-{ctx[0]}-{ctx[1]}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[SpanContext]:
+    """Strict parse (None for anything malformed — a bad header from a
+    skewed client must degrade to 'no trace', never to a 500)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if not m:
+        return None
+    return SpanContext(m.group(1), m.group(2))
+
+
+# sentinel for start_span(parent=ROOT): force a root span even when the
+# calling thread has a span open — plain parent=None means "inherit the
+# implicit stack parent", so rootness was otherwise inexpressible (a
+# leaked-open span would silently adopt every later "root")
+ROOT = object()
+
+
+def _as_ctx(parent: Any) -> Optional[SpanContext]:
+    """Normalize a parent argument: Span, SpanContext, (tid, sid) tuple,
+    or None. Anything else (a corrupt wire value) degrades to None."""
+    if parent is None:
+        return None
+    if isinstance(parent, Span):
+        return parent.context()
+    if isinstance(parent, SpanContext):
+        return parent
+    if (
+        isinstance(parent, (tuple, list))
+        and len(parent) == 2
+        and all(isinstance(p, str) for p in parent)
+    ):
+        return SpanContext(parent[0], parent[1])
+    return None
+
+
+class Span:
+    """One timed, attributed unit of work. Context-manager protocol:
+    ``with start_span(...) as sp:`` — entry is a no-op (the span is already
+    open and current), exit closes and exports it. oplint OBS001 enforces
+    the with-form, because a span left open on an exception path stays on
+    the thread's stack and silently re-parents everything after it."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "component",
+        "start", "end", "attrs", "error", "_tracer", "_ended",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], attrs: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.component = tracer.component
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        self.error: Optional[str] = None
+        self._ended = False
+
+    # -- identity ------------------------------------------------------------
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def adopt_trace(self, trace_id: Optional[str]) -> "Span":
+        """Re-home this span (and the children opened after this call) into
+        ``trace_id`` — the job-annotation anchor. Used by components whose
+        span opens before the job-scoped object is read (the controller's
+        reconcile): the causal parent edge (possibly into another trace)
+        is kept, only the trace grouping moves."""
+        if trace_id and trace_id != self.trace_id:
+            self.trace_id = trace_id
+        return self
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None and self.error is None:
+            self.error = f"{type(exc).__name__}: {exc}"
+        self.finish()
+
+    def finish(self) -> None:
+        """Close and export. Idempotent; also defensively pops any child
+        spans a non-with caller left open above us on the thread stack."""
+        if self._ended:
+            return
+        self._ended = True
+        self.end = time.time()
+        self._tracer._pop(self)
+        self._tracer._export(self.to_dict())
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "component": self.component,
+            "pid": _PID,
+            "start": self.start,
+            "end": self.end,
+            "attrs": self.attrs,
+        }
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+class _NoopSpan:
+    """The disabled-tracing span: every operation is a cheap no-op, one
+    shared instance. Keeps call sites branch-free."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    attrs: Dict[str, Any] = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def set_attr(self, key, value):
+        return self
+
+    def adopt_trace(self, trace_id):
+        return self
+
+    def context(self):
+        return None
+
+    def finish(self) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Per-process span factory + exporter (module singleton ``TRACER``).
+
+    The JSONL export is BUFFERED off the hot path: span close appends an
+    encoded line to an in-memory list and a background flusher writes +
+    flushes every ``FLUSH_INTERVAL`` (0.2 s) — per-span file I/O was the
+    dominant tracing tax in the reconcile storm (the ≤5% overhead budget,
+    PERF round 9). A SIGKILLed process therefore loses at most the last
+    interval's spans plus its open ones; the chaos continuity test's
+    anchor spans are all older than that by construction."""
+
+    FLUSH_INTERVAL = 0.2
+    # memory bound: past this many buffered spans the exporting thread
+    # flushes inline rather than letting a stalled flusher grow the
+    # buffer without limit (~4k spans ≈ 1-2 MB encoded)
+    FLUSH_SPANS = 4096
+
+    def __init__(self):
+        self.enabled = False
+        self.component = "unknown"
+        self.ring_capacity = 2048
+        self._ring: "collections.deque" = collections.deque(maxlen=2048)
+        self._ring_lock = threading.Lock()
+        self._dir: Optional[str] = None
+        self._file = None
+        self._file_lock = threading.Lock()
+        self._buf: List[Dict[str, Any]] = []
+        self._flusher: Optional[threading.Thread] = None
+        self._flush_stop = threading.Event()
+        self._local = threading.local()
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, component: str, *, dir: Optional[str] = None,
+                  ring_capacity: int = 2048, enabled: bool = True) -> "Tracer":
+        """Turn tracing on for this process. ``dir`` adds the durable JSONL
+        export (one ``<component>-<pid>.jsonl`` per process) the collector
+        merges; without it spans live only in the in-process ring."""
+        self.flush()  # spans buffered for the OLD dir must not vanish
+        self.component = component
+        self.ring_capacity = ring_capacity
+        with self._ring_lock:
+            self._ring = collections.deque(self._ring, maxlen=ring_capacity)
+        with self._file_lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    log.debug("closing old trace export failed", exc_info=True)
+                self._file = None
+            self._buf = []
+            self._dir = dir
+        self.enabled = enabled
+        if dir:
+            # always a FRESH flusher generation with its own stop event:
+            # re-checking the old thread's liveness would race its exit
+            # (disable() just signalled it) and could leave tracing
+            # re-enabled with NO cadence flusher — spans would reach disk
+            # only at the inline threshold or atexit, i.e. a SIGKILL
+            # loses everything since the reconfigure
+            self._flush_stop.set()
+            self._flush_stop = stop = threading.Event()
+            self._flusher = threading.Thread(
+                target=self._flush_loop, args=(stop,),
+                name="trace-flush", daemon=True,
+            )
+            self._flusher.start()
+            # clean exits (one-shot CLIs like `ctl create`) must not lose
+            # the tail the interval-flusher hasn't reached yet
+            import atexit
+
+            atexit.register(self.flush)
+        return self
+
+    def configure_from_env(self, component: str) -> "Tracer":
+        """The entry-point hook every process calls once: tracing turns on
+        iff ``TPUJOB_TRACE_DIR`` is set (the chaos/e2e harnesses and real
+        deployments both use it), exporting there."""
+        d = os.environ.get(ENV_TRACE_DIR)
+        if d:
+            self.configure(component, dir=d)
+        else:
+            self.component = component
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+        self.flush()
+        self._flush_stop.set()
+        with self._file_lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    log.debug("closing trace export failed", exc_info=True)
+            self._file = None
+            self._dir = None
+
+    # -- thread-local span stack --------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current_span(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def current(self) -> Optional[SpanContext]:
+        sp = self.current_span()
+        return sp.context() if sp is not None else None
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        if span in st:
+            # pop through any children a non-with caller left open: the
+            # stack must never keep a closed span as somebody's parent
+            while st and st[-1] is not span:
+                st.pop()
+            if st:
+                st.pop()
+
+    # -- span creation -------------------------------------------------------
+
+    def start_span(self, name: str, *, parent: Any = None,
+                   trace_id: Optional[str] = None,
+                   attrs: Optional[Dict[str, Any]] = None):
+        """Open a span and make it current for this thread. ``parent``
+        (Span / SpanContext / (tid, sid) tuple) overrides the implicit
+        thread-stack parent — that's how cross-process causality (a watch
+        delivery's origin, an extracted traceparent) is stitched in.
+        ``trace_id`` pins the trace (the job-annotation anchor) regardless
+        of where the parent edge points. ALWAYS use the with-form
+        (oplint OBS001): a bare call leaks the span on exception paths."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is ROOT:
+            pctx = None
+        else:
+            pctx = _as_ctx(parent)
+            if pctx is None and parent is None:
+                cur = self.current_span()
+                if cur is not None:
+                    pctx = cur.context()
+        tid = trace_id or (pctx.trace_id if pctx else None) or new_trace_id()
+        span = Span(self, name, tid, pctx.span_id if pctx else None, attrs)
+        self._stack().append(span)
+        return span
+
+    # -- propagation helpers -------------------------------------------------
+
+    def inject(self) -> Optional[str]:
+        """traceparent header value for the current span (None = nothing
+        to propagate; callers skip the header)."""
+        ctx = self.current()
+        return format_traceparent(ctx) if ctx is not None else None
+
+    def current_ids(self) -> Optional[Tuple[str, str]]:
+        """The current span context as a plain (trace_id, span_id) tuple —
+        what store backends stamp onto the watch events a write produces."""
+        ctx = self.current()
+        return (ctx.trace_id, ctx.span_id) if ctx is not None else None
+
+    # -- watch-delivery context ---------------------------------------------
+    #
+    # A watch consumer (informer drain, executor loop) sets the delivering
+    # event's origin context here for the duration of its handlers; the
+    # handler side (controller enqueue, scheduler wake, executor launch)
+    # reads it to parent the work the event caused. Thread-local, so one
+    # noisy stream never cross-contaminates another.
+
+    def set_delivery(self, ctx: Any) -> None:
+        self._local.delivery = _as_ctx(ctx)
+
+    def get_delivery(self) -> Optional[SpanContext]:
+        return getattr(self._local, "delivery", None)
+
+    def clear_delivery(self) -> None:
+        self._local.delivery = None
+
+    # -- export --------------------------------------------------------------
+
+    def _export(self, d: Dict[str, Any]) -> None:
+        with self._ring_lock:
+            self._ring.append(d)
+        if self._dir is None:
+            return
+        # the hot path only appends the dict; the flusher thread does the
+        # JSON encoding AND the file I/O — spans close in O(append)
+        with self._file_lock:
+            self._buf.append(d)
+            inline_flush = len(self._buf) >= self.FLUSH_SPANS
+        if inline_flush:
+            self.flush()
+
+    def _flush_loop(self, stop: threading.Event) -> None:
+        # `stop` is THIS generation's event (passed in, never re-read from
+        # self): a reconfigure signals exactly its own flusher
+        while not stop.wait(self.FLUSH_INTERVAL):
+            self.flush()
+
+    def flush(self) -> None:
+        """Encode + write + flush the buffered spans (flusher thread
+        cadence, atexit, disable(), and the over-budget inline path)."""
+        with self._file_lock:
+            batch, self._buf = self._buf, []
+            if not batch or self._dir is None:
+                return
+        lines = "\n".join(
+            json.dumps(d, separators=(",", ":")) for d in batch
+        )
+        try:
+            with self._file_lock:
+                if self._dir is None:
+                    return
+                if self._file is None:
+                    os.makedirs(self._dir, exist_ok=True)
+                    path = os.path.join(
+                        self._dir, f"{self.component}-{os.getpid()}.jsonl"
+                    )
+                    self._file = open(path, "a", encoding="utf-8")
+                self._file.write(lines + "\n")
+                self._file.flush()
+        except OSError:
+            # a full/readonly disk must never take the control plane down
+            # with it — drop the durable export, keep the ring
+            log.warning("trace export failed; disabling file export",
+                        exc_info=True)
+            with self._file_lock:
+                self._dir = None
+                self._file = None
+                self._buf = []
+
+    def ring(self) -> List[Dict[str, Any]]:
+        with self._ring_lock:
+            return list(self._ring)
+
+
+TRACER = Tracer()
+
+# module-level conveniences (the call-site API)
+configure = TRACER.configure
+configure_from_env = TRACER.configure_from_env
+start_span = TRACER.start_span
+current = TRACER.current
+current_ids = TRACER.current_ids
+inject = TRACER.inject
+set_delivery = TRACER.set_delivery
+get_delivery = TRACER.get_delivery
+clear_delivery = TRACER.clear_delivery
+
+
+# ---------------------------------------------------------------------------
+# collector: merge per-process JSONL exports, render causal timelines
+# ---------------------------------------------------------------------------
+
+
+def load_spans(trace_dir: str) -> List[Dict[str, Any]]:
+    """Every finished span exported under ``trace_dir``, merged across all
+    per-process files, start-ordered. Torn tail lines (a process SIGKILLed
+    mid-write) are skipped, not fatal. When THIS process exports to the
+    same dir, its buffer is flushed first so a reader never races the
+    0.2 s flush cadence; other processes' flushers run on their own."""
+    if TRACER._dir:
+        try:
+            same = os.path.abspath(TRACER._dir) == os.path.abspath(trace_dir)
+        except OSError:
+            same = False
+        if same:
+            TRACER.flush()
+    spans: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return []
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(trace_dir, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from a killed process
+                    if isinstance(d, dict) and d.get("span_id"):
+                        spans.append(d)
+        except OSError:
+            log.debug("unreadable trace file %s", path, exc_info=True)
+    spans.sort(key=lambda d: (d.get("start") or 0.0, d.get("span_id", "")))
+    return spans
+
+
+def spans_for_trace(spans: Iterable[Dict[str, Any]],
+                    trace_id: str) -> List[Dict[str, Any]]:
+    return [s for s in spans if s.get("trace_id") == trace_id]
+
+
+def connected_components(spans: List[Dict[str, Any]],
+                         link_traces: bool = False) -> List[set]:
+    """Span-id sets connected by parent edges (cross-trace edges count —
+    a NodeLost span caused evictions in several jobs' traces). With
+    ``link_traces``, spans sharing a trace id are also connected: a trace
+    IS one causal group by construction (the job annotation), so the
+    chaos continuity test can assert the whole incident — job trace plus
+    the cross-trace causes feeding it — is ONE component."""
+    ids = {s["span_id"] for s in spans}
+    parent = {s["span_id"]: s.get("parent_id") for s in spans}
+    # union-find over the edge list
+    root: Dict[str, str] = {i: i for i in ids}
+
+    def find(x: str) -> str:
+        while root[x] != x:
+            root[x] = root[root[x]]
+            x = root[x]
+        return x
+
+    for sid, pid in parent.items():
+        if pid in ids:
+            root[find(sid)] = find(pid)
+    if link_traces:
+        first_of_trace: Dict[str, str] = {}
+        for s in spans:
+            tid = s.get("trace_id") or ""
+            if tid in first_of_trace:
+                root[find(s["span_id"])] = find(first_of_trace[tid])
+            else:
+                first_of_trace[tid] = s["span_id"]
+    comps: Dict[str, set] = {}
+    for i in ids:
+        comps.setdefault(find(i), set()).add(i)
+    return sorted(comps.values(), key=len, reverse=True)
+
+
+def render_timeline(all_spans: List[Dict[str, Any]], trace_id: str,
+                    *, title: str = "") -> str:
+    """The causal timeline `ctl trace` prints: the trace's spans as a
+    parent-indented tree in start order, each with its offset from trace
+    start, duration, component, and key attributes. A span whose parent
+    lives in ANOTHER trace (the cross-trace causal edge — e.g. a gang
+    restart caused by a NodeLost detection) is annotated with the causing
+    span, which is how "why did this happen" reads straight off the
+    output."""
+    trace = spans_for_trace(all_spans, trace_id)
+    if not trace:
+        return f"no spans recorded for trace {trace_id}"
+    by_id = {s["span_id"]: s for s in all_spans}
+    in_trace = {s["span_id"] for s in trace}
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in trace:
+        pid = s.get("parent_id")
+        if pid in in_trace:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    t0 = min(s.get("start") or 0.0 for s in trace)
+    lines = [title or f"trace {trace_id}"]
+
+    def _dur(s: Dict[str, Any]) -> str:
+        if s.get("end") is None:
+            return "open"
+        return f"{(s['end'] - s['start']) * 1e3:.1f}ms"
+
+    def _attrs(s: Dict[str, Any]) -> str:
+        attrs = s.get("attrs") or {}
+        if not attrs:
+            return ""
+        body = " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+        return f" [{body}]"
+
+    def emit(s: Dict[str, Any], depth: int) -> None:
+        off = (s.get("start", t0) - t0) * 1e3
+        err = f" ERROR({s['error']})" if s.get("error") else ""
+        lines.append(
+            f"  {off:>9.1f}ms {'  ' * depth}{s.get('component', '?')}/"
+            f"{s.get('name', '?')} {_dur(s)}{_attrs(s)}{err}"
+        )
+        pid = s.get("parent_id")
+        if pid and pid not in in_trace and pid in by_id:
+            cause = by_id[pid]
+            lines.append(
+                f"  {'':>11} {'  ' * depth}  ⇐ caused by "
+                f"{cause.get('component', '?')}/{cause.get('name', '?')}"
+                f"{_attrs(cause)}"
+            )
+        for child in sorted(
+            children.get(s["span_id"], ()),
+            key=lambda c: (c.get("start") or 0.0, c.get("span_id", "")),
+        ):
+            emit(child, depth + 1)
+
+    for r in sorted(roots, key=lambda s: (s.get("start") or 0.0,
+                                          s.get("span_id", ""))):
+        emit(r, 0)
+    return "\n".join(lines)
+
+
+# incident span names `ctl trace --last-incident` anchors on
+_INCIDENT_NAMES = ("controller.gang_restart", "replica.election",
+                   "monitor.node_lost")
+
+
+def last_incident(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The most recent gang restart / failover / node loss span — the
+    anchor `ctl trace --last-incident` reconstructs from."""
+    incidents = [s for s in spans if s.get("name") in _INCIDENT_NAMES]
+    if not incidents:
+        return None
+    return max(incidents, key=lambda s: s.get("start") or 0.0)
+
+
+def render_incident(all_spans: List[Dict[str, Any]],
+                    incident: Dict[str, Any]) -> str:
+    """The incident's causal neighborhood: its ancestry chain (walking
+    parent edges across traces — the NodeLost behind the eviction behind
+    the restart), then the full trace it belongs to."""
+    by_id = {s["span_id"]: s for s in all_spans}
+    chain: List[Dict[str, Any]] = []
+    seen = set()
+    cur: Optional[Dict[str, Any]] = incident
+    while cur is not None and cur["span_id"] not in seen:
+        seen.add(cur["span_id"])
+        chain.append(cur)
+        cur = by_id.get(cur.get("parent_id") or "")
+    lines = [
+        f"last incident: {incident.get('component', '?')}/"
+        f"{incident.get('name', '?')} at {incident.get('start', 0):.3f}",
+        "causal chain (effect ← cause):",
+    ]
+    for s in chain:
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted((s.get("attrs") or {}).items())
+        )
+        lines.append(
+            f"  {s.get('component', '?')}/{s.get('name', '?')}"
+            + (f" [{attrs}]" if attrs else "")
+        )
+    lines.append("")
+    lines.append(render_timeline(all_spans, incident["trace_id"]))
+    return "\n".join(lines)

@@ -115,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "this replica stops leading")
     ap.add_argument("--retry-period", type=float, default=5.0,
                     help="seconds between lease acquire/renew attempts")
+    ap.add_argument("--event-ttl", type=float, default=3600.0,
+                    help="prune Events older than this many seconds "
+                         "(the controller's housekeeping sweep, ≙ the "
+                         "apiserver's 1h event TTL); 0 disables and keeps "
+                         "the audit trail forever")
     ap.add_argument("--chaos-script", default=None, metavar="PATH",
                     help="fault-injection timeline (machinery/chaos.py "
                          "format) armed when this replica becomes leader; "
@@ -152,10 +157,14 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    from mpi_operator_tpu.machinery import trace
     from mpi_operator_tpu.machinery.http_store import (
         read_agent_tokens_file,
         read_token_file,
     )
+
+    # tracing rides TPUJOB_TRACE_DIR (off otherwise; ~zero cost when off)
+    trace.configure_from_env("operator")
 
     try:
         token = read_token_file(args.token_file)
@@ -213,6 +222,7 @@ def main(argv=None) -> int:
             threadiness=args.threadiness,
             coordinator_port=args.coordinator_port,
             gang_scheduling=not args.no_gang_scheduling,
+            event_ttl=args.event_ttl if args.event_ttl > 0 else None,
         ),
         cache=cache,
     )

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, List, Optional
@@ -614,6 +615,76 @@ def cmd_store(client: TPUJobClient, args) -> int:
     return rc
 
 
+def cmd_trace(client: TPUJobClient, args) -> int:
+    """`ctl trace <job>` / `ctl trace --last-incident`: the causal
+    timeline of a job's lifecycle (submit → scheduled → launched →
+    running → restarts/failovers → terminal), rendered from the spans
+    every component exported under the trace dir (machinery/trace.py).
+    The runbook's first stop for "why did job X restart, and where did
+    the time go?"."""
+    from mpi_operator_tpu.machinery import trace as tr
+
+    trace_dir = args.trace_dir or os.environ.get(tr.ENV_TRACE_DIR)
+    if not trace_dir:
+        print("error: no trace dir — pass --trace-dir or set "
+              f"{tr.ENV_TRACE_DIR} (the operator/agents/store must have "
+              "run with it to have exported spans)", file=sys.stderr)
+        return 2
+    spans = tr.load_spans(trace_dir)
+    if not spans:
+        print(f"error: no spans found under {trace_dir}", file=sys.stderr)
+        return 1
+    if args.last_incident:
+        incident = tr.last_incident(spans)
+        if incident is None:
+            print("no incident spans (gang restart / failover / node "
+                  "loss) recorded")
+            return 0
+        print(tr.render_incident(spans, incident))
+        return 0
+    if not args.name:
+        print("error: a job name (or --last-incident) is required",
+              file=sys.stderr)
+        return 2
+    try:
+        job = client.get(args.name)
+        tid = job.metadata.annotations.get(tr.ANNOTATION_TRACE_ID)
+        header = [f"TPUJob {job.metadata.namespace}/{job.metadata.name}"]
+        for c in job.status.conditions:
+            header.append(
+                f"  {c.type:<12} {str(bool(c.status)):<6} {c.reason}"
+            )
+        if job.status.restart_count or job.status.restart_generation:
+            header.append(
+                f"  restarts: count={job.status.restart_count} "
+                f"generation={job.status.restart_generation}"
+            )
+    except NotFound:
+        # deleted jobs still have their spans; fall back to the newest
+        # trace that names the job in a span attribute. Pod attrs match
+        # on the worker-name shape ("<ns>/<job>-worker-N"), never a bare
+        # prefix — job "train" must not adopt job "train2"'s trace.
+        tid = None
+        header = [f"TPUJob {client.namespace}/{args.name} (deleted; "
+                  f"reconstructing from spans)"]
+        needle = f"{client.namespace}/{args.name}"
+        pod_prefix = f"{needle}-worker-"
+        for s in spans:
+            attrs = s.get("attrs") or {}
+            if attrs.get("job") == needle or str(
+                attrs.get("pod", "")
+            ).startswith(pod_prefix):
+                tid = s.get("trace_id")
+    if not tid:
+        print(f"error: job {args.name} carries no trace id (created "
+              "before tracing, or by an old client) and no span "
+              "mentions it", file=sys.stderr)
+        return 1
+    print("\n".join(header))
+    print(tr.render_timeline(spans, tid, title=f"trace {tid}"))
+    return 0
+
+
 def cmd_watch(client: TPUJobClient, args) -> int:
     """Stream state transitions until the job finishes (≙ kubectl get -w —
     which rides the watch API, so this does too: the store's watch queue
@@ -742,6 +813,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("action", choices=["status"])
     p.add_argument("-o", "--output", choices=["table", "json"],
                    default="table")
+    p = sub.add_parser("trace", help="render a job's causal span timeline "
+                                     "(submit → scheduled → launched → "
+                                     "restarts → terminal) from the "
+                                     "exported trace dir")
+    p.add_argument("name", nargs="?",
+                   help="job name (omit with --last-incident)")
+    p.add_argument("--trace-dir", default=None,
+                   help=f"span export dir (default: ${{{'TPUJOB_TRACE_DIR'}}})")
+    p.add_argument("--last-incident", action="store_true",
+                   help="reconstruct the most recent gang restart / "
+                        "failover / node loss instead of a named job")
     return ap
 
 
@@ -754,9 +836,13 @@ def main(argv=None) -> int:
               "point at a shared store (sqlite:PATH or http://HOST:PORT)",
               file=sys.stderr)
         return 2
+    from mpi_operator_tpu.machinery import trace as _tr
     from mpi_operator_tpu.machinery.http_store import read_token_file
     from mpi_operator_tpu.opshell.__main__ import build_store
 
+    # `ctl create` under TPUJOB_TRACE_DIR exports the client.submit span —
+    # the "submit" entry `ctl trace` renders at the head of the timeline
+    _tr.configure_from_env("ctl")
     try:
         token = read_token_file(args.token_file)
         read_token = read_token_file(args.read_token_file)
@@ -791,6 +877,7 @@ def main(argv=None) -> int:
             "uncordon": cmd_uncordon,
             "drain": cmd_drain,
             "store": cmd_store,
+            "trace": cmd_trace,
         }[args.verb](client, args)
     except Forbidden as e:
         # read-tier token on a mutating verb: authenticated but not

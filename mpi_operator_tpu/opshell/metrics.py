@@ -7,12 +7,62 @@ mpi_operator_job_info — and mpi_operator_is_leader,
 v2/cmd/mpi-operator/app/server.go:73-78). Same metric names with the
 ``tpu_operator_`` prefix; rendered in Prometheus text exposition format by
 ``render()`` for the /metrics endpoint (opshell.server).
+
+Three kinds: counter, gauge, and — since the tracing round (ISSUE 9) —
+**histogram**, exported in the standard ``_bucket``/``_sum``/``_count``
+form with cumulative ``le`` buckets. Histogram instruments are wired at
+the span-close sites of machinery/trace.py's consumers (reconcile, store
+request, watch delivery, scheduler bind, replication ship, failover), so
+the latencies PERF.md claims are the latencies /metrics exports —
+``bench_controlplane.py``'s hist mode reads its p50/p99 back OUT of the
+exposition via :func:`parse_exposition` + :func:`histogram_quantile` to
+prove the two agree.
+
+Label values are escaped per the exposition spec (``\\`` → ``\\\\``,
+``"`` → ``\\"``, newline → ``\\n``); HELP text escapes ``\\`` and
+newlines. :func:`parse_exposition` is the STRICT round-trip parser the
+test suite (and the verify static gate) runs over the full registry so
+the endpoint stays machine-valid forever.
 """
 
 from __future__ import annotations
 
+import bisect
+import math
+import re
 import threading
-from typing import Dict, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote and
+    newline are the three characters the spec requires escaping — emitting
+    them raw produces text a strict scraper rejects (the bug this round's
+    satellite fixed: a node name with a quote broke the whole endpoint)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline (quotes are legal there)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    return f"{v:g}"
 
 
 class _Metric:
@@ -40,22 +90,130 @@ class _Metric:
             return self._values.get(self._key(labels), 0.0)
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        lines = [
+            f"# HELP {self.name} {escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
         with self._lock:
             if not self._values:
                 lines.append(f"{self.name} 0")
             for k, v in sorted(self._values.items()):
-                if k:
-                    lbl = "{" + ",".join(f'{a}="{b}"' for a, b in k) + "}"
-                else:
-                    lbl = ""
-                lines.append(f"{self.name}{lbl} {v:g}")
+                lines.append(f"{self.name}{_render_labels(k)} {v:g}")
         return "\n".join(lines)
+
+
+# latency buckets (seconds): sub-ms store hits through multi-second
+# failovers — chosen so the write-path p50s PERF.md records (~1-10ms) land
+# mid-range with neighbors close enough for quantile estimates to agree
+# with the bench's direct timers within one bucket step
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _Histogram:
+    """Prometheus histogram: cumulative ``le`` buckets + ``_sum`` +
+    ``_count`` per label set. ``observe`` is the one write verb — wired at
+    the span-close sites so tracing and metrics can never disagree about
+    what was measured."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.kind = "histogram"
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"histogram {name}: needs at least one bucket")
+        self.buckets: Tuple[float, ...] = tuple(bs)
+        # label-set key → [bucket counts..., +Inf count] ; (sum, count)
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted(labels.items()))
+
+    def observe(self, value: float, **labels: str) -> None:
+        if "le" in labels:
+            raise ValueError("'le' is the reserved histogram bucket label")
+        i = bisect.bisect_left(self.buckets, value)
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+                self._sums[k] = 0.0
+            counts[i] += 1
+            self._sums[k] += value
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            counts = self._counts.get(self._key(labels))
+            return sum(counts) if counts else 0
+
+    def snapshot(self, **labels: str) -> List[Tuple[float, int]]:
+        """Cumulative (le, count) pairs incl. +Inf — quantile input."""
+        with self._lock:
+            counts = self._counts.get(self._key(labels))
+            if counts is None:
+                return []
+        out = []
+        acc = 0
+        for le, c in zip((*self.buckets, math.inf), counts):
+            acc += c
+            out.append((le, acc))
+        return out
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(
+                (k, list(c), self._sums[k]) for k, c in self._counts.items()
+            )
+        for k, counts, total in items:
+            acc = 0
+            for le, c in zip((*self.buckets, math.inf), counts):
+                acc += c
+                pairs = (*k, ("le", _fmt(le)))
+                lines.append(f"{self.name}_bucket{_render_labels(pairs)} {acc}")
+            lines.append(f"{self.name}_sum{_render_labels(k)} {total:g}")
+            lines.append(f"{self.name}_count{_render_labels(k)} {acc}")
+        return "\n".join(lines)
+
+
+def histogram_quantile(q: float,
+                       cumulative: Sequence[Tuple[float, int]]) -> float:
+    """Estimate the q-quantile from cumulative (le, count) pairs, the way
+    PromQL's histogram_quantile does: find the bucket the rank lands in and
+    interpolate linearly inside it (the +Inf bucket clamps to the highest
+    finite bound). Resolution is therefore one bucket step — exactly the
+    agreement tolerance the hist bench mode asserts."""
+    if not cumulative:
+        return 0.0
+    total = cumulative[-1][1]
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_le, prev_n = 0.0, 0
+    for le, n in cumulative:
+        if n >= rank:
+            if le == math.inf:
+                return prev_le  # clamp, like PromQL
+            if n == prev_n:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_n) / (n - prev_n)
+        prev_le, prev_n = le, n
+    return prev_le
 
 
 class Registry:
     def __init__(self):
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str) -> _Metric:
@@ -63,6 +221,18 @@ class Registry:
 
     def gauge(self, name: str, help_: str) -> _Metric:
         return self._register(name, help_, "gauge")
+
+    def histogram(
+        self, name: str, help_: str,
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> _Histogram:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = _Histogram(name, help_, buckets)
+            m = self._metrics[name]
+        if not isinstance(m, _Histogram):
+            raise ValueError(f"{name} is already registered as {m.kind}")
+        return m
 
     def _register(self, name: str, help_: str, kind: str) -> _Metric:
         with self._lock:
@@ -72,7 +242,154 @@ class Registry:
 
     def render(self) -> str:
         with self._lock:
-            return "\n".join(m.render() for m in self._metrics.values()) + "\n"
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# strict exposition parser (round-trip gate + the hist bench's read path)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)(?: (?P<ts>-?\d+))?$"
+)
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+_ESCAPE_RE = re.compile(r"\\(.)")
+
+
+class ExpositionError(ValueError):
+    """A line the text exposition format forbids — the strict parser's
+    one failure mode, so tests fail loudly the moment render() drifts."""
+
+
+def _unescape_label(value: str) -> str:
+    def sub(m) -> str:
+        c = m.group(1)
+        if c == "n":
+            return "\n"
+        if c in ('"', "\\"):
+            return c
+        raise ExpositionError(f"invalid escape \\{c} in label value")
+
+    return _ESCAPE_RE.sub(sub, value)
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    pos = 0
+    while pos < len(body):
+        m = _LABEL_RE.match(body, pos)
+        if not m:
+            raise ExpositionError(f"malformed label pair at {body[pos:]!r}")
+        out[m.group("key")] = _unescape_label(m.group("value"))
+        pos = m.end()
+        if pos < len(body):
+            if body[pos] != ",":
+                raise ExpositionError(
+                    f"expected ',' between labels at {body[pos:]!r}"
+                )
+            pos += 1
+    return out
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[str, object]]:
+    """STRICT parse of Prometheus text format. Returns
+    ``{family: {"help": str, "type": str, "samples": [(name, labels, value)]}}``
+    and raises :class:`ExpositionError` on anything malformed — unescaped
+    quotes/newlines in label values, bad sample lines, samples outside a
+    TYPE'd family, non-float values. The full-registry round-trip test and
+    the verify static gate run this over ``render()`` output."""
+    families: Dict[str, Dict[str, object]] = {}
+    current: Optional[str] = None
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise ExpositionError(f"bad metric name in HELP: {name!r}")
+            families.setdefault(
+                name, {"help": "", "type": "untyped", "samples": []}
+            )["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise ExpositionError(f"bad metric name in TYPE: {name!r}")
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ExpositionError(f"unknown TYPE {kind!r} for {name}")
+            families.setdefault(
+                name, {"help": "", "type": "untyped", "samples": []}
+            )["type"] = kind
+            current = name
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ExpositionError(f"malformed sample line: {line!r}")
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels") or "")
+        sval = m.group("value")
+        if sval == "+Inf":
+            value = math.inf
+        elif sval == "-Inf":
+            value = -math.inf
+        else:
+            try:
+                value = float(sval)
+            except ValueError:
+                raise ExpositionError(
+                    f"non-numeric sample value {sval!r} in {line!r}"
+                ) from None
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and base in families \
+                    and families[base]["type"] == "histogram":
+                family = base
+                break
+        if family not in families:
+            raise ExpositionError(
+                f"sample {name!r} outside any HELP/TYPE family"
+            )
+        if current is not None and family != current and name != current:
+            # interleaved families are illegal in the text format
+            raise ExpositionError(
+                f"sample {name!r} interleaved into family {current!r}"
+            )
+        families[family]["samples"].append((name, labels, value))
+    return families
+
+
+def exposition_quantile(
+    text: str, family: str, q: float, **labels: str
+) -> float:
+    """Read a histogram quantile straight out of exposition text (the hist
+    bench mode's read path: what a real Prometheus would compute)."""
+    fams = parse_exposition(text)
+    if family not in fams:
+        raise KeyError(f"no histogram family {family!r} in exposition")
+    pairs: List[Tuple[float, int]] = []
+    for name, lbls, value in fams[family]["samples"]:
+        if not name.endswith("_bucket"):
+            continue
+        rest = {k: v for k, v in lbls.items() if k != "le"}
+        if rest != labels:
+            continue
+        le = lbls.get("le", "")
+        pairs.append((math.inf if le == "+Inf" else float(le), int(value)))
+    pairs.sort()
+    return histogram_quantile(q, pairs)
 
 
 REGISTRY = Registry()
@@ -158,4 +475,51 @@ store_writes_elided = REGISTRY.counter(
     "Writes skipped because the intended object matched the lister's copy "
     "(no-op write elision, by component) — the write-side twin of the "
     "informer cache's zero-read guarantee",
+)
+events_pruned = REGISTRY.counter(
+    "tpu_operator_events_pruned_total",
+    "Events deleted by the controller's TTL sweep (kube prunes its events "
+    "the same way; without this the store grows without bound)",
+)
+
+# --- the histogram catalog (ISSUE 9): latencies at the span-close sites ----
+
+reconcile_latency = REGISTRY.histogram(
+    "tpu_operator_reconcile_latency_seconds",
+    "Controller sync_handler wall time per reconcile — the control "
+    "plane's headline latency (PERF 'reconcile p50'); observed where the "
+    "controller.reconcile span closes",
+)
+store_request_latency = REGISTRY.histogram(
+    "tpu_operator_store_request_latency_seconds",
+    "Store-server request handling time by verb and backing store class "
+    "(watch long-polls excluded — they park by design); observed where "
+    "the server-side store.request span closes",
+)
+watch_delivery_lag = REGISTRY.histogram(
+    "tpu_operator_watch_delivery_lag_seconds",
+    "Commit-to-informer-delivery lag per watch event (how stale a lister "
+    "read can be); observed as the informer cache applies each event",
+)
+scheduler_bind_latency = REGISTRY.histogram(
+    "tpu_operator_scheduler_bind_latency_seconds",
+    "Gang-scheduler pod-binding write latency (the admission hot path); "
+    "observed where the scheduler.bind span closes",
+)
+replication_ship_latency = REGISTRY.histogram(
+    "tpu_operator_replication_ship_latency_seconds",
+    "Leader commit-to-majority-ack time per replicated write (the HA "
+    "write tax PERF round 8 measured); observed where the replica.ship "
+    "span closes",
+)
+failover_duration = REGISTRY.histogram(
+    "tpu_operator_failover_duration_seconds",
+    "Campaign-start-to-leadership time of WON replica-set elections "
+    "(the 871ms PERF round 8 clocked by hand); observed where the "
+    "replica.election span closes",
+)
+agent_tick_latency = REGISTRY.histogram(
+    "tpu_operator_agent_tick_latency_seconds",
+    "Node-agent tick (heartbeat + batched pod mirrors, one patch-batch) "
+    "round-trip time; observed where the agent.tick span closes",
 )

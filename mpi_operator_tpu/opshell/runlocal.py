@@ -98,7 +98,9 @@ def main(argv=None) -> int:
                          "slice, e.g. '4x4,4x4')")
     ap.add_argument("--events", action="store_true", help="print the event log")
     args = ap.parse_args(argv)
+    from mpi_operator_tpu.machinery import trace
 
+    trace.configure_from_env("runlocal")
     inventory = None
     if args.inventory is not None:
         try:

@@ -44,6 +44,7 @@ from mpi_operator_tpu.controller.placement import (
     ANNOTATION_HOST_MESH,
     ANNOTATION_SLICE_ID,
 )
+from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
     LOCAL_NODE,
@@ -185,6 +186,10 @@ class GangScheduler:
         # always wake the loop. An idle cluster does zero list traffic.
         self._dirty = True
         self._last_warning: Dict[str, str] = {}  # pg key → message (dedupe)
+        # origin span of the latest watch event that woke the sync loop:
+        # the scheduler.sync span's causal parent (last-writer-wins, like
+        # the event coalescing itself)
+        self._wake_link = None
         # pg key → when it last became pending (has unbound pods); drives
         # the starvation guard. PodGroups outlive gang restarts, so aging
         # must measure time-PENDING, not object age — a long-running job
@@ -207,9 +212,14 @@ class GangScheduler:
             import queue as _queue
 
             self._watch_q = _queue.Queue()
+            # the informer drain sets the delivering event's origin span
+            # (trace.set_delivery) around handler callbacks — capture it
+            # onto the queued event so the sync it wakes can link back to
+            # the write that caused it
             self.cache.add_event_handler(
                 lambda etype, obj: self._watch_q.put(
-                    WatchEvent(etype, obj.kind, obj)
+                    WatchEvent(etype, obj.kind, obj,
+                               trace.get_delivery())
                 )
             )
         else:
@@ -242,6 +252,8 @@ class GangScheduler:
             try:
                 ev = self._watch_q.get(timeout=0.2)
                 need_sync = _wakes(ev)
+                if need_sync:
+                    self._wake_link = getattr(ev, "trace", None)
                 # COALESCE the burst: creating one 100-pod gang emits 100+
                 # events, and every binding this scheduler writes emits one
                 # more — syncing per event is the O(events × full-relist)
@@ -253,7 +265,9 @@ class GangScheduler:
                 # the events.
                 while True:
                     ev = self._watch_q.get_nowait()
-                    need_sync = need_sync or _wakes(ev)
+                    if _wakes(ev):
+                        need_sync = True
+                        self._wake_link = getattr(ev, "trace", None)
             except queue.Empty:
                 pass
             if not need_sync and time.monotonic() - last_sync < 2.0:
@@ -333,8 +347,10 @@ class GangScheduler:
             # initial snapshot lands (≙ WaitForCacheSync).
             self._dirty = True
             return
-        with self._lock:
-            self._sync_locked()
+        link, self._wake_link = self._wake_link, None
+        with trace.start_span("scheduler.sync", parent=link):
+            with self._lock:
+                self._sync_locked()
 
     def _overlay_assumed(self, pods: List[Pod], retire: bool = True) -> None:
         """Apply not-yet-echoed bindings onto the cached pod snapshot and
@@ -964,20 +980,33 @@ class GangScheduler:
                  "spec": {"node_name": node}},
             )
 
-        try:
-            committed = attempt(pod.metadata.resource_version)
-        except NotFound:
-            return False
-        except Conflict:
-            # snapshot went stale (executor mirror, eviction, another
-            # writer): re-read once and re-check the binding precondition
-            cur = self.store.try_get("Pod", ns, name)
-            if cur is None or cur.spec.node_name or cur.is_finished():
-                return False
+        # the bind span lives in the JOB's trace (the pod carries the
+        # job's trace-id annotation) with the scheduler.sync pass as its
+        # causal parent; its latency is the admission hot path PERF
+        # tracks, observed where the span closes
+        t0 = time.perf_counter()
+        with trace.start_span(
+            "scheduler.bind",
+            trace_id=pod.metadata.annotations.get(trace.ANNOTATION_TRACE_ID),
+            attrs={"pod": f"{ns}/{name}", "node": node},
+        ) as sp:
             try:
-                committed = attempt(cur.metadata.resource_version)
-            except (NotFound, Conflict):
-                return False  # level-triggered: the next pass retries
+                committed = attempt(pod.metadata.resource_version)
+            except NotFound:
+                return False
+            except Conflict:
+                # snapshot went stale (executor mirror, eviction, another
+                # writer): re-read once and re-check the binding
+                # precondition
+                cur = self.store.try_get("Pod", ns, name)
+                if cur is None or cur.spec.node_name or cur.is_finished():
+                    return False
+                try:
+                    committed = attempt(cur.metadata.resource_version)
+                except (NotFound, Conflict):
+                    return False  # level-triggered: the next pass retries
+            sp.set_attr("rv", committed.metadata.resource_version)
+        metrics.scheduler_bind_latency.observe(time.perf_counter() - t0)
         if self.cache is not None:
             # remember the binding until the informer echoes it back — the
             # next pass's cached snapshot must not undercount this gang

@@ -1,0 +1,27 @@
+# oplint fixture: OBS001 must fire on every start_span() call that is not
+# the context expression of a `with` — a bare call leaks the open span on
+# the exception path and every later span re-parents under it.
+from mpi_operator_tpu.machinery import trace
+
+
+def leaky_manual_close(self):
+    sp = trace.start_span("reconcile")  # expect: OBS001
+    self.do_work()
+    sp.finish()  # never reached if do_work raises: the span leaks
+
+
+def leaky_on_tracer_receiver(self, tracer):
+    span = tracer.start_span("bind", attrs={"pod": "p0"})  # expect: OBS001
+    return span
+
+
+def assign_then_with_still_leaks(self):
+    # the window between the call and the with is an exception path
+    sp = trace.start_span("tick")  # expect: OBS001
+    self.prepare()
+    with sp:
+        self.run()
+
+
+def bare_call_as_expression(self):
+    trace.start_span("dropped-on-the-floor")  # expect: OBS001

@@ -1,0 +1,34 @@
+# oplint fixture: blessed OBS001 shapes — the with-form (bare, aliased, or
+# nested), plus the suppressed deliberate exception.
+from mpi_operator_tpu.machinery import trace
+
+
+def blessed_with_form(self):
+    with trace.start_span("reconcile", attrs={"job": "d/j"}) as sp:
+        sp.set_attr("ok", True)
+        return self.sync()
+
+
+def blessed_no_alias(self):
+    with trace.start_span("scheduler.sync"):
+        self.sync_locked()
+
+
+def blessed_nested(self, tracer):
+    with tracer.start_span("outer"):
+        with tracer.start_span("inner", attrs={"depth": 1}):
+            self.work()
+
+
+def blessed_other_calls_unaffected(self):
+    # only start_span is span-shaped; ordinary calls never fire
+    handle = self.start_watch("Pod")
+    return handle
+
+
+def exempted_generator_plumbing(self):
+    # oplint: disable=OBS001 — harness-internal: this helper hands the
+    # open span to a caller that finishes it in its own finally block,
+    # which the rule cannot see across the call boundary
+    sp = trace.start_span("handed-off")
+    return sp

@@ -253,17 +253,31 @@ def test_leader_sigkilled_standby_adopts_without_double_create(tmp_path, chaos_r
 
 
 @TWO_RUNS
-def test_agent_sigkilled_gang_restarts_and_trainer_resumes(tmp_path, chaos_run):
+def test_agent_sigkilled_gang_restarts_and_trainer_resumes(
+    tmp_path, chaos_run, monkeypatch
+):
     """The full recovery loop on a real trainer: the only agent is
     SIGKILLed mid-llama-training (its worker processes die with it via
     PDEATHSIG), the NodeMonitor marks the node NotReady and evicts the
     gang, the controller drives ONE gang-coherent restart, the respawned
     agent re-registers and re-runs the gang, and the trainer RESUMES from
     its orbax checkpoint (start_step > 0) to completion. Checkpoint steps
-    sampled across the whole run never regress."""
+    sampled across the whole run never regress.
+
+    Runs with TRACING ON (ISSUE 9): every process exports spans to one
+    dir, and after recovery the merged spans must form ONE connected
+    causal trace under the job's trace id — NodeLost detection →
+    eviction → gang restart generation → checkpoint-resume launch —
+    across ≥3 OS processes, renderable by `ctl trace`."""
     port = free_port()
     shared = tmp_path / "ckpt"
     shared.mkdir()
+    traces = tmp_path / "traces"
+    traces.mkdir()
+    # inherited by every _spawn'd process (operator, both agent
+    # incarnations); the pytest process itself stays untraced until the
+    # `ctl trace` call below configures from the same env
+    monkeypatch.setenv("TPUJOB_TRACE_DIR", str(traces))
     procs = []
     spawned = [0]
 
@@ -347,10 +361,78 @@ def test_agent_sigkilled_gang_restarts_and_trainer_resumes(tmp_path, chaos_run):
             f"checkpoint: {report}")
         trail.stop()
         check_invariants(trail, detail=_proc_logs(tmp_path, tags()))
+        _assert_one_connected_trace(
+            store, traces, port, _proc_logs(tmp_path, tags()))
     finally:
+        from mpi_operator_tpu.machinery import trace as _tr
+
+        _tr.TRACER.disable()  # `ctl trace` configured from env in-process
         if store is not None:
             store.close()
         _reap(procs)
+
+
+def _assert_one_connected_trace(store, trace_dir, port, detail):
+    """The ISSUE 9 continuity bar: the NodeLost detection, the eviction,
+    the gang restart generation and the checkpoint-resume launch share
+    the job's trace id with correct parent edges, across ≥3 processes,
+    and `ctl trace <job>` renders the connected timeline."""
+    from mpi_operator_tpu.machinery import trace as tr
+    from mpi_operator_tpu.opshell import ctl
+
+    spans = tr.load_spans(str(trace_dir))
+    job = store.get("TPUJob", "default", "llama-crash")
+    tid = job.metadata.annotations.get(tr.ANNOTATION_TRACE_ID)
+    assert tid, "job lost its trace-id annotation\n" + detail
+    job_spans = tr.spans_for_trace(spans, tid)
+    names = {s["name"] for s in job_spans}
+    assert {"controller.reconcile", "controller.gang_restart",
+            "scheduler.bind", "executor.launch",
+            "monitor.evict"} <= names, (str(sorted(names)) + detail)
+    # ≥3 OS processes contributed spans to the ONE job trace (operator +
+    # both agent incarnations)
+    pids = {s["pid"] for s in job_spans}
+    assert len(pids) >= 3, (str(pids) + detail)
+    by_id = {s["span_id"]: s for s in spans}
+    # the eviction is attributed to the NodeLost detection that caused it
+    # (the cross-trace parent edge `ctl trace` renders as 'caused by')
+    evicts = [s for s in job_spans if s["name"] == "monitor.evict"]
+    assert any(
+        by_id.get(s.get("parent_id") or "", {}).get("name")
+        == "monitor.node_lost"
+        for s in evicts
+    ), (str(evicts) + detail)
+    # the restart generation hangs off a reconcile of this job
+    restarts = [s for s in job_spans
+                if s["name"] == "controller.gang_restart"]
+    assert len(restarts) == 1, (str(restarts) + detail)
+    parent = by_id.get(restarts[0].get("parent_id") or "")
+    assert parent is not None and parent["name"] == "controller.reconcile"
+    assert restarts[0]["attrs"].get("generation") == 1
+    # the checkpoint-resume launch: generation 1, in the job's trace, on
+    # the RESPAWNED agent (a different pid than the gen-0 launches)
+    launches = [s for s in job_spans if s["name"] == "executor.launch"]
+    gen0 = [s for s in launches if str(s["attrs"].get("generation")) == "0"]
+    gen1 = [s for s in launches if str(s["attrs"].get("generation")) == "1"]
+    assert gen0 and gen1, (str(launches) + detail)
+    assert {s["pid"] for s in gen1}.isdisjoint({s["pid"] for s in gen0}), (
+        "the resume launch must come from the respawned agent process")
+    # one connected causal component: the job's trace plus the NodeLost
+    # cause feeding it (trace grouping + parent edges)
+    comps = tr.connected_components(spans, link_traces=True)
+    comp = next(c for c in comps if restarts[0]["span_id"] in c)
+    comp_names = {by_id[sid]["name"] for sid in comp}
+    assert "monitor.node_lost" in comp_names, (str(comp_names) + detail)
+    for s in (*evicts, *gen1):
+        assert s["span_id"] in comp, (s["name"] + detail)
+    # and the operator-facing rendering works end to end
+    rc = ctl.main(["--store", f"http://127.0.0.1:{port}",
+                   "trace", "llama-crash", "--trace-dir", str(trace_dir)])
+    assert rc == 0, detail
+    rc = ctl.main(["--store", f"http://127.0.0.1:{port}",
+                   "trace", "--last-incident", "--trace-dir",
+                   str(trace_dir)])
+    assert rc == 0, detail
 
 
 # ---------------------------------------------------------------------------

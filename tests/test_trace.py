@@ -1,0 +1,505 @@
+"""Tracing subsystem tests (ISSUE 9): span mechanics, cross-process
+propagation over the store seam, causal links from watch deliveries into
+the controller/scheduler, the collector/timeline, and `ctl trace`.
+
+The multi-process continuity proof (one connected trace across operator +
+two agent incarnations through a real gang restart) rides the agent-loss
+chaos scenario in tests/test_chaos.py — these are the fast-tier
+mechanics it builds on."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.client import TPUJobClient
+from mpi_operator_tpu.api.types import ObjectMeta
+from mpi_operator_tpu.controller import TPUJobController
+from mpi_operator_tpu.controller.controller import ControllerOptions
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.http_store import HttpStoreClient, StoreServer
+from mpi_operator_tpu.machinery.objects import Pod
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.opshell import metrics
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+from tests.test_api_types import make_job
+
+
+@pytest.fixture
+def tracer(tmp_path):
+    """Tracing on (ring + JSONL under tmp), restored to off afterwards —
+    the suite must never leak an enabled tracer into other tests."""
+    d = str(tmp_path / "traces")
+    trace.TRACER.configure("test", dir=d)
+    yield d
+    trace.TRACER.disable()
+
+
+def _ring(name=None):
+    spans = trace.TRACER.ring()
+    return [s for s in spans if name is None or s["name"] == name]
+
+
+# ---------------------------------------------------------------------------
+# span mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_parents_and_export(tracer):
+    with trace.start_span("root", attrs={"k": "v"}) as root:
+        assert trace.current().span_id == root.span_id
+        with trace.start_span("child") as child:
+            assert child.parent_id == root.span_id
+            assert child.trace_id == root.trace_id
+    assert trace.current() is None
+    exported = trace.load_spans(tracer)
+    assert {s["name"] for s in exported} == {"root", "child"}
+    c = next(s for s in exported if s["name"] == "child")
+    assert c["end"] >= c["start"]
+    assert c["component"] == "test"
+    assert c["pid"] == os.getpid()
+
+
+def test_explicit_parent_and_trace_id_override(tracer):
+    ctx = trace.SpanContext(trace.new_trace_id(), trace.new_span_id())
+    with trace.start_span("linked", parent=ctx) as sp:
+        assert sp.parent_id == ctx.span_id
+        assert sp.trace_id == ctx.trace_id
+    # trace_id pins the trace even when the parent edge points elsewhere
+    # (the job-annotation anchor + cross-trace causal edge)
+    tid = trace.new_trace_id()
+    with trace.start_span("pinned", parent=ctx, trace_id=tid) as sp:
+        assert sp.parent_id == ctx.span_id
+        assert sp.trace_id == tid
+    # a wire-shaped (tid, sid) tuple is accepted as a parent
+    with trace.start_span("tuple-parent", parent=(tid, "ab" * 8)) as sp:
+        assert sp.parent_id == "ab" * 8
+    # garbage parents degrade to None, never raise
+    with trace.start_span("bad-parent", parent={"not": "a ctx"}) as sp:
+        assert sp.parent_id is None
+
+
+def test_exception_path_closes_and_records_error(tracer):
+    with pytest.raises(RuntimeError):
+        with trace.start_span("boom"):
+            raise RuntimeError("kaput")
+    sp = _ring("boom")[-1]
+    assert "kaput" in sp["error"]
+    assert trace.current() is None
+
+
+def test_finish_pops_leaked_children(tracer):
+    # a bare start_span (the OBS001 bad form) must not poison the stack
+    # past its parent's finish
+    with trace.start_span("parent") as parent:
+        leaked = trace.start_span("leaked")  # oplint would flag this form
+        assert trace.current().span_id == leaked.span_id
+    # parent closed: the leaked child was defensively popped with it
+    assert trace.current() is None
+    with trace.start_span("after") as after:
+        assert after.parent_id is None
+
+
+def test_adopt_trace_rehomes_span_and_descendants(tracer):
+    tid = trace.new_trace_id()
+    with trace.start_span("reconcile") as sp:
+        sp.adopt_trace(tid)
+        with trace.start_span("inner"):
+            pass
+    assert _ring("reconcile")[-1]["trace_id"] == tid
+    assert _ring("inner")[-1]["trace_id"] == tid
+
+
+def test_root_sentinel_forces_rootness(tracer):
+    with trace.start_span("outer"):
+        with trace.start_span("forced-root", parent=trace.ROOT) as sp:
+            assert sp.parent_id is None
+        with trace.start_span("inherits") as sp:
+            assert sp.parent_id is not None
+
+
+def test_reconfigure_after_disable_restarts_flusher(tmp_path):
+    """A configure() racing a disable()'s flusher exit must still end up
+    with a LIVE cadence flusher (and must not discard spans buffered for
+    the old dir) — otherwise spans only reach disk at atexit and a
+    SIGKILL loses everything since the reconfigure."""
+    d1 = str(tmp_path / "t1")
+    d2 = str(tmp_path / "t2")
+    trace.TRACER.configure("test", dir=d1)
+    try:
+        with trace.start_span("before"):
+            pass
+        trace.TRACER.disable()
+        trace.TRACER.configure("test", dir=d2)
+        assert trace.TRACER._flusher is not None
+        assert trace.TRACER._flusher.is_alive()
+        with trace.start_span("after"):
+            pass
+        # the cadence flusher (NOT a reader-triggered flush) must land it
+        deadline = time.time() + 3.0
+        found = False
+        while time.time() < deadline and not found:
+            for name in (os.listdir(d2) if os.path.isdir(d2) else ()):
+                with open(os.path.join(d2, name)) as f:
+                    found = found or '"after"' in f.read()
+            time.sleep(0.05)
+        assert found, "flusher never wrote the span after reconfigure"
+        # and the pre-disable span reached the OLD dir, not the void
+        assert any(s["name"] == "before" for s in trace.load_spans(d1))
+    finally:
+        trace.TRACER.disable()
+
+
+def test_two_nodes_lost_in_one_tick_attribute_their_own_evictions(tracer):
+    from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+    from mpi_operator_tpu.machinery.objects import (
+        NODE_NAMESPACE,
+        Node,
+        PodPhase,
+        PodSpec,
+    )
+
+    store = ObjectStore()
+    now = time.time()
+    for name in ("node-a", "node-b"):
+        n = Node()
+        n.metadata.namespace = NODE_NAMESPACE
+        n.metadata.name = name
+        n.status.ready = True
+        n.status.last_heartbeat = now - 100
+        store.create(n)
+        p = Pod(metadata=ObjectMeta(name=f"pod-{name}", namespace="d"))
+        p.spec = PodSpec(node_name=name)
+        p.status.phase = PodPhase.RUNNING
+        store.create(p)
+    NodeMonitor(store, EventRecorder(store), grace=1.0).sync()
+    spans = trace.TRACER.ring()
+    lost = {s["attrs"]["node"]: s for s in spans
+            if s["name"] == "monitor.node_lost"}
+    assert set(lost) == {"node-a", "node-b"}
+    evicts = [s for s in spans if s["name"] == "monitor.evict"]
+    assert len(evicts) == 2
+    for ev in evicts:
+        node = ev["attrs"]["node"]
+        assert ev["parent_id"] == lost[node]["span_id"], (
+            f"eviction off {node} attributed to the wrong node_lost span")
+
+
+def test_ctl_trace_deleted_job_never_adopts_prefix_sibling(tracer, tmp_path,
+                                                           capsys):
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+    from mpi_operator_tpu.opshell import ctl
+
+    # spans for job "train2" only; job "train" was deleted and traced
+    # nothing — the fallback must NOT adopt train2's trace via prefixing
+    with trace.start_span("executor.launch",
+                          attrs={"pod": "default/train2-worker-0"}):
+        pass
+    db = tmp_path / "store.db"
+    SqliteStore(str(db)).close()
+    rc = ctl.main(["--store", f"sqlite:{db}", "trace", "train",
+                   "--trace-dir", tracer])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "no span mentions it" in err
+
+
+def test_disabled_tracer_is_noop():
+    trace.TRACER.disable()
+    sp = trace.start_span("nothing")
+    assert sp is trace.NOOP_SPAN
+    with sp as inner:
+        assert inner.set_attr("a", 1) is inner
+        assert inner.context() is None
+    assert trace.current() is None
+    assert trace.inject() is None
+    assert trace.current_ids() is None
+
+
+def test_traceparent_roundtrip_and_strictness():
+    ctx = trace.SpanContext(trace.new_trace_id(), trace.new_span_id())
+    assert trace.parse_traceparent(trace.format_traceparent(ctx)) == ctx
+    for bad in ("", None, "garbage", "00-short-short-01",
+                "00-" + "g" * 32 + "-" + "a" * 16 + "-01"):
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_load_spans_skips_torn_tail(tracer, tmp_path):
+    with trace.start_span("whole"):
+        pass
+    # a SIGKILLed process leaves a torn last line; the collector skips it
+    os.makedirs(tracer, exist_ok=True)  # export creates it lazily on flush
+    path = os.path.join(tracer, "killed-123.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"span_id": "x1", "trace_id": "t",
+                            "name": "ok", "start": 1.0, "end": 2.0}) + "\n")
+        f.write('{"span_id": "x2", "trace_id": "t", "na')
+    spans = trace.load_spans(tracer)
+    assert {s["name"] for s in spans} >= {"whole", "ok"}
+    assert not any(s.get("span_id") == "x2" for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# cross-process propagation over the store seam
+# ---------------------------------------------------------------------------
+
+
+def test_http_seam_stitches_client_server_and_watch(tracer):
+    backing = ObjectStore()
+    server = StoreServer(backing).start()
+    client = HttpStoreClient(server.url)
+    q = client.watch(None)
+    try:
+        with trace.start_span("writer") as writer:
+            client.create(Pod(metadata=ObjectMeta(name="p0", namespace="d")))
+        ev = q.get(timeout=5)
+        # the server-side request span parents on the client's span...
+        server_spans = _ring("store.request")
+        assert server_spans, "no server span recorded"
+        srv = server_spans[-1]
+        assert srv["parent_id"] == writer.span_id
+        assert srv["trace_id"] == writer.trace_id
+        assert srv["attrs"]["verb"] == "create"
+        assert srv["attrs"]["backend"] == "ObjectStore"
+        # ...and the watch event carries that write span as its origin,
+        # with the commit timestamp for the lag histogram
+        assert tuple(ev.trace) == (srv["trace_id"], srv["span_id"])
+        assert ev.ts > 0
+        # the request landed in the verb×backend histogram
+        assert metrics.store_request_latency.count(
+            verb="create", backend="ObjectStore") >= 1
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_informer_delivery_exposes_origin_to_handlers(tracer):
+    store = ObjectStore()
+    cache = InformerCache(store).start()
+    seen = []
+    done = threading.Event()
+
+    def handler(etype, obj):
+        seen.append((etype, trace.get_delivery()))
+        done.set()
+
+    try:
+        assert cache.wait_for_sync(5)
+        cache.add_event_handler(handler)
+        with trace.start_span("writer") as writer:
+            store.create(Pod(metadata=ObjectMeta(name="p1", namespace="d")))
+        assert done.wait(5)
+        etype, delivered = seen[0]
+        assert delivered is not None
+        assert delivered.span_id == writer.span_id
+        # the handler window closed with the delivery
+        assert trace.get_delivery() is None or threading.current_thread()
+    finally:
+        cache.stop()
+
+
+def test_watch_lag_histogram_observed_via_cache(tracer):
+    before = metrics.watch_delivery_lag.count()
+    store = ObjectStore()
+    cache = InformerCache(store).start()
+    try:
+        assert cache.wait_for_sync(5)
+        store.create(Pod(metadata=ObjectMeta(name="lagpod", namespace="d")))
+        deadline = time.time() + 5
+        while metrics.watch_delivery_lag.count() <= before:
+            assert time.time() < deadline, "lag never observed"
+            time.sleep(0.01)
+    finally:
+        cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# control-plane integration: reconcile links + annotation stamping
+# ---------------------------------------------------------------------------
+
+
+def test_job_trace_id_stamped_at_admission_and_propagated(tracer):
+    store = ObjectStore()
+    client = TPUJobClient(store)
+    job = client.create(make_job(name="traced", replicas=2).to_dict())
+    tid = job.metadata.annotations.get(trace.ANNOTATION_TRACE_ID)
+    assert tid, "admission must stamp the trace id"
+    controller = TPUJobController(
+        store, EventRecorder(store), ControllerOptions(threadiness=0)
+    )
+    assert controller.sync_handler("default/traced")
+    # the reconcile span re-homed into the job's trace
+    rec = _ring("controller.reconcile")[-1]
+    assert rec["trace_id"] == tid
+    assert rec["attrs"]["job"] == "default/traced"
+    # worker pods carry the annotation (the robust cross-component anchor)
+    for pod in store.list("Pod", "default"):
+        assert pod.metadata.annotations[trace.ANNOTATION_TRACE_ID] == tid
+
+
+def test_controller_backstops_unstamped_jobs(tracer):
+    store = ObjectStore()
+    store.create(make_job(name="raw", replicas=1))
+    controller = TPUJobController(
+        store, EventRecorder(store), ControllerOptions(threadiness=0)
+    )
+    assert controller.sync_handler("default/raw")
+    stored = store.get("TPUJob", "default", "raw")
+    tid = stored.metadata.annotations.get(trace.ANNOTATION_TRACE_ID)
+    assert tid, "controller must backstop-stamp direct store creates"
+    # idempotent: the next reconcile keeps the id (no re-mint churn)
+    assert controller.sync_handler("default/raw")
+    again = store.get("TPUJob", "default", "raw")
+    assert again.metadata.annotations[trace.ANNOTATION_TRACE_ID] == tid
+
+
+def test_reconcile_parents_on_triggering_write(tracer):
+    """The causal 'why': a reconcile woken by a watch event links back to
+    the write that produced the event — across cache delivery, enqueue,
+    and a worker thread."""
+    store = ObjectStore()
+    cache = InformerCache(store).start()
+    controller = TPUJobController(
+        store, EventRecorder(store),
+        ControllerOptions(threadiness=1), cache=cache,
+    )
+    try:
+        assert cache.wait_for_sync(5)
+        controller.run()
+        client = TPUJobClient(store)
+        with trace.start_span("submitter") as sub:
+            client.create(make_job(name="linked", replicas=1).to_dict())
+        deadline = time.time() + 10
+        rec = None
+        while time.time() < deadline:
+            recs = [s for s in _ring("controller.reconcile")
+                    if s["attrs"].get("job") == "default/linked"
+                    and s["parent_id"]]
+            if recs:
+                rec = recs[0]
+                break
+            time.sleep(0.05)
+        assert rec is not None, "no linked reconcile span"
+        # parent chain: reconcile ← client.submit (in-process store: the
+        # write span IS the submit span opened by TPUJobClient.create,
+        # itself a child of our submitter span)
+        by_id = {s["span_id"]: s for s in trace.TRACER.ring()}
+        parent = by_id.get(rec["parent_id"])
+        assert parent is not None, "parent span not exported"
+        assert parent["name"] == "client.submit"
+        assert parent["parent_id"] == sub.span_id
+    finally:
+        controller.stop()
+        cache.stop()
+
+
+def test_scheduler_bind_span_lives_in_job_trace(tracer):
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    client = TPUJobClient(store)
+    job = client.create(make_job(name="bindme", replicas=2).to_dict())
+    tid = job.metadata.annotations[trace.ANNOTATION_TRACE_ID]
+    controller = TPUJobController(
+        store, recorder, ControllerOptions(threadiness=0)
+    )
+    assert controller.sync_handler("default/bindme")
+    before = metrics.scheduler_bind_latency.count()
+    scheduler = GangScheduler(store, recorder)
+    scheduler.sync()
+    binds = [s for s in _ring("scheduler.bind")
+             if s["attrs"].get("pod", "").startswith("default/bindme")]
+    assert len(binds) == 2
+    for b in binds:
+        assert b["trace_id"] == tid
+        assert b["attrs"]["node"] == "local"
+    assert metrics.scheduler_bind_latency.count() - before == 2
+    for pod in store.list("Pod", "default"):
+        assert pod.spec.node_name == "local"
+
+
+# ---------------------------------------------------------------------------
+# collector + ctl trace
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_renders_tree_with_cross_trace_cause(tracer):
+    tid = trace.new_trace_id()
+    with trace.start_span("monitor.node_lost", attrs={"node": "n0"}) as lost:
+        pass
+    with trace.start_span("monitor.evict", parent=lost.context(),
+                          trace_id=tid, attrs={"pod": "d/p0"}):
+        with trace.start_span("inner.work"):
+            pass
+    spans = trace.load_spans(tracer)
+    out = trace.render_timeline(spans, tid)
+    assert "monitor.evict" in out
+    assert "inner.work" in out
+    assert "caused by" in out and "monitor.node_lost" in out
+    # connectivity: the cross-trace parent edge joins the components
+    comps = trace.connected_components(spans)
+    assert len(comps) == 1
+
+
+def test_last_incident_reconstruction(tracer):
+    with trace.start_span("controller.reconcile", attrs={"job": "d/j"}):
+        with trace.start_span("controller.gang_restart",
+                              attrs={"job": "d/j", "generation": 1}):
+            pass
+    spans = trace.load_spans(tracer)
+    incident = trace.last_incident(spans)
+    assert incident is not None
+    assert incident["name"] == "controller.gang_restart"
+    out = trace.render_incident(spans, incident)
+    assert "causal chain" in out
+    assert "controller.reconcile" in out
+
+
+def test_ctl_trace_renders_job_timeline(tracer, tmp_path, capsys):
+    from mpi_operator_tpu.opshell import ctl
+
+    db = tmp_path / "store.db"
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    store = SqliteStore(str(db))
+    try:
+        client = TPUJobClient(store)
+        job = client.create(make_job(name="cli-traced", replicas=1).to_dict())
+        tid = job.metadata.annotations[trace.ANNOTATION_TRACE_ID]
+        controller = TPUJobController(
+            store, EventRecorder(store), ControllerOptions(threadiness=0)
+        )
+        assert controller.sync_handler("default/cli-traced")
+    finally:
+        store.close()
+    rc = ctl.main(["--store", f"sqlite:{db}", "trace", "cli-traced",
+                   "--trace-dir", tracer])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert tid in out
+    assert "controller.reconcile" in out
+    # and the incident path answers with a clean "nothing yet"
+    rc = ctl.main(["--store", f"sqlite:{db}", "trace", "--last-incident",
+                   "--trace-dir", tracer])
+    out2 = capsys.readouterr().out
+    assert rc == 0
+    assert "no incident spans" in out2
+
+
+def test_ctl_trace_without_dir_fails_with_hint(tmp_path, capsys,
+                                              monkeypatch):
+    from mpi_operator_tpu.opshell import ctl
+
+    monkeypatch.delenv(trace.ENV_TRACE_DIR, raising=False)
+    db = tmp_path / "store.db"
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    SqliteStore(str(db)).close()
+    rc = ctl.main(["--store", f"sqlite:{db}", "trace", "nope"])
+    assert rc == 2
+    assert "TPUJOB_TRACE_DIR" in capsys.readouterr().err

@@ -18,8 +18,13 @@ measuring per-sync latency and the server's read counters. Run it via::
   BENCH_MODEL=controlplane python bench.py          # same, no TPU work
 
 Knobs: BENCH_CP_JOBS, BENCH_CP_PODS, BENCH_CP_ROUNDS, BENCH_CP_MODES
-("store", "informer", "write", "replica", "hist", "traceoverhead", or a
-comma list). No jax required — this is the pure-python control plane.
+("store", "informer", "write", "replica", "hist", "traceoverhead",
+"scale", "fanout", or a comma list). No jax required — this is the pure-
+python control plane. The **scale** mode (ISSUE 10) drives a hollow-node
+fleet (BENCH_CP_SCALE_NODES × simulated nodes, BENCH_CP_SCALE_JOBS jobs)
+against the sharded+fair-queued stack and reads p50/p99 out of the PR 9
+histograms with p99 SLOs as the tripwire; **fanout** proves watch fan-out
+encode cost is O(events), not O(watchers×events).
 The **hist** mode proves the exported latency histograms (ISSUE 9) agree
 with the direct timers within bucket resolution; **traceoverhead** bounds
 the tracing tax (reconcile p50 traced vs untraced, acceptance ≤5%).
@@ -67,12 +72,12 @@ from mpi_operator_tpu.machinery.sqlite_store import SqliteStore  # noqa: E402
 from mpi_operator_tpu.scheduler.gang import GangScheduler  # noqa: E402
 
 
-def _make_job(i: int, pods: int) -> TPUJob:
+def _make_job(i: int, pods: int, clean: str = "None") -> TPUJob:
     return TPUJob(
         metadata=ObjectMeta(name=f"storm-{i:04d}", namespace="bench"),
         spec=TPUJobSpec(
             slots_per_worker=1,
-            run_policy=RunPolicy(clean_pod_policy="None"),
+            run_policy=RunPolicy(clean_pod_policy=clean),
             worker=ReplicaSpec(
                 replicas=pods,
                 restart_policy="Never",
@@ -576,6 +581,313 @@ def run_replica_mode(writes: int) -> dict:
     return out
 
 
+def _hist_quantile_delta(hist, q, before, after, **labels):
+    """Quantile of a histogram's observations BETWEEN two snapshots
+    (cumulative (le,count) pairs from _Histogram.snapshot) — isolates this
+    bench run from whatever the process observed earlier."""
+    from mpi_operator_tpu.opshell.metrics import histogram_quantile
+
+    b = dict(before)
+    delta = [(le, c - b.get(le, 0)) for le, c in after]
+    return histogram_quantile(q, delta)
+
+
+def run_scale_mode(nodes: int, jobs: int, pods: int) -> dict:
+    """The 10k-job scale run (BENCH_CP_MODES=scale), in the DEPLOYED
+    three-process shape: a sqlite-backed `tpu-store` server process
+    (preencoded watch fan-out + APF fair queuing on), a hollow-fleet
+    process simulating ``nodes`` agents, and THIS process as the leader —
+    informer cache, sharded-workqueue controller, gang scheduler.
+    (A single shared process understates the result badly: at 1k nodes
+    the three planes' GIL contention dominates every latency.) ``jobs``
+    TPUJobs × ``pods`` workers are submitted with wave backpressure and
+    driven to Succeeded; reconcile/bind/watch-lag p50/p99 come OUT OF
+    THE PR 9 HISTOGRAMS (the numbers /metrics would export), and the
+    p99 SLOs are the tripwire this bench exists to arm."""
+    import math
+    import socket
+    import subprocess
+    import threading
+
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.opshell import metrics
+
+    run_s = float(os.environ.get("BENCH_CP_SCALE_RUN_S", "0.2"))
+    wave = int(os.environ.get("BENCH_CP_SCALE_WAVE", "500"))
+    threadiness = int(os.environ.get("BENCH_CP_SCALE_WORKERS", "8"))
+    # p99 SLO tripwires, calibrated on this sandbox's round-10 run
+    # (measured 570 / 225 / 4404 ms at 1k nodes / 10k jobs) with ~2×
+    # headroom for run-to-run drift — a regression that blows these is a
+    # scalability bug, not noise. Override per deployment via env.
+    slo_reconcile = float(os.environ.get("BENCH_CP_SLO_RECONCILE_P99_MS",
+                                         "1000"))
+    slo_bind = float(os.environ.get("BENCH_CP_SLO_BIND_P99_MS", "500"))
+    slo_lag = float(os.environ.get("BENCH_CP_SLO_WATCHLAG_P99_MS", "7500"))
+    chips = max(2, math.ceil(jobs * pods / max(1, nodes)) + 2)
+
+    tmp = tempfile.mkdtemp(prefix="bench-cp-scale-")
+    with socket.socket() as s:  # free port for the store process
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.abspath(__file__)))
+    store_proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+         "--store", f"sqlite:{os.path.join(tmp, 'store.db')}",
+         "--listen", f"127.0.0.1:{port}", "--log-capacity", "65536",
+         "--fair-queue", "inflight=32,queue=512,max_wait=60"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    fleet_proc = None
+    client = HttpStoreClient(url, timeout=60.0, watch_poll_timeout=5.0,
+                             conn_refused_retries=20)
+    cache = None
+    controller = None
+    stop = threading.Event()
+    snaps = {
+        "reconcile": metrics.reconcile_latency.snapshot(),
+        "bind": metrics.scheduler_bind_latency.snapshot(),
+        "lag": metrics.watch_delivery_lag.snapshot(),
+    }
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:  # store process up?
+            try:
+                client.list("Node")
+                break
+            except Exception:
+                time.sleep(0.2)
+        fleet_proc = subprocess.Popen(
+            [sys.executable, "-m", "mpi_operator_tpu.executor.hollow",
+             "--store", url, "--nodes", str(nodes),
+             "--chips", str(chips), "--run-s", str(run_s),
+             "--heartbeat", "15", "--seed", "10"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        cache = InformerCache(client).start()
+        if not cache.wait_for_sync(30.0):
+            raise RuntimeError("informer cache never synced")
+        recorder = EventRecorder(client)
+        controller = TPUJobController(
+            client, recorder,
+            ControllerOptions(threadiness=threadiness,
+                              queue_shards=threadiness),
+            cache=cache,
+        )
+        scheduler = GangScheduler(client, recorder, cache=cache)
+        # O(1)-per-event progress probe off the informer stream (listing
+        # 10k cached jobs per poll would make the BENCH the noisy
+        # tenant); Succeeded is terminal write-once, so a name set is
+        # exact
+        done_names = set()
+
+        def note_done(etype, obj):
+            if obj.kind == "TPUJob" and cond.is_succeeded(obj.status):
+                done_names.add(obj.metadata.name)
+
+        cache.add_event_handler(note_done)
+        # fleet registration visible before the first gangs admit
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(cache.list("Node")) >= nodes:
+                break
+            time.sleep(0.2)
+        controller.run()
+
+        def sched_loop():
+            while not stop.is_set():
+                try:
+                    scheduler.sync()
+                except Exception:
+                    pass  # transient conflicts; next pass heals
+                stop.wait(0.2)
+
+        st = threading.Thread(target=sched_loop, daemon=True)
+        st.start()
+
+        t0 = time.perf_counter()
+        submitted = 0
+        done = 0
+        deadline = time.time() + float(os.environ.get(
+            "BENCH_CP_SCALE_DEADLINE_S", max(600.0, jobs * 0.25)))
+        while time.time() < deadline:
+            done = len(done_names)
+            while submitted < jobs and submitted - done < wave:
+                # CleanPodPolicy=All (the batch-workload default): a
+                # finished job's pods/podgroup are reaped, so the
+                # scheduler's per-pass working set stays O(in-flight),
+                # not O(all jobs ever) — at 10k jobs the difference
+                # between a ~1.5k-object and a ~30k-object deepcopy per
+                # 0.2s pass in the leader process
+                client.create(_make_job(submitted, pods, clean="All"))
+                submitted += 1
+            if done >= jobs:
+                break
+            time.sleep(0.5)
+        elapsed = time.perf_counter() - t0
+        # authoritative final count (one full list, off the clock)
+        done = sum(1 for j in cache.list("TPUJob", "bench")
+                   if cond.is_succeeded(j.status))
+        out = {
+            "metric": "controlplane_scale",
+            "processes": "store / hollow-fleet / operator (deployed shape)",
+            "nodes": nodes,
+            "jobs": jobs,
+            "pods_per_job": pods,
+            "hollow_run_s": run_s,
+            "jobs_succeeded": done,
+            "elapsed_s": round(elapsed, 1),
+            "jobs_per_s": round(done / max(1e-9, elapsed), 1),
+            "queue_shards": threadiness,
+        }
+        for q, tag in ((0.50, "p50"), (0.99, "p99")):
+            out[f"reconcile_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.reconcile_latency, q, snaps["reconcile"],
+                metrics.reconcile_latency.snapshot()) * 1e3, 2)
+            out[f"bind_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.scheduler_bind_latency, q, snaps["bind"],
+                metrics.scheduler_bind_latency.snapshot()) * 1e3, 2)
+            out[f"watch_lag_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.watch_delivery_lag, q, snaps["lag"],
+                metrics.watch_delivery_lag.snapshot()) * 1e3, 2)
+        out["slo"] = {
+            "reconcile_p99_ms": slo_reconcile,
+            "bind_p99_ms": slo_bind,
+            "watch_lag_p99_ms": slo_lag,
+        }
+        out["slo_ok"] = bool(
+            done >= jobs
+            and out["reconcile_p99_ms"] <= slo_reconcile
+            and out["bind_p99_ms"] <= slo_bind
+            and out["watch_lag_p99_ms"] <= slo_lag
+        )
+        return out
+    finally:
+        stop.set()
+        if controller is not None:
+            controller.stop()
+        if cache is not None:
+            cache.stop()
+        client.close()
+        for proc in (fleet_proc, store_proc):
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+def run_fanout_mode() -> dict:
+    """The O(events) fan-out proof (BENCH_CP_MODES=fanout): a fixed event
+    stream delivered to 10 vs ``BENCH_CP_FANOUT_WATCHERS`` (default 500)
+    long-poll watchers, with the per-event wire bytes PREENCODED at append
+    (the new path) vs re-encoded per watcher (preencode=False, the old
+    path). Measured: server-side encode+assembly wall time from
+    http_store.watch_encode_stats. Acceptance: growing watchers 10→500
+    raises the preencoded cost <2× while the legacy path grows ~linearly
+    with watchers (~50×)."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from mpi_operator_tpu.machinery.http_store import (
+        reset_watch_encode_stats,
+        watch_encode_stats,
+    )
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    events = int(os.environ.get("BENCH_CP_FANOUT_EVENTS", "200"))
+    big = int(os.environ.get("BENCH_CP_FANOUT_WATCHERS", "500"))
+
+    def drive(preencode: bool, watchers: int) -> dict:
+        server = StoreServer(ObjectStore(), "127.0.0.1", 0,
+                             log_capacity=events * 2 + 64,
+                             preencode=preencode).start()
+        stop = threading.Event()
+        seen = [0] * watchers
+        registered = [False] * watchers
+
+        def watcher(i: int) -> None:
+            base = f"http://127.0.0.1:{server.port}/v1/watch"
+            try:
+                with urllib.request.urlopen(base + "?after=-1",
+                                            timeout=30) as r:
+                    reg = _json.loads(r.read())
+                cursor, inst = reg["next"], reg["instance"]
+                registered[i] = True
+                while not stop.is_set() and seen[i] < events:
+                    with urllib.request.urlopen(
+                        f"{base}?after={cursor}&timeout=5&instance={inst}",
+                        timeout=20,
+                    ) as r:
+                        payload = _json.loads(r.read())
+                    cursor = payload.get("next", cursor)
+                    seen[i] += len(payload.get("events", []))
+            except Exception:
+                registered[i] = True  # do not wedge the start barrier
+                # a dead watcher just stops counting
+
+        threads = [threading.Thread(target=watcher, args=(i,), daemon=True)
+                   for i in range(watchers)]
+        for t in threads:
+            t.start()
+        # every watcher must be REGISTERED before the event stream starts:
+        # registration hands the current head, so late registrants would
+        # silently miss early events and the drain below would never end
+        deadline = time.time() + 60
+        while time.time() < deadline and not all(registered):
+            time.sleep(0.05)
+        reset_watch_encode_stats()
+        cpu0 = time.process_time()
+        writer = HttpStoreClient(server.url, timeout=30.0)
+        for i in range(events):
+            writer.create(Pod(metadata=ObjectMeta(
+                name=f"f-{i:05d}", namespace="bench")))
+        # drain until everyone saw everything, or delivery plateaus
+        deadline = time.time() + 60 + watchers * 0.1
+        last_total, last_change = -1, time.time()
+        while time.time() < deadline and min(seen) < events:
+            total = sum(seen)
+            if total != last_total:
+                last_total, last_change = total, time.time()
+            elif time.time() - last_change > 10.0:
+                break  # plateaued (some watcher died); report what landed
+            time.sleep(0.05)
+        stats = watch_encode_stats()
+        cpu = time.process_time() - cpu0
+        stop.set()
+        writer.close()
+        server.stop()
+        for t in threads:
+            t.join(timeout=2.0)
+        return {
+            "watchers": watchers,
+            "delivered_min": min(seen),
+            "encode_s": round(stats["encode_s"], 4),
+            "assembly_s": round(stats["assembly_s"], 4),
+            "events_encoded": stats["events_encoded"],
+            "payloads": stats["payloads"],
+            "process_cpu_s": round(cpu, 3),
+        }
+
+    out = {"metric": "controlplane_watch_fanout", "events": events}
+    for label, pre in (("preencoded", True), ("reencode", False)):
+        small = drive(pre, 10)
+        large = drive(pre, big)
+        ratio = large["encode_s"] / max(1e-9, small["encode_s"])
+        out[label] = {
+            "w10": small, f"w{big}": large,
+            "encode_cost_ratio": round(ratio, 2),
+        }
+    out["fanout_is_o_events"] = bool(
+        out["preencoded"]["encode_cost_ratio"] < 2.0
+    )
+    return out
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_CP_JOBS", "200"))
     pods = int(os.environ.get("BENCH_CP_PODS", "8"))
@@ -594,6 +906,14 @@ def main() -> None:
             r = run_hist_mode(writes)
         elif mode == "traceoverhead":
             r = run_trace_overhead(jobs, pods, rounds)
+        elif mode == "scale":
+            r = run_scale_mode(
+                int(os.environ.get("BENCH_CP_SCALE_NODES", "1000")),
+                int(os.environ.get("BENCH_CP_SCALE_JOBS", "10000")),
+                int(os.environ.get("BENCH_CP_SCALE_PODS", "1")),
+            )
+        elif mode == "fanout":
+            r = run_fanout_mode()
         else:
             r = run_mode(mode, jobs, pods, rounds)
         results[mode] = r

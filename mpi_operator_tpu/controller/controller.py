@@ -75,7 +75,10 @@ from mpi_operator_tpu.machinery.store import (
     WatchEvent,
     diff_merge_patch,
 )
-from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
+from mpi_operator_tpu.machinery.workqueue import (
+    RateLimitingQueue,
+    ShardedRateLimitingQueue,
+)
 from mpi_operator_tpu.opshell import metrics
 
 log = logging.getLogger("tpujob.controller")
@@ -129,6 +132,11 @@ class ControllerOptions:
 
     namespace: Optional[str] = None  # None = cluster-scoped
     threadiness: int = 2
+    # workqueue shard count (the 10k-job dispatch bottleneck fix): None =
+    # one shard per worker thread (dispatch parallelism tracks the pool),
+    # 1 = the classic single RateLimitingQueue, N = explicit. Same key
+    # never processed concurrently regardless of the shape.
+    queue_shards: Optional[int] = None
     coordinator_port: int = DEFAULT_COORDINATOR_PORT
     gang_scheduling: bool = True
     # Event TTL sweep (the controller's housekeeping pass): Events older
@@ -165,7 +173,13 @@ class TPUJobController:
         self.read = cache if cache is not None else store
         self.options = options or ControllerOptions()
         self.recorder = recorder or EventRecorder(store)
-        self.queue = RateLimitingQueue()
+        shards = self.options.queue_shards
+        if shards is None:
+            shards = max(1, self.options.threadiness)
+        self.queue = (
+            ShardedRateLimitingQueue(shards) if shards > 1
+            else RateLimitingQueue()
+        )
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._watch_q = None
@@ -179,6 +193,9 @@ class TPUJobController:
         # persists (cleared when the job disappears)
         self._port_lock = threading.Lock()
         self._ports_inflight: Dict[str, int] = {}
+        # TTL-cached TPUJob snapshot for port probing (see
+        # _assign_coordinator_port): (jobs, taken_at_monotonic) or None
+        self._ports_snapshot = None
         # job key → span context of the latest watch write that enqueued
         # it: the reconcile span's causal parent ("why did this reconcile
         # run"). Last-writer-wins per key matches the workqueue's own
@@ -216,7 +233,8 @@ class TPUJobController:
             self._threads.append(pump)
         for i in range(self.options.threadiness):
             t = threading.Thread(
-                target=self._run_worker, name=f"tpujob-worker-{i}", daemon=True
+                target=self._run_worker, args=(i,),
+                name=f"tpujob-worker-{i}", daemon=True,
             )
             t.start()
             self._threads.append(t)
@@ -309,7 +327,7 @@ class TPUJobController:
                 return ref
         return None
 
-    def _run_worker(self) -> None:
+    def _run_worker(self, worker: int = 0) -> None:
         # a worker reconciling against a cold cache would observe an empty
         # world — and e.g. recreate every pod of a live job (AlreadyExists
         # storms) or mark a running job freshly Created
@@ -322,8 +340,9 @@ class TPUJobController:
             # was safe, but any future stop path that forgets shut_down()
             # (or a queue bug swallowing the wake) parked the worker forever
             # with no way to observe _stop. The watch pump at _pump already
-            # polls at 0.2s for exactly this reason.
-            key = self.queue.get(timeout=0.2)
+            # polls at 0.2s for exactly this reason. ``worker`` is the
+            # sharded queue's home-shard index (ignored by the single queue).
+            key = self.queue.get(timeout=0.2, shard=worker)
             if key is None:
                 if self._stop.is_set() or self.queue.shutting_down:
                     return
@@ -564,6 +583,10 @@ class TPUJobController:
 
     # ports probed above options.coordinator_port before wrapping
     PORT_RANGE = 1024
+    # max age of the used-port snapshot (seconds): _ports_inflight covers
+    # everything this leader assigned, so the snapshot only needs to age
+    # fast enough to learn a PREVIOUS leader's assignments after failover
+    _PORTS_SNAPSHOT_TTL = 30.0
 
     def _assign_coordinator_port(self, job: TPUJob) -> int:
         """Per-job rendezvous port, recorded in status (once assigned it is
@@ -590,18 +613,35 @@ class TPUJobController:
         # concurrent assignment ALWAYS lands in _ports_inflight under the
         # lock before its status write — re-checked below — so a port
         # missing from this (possibly stale) snapshot cannot be lost.
-        jobs = self.read.list("TPUJob")
+        # The snapshot is a TTL-cached PORT SET (10k-job round): one full
+        # list per NEW job made first-assignment cost O(jobs²) across a
+        # submission storm, and caching the deepcopied job objects still
+        # cost O(jobs) per refresh — the set of busy ports is all this
+        # probe needs. A port freshly assigned by THIS controller is
+        # always visible through _ports_inflight regardless of snapshot
+        # age (the leader is the only assigner), so staleness only risks
+        # probing onto a port a *finished* job recently freed — harmless:
+        # assignment is best-effort hash probing by design.
+        now = time.monotonic()
+        with self._port_lock:
+            snap = self._ports_snapshot
+        if snap is None or now - snap[1] > self._PORTS_SNAPSHOT_TTL:
+            listed = {
+                (j.metadata.uid, j.status.coordinator_port)
+                for j in self.read.list("TPUJob")
+                if j.status.coordinator_port
+                and not cond.is_finished(j.status)
+            }
+            with self._port_lock:
+                self._ports_snapshot = (listed, now)
+                snap = self._ports_snapshot
         with self._port_lock:
             reserved = self._ports_inflight.get(key)
             if reserved is not None:
                 job.status.coordinator_port = reserved
                 return reserved
             used = {
-                j.status.coordinator_port
-                for j in jobs
-                if j.status.coordinator_port
-                and j.metadata.uid != job.metadata.uid
-                and not cond.is_finished(j.status)
+                p for uid, p in snap[0] if uid != job.metadata.uid
             }
             used |= {
                 p for k, p in self._ports_inflight.items() if k != key

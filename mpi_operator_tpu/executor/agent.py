@@ -306,6 +306,7 @@ class NodeAgent:
         log_tokens: Optional[Sequence[str]] = None,
         ckpt_dir: Optional[str] = None,
         eviction_grace: float = 5.0,
+        hollow=None,
     ):
         from mpi_operator_tpu.machinery.objects import LOCAL_NODE
 
@@ -341,17 +342,32 @@ class NodeAgent:
         # out the heartbeat interval (prompt transitions, still 1 request)
         self._wake = threading.Event()
         self.batcher = StatusBatcher(on_dirty=self._wake.set)
-        self.executor = LocalExecutor(
-            store,
-            require_binding=True,
-            node_name=node_name,
-            logs_dir=self.logs_dir,
-            workdir=workdir,
-            extra_env=extra_env,
-            log_url_base=None,  # filled at start (needs the bound log port)
-            status_sink=self.batcher,
-            eviction_grace=eviction_grace,
-        )
+        if hollow is not None:
+            # kubemark mode (--hollow): the REAL agent loop — watch, bind
+            # pickup, heartbeats, one patch-batch per tick — over scripted
+            # phase transitions instead of process launches, so one host
+            # can stand in for a whole fleet (executor/hollow.py)
+            from mpi_operator_tpu.executor.hollow import HollowExecutor
+
+            self.executor = HollowExecutor(
+                store,
+                node_name=node_name,
+                timeline=hollow,
+                status_sink=self.batcher,
+                logs_dir=self.logs_dir,
+            )
+        else:
+            self.executor = LocalExecutor(
+                store,
+                require_binding=True,
+                node_name=node_name,
+                logs_dir=self.logs_dir,
+                workdir=workdir,
+                extra_env=extra_env,
+                log_url_base=None,  # filled at start (needs bound log port)
+                status_sink=self.batcher,
+                eviction_grace=eviction_grace,
+            )
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
 
@@ -670,6 +686,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "window a preempted trainer uses to force-"
                          "checkpoint; 0 = immediate SIGKILL")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--hollow", action="store_true",
+                    help="kubemark mode: run the real agent loop (watch, "
+                         "bind pickup, heartbeats, patch-batches) but walk "
+                         "pods through a SCRIPTED phase timeline instead of "
+                         "launching processes — control-plane scale testing "
+                         "without the hardware")
+    ap.add_argument("--hollow-run-s", type=float, default=0.5,
+                    help="--hollow: scripted Running duration per pod")
+    ap.add_argument("--hollow-pending-s", type=float, default=0.0,
+                    help="--hollow: bind-pickup to Running delay")
+    ap.add_argument("--hollow-failure-rate", type=float, default=0.0,
+                    help="--hollow: probability a pod terminates Failed "
+                         "(seeded; exercises the gang-restart paths)")
+    ap.add_argument("--hollow-seed", type=int, default=0)
     ap.add_argument("--tls-ca-file", default=None,
                     help="CA bundle (or the self-signed cert itself) to "
                          "verify a --store https://... against")
@@ -706,6 +736,16 @@ def main(argv=None) -> int:
               "(the admin tier anchors auth)", file=sys.stderr)
         return 2
     store = build_store(args.store, token=token, ca_file=args.tls_ca_file)
+    hollow = None
+    if args.hollow:
+        from mpi_operator_tpu.executor.hollow import HollowTimeline
+
+        hollow = HollowTimeline(
+            pending_s=args.hollow_pending_s,
+            run_s=args.hollow_run_s,
+            failure_rate=args.hollow_failure_rate,
+            seed=args.hollow_seed,
+        )
     try:
         agent = NodeAgent(
             store,
@@ -719,6 +759,7 @@ def main(argv=None) -> int:
             log_tokens=[t for t in (token, read_token) if t],
             ckpt_dir=args.ckpt_dir,
             eviction_grace=args.eviction_grace,
+            hollow=hollow,
         ).start()
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

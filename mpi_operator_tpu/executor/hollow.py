@@ -1,0 +1,660 @@
+"""Hollow node agents: kubemark for the TPU control plane.
+
+≙ kubernetes' kubemark/hollow-node: to measure the control plane at 1k
+nodes / 10k jobs you do not need 1k machines — you need 1k agents that
+exercise every CONTROL-PLANE path for real (watch, bind pickup, status
+patch-batches, Node heartbeats) while faking only the one thing that
+needs hardware: running the process. This module supplies that fake:
+
+- :class:`HollowExecutor` duck-types the LocalExecutor surface the
+  NodeAgent drives (start/stop/join_reapers/wait_idle/status_sink), but
+  instead of ``subprocess.Popen`` it walks each claimed pod through a
+  SCRIPTED phase timeline — Pending → Running after ``pending_s`` →
+  Succeeded/Failed after ``run_s`` (seeded per-pod jitter + failure
+  rate) — mirroring every transition through the SAME StatusBatcher /
+  ``patch_pod_status`` machinery a real agent uses, so the store sees
+  byte-identical traffic shapes and the chaos invariants
+  (tests/invariants.py) hold over hollow trails too.
+- ``NodeAgent(..., hollow=HollowTimeline(...))`` (the ``--hollow`` agent
+  flag) runs the REAL agent loop — registration, heartbeat ticks, batch
+  flushes, eviction handling — over a hollow executor: one process, one
+  node, zero workload processes.
+- :class:`HollowFleet` packs N hollow nodes into ONE process for the
+  scale bench: a single shared watch (fan-in, not N long-polls), a
+  single timer wheel (not N threads), heartbeats staggered across the
+  interval and shipped in CHUNKED patch-batches together with the dirty
+  pod mirrors — one host simulates 1k nodes / 100k pods against a real
+  StoreServer (``BENCH_CP_MODES=scale``).
+
+Run a fleet standalone against a live store::
+
+  python -m mpi_operator_tpu.executor.hollow \\
+      --store http://127.0.0.1:8475 --nodes 1000 --chips 32
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from mpi_operator_tpu.machinery.objects import (
+    NODE_NAMESPACE,
+    Node,
+    Pod,
+    PodPhase,
+    patch_pod_status,
+)
+from mpi_operator_tpu.machinery.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+)
+
+log = logging.getLogger("tpujob.hollow")
+
+
+@dataclass
+class HollowTimeline:
+    """The scripted pod lifecycle (≙ kubemark's pod lifecycle knobs).
+
+    ``pending_s``: bind-pickup → Running delay (scheduler-visible launch
+    latency). ``run_s`` + uniform ``run_jitter_s``: Running → terminal.
+    ``failure_rate``: probability the terminal phase is Failed with
+    ``failure_exit_code`` (drawn from a PER-POD rng seeded by ``seed`` +
+    the pod's identity, so a rerun of the same fleet is deterministic).
+    """
+
+    pending_s: float = 0.0
+    run_s: float = 0.2
+    run_jitter_s: float = 0.0
+    failure_rate: float = 0.0
+    failure_exit_code: int = 1
+    seed: int = 0
+
+    def pod_rng(self, namespace: str, name: str, uid: str) -> random.Random:
+        return random.Random(f"{self.seed}:{namespace}/{name}:{uid}")
+
+
+class _TimerWheel:
+    """One thread serving many scheduled callbacks (heapq): 100k hollow
+    pods cannot afford a threading.Timer thread each. Handles are dicts
+    with a ``cancelled`` flag — cancel is O(1), the heap entry is skipped
+    at fire time."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "_TimerWheel":
+        with self._cond:
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._run, name="hollow-timer-wheel", daemon=True
+                )
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def schedule(self, delay: float, fn) -> Dict[str, Any]:
+        handle = {"cancelled": False, "fn": fn}
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(
+                self._heap, (time.monotonic() + max(0.0, delay),
+                             self._seq, handle)
+            )
+            self._cond.notify()
+        return handle
+
+    @staticmethod
+    def cancel(handle: Dict[str, Any]) -> None:
+        handle["cancelled"] = True
+        handle["fn"] = None  # drop the closure (and its pod) promptly
+
+    def pending(self) -> int:
+        with self._cond:
+            return sum(
+                1 for (_, _, h) in self._heap if not h["cancelled"]
+            )
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if not self._heap:
+                    self._cond.wait(0.5)  # bounded: observes stop
+                    continue
+                due, _, handle = self._heap[0]
+                now = time.monotonic()
+                if due > now:
+                    self._cond.wait(min(due - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+                fn = None if handle["cancelled"] else handle["fn"]
+            if fn is None:
+                continue
+            try:
+                fn()
+            except Exception:
+                # one pod's transition must not stall the whole wheel
+                log.exception("hollow timer callback failed; continuing")
+
+
+class HollowExecutor:
+    """Scripted phase transitions behind the LocalExecutor surface.
+
+    Claims pods exactly like the real executor (bound to ``node_name``,
+    Pending), then walks them through the :class:`HollowTimeline` instead
+    of spawning processes. Mirrors ride ``status_sink`` (the NodeAgent's
+    StatusBatcher → one patch-batch per tick) when present, direct
+    uid+rv-guarded ``patch_pod_status`` otherwise — the same write paths,
+    guards included, as the real agent.
+    """
+
+    def __init__(self, store, *, node_name: str,
+                 timeline: Optional[HollowTimeline] = None,
+                 status_sink=None, wheel: Optional[_TimerWheel] = None,
+                 external_events: bool = False,
+                 logs_dir: str = ""):
+        self.store = store
+        self.node_name = node_name
+        self.timeline = timeline or HollowTimeline()
+        self.status_sink = status_sink
+        self.logs_dir = logs_dir
+        self.log_url_base: Optional[str] = None  # NodeAgent stamps; unused
+        # fleet mode: the fleet owns ONE watch and routes events here via
+        # handle_event() — N nodes, one long-poll, not N
+        self._external_events = external_events
+        self._own_wheel = wheel is None
+        self._wheel = wheel or _TimerWheel()
+        self._lock = threading.Lock()
+        # pod key → uid of the incarnation whose timeline is scheduled or
+        # finished (relist replays / duplicate deliveries are no-ops)
+        self._seen: Dict[str, str] = {}
+        # pod key → live wheel handles (cancelled on delete/evict)
+        self._handles: Dict[str, List[Dict[str, Any]]] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._watch_q = None
+
+    # -- lifecycle (the NodeAgent-driven surface) ---------------------------
+
+    def start(self) -> None:
+        self._wheel.start()
+        if not self._external_events:
+            self._watch_q = self.store.watch(None)
+            t = threading.Thread(
+                target=self._run, name=f"hollow-{self.node_name}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+            # adopt pods bound before the watch began (level-triggered,
+            # same as LocalExecutor.start's adoption pass)
+            for pod in self.store.list("Pod"):
+                self.observe(pod)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+        with self._lock:
+            handles = [h for hs in self._handles.values() for h in hs]
+            self._handles.clear()
+        for h in handles:
+            _TimerWheel.cancel(h)
+        if self._own_wheel:
+            self._wheel.stop()
+
+    def join_reapers(self, timeout: float = 2.0) -> None:
+        """No reap threads exist — transitions ride the timer wheel; the
+        surface exists so NodeAgent.stop() runs unchanged."""
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no scheduled transition is outstanding."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not any(
+                    not h["cancelled"]
+                    for hs in self._handles.values() for h in hs
+                ):
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # -- event intake -------------------------------------------------------
+
+    def _run(self) -> None:
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.handle_event(ev)
+            except Exception:
+                log.exception("hollow event handling failed; continuing")
+
+    def handle_event(self, ev) -> None:
+        """One watch event (fleet routing entry point)."""
+        if ev.kind != "Pod":
+            return
+        if ev.type == DELETED:
+            self._forget(ev.obj)
+        elif ev.type in (ADDED, MODIFIED):
+            self.observe(ev.obj)
+
+    def observe(self, pod: Pod) -> None:
+        """Level-triggered pickup: schedule the timeline for a newly bound
+        incarnation; cancel it when the pod finished externally (eviction
+        — the kubelet-kill equivalent: the 'process' dies with it)."""
+        if pod.spec.node_name != self.node_name:
+            return
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        uid = pod.metadata.uid
+        if pod.is_finished():
+            # external terminal (monitor eviction, drain): kill the
+            # scripted timeline exactly like a SIGKILL kills a process;
+            # _seen keeps the uid so a relist replay cannot resurrect it
+            with self._lock:
+                self._seen[key] = uid
+                handles = self._handles.pop(key, [])
+            for h in handles:
+                _TimerWheel.cancel(h)
+            return
+        if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+            return
+        with self._lock:
+            if self._seen.get(key) == uid:
+                return  # duplicate delivery / relist replay
+            self._seen[key] = uid
+            self._handles[key] = []
+        # a pod already RUNNING on first sight is a restarted hollow
+        # agent/fleet adopting its prior claims (the real agent's analog
+        # is _evict_orphans — here the scripted 'process' can simply
+        # resume): skip the Running mirror, arm only the terminal
+        # transition, or the pod would stay Running forever
+        self._schedule_timeline(
+            pod, key, uid,
+            already_running=pod.status.phase == PodPhase.RUNNING,
+        )
+
+    def _forget(self, pod: Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            self._seen.pop(key, None)
+            handles = self._handles.pop(key, [])
+        for h in handles:
+            _TimerWheel.cancel(h)
+
+    # -- the scripted lifecycle ---------------------------------------------
+
+    def _schedule_timeline(self, pod: Pod, key: str, uid: str,
+                           already_running: bool = False) -> None:
+        tl = self.timeline
+        rng = tl.pod_rng(pod.metadata.namespace, pod.metadata.name, uid)
+        run_s = tl.run_s + rng.uniform(0.0, tl.run_jitter_s)
+        failed = rng.random() < tl.failure_rate
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        rv = pod.metadata.resource_version or 0
+
+        def to_running():
+            self._mirror(ns, name, uid, rv, {
+                "phase": PodPhase.RUNNING, "ready": True, "reason": "",
+                "pod_ip": "127.0.0.1",
+            })
+
+        def to_terminal():
+            with self._lock:
+                if self._seen.get(key) != uid:
+                    return  # deleted/recreated while the timer was armed
+                self._handles.pop(key, None)
+            if failed:
+                self._mirror(ns, name, uid, rv, {
+                    "phase": PodPhase.FAILED, "ready": False,
+                    "reason": f"ExitCode{tl.failure_exit_code}",
+                    "message": "hollow scripted failure",
+                    "exit_code": tl.failure_exit_code,
+                })
+            else:
+                self._mirror(ns, name, uid, rv, {
+                    "phase": PodPhase.SUCCEEDED, "ready": False,
+                    "reason": "", "exit_code": 0,
+                })
+
+        handles = []
+        if not already_running:
+            handles.append(self._wheel.schedule(tl.pending_s, to_running))
+            handles.append(
+                self._wheel.schedule(tl.pending_s + run_s, to_terminal)
+            )
+        else:
+            # adopted mid-run: remaining runtime unknowable — restart the
+            # scripted clock from now (a restarted real process would
+            # also start over)
+            handles.append(self._wheel.schedule(run_s, to_terminal))
+        with self._lock:
+            if self._seen.get(key) == uid and key in self._handles:
+                self._handles[key].extend(handles)
+            else:
+                # evicted/deleted between scheduling and recording
+                for h in handles:
+                    _TimerWheel.cancel(h)
+
+    def _mirror(self, ns: str, name: str, uid: str, rv: int,
+                changes: Dict[str, Any]) -> None:
+        """One status transition, through the real write machinery: the
+        batcher (one patch-batch per agent tick, Conflict fallback with
+        incarnation + write-once-terminal guards) or the direct
+        uid-pinned ``patch_pod_status`` path."""
+        if self._stop.is_set():
+            return
+        if self.status_sink is not None:
+            self.status_sink.enqueue(ns, name, uid, rv, changes)
+            return
+        try:
+            patch_pod_status(
+                self.store, ns, name, uid, changes, expected_rv=rv,
+                what="hollow-mirror",
+            )
+        except Exception:
+            log.warning("hollow mirror of %s/%s failed", ns, name,
+                        exc_info=True)
+
+
+class HollowFleet:
+    """N hollow nodes in one process (the kubemark cluster shape).
+
+    Shared machinery instead of N× everything: ONE store watch routed to
+    per-node executors by ``spec.node_name``, ONE timer wheel, ONE
+    StatusBatcher, and a flusher that ships Node heartbeats (staggered
+    round the interval) together with the dirty pod mirrors as CHUNKED
+    patch-batch requests — store load is O(transitions + nodes/interval)
+    requests regardless of pod count, which is what lets one host drive
+    1k nodes / 100k pods against a real StoreServer.
+    """
+
+    def __init__(self, store, nodes: int, *,
+                 name_prefix: str = "hollow-",
+                 timeline: Optional[HollowTimeline] = None,
+                 capacity_chips: int = 32,
+                 advertise: str = "127.0.0.1",
+                 heartbeat_interval: float = 10.0,
+                 batch_items: int = 256):
+        from mpi_operator_tpu.executor.agent import StatusBatcher
+
+        self.store = store
+        self.timeline = timeline or HollowTimeline()
+        self.capacity_chips = capacity_chips
+        self.advertise = advertise
+        self.heartbeat_interval = heartbeat_interval
+        self.batch_items = batch_items
+        self.node_names = [f"{name_prefix}{i:04d}" for i in range(nodes)]
+        self._wake = threading.Event()
+        self.batcher = StatusBatcher(on_dirty=self._wake.set)
+        self.wheel = _TimerWheel()
+        self.executors: Dict[str, HollowExecutor] = {
+            name: HollowExecutor(
+                store, node_name=name, timeline=self.timeline,
+                status_sink=self.batcher, wheel=self.wheel,
+                external_events=True,
+            )
+            for name in self.node_names
+        }
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._watch_q = None
+        # node → next heartbeat due (monotonic), staggered across the
+        # interval so 1k nodes do not beat in one thundering tick
+        self._hb_due: Dict[str, float] = {}
+        self.stats = {"heartbeats": 0, "mirrors": 0, "batches": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "HollowFleet":
+        self.wheel.start()
+        for ex in self.executors.values():
+            ex.start()  # external_events: no watch, just arms the wheel
+        self._register_nodes()
+        now = time.monotonic()
+        n = max(1, len(self.node_names))
+        for i, name in enumerate(self.node_names):
+            self._hb_due[name] = now + (i / n) * self.heartbeat_interval
+        self._watch_q = self.store.watch(None)
+        pump = threading.Thread(
+            target=self._pump, name="hollow-fleet-pump", daemon=True
+        )
+        flush = threading.Thread(
+            target=self._flush_loop, name="hollow-fleet-flush", daemon=True
+        )
+        pump.start()
+        flush.start()
+        self._threads += [pump, flush]
+        # adopt pods bound before the watch began
+        for pod in self.store.list("Pod"):
+            ex = self.executors.get(pod.spec.node_name or "")
+            if ex is not None:
+                ex.observe(pod)
+        log.info("hollow fleet up: %d nodes, %d chips each",
+                 len(self.node_names), self.capacity_chips)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for ex in self.executors.values():
+            ex.stop()
+        self.wheel.stop()
+
+    # -- internals -----------------------------------------------------------
+
+    def _node_status(self, name: str) -> Dict[str, Any]:
+        return {
+            "address": self.advertise,
+            "capacity_chips": self.capacity_chips,
+            "ready": True,
+            "last_heartbeat": time.time(),
+        }
+
+    def _register_nodes(self) -> None:
+        for name in self.node_names:
+            node = Node()
+            node.metadata.namespace = NODE_NAMESPACE
+            node.metadata.name = name
+            node.status.address = self.advertise
+            node.status.capacity_chips = self.capacity_chips
+            node.status.ready = True
+            node.status.last_heartbeat = time.time()
+            try:
+                self.store.create(node)
+            except AlreadyExists:
+                # restarted fleet: the first heartbeat patch refreshes it
+                pass
+
+    def _pump(self) -> None:
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if ev.kind != "Pod":
+                continue
+            try:
+                ex = self.executors.get(ev.obj.spec.node_name or "")
+                if ex is not None:
+                    ex.handle_event(ev)
+            except Exception:
+                log.exception("hollow fleet routing failed; continuing")
+
+    def _flush_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._flush_once()
+            except Exception:
+                # store briefly unreachable past the client's retry window:
+                # mirrors were requeued, heartbeats re-due next pass
+                log.warning("hollow fleet flush failed; retrying",
+                            exc_info=True)
+
+    def _flush_once(self) -> None:
+        now = time.monotonic()
+        hb_nodes = [n for n, due in self._hb_due.items() if due <= now]
+        entries = self.batcher.drain()
+        if not hb_nodes and not entries:
+            return
+        # (wire item, originating batcher entry | None-for-heartbeats)
+        tagged: List[tuple] = []
+        for n in hb_nodes:
+            self._hb_due[n] = now + self.heartbeat_interval
+            tagged.append(({
+                "kind": "Node", "namespace": NODE_NAMESPACE, "name": n,
+                "subresource": "status",
+                "patch": {"status": self._node_status(n)},
+            }, None))
+        for e in entries:
+            patch: Dict[str, Any] = {"status": e["changes"]}
+            if e["rv"]:
+                patch["metadata"] = {"resource_version": e["rv"]}
+            tagged.append(({
+                "kind": "Pod", "namespace": e["namespace"],
+                "name": e["name"], "subresource": "status", "patch": patch,
+            }, e))
+        self.stats["heartbeats"] += len(hb_nodes)
+        self.stats["mirrors"] += len(entries)
+        # chunked: one giant 100k-item batch would stall the store's
+        # handler (and every other tenant) for its whole apply
+        for ofs in range(0, len(tagged), self.batch_items):
+            chunk = tagged[ofs:ofs + self.batch_items]
+            self.stats["batches"] += 1
+            try:
+                results = self.store.patch_batch([it for it, _ in chunk])
+            except Exception:
+                # the REQUEST failed: nothing in this or later chunks
+                # committed — requeue their mirrors for the next pass and
+                # re-due EVERY heartbeat this pass claimed (it was marked
+                # sent before the wire attempt; leaving it for a full
+                # interval could flap the node past the monitor's grace —
+                # a redundant re-send is an idempotent status patch)
+                self.batcher.requeue(
+                    [e for _, e in tagged[ofs:] if e is not None]
+                )
+                for n in hb_nodes:
+                    self._hb_due[n] = now
+                raise
+            for (_item, e), res in zip(chunk, results):
+                if e is None:
+                    continue  # heartbeat misses self-heal next beat
+                self._settle_pod(e, res)
+
+    def _settle_pod(self, e: Dict[str, Any], res: Any) -> None:
+        """Per-item result handling — the NodeAgent._tick contract:
+        Conflict → guarded re-read via patch_pod_status (incarnation +
+        write-once-terminal checks), NotFound → the pod is gone, forget
+        its anchor."""
+        try:
+            if isinstance(res, Conflict):
+                committed = patch_pod_status(
+                    self.store, e["namespace"], e["name"], e["uid"],
+                    e["changes"], what="hollow-fleet-mirror",
+                )
+                if committed is not None:
+                    self.batcher.note_committed(e, committed)
+            elif isinstance(res, NotFound):
+                self.batcher.forget(e["namespace"], e["name"])
+            elif isinstance(res, Exception):
+                log.warning("hollow mirror of %s/%s rejected: %s",
+                            e["namespace"], e["name"], res)
+            else:
+                self.batcher.note_committed(e, res)
+        except Exception:
+            self.batcher.requeue([e])
+            raise
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpu-hollow-fleet",
+        description="Simulate N hollow nodes against a live store "
+                    "(kubemark for the TPU control plane).",
+    )
+    ap.add_argument("--store", required=True,
+                    help="the shared store ('http://HOST:PORT')")
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--chips", type=int, default=32)
+    ap.add_argument("--prefix", default="hollow-")
+    ap.add_argument("--heartbeat", type=float, default=10.0)
+    ap.add_argument("--run-s", type=float, default=0.5,
+                    help="scripted Running duration per pod")
+    ap.add_argument("--failure-rate", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch-items", type=int, default=128,
+                    help="max patches per batch request flush")
+    ap.add_argument("--token-file", default=None)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    from mpi_operator_tpu.machinery.http_store import (
+        HttpStoreClient,
+        read_token_file,
+    )
+
+    # a generous request timeout: one chunked flush against a store busy
+    # with a 10k-job storm may legitimately take several seconds
+    store = HttpStoreClient(args.store, timeout=60.0,
+                            token=read_token_file(args.token_file))
+    fleet = HollowFleet(
+        store, args.nodes, name_prefix=args.prefix,
+        timeline=HollowTimeline(run_s=args.run_s,
+                                failure_rate=args.failure_rate,
+                                seed=args.seed),
+        capacity_chips=args.chips, heartbeat_interval=args.heartbeat,
+        batch_items=args.batch_items,
+    ).start()
+    print(f"hollow fleet of {args.nodes} nodes running", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    fleet.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

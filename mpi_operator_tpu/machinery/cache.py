@@ -183,11 +183,18 @@ class Lister:
         self,
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
+        copy: bool = True,
     ) -> List[Any]:
         """Same contract (and sort order) as ``store.list(kind, ...)``. When
         the selector carries an indexed label the candidate set is a dict
         hit; the remaining selector pairs and the namespace filter apply on
-        top."""
+        top.
+
+        ``copy=False`` returns the CACHED objects themselves — strictly
+        read-only for the caller, invalidated by the next watch apply (the
+        10k-job round: the gang scheduler's per-pass Node list deepcopied
+        1k Nodes 5×/second; a read-only snapshot is free). Callers that
+        mutate or retain the result must keep the default."""
         yield_point("cache.list", self.kind)
         with self._lock:
             candidates = None
@@ -208,7 +215,7 @@ class Lister:
                     m.labels.get(sk) != sv for sk, sv in selector.items()
                 ):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj.deepcopy() if copy else obj)
         out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
         return out
 
@@ -388,5 +395,6 @@ class InformerCache:
         kind: str,
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
+        copy: bool = True,
     ) -> List[Any]:
-        return self._listers[kind].list(namespace, selector)
+        return self._listers[kind].list(namespace, selector, copy=copy)

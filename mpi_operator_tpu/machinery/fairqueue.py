@@ -1,0 +1,354 @@
+"""APF-style per-tenant fair queuing + namespace quota admission.
+
+≙ kube-apiserver's Priority & Fairness (the flow-schema/fair-queuing
+layer the reference operator leans on at scale) plus the RBAC/quota
+admission layer of PAPER.md §1. The 10k-job regime surfaced the failure
+mode this module removes: the store server is thread-per-request, so one
+noisy tenant hammering LISTs occupies every handler thread and the other
+tenants' writes — and the watch pump feeding every informer — queue
+behind it unboundedly.
+
+Two pieces:
+
+- :class:`FairQueue` — admission control in front of the request
+  handlers. Requests are classified to a **tenant** (namespace, or token
+  tier for cluster-scoped traffic), and each tenant gets a bounded FIFO
+  wait queue plus an optional token-bucket rate limit. A fixed number of
+  concurrency **seats** (``max_inflight``) is dispatched round-robin
+  ACROSS tenants: when a seat frees, the next tenant in rotation runs,
+  so a tenant with 500 queued lists still yields every other seat to the
+  tenant with 1 queued write. Over-limit or over-queue requests are
+  load-shed with :class:`~mpi_operator_tpu.machinery.store.TooManyRequests`
+  (429 on the wire) instead of being allowed to park forever — the APF
+  posture: reject the noisy tenant, never starve the quiet one.
+- :class:`NamespaceQuota` — create-time admission caps per namespace
+  (max live jobs, max requested chips), rejecting with
+  :class:`~mpi_operator_tpu.machinery.store.QuotaExceeded` (403, typed).
+
+Watch long-polls are deliberately NOT seat-gated: they park by design
+(25s+), so one tenant's watchers would consume the whole seat pool doing
+nothing. They ARE rate-limited via :meth:`FairQueue.throttle` (the store
+server calls it on every watch request): a reconnect herd's relists are
+the single most expensive read the server serves and must drain the same
+token bucket as the tenant's other traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from mpi_operator_tpu.machinery.store import QuotaExceeded, TooManyRequests
+
+
+class _Seat:
+    """Held concurrency seat; releasing hands it to the next tenant in
+    round-robin rotation (see FairQueue._release)."""
+
+    __slots__ = ("_fq",)
+
+    def __init__(self, fq: "FairQueue"):
+        self._fq = fq
+
+    def __enter__(self) -> "_Seat":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._fq._release()
+
+
+class FairQueue:
+    """Bounded per-tenant queues with round-robin seat dispatch.
+
+    ``max_inflight``: concurrency seats shared by all tenants.
+    ``queue_limit``: per-tenant bounded wait queue; overflow → 429.
+    ``max_wait``: seconds a request may wait for a seat; timeout → 429
+    (a bounded queue that can park forever is not bounded).
+    ``rate``/``burst``: optional per-tenant token bucket (requests/s);
+    empty bucket → immediate 429, the noisy tenant's primary limiter.
+    """
+
+    def __init__(self, *, max_inflight: int = 16, queue_limit: int = 64,
+                 max_wait: float = 30.0, rate: Optional[float] = None,
+                 burst: Optional[float] = None):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if queue_limit < 0:
+            raise ValueError(f"queue_limit must be >= 0, got {queue_limit}")
+        self.max_inflight = max_inflight
+        self.queue_limit = queue_limit
+        self.max_wait = max_wait
+        self.rate = rate
+        self.burst = float(burst if burst is not None else (rate or 0) * 2)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        # tenant → FIFO of parked threading.Events (a seat handoff sets one)
+        self._waiting: Dict[str, deque] = {}
+        # tenant → (tokens, last_refill_monotonic)
+        self._buckets: Dict[str, tuple] = {}
+        self._last_tenant = ""
+        # observability snapshot counters (the metrics module mirrors these
+        # as tpu_operator_store_tenant_{queued,rejected}_total)
+        self.stats = {"admitted": 0, "queued": 0, "rejected": 0}
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, tenant: str) -> _Seat:
+        """Take a seat for ``tenant`` (blocking fairly, bounded), or raise
+        :class:`TooManyRequests`. Use as a context manager::
+
+            with fq.admit(tenant):
+                ... handle the request ...
+
+        The ``admin`` tenant (the operator's own system traffic) is
+        exempt from the token bucket — kube APF exempts the system flow
+        schemas the same way: a tenant hammering its namespace must not
+        rate-starve the CONTROLLER writes that reconcile that very
+        namespace's jobs. Admin traffic still takes seats (bounded
+        concurrency), where round-robin guarantees it a turn."""
+        if tenant != "admin":
+            self._take_token(tenant)
+        self._acquire_seat(tenant)
+        return _Seat(self)
+
+    def throttle(self, tenant: str) -> None:
+        """Rate-limit WITHOUT a seat — the watch-registration path: long
+        polls must not consume concurrency (they park by design), but a
+        reconnect/relist storm is real load (a relist is a full-store
+        dump) and must drain the same token bucket as the tenant's other
+        traffic. Raises :class:`TooManyRequests` when over."""
+        if tenant != "admin":
+            self._take_token(tenant)
+
+    def _reject(self, tenant: str, reason: str, msg: str) -> None:
+        from mpi_operator_tpu.opshell import metrics
+
+        self.stats["rejected"] += 1
+        metrics.store_tenant_rejected.inc(tenant=tenant, reason=reason)
+        raise TooManyRequests(msg)
+
+    # tenant-state bound: tenants are derived from request paths, so an
+    # adversarial (or merely enumerating) client could mint one bucket per
+    # distinct namespace string forever — prune the longest-idle buckets
+    # past this cap. An evicted tenant's next request just starts a fresh
+    # full bucket (one free burst — the cap is a memory bound, not a
+    # security boundary; kube APF bounds the same way via flow schemas).
+    _BUCKET_CAP = 4096
+
+    def _take_token(self, tenant: str) -> None:
+        if self.rate is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._buckets.get(tenant, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens < 1.0:
+                self._buckets[tenant] = (tokens, now)
+                over = True
+            else:
+                self._buckets[tenant] = (tokens - 1.0, now)
+                over = False
+            if len(self._buckets) > self._BUCKET_CAP:
+                for idle in sorted(
+                    self._buckets, key=lambda t: self._buckets[t][1]
+                )[:len(self._buckets) - self._BUCKET_CAP]:
+                    del self._buckets[idle]
+        if over:
+            self._reject(
+                tenant, "rate",
+                f"tenant {tenant!r} over its rate limit "
+                f"({self.rate:g} req/s, burst {self.burst:g})",
+            )
+
+    def _acquire_seat(self, tenant: str) -> None:
+        from mpi_operator_tpu.opshell import metrics
+
+        parked = None
+        with self._lock:
+            q = self._waiting.get(tenant)
+            if self._inflight < self.max_inflight and not q:
+                # free seat and no same-tenant waiters to overtake
+                self._inflight += 1
+                self.stats["admitted"] += 1
+                return
+            if q is None:
+                q = self._waiting[tenant] = deque()
+            if len(q) < self.queue_limit:
+                parked = threading.Event()
+                q.append(parked)
+                self.stats["queued"] += 1
+                metrics.store_tenant_queued.inc(tenant=tenant)
+        if parked is None:
+            self._reject(
+                tenant, "queue-full",
+                f"tenant {tenant!r} wait queue full "
+                f"({self.queue_limit} deep)",
+            )
+        if parked.wait(self.max_wait):
+            with self._lock:  # counter shares the locked discipline
+                self.stats["admitted"] += 1
+            return  # seat handed over by a releasing request
+        with self._lock:
+            if parked.is_set():
+                # dispatched concurrently with the timeout: the seat is ours
+                self.stats["admitted"] += 1
+                return
+            try:
+                self._waiting[tenant].remove(parked)
+            except (KeyError, ValueError):
+                pass
+        self._reject(
+            tenant, "timeout",
+            f"tenant {tenant!r} waited {self.max_wait:g}s for a seat",
+        )
+
+    def _release(self) -> None:
+        with self._lock:
+            # hand the seat to the next tenant in rotation (round-robin by
+            # tenant name, starting strictly after the last one served) —
+            # the fairness core: a tenant with a deep queue gets ONE seat
+            # per rotation, same as a tenant with one waiter. Drained
+            # tenants' empty deques are pruned here (same unbounded-
+            # tenant-string concern as the token buckets).
+            for t in [t for t, q in self._waiting.items() if not q]:
+                del self._waiting[t]
+            tenants = sorted(self._waiting)
+            if not tenants:
+                self._inflight -= 1
+                return
+            after = [t for t in tenants if t > self._last_tenant]
+            chosen = after[0] if after else tenants[0]
+            self._last_tenant = chosen
+            self._waiting[chosen].popleft().set()  # seat transferred
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Queue depths + counters (the runbook's 'tenant starved?' probe)."""
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "waiting": {t: len(q) for t, q in self._waiting.items() if q},
+                **self.stats,
+            }
+
+
+class NamespaceQuota:
+    """Create-time namespace quota admission (max jobs / max chips).
+
+    ``quotas`` maps namespace → ``{"max_jobs": N, "max_chips": M}`` (either
+    key optional). Checked against the backing store's LIVE (non-finished)
+    jobs at create time; a concurrent pair of creates can overshoot by the
+    race window — the same eventually-consistent posture as kube's quota
+    controller, acceptable because the cap defends capacity, not
+    invariants. Namespaces without an entry are unlimited.
+    """
+
+    def __init__(self, quotas: Dict[str, Dict[str, int]]):
+        for ns, q in quotas.items():
+            unknown = set(q) - {"max_jobs", "max_chips"}
+            if unknown:
+                raise ValueError(
+                    f"quota for namespace {ns!r}: unknown keys "
+                    f"{sorted(unknown)} (use max_jobs/max_chips)"
+                )
+            for k, v in q.items():
+                # values fail closed at LOAD time: a hand-edited "10"
+                # (string) passing here would turn every create in the
+                # namespace into an opaque 500 at its first comparison
+                if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                    raise ValueError(
+                        f"quota for namespace {ns!r}: {k} must be a "
+                        f"non-negative integer, got {v!r}"
+                    )
+        self.quotas = {ns: dict(q) for ns, q in quotas.items()}
+
+    @staticmethod
+    def _job_chips(job: Any) -> int:
+        spec = getattr(job, "spec", None)
+        worker = getattr(spec, "worker", None)
+        slice_ = getattr(spec, "slice", None)
+        replicas = getattr(worker, "replicas", 0) or 0
+        chips = getattr(slice_, "chips_per_host", 1) or 1
+        return replicas * chips
+
+    def check_create(self, backing: Any, obj: Any) -> None:
+        """Raise :class:`QuotaExceeded` when creating ``obj`` (a TPUJob)
+        would exceed its namespace's caps; no-op for other kinds."""
+        if getattr(obj, "kind", "") != "TPUJob":
+            return
+        ns = obj.metadata.namespace
+        quota = self.quotas.get(ns)
+        if not quota:
+            return
+        from mpi_operator_tpu.api.conditions import is_finished
+
+        live: List[Any] = [
+            j for j in backing.list("TPUJob", ns)
+            if not is_finished(j.status)
+        ]
+        max_jobs = quota.get("max_jobs")
+        if max_jobs is not None and len(live) >= max_jobs:
+            raise QuotaExceeded(
+                f"namespace {ns!r} quota: {len(live)}/{max_jobs} live jobs "
+                f"(delete or finish one, or raise the quota)"
+            )
+        max_chips = quota.get("max_chips")
+        if max_chips is not None:
+            used = sum(self._job_chips(j) for j in live)
+            want = self._job_chips(obj)
+            if used + want > max_chips:
+                raise QuotaExceeded(
+                    f"namespace {ns!r} quota: job wants {want} chips but "
+                    f"{used}/{max_chips} are already requested"
+                )
+
+
+def parse_fair_queue(spec: Optional[str]) -> Optional[FairQueue]:
+    """Build a FairQueue from the CLI spec ``inflight=16,queue=64,
+    rate=200,burst=400`` (any subset; unknown keys fail closed — a typo'd
+    knob silently ignored would be an invisible policy downgrade)."""
+    if not spec:
+        return None
+    kwargs: Dict[str, Any] = {}
+    names = {"inflight": "max_inflight", "queue": "queue_limit",
+             "rate": "rate", "burst": "burst", "max_wait": "max_wait"}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        if not sep or key.strip() not in names:
+            raise ValueError(
+                f"--fair-queue: expected key=value with keys "
+                f"{sorted(names)}, got {part!r}"
+            )
+        try:
+            num = float(val)
+        except ValueError:
+            raise ValueError(f"--fair-queue: {part!r} is not numeric") from None
+        dest = names[key.strip()]
+        kwargs[dest] = int(num) if dest in ("max_inflight",
+                                            "queue_limit") else num
+    return FairQueue(**kwargs)
+
+
+def load_quota_file(path: Optional[str]) -> Optional[NamespaceQuota]:
+    """Parse a quota JSON file ``{"ns": {"max_jobs": N, "max_chips": M}}``.
+    Fails closed on malformed content (a truncated quota file silently
+    becoming 'unlimited' would be an invisible policy downgrade)."""
+    if not path:
+        return None
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or not all(
+        isinstance(v, dict) for v in data.values()
+    ):
+        raise ValueError(
+            f"quota file {path!r}: expected "
+            '{"namespace": {"max_jobs": N, "max_chips": M}}'
+        )
+    return NamespaceQuota(data)

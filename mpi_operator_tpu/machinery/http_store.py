@@ -63,7 +63,9 @@ from mpi_operator_tpu.machinery.store import (
     Forbidden,
     NotFound,
     NotLeader,
+    QuotaExceeded,
     ReplicationUnavailable,
+    TooManyRequests,
     Unauthorized,
     WatchEvent,
     patch_batch_via_loop,
@@ -81,12 +83,19 @@ _ERROR_CLASSES = {
     "BadPatch": BadPatch,
     "NotLeader": NotLeader,
     "ReplicationUnavailable": ReplicationUnavailable,
+    "TooManyRequests": TooManyRequests,
+    "QuotaExceeded": QuotaExceeded,
 }
 
 # Store objects are manifests and status records — O(KB). The cap keeps an
 # untrusted peer from driving a multi-GB allocation through Content-Length
 # (same posture as tpucoll.cc's kMaxCount on the native wire).
 _MAX_BODY_BYTES = 8 << 20
+
+# Largest POST body the fair-queue tenant classifier will json.loads just
+# to learn the namespace: a shed tenant's create must cost at most a
+# bounded parse before its 429, never the full 8 MB one.
+_TENANT_PARSE_CAP = 64 << 10
 
 
 class _BodyTooLarge(Exception):
@@ -214,6 +223,75 @@ def parse_listen(spec: str) -> Tuple[str, int]:
 # ---------------------------------------------------------------------------
 
 
+# Watch fan-out encode accounting (the 10k-job round's O(events) proof):
+# time spent turning committed events into response bytes, server-wide.
+# With preencoding (the default) each event is JSON-encoded ONCE at append
+# and every watcher's response is assembled by byte-joining the cached
+# segments — growing watchers grows only the cheap join, so this clock is
+# O(events). The legacy path (preencode=False, kept for the A/B bench)
+# re-runs the wire-dict build + json.dumps per watcher per poll:
+# O(watchers × events). bench_controlplane.py's fanout mode reads this.
+_WATCH_ENCODE_LOCK = threading.Lock()
+_WATCH_ENCODE_STATS = {
+    "events_encoded": 0,   # json.dumps runs over event payloads
+    "payloads": 0,         # watch response bodies produced
+    "payload_bytes": 0,
+    "encode_s": 0.0,       # wall time in json ENCODING of event data
+    "assembly_s": 0.0,     # wall time byte-joining cached segments
+}
+
+
+def _note_watch_encode(dt: float, events: int = 0, payloads: int = 0,
+                       nbytes: int = 0, assembly: bool = False) -> None:
+    with _WATCH_ENCODE_LOCK:
+        _WATCH_ENCODE_STATS["assembly_s" if assembly else "encode_s"] += dt
+        _WATCH_ENCODE_STATS["events_encoded"] += events
+        _WATCH_ENCODE_STATS["payloads"] += payloads
+        _WATCH_ENCODE_STATS["payload_bytes"] += nbytes
+
+
+def watch_encode_stats() -> Dict[str, Any]:
+    """Snapshot of the server-side watch encode/delivery cost counters."""
+    with _WATCH_ENCODE_LOCK:
+        return dict(_WATCH_ENCODE_STATS)
+
+
+def reset_watch_encode_stats() -> None:
+    with _WATCH_ENCODE_LOCK:
+        for k in _WATCH_ENCODE_STATS:
+            _WATCH_ENCODE_STATS[k] = (
+                0.0 if k in ("encode_s", "assembly_s") else 0
+            )
+
+
+class _Preencoded:
+    """A response body assembled from cached byte segments (the watch
+    fan-out hot path): ``_send`` writes it verbatim instead of running
+    json.dumps over a payload dict every watcher already paid for once.
+    Either a fully-formed ``body`` or ``(prefix, segments, suffix)`` to
+    byte-join lazily (assembled once, cached)."""
+
+    __slots__ = ("_body", "_prefix", "_segments", "_suffix")
+
+    def __init__(self, body: Optional[bytes] = None,
+                 prefix: bytes = b"", segments: Optional[List[bytes]] = None,
+                 suffix: bytes = b""):
+        self._body = body
+        self._prefix = prefix
+        self._segments = segments or []
+        self._suffix = suffix
+
+    def assemble(self) -> bytes:
+        if self._body is None:
+            t0 = time.perf_counter()
+            self._body = self._prefix + b",".join(self._segments) + self._suffix
+            _note_watch_encode(
+                time.perf_counter() - t0, payloads=1,
+                nbytes=len(self._body), assembly=True,
+            )
+        return self._body
+
+
 class _RegistrationBarrier:
     """Sentinel pushed through the drain queue at watch registration: the
     backing store enqueues events in commit order, so once the drain thread
@@ -239,13 +317,19 @@ class _EventLog:
     full history past that rv.
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, preencode: bool = True):
         self.capacity = capacity
+        self.preencode = preencode
         self._cond = threading.Condition()
-        # (seq, etype, kind, data, rv, origin, ts): origin is the writing
-        # span's (trace_id, span_id) or None, ts the commit time — both
-        # ride the wire so a remote informer can link the work an event
-        # causes back to the write that produced it (machinery/trace.py)
+        # (seq, etype, kind, data, rv, origin, ts[, wire]): origin is the
+        # writing span's (trace_id, span_id) or None, ts the commit time —
+        # both ride the wire so a remote informer can link the work an
+        # event causes back to the write that produced it
+        # (machinery/trace.py). ``wire`` is the event's encoded wire BYTES,
+        # computed once at append so fan-out to N watchers byte-joins
+        # cached segments instead of re-running json.dumps N times
+        # (O(events), not O(watchers×events) — preencode=False keeps the
+        # legacy per-watcher path for the A/B bench).
         self._events: List[Tuple] = []
         self._next_seq = 1
         # rv completeness bounds for resume_after_rv: events with
@@ -273,10 +357,24 @@ class _EventLog:
 
     def append(self, etype: str, kind: str, data: Dict[str, Any],
                rv: int = 0, origin: Any = None, ts: float = 0.0) -> None:
+        rest = None
+        if self.preencode:
+            # THE one json.dumps this event ever gets: every watcher's
+            # long-poll response joins this cached segment by bytes. Run
+            # OUTSIDE the condition lock (a large manifest's encode would
+            # otherwise convoy every parked watch reader behind the write
+            # path); only the seq — assigned under the lock — is spliced
+            # in afterwards, a constant-cost bytes format.
+            t0 = time.perf_counter()
+            wire = _event_wire((0, etype, kind, data, rv, origin, ts))
+            del wire["seq"]
+            rest = json.dumps(wire).encode()[1:]  # '"type": ...}'
+            _note_watch_encode(time.perf_counter() - t0, events=1)
         with self._cond:
-            self._events.append(
-                (self._next_seq, etype, kind, data, rv, origin, ts)
-            )
+            entry = (self._next_seq, etype, kind, data, rv, origin, ts)
+            if rest is not None:
+                entry = entry + (b'{"seq": %d, ' % self._next_seq + rest,)
+            self._events.append(entry)
             self._next_seq += 1
             self._max_rv = max(self._max_rv, rv)
             if len(self._events) > self.capacity:
@@ -344,8 +442,18 @@ class StoreServer:
                  auth_reads: bool = False, read_token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 agent_tokens: Optional[Dict[str, str]] = None):
+                 agent_tokens: Optional[Dict[str, str]] = None,
+                 preencode: bool = True,
+                 fairness: Optional[Any] = None,
+                 quota: Optional[Any] = None):
         self.backing = backing
+        # APF-style per-tenant admission (machinery/fairqueue.FairQueue):
+        # None = open admission (the pre-scale-out behavior). Watch
+        # long-polls and probes bypass the seat gate (they park by design).
+        self.fairness = fairness
+        # namespace quota admission (fairqueue.NamespaceQuota): checked on
+        # TPUJob creates, rejects with a typed 403 QuotaExceeded
+        self.quota = quota
         # three token tiers (≙ kube RBAC: the aggregated edit-vs-view split
         # of /root/reference/manifests/base/cluster-role.yaml:96-151, plus
         # the node-scoped kubelet credential model):
@@ -391,7 +499,7 @@ class StoreServer:
         # restarted server (fresh seqs) can't be confused with the old one
         # even after the new log catches up past a stale cursor
         self.instance = uuid.uuid4().hex
-        self._log = _EventLog(capacity=log_capacity)
+        self._log = _EventLog(capacity=log_capacity, preencode=preencode)
         self._stop = threading.Event()
         server = self
 
@@ -411,8 +519,14 @@ class StoreServer:
             def log_message(self, fmt, *args):  # quiet
                 pass
 
-            def _send(self, code: int, payload: Dict[str, Any]) -> None:
-                body = json.dumps(payload).encode()
+            def _send(self, code: int, payload: Any) -> None:
+                # preencoded-segments path (watch fan-out): the body is
+                # byte-joined from per-event segments each encoded ONCE at
+                # commit — this method must never re-serialize them
+                if isinstance(payload, _Preencoded):
+                    body = payload.assemble()
+                else:
+                    body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -439,7 +553,13 @@ class StoreServer:
                 token or (403, msg) for a valid token outside its scope.
                 ``body`` is a CALLABLE returning the parsed body — only the
                 agent tier (already authenticated) ever parses it, so
-                anonymous peers cannot drive json.loads CPU."""
+                anonymous peers cannot drive json.loads CPU. Stashes the
+                matched token tier on the handler (``self._tier``) so the
+                fair-queue tenant classification reuses it instead of
+                re-running the O(tokens) constant-time scan — at a
+                1k-entry agent-tokens file that second scan would double
+                the auth cost of every admitted request."""
+                self._tier = None
                 if server.token is None and not server.agent_tokens:
                     return None
                 if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
@@ -460,6 +580,12 @@ class StoreServer:
                     if matched is not None and not (is_admin or is_read)
                     else None
                 )
+                if is_admin:
+                    self._tier = "admin"
+                elif is_read:
+                    self._tier = "read"
+                elif agent_node is not None:
+                    self._tier = ("node", agent_node)
                 if method == "GET":
                     if not server.auth_reads:
                         return None
@@ -510,11 +636,50 @@ class StoreServer:
                             "message": msg,
                         })
                         return
-                    code, payload = server._handle_traced(
-                        method, self.path,
-                        self.headers.get(trace.TRACEPARENT_HEADER, ""),
-                        body() if method in ("POST", "PUT", "PATCH") else {},
-                    )
+                    seat = None
+                    if server.fairness is not None:
+                        # APF admission AFTER authn (the tenant identity is
+                        # trustworthy) and BEFORE any backing-store work:
+                        # over-limit tenants are shed here at bounded cost.
+                        # Classification parses a POST body only below
+                        # _TENANT_PARSE_CAP — an 8 MB create from an
+                        # already-shed tenant must not buy a full
+                        # json.loads before its 429 (oversized bodies
+                        # classify by token tier instead).
+                        try:
+                            tenant = server._tenant_of(
+                                method, self.path,
+                                body=(
+                                    body()
+                                    if method == "POST"
+                                    and len(raw) <= _TENANT_PARSE_CAP
+                                    else None
+                                ),
+                                tier=self._tier,
+                            )
+                            if server._fair_gated(method, self.path):
+                                seat = server.fairness.admit(tenant)
+                            elif _route_parts(self.path) == ["v1", "watch"]:
+                                # long-polls skip the seat pool (they park
+                                # by design) but a reconnect/relist storm
+                                # still drains the tenant's token bucket
+                                server.fairness.throttle(tenant)
+                        except TooManyRequests as e:
+                            self._send(429, {
+                                "error": "TooManyRequests",
+                                "message": str(e),
+                            })
+                            return
+                    try:
+                        code, payload = server._handle_traced(
+                            method, self.path,
+                            self.headers.get(trace.TRACEPARENT_HEADER, ""),
+                            body() if method in ("POST", "PUT", "PATCH")
+                            else {},
+                        )
+                    finally:
+                        if seat is not None:
+                            seat.__exit__(None, None, None)
                     self._send(code, payload)
                 except json.JSONDecodeError as e:
                     # malformed body from an (authenticated) peer: a 400,
@@ -563,6 +728,14 @@ class StoreServer:
                 self._dispatch("DELETE")
 
         class QuietThreadingHTTPServer(ThreadingHTTPServer):
+            # listen(2) backlog: socketserver's default of 5 silently
+            # RSTs concurrent connects the moment a fleet of agents (or a
+            # watcher herd re-polling after a sever) dials in together —
+            # at 1k hollow nodes the scale bench hit exactly this. 512 ≙
+            # the order kube-apiserver serves; the kernel clamps to
+            # net.core.somaxconn anyway.
+            request_queue_size = 512
+
             def handle_error(self, request, client_address):
                 # port scanners / plain-HTTP probes against a TLS listener
                 # fail their deferred handshake in the handler thread; one
@@ -682,6 +855,75 @@ class StoreServer:
             metrics.store_write_requests.inc(verb=what)
         elif what == "conflict":
             metrics.store_write_conflicts.inc()
+
+    # -- fair-queuing admission (APF) ---------------------------------------
+
+    @staticmethod
+    def _fair_gated(method: str, path: str) -> bool:
+        """Routes the fair queue's concurrency seats apply to: everything
+        except watch long-polls (they PARK by design — seat-gating them
+        would let one tenant's idle watchers consume the whole pool) and
+        the healthz/replica-status probes (liveness must not queue behind
+        tenant load — a starved probe reads as a dead store)."""
+        parts = _route_parts(path)
+        if parts == ["healthz"] or parts == ["v1", "replica", "status"]:
+            return False
+        if parts == ["v1", "watch"] and method == "GET":
+            return False
+        return True
+
+    def _tenant_of(self, method: str, path: str,
+                   auth_header: Optional[str] = None,
+                   body: Optional[Dict[str, Any]] = None,
+                   tier: Any = None) -> str:
+        """Classify a request to its fairness tenant: the NAMESPACE for
+        object routes (the natural multi-tenancy boundary — one team's
+        list storm is that team's tenant; creates carry it in the body),
+        the token tier otherwise (``node:<name>`` for agent credentials,
+        ``admin``/``read`` for the shared tiers, ``anon`` for
+        unauthenticated traffic). Agent tokens classify by node identity
+        even on object routes so a misbehaving node cannot launder load
+        through its pods' namespaces. ``tier`` is the identity
+        ``_auth_error`` already matched ("admin"/"read"/("node", name)/
+        None) — pass ``auth_header`` instead only where no prior auth ran
+        (direct callers, tests)."""
+        if tier is None and auth_header:
+            matched = check_bearer(
+                auth_header,
+                (self.token, self.read_token, *self.agent_tokens),
+            )
+            if matched is self.token and matched is not None:
+                tier = "admin"
+            elif matched is self.read_token and matched is not None:
+                tier = "read"
+            elif matched is not None:
+                tier = ("node", self.agent_tokens[matched])
+        if isinstance(tier, tuple):
+            return f"node:{tier[1]}"
+        if tier == "admin":
+            # system traffic outranks namespace attribution (≙ kube APF's
+            # exempt system flow schemas): the controller's writes INTO a
+            # noisy tenant's namespace must not land in that tenant's
+            # bucket, or the tenant's own client could rate-starve its
+            # jobs' reconciliation
+            return "admin"
+        parts = _route_parts(path)
+        if parts[:2] == ["v1", "objects"] and len(parts) >= 4:
+            return f"ns:{parts[3]}"
+        if parts[:2] == ["v1", "objects"] and len(parts) == 3:
+            qs = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+            ns = qs.get("namespace", [None])[0]
+            if ns:
+                return f"ns:{ns}"
+        if parts == ["v1", "objects"] and method == "POST" and body:
+            obj = body.get("object")
+            meta = obj.get("metadata") if isinstance(obj, dict) else None
+            ns = meta.get("namespace") if isinstance(meta, dict) else None
+            if ns:
+                return f"ns:{ns}"
+        if tier == "read":
+            return "read"
+        return "anon"
 
     # -- authorization ------------------------------------------------------
 
@@ -1011,6 +1253,14 @@ class StoreServer:
             # must surface it so the caller can re-read first
             return 503, {"error": "ReplicationUnavailable",
                          "message": str(e)}
+        except QuotaExceeded as e:
+            # BEFORE the subsumed classes: a typed quota denial carries the
+            # actionable "raise the quota or free capacity" message
+            return 403, {"error": "QuotaExceeded", "message": str(e)}
+        except TooManyRequests as e:
+            # a backing store may load-shed too (a replica proxying to a
+            # fair-queued leader): surface, never mask as a 500
+            return 429, {"error": "TooManyRequests", "message": str(e)}
         except BadPatch as e:
             return 400, {"error": "BadPatch", "message": str(e)}
         except KeyError as e:  # unknown kind from serialize registry
@@ -1025,6 +1275,10 @@ class StoreServer:
     ) -> Tuple[int, Dict[str, Any]]:
         if method == "POST" and not rest:
             obj = decode(body["kind"], body["object"])
+            if self.quota is not None:
+                # namespace quota admission (the reference's ResourceQuota
+                # layer): a typed 403 BEFORE the create hits the backing
+                self.quota.check_create(self.backing, obj)
             self._count("create")
             created = self.backing.create(obj)
             return 200, {"object": encode(created)}
@@ -1163,11 +1417,36 @@ class StoreServer:
         if events is None:
             # cursor fell off the window → rv resume or relist ('rv too old')
             return 200, self._resume_or_relist(resume_rv)
-        return 200, {
+        return 200, self._watch_payload(events, head)
+
+    def _watch_payload(self, events: List[Tuple], next_seq: int) -> Any:
+        """A watch response for ``events``. Preencoded path (default):
+        byte-join each event's cached wire segment — the ONE json.dumps
+        per event already ran at append, so serving N watchers costs N
+        byte-joins, not N re-encodes (O(events) fan-out). Legacy path
+        (``preencode=False``, the A/B bench baseline): rebuild the wire
+        dict and json.dumps the whole payload per watcher — the
+        O(watchers×events) shape this round removed."""
+        if self._log.preencode and all(
+            len(e) > 7 and e[7] is not None for e in events
+        ):
+            return _Preencoded(
+                prefix=b'{"events":[',
+                segments=[e[7] for e in events],
+                suffix=b'],"next":%d,"instance":"%s"}'
+                       % (next_seq, self.instance.encode()),
+            )
+        t0 = time.perf_counter()
+        body = json.dumps({
             "events": [_event_wire(e) for e in events],
-            "next": head,
+            "next": next_seq,
             "instance": self.instance,
-        }
+        }).encode()
+        _note_watch_encode(
+            time.perf_counter() - t0,
+            events=len(events), payloads=1, nbytes=len(body),
+        )
+        return _Preencoded(body=body)
 
     def _resume_or_relist(self, resume_rv: Optional[int]) -> Dict[str, Any]:
         """Serve an rv-anchored resume from the event ring when the ring
@@ -1176,11 +1455,9 @@ class StoreServer:
         if resume_rv is not None:
             events = self._log.resume_after_rv(resume_rv)
             if events is not None:
-                return {
-                    "events": [_event_wire(e) for e in events],
-                    "next": events[-1][0] if events else self._log.head,
-                    "instance": self.instance,
-                }
+                return self._watch_payload(
+                    events, events[-1][0] if events else self._log.head
+                )
         return self._relist_payload()
 
     def _relist_payload(self) -> Dict[str, Any]:
@@ -1249,7 +1526,8 @@ class HttpStoreClient:
                  ca_file: Optional[str] = None,
                  conn_refused_retries: int = 5,
                  retry_base_delay: float = 0.1,
-                 not_leader_redirects: int = 3):
+                 not_leader_redirects: int = 3,
+                 watch_retry_base: float = 0.5):
         urls = url.split(",") if isinstance(url, str) else list(url)
         self._endpoints = [u.strip().rstrip("/") for u in urls if u.strip()]
         if not self._endpoints:
@@ -1276,6 +1554,11 @@ class HttpStoreClient:
         self.conn_refused_retries = conn_refused_retries
         self.retry_base_delay = retry_base_delay
         self.not_leader_redirects = not_leader_redirects
+        # watch re-poll backoff base: the actual delay is JITTERED per
+        # client (see _watch_retry_delay) — N watchers severed together by
+        # one server restart must NOT re-poll in lockstep, or every
+        # recovery becomes a thundering herd of simultaneous relists
+        self.watch_retry_base = watch_retry_base
         self._retry_rng = random.Random(f"{id(self)}:{self._endpoints[0]}")
         # observable by tests/benches: how often each failover path fired
         self.retry_stats = {"conn_refused_retries": 0,
@@ -1598,6 +1881,16 @@ class HttpStoreClient:
         with self._lock:
             self._relist_listeners.append(cb)
 
+    def _watch_retry_delay(self) -> float:
+        """Jittered watch re-poll backoff in [0.5, 1.5] × the base: N
+        clients severed by the same server restart spread their resume
+        polls across a full base-width window instead of stampeding the
+        just-recovered server in lockstep (each resume can be a relist —
+        the single most expensive read the server serves). Seeded per
+        client instance, so the spread is deterministic within a process
+        (pinned by the spread test in tests/test_http_store.py)."""
+        return self.watch_retry_base * (0.5 + self._retry_rng.uniform(0, 1.0))
+
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -1617,7 +1910,7 @@ class HttpStoreClient:
                 # its ring when provable — the relist is the fallback, not
                 # the first resort
                 log.debug("watch poll failed; retrying", exc_info=True)
-                if self._stop.wait(0.5):
+                if self._stop.wait(self._watch_retry_delay()):
                     return
                 continue
             try:
@@ -1674,7 +1967,7 @@ class HttpStoreClient:
                 # dead poll thread would silently stall every watcher
                 # forever — back off and retry instead, same as unreachable
                 log.debug("malformed watch response; retrying", exc_info=True)
-                if self._stop.wait(0.5):
+                if self._stop.wait(self._watch_retry_delay()):
                     return
 
     @staticmethod
@@ -1717,6 +2010,11 @@ def main(argv=None) -> int:
                     help="'memory' or 'sqlite:PATH' backing store")
     ap.add_argument("--listen", default="127.0.0.1:8475",
                     help="host:port to bind")
+    ap.add_argument("--log-capacity", type=int, default=4096,
+                    help="watch event-ring size (events retained for "
+                         "?resource_version= resume before clients must "
+                         "relist); size it above the burst a lagging "
+                         "watcher may miss — a 10k-job storm wants 64k+")
     ap.add_argument("--token-file", default=None,
                     help="file holding the ADMIN bearer token; when set, "
                          "every mutating request must present it")
@@ -1732,6 +2030,15 @@ def main(argv=None) -> int:
                          "present theirs via their --token-file")
     ap.add_argument("--auth-reads", action="store_true",
                     help="require a token (any tier) on reads/watches too")
+    ap.add_argument("--fair-queue", default=None, metavar="SPEC",
+                    help="APF-style per-tenant fair queuing: "
+                         "'inflight=16,queue=64,rate=200,burst=400' (any "
+                         "subset; rate in req/s per tenant); over-limit "
+                         "requests get 429 TooManyRequests")
+    ap.add_argument("--quota-file", default=None, metavar="PATH",
+                    help='namespace quota admission: JSON {"namespace": '
+                         '{"max_jobs": N, "max_chips": M}}; over-quota '
+                         "TPUJob creates get a typed 403 QuotaExceeded")
     ap.add_argument("--tls-cert", default=None,
                     help="serve over TLS with this certificate (PEM; "
                          "self-signed acceptable — clients pin it with "
@@ -1756,6 +2063,16 @@ def main(argv=None) -> int:
         agent_tokens = read_agent_tokens_file(args.agent_tokens_file)
     except (OSError, ValueError) as e:
         raise SystemExit(f"error: token file: {e}") from None
+    from mpi_operator_tpu.machinery.fairqueue import (
+        load_quota_file,
+        parse_fair_queue,
+    )
+
+    try:
+        fairness = parse_fair_queue(args.fair_queue)
+        quota = load_quota_file(args.quota_file)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: {e}") from None
     if args.auth_reads and token is None:
         raise SystemExit("error: --auth-reads requires --token-file")
     if (read_token is not None or agent_tokens) and token is None:
@@ -1763,11 +2080,13 @@ def main(argv=None) -> int:
                          "require --token-file (the admin tier anchors auth)")
     server = StoreServer(
         backing, host, port, token=token,
+        log_capacity=args.log_capacity,
         # a read tier with open reads would be meaningless: configuring it
         # implies reads need a token (either tier)
         auth_reads=args.auth_reads or read_token is not None,
         read_token=read_token, agent_tokens=agent_tokens,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
+        fairness=fairness, quota=quota,
     ).start()
     print(f"store serving on {server.url}", flush=True)
     try:

@@ -61,6 +61,21 @@ class BadPatch(ValueError):
     retryable condition."""
 
 
+class TooManyRequests(RuntimeError):
+    """The store's fair-queuing admission rejected this request: the
+    caller's tenant is over its rate limit or its bounded wait queue is
+    full (machinery/fairqueue.py — the APF posture: load-shed the noisy
+    tenant instead of letting it starve everyone else). 429 on the wire.
+    DEFINITE: nothing was committed; retry after backing off."""
+
+
+class QuotaExceeded(Forbidden):
+    """A create was rejected by namespace quota admission (max jobs /
+    max chips per namespace — the reference's ResourceQuota layer,
+    PAPER.md §1). A policy denial, not a transient: 403 on the wire,
+    and retrying without freeing capacity will keep failing."""
+
+
 class NotLeader(RuntimeError):
     """A mutation reached a replica that is not the leased leader
     (machinery/replicated_store.py). DEFINITE: nothing was staged or

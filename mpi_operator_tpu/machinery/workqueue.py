@@ -10,12 +10,22 @@ drain loop processNextWorkItem :381-438). Semantics preserved:
   client-go defaults) via add_rate_limited(); forget() resets the failure
   count, ≙ the Forget/AddRateLimited pair in processNextWorkItem.
 - **Shutdown**: get() returns None after shutdown and the queue drains.
+
+:class:`ShardedRateLimitingQueue` (the 10k-job scale-out round) hash-
+partitions keys over N independent shards so dispatch no longer funnels
+every worker wakeup through ONE condition variable: at 10k live keys the
+single queue's lock is the bottleneck every reconcile crosses twice. The
+dedup/ordering contract is preserved ACROSS shards — a key being processed
+anywhere is never handed out again until done(), re-adds during processing
+coalesce and re-queue afterwards — and ``rebalance()`` re-hashes pending
+keys over a new shard count without losing any.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Set
 
 from mpi_operator_tpu.machinery.yieldpoints import yield_point
@@ -46,9 +56,12 @@ class RateLimitingQueue:
                 self._queue.append(key)
                 self._cond.notify()
 
-    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+    def get(self, timeout: Optional[float] = None,
+            shard: int = 0) -> Optional[str]:
         """Blocks until an item is available; returns None on shutdown or
-        timeout. The caller must call done(key) when finished."""
+        timeout. The caller must call done(key) when finished. ``shard``
+        is accepted (and ignored) so workers drive the single-queue and
+        sharded shapes through one call signature."""
         yield_point("wq.get")
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
@@ -71,6 +84,45 @@ class RateLimitingQueue:
             if key in self._dirty and key not in self._queue:
                 self._queue.append(key)
                 self._cond.notify()
+
+    def try_get(self) -> Optional[str]:
+        """Non-blocking get: a queued key or None, never waiting. The
+        sharded queue's cross-shard sweep rides this so one worker can
+        serve keys from shards no worker is parked on."""
+        with self._cond:
+            if not self._queue:
+                return None
+            key = self._queue.pop(0)
+            self._dirty.discard(key)
+            self._processing.add(key)
+            return key
+
+    def wait_for_item(self, timeout: float) -> bool:
+        """Park until this shard has a queued item (or shutdown/timeout)
+        WITHOUT popping it — the sharded queue's blocking leg: the actual
+        pop must happen atomically with its cross-shard ownership record
+        (under the parent lock), so waiters only observe readiness here
+        and loop back to the atomic sweep."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return bool(self._queue)
+
+    def drain_pending(self) -> List[str]:
+        """Atomically remove and return every QUEUED key (keys currently
+        being processed are untouched — their owner finishes them). The
+        sharded queue's rebalance uses this to re-hash pending work onto
+        a new shard layout without losing or duplicating keys."""
+        with self._cond:
+            keys = list(self._queue)
+            self._queue.clear()
+            for k in keys:
+                self._dirty.discard(k)
+            return keys
 
     def __len__(self) -> int:
         with self._lock:
@@ -115,6 +167,220 @@ class RateLimitingQueue:
                 t.cancel()
             self._timers.clear()
             self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._lock:
+            return self._shutdown
+
+
+class ShardedRateLimitingQueue:
+    """N hash-partitioned :class:`RateLimitingQueue` shards behind the
+    same surface (≙ splitting client-go's one workqueue per controller
+    into per-shard queues, the way kube's scheduler shards its scheduling
+    queue at scale).
+
+    - **Placement**: ``shard_of(key)`` = crc32(key) % shards — stable, so
+      a key's events always land on the same shard and per-key FIFO order
+      is preserved within it.
+    - **Never-concurrent**: the parent tracks which shard handed out each
+      in-flight key (``_owner``); an ``add()`` for a key being processed
+      anywhere is coalesced into ``_redirty`` and re-queued only at
+      ``done()`` — the single-queue dirty/processing contract, made safe
+      across shard boundaries (and across ``rebalance()``, where the
+      owning shard may no longer be in the live set).
+    - **Dispatch**: workers call ``get(timeout, shard=i)`` — a fast
+      non-blocking sweep over every shard starting at the worker's home
+      shard (so shards outnumbering workers still drain), then a blocking
+      wait on the home shard alone. No global condition variable exists:
+      at 10k keys, N shards mean N-way parallel dispatch instead of every
+      worker contending one lock.
+    - **Rate limiting**: per-key failure counts live at the parent (they
+      must survive rebalance), delays re-enter through the parent's
+      ``add()`` so the dedup guard applies.
+    """
+
+    def __init__(self, shards: int = 8, base_delay: float = 0.005,
+                 max_delay: float = 1000.0):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self._lock = threading.Lock()
+        self._shards: List[RateLimitingQueue] = [
+            RateLimitingQueue(base_delay, max_delay) for _ in range(shards)
+        ]
+        self._owner: Dict[str, RateLimitingQueue] = {}
+        self._redirty: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._timers: List[threading.Timer] = []
+        self._shutdown = False
+        self._base = base_delay
+        self._cap = max_delay
+
+    @property
+    def shards(self) -> int:
+        with self._lock:
+            return len(self._shards)
+
+    def shard_of(self, key: str) -> int:
+        """Stable shard index for ``key`` (crc32 — same keyed placement
+        idea as the controller's coordinator-port hashing)."""
+        with self._lock:
+            n = len(self._shards)
+        return zlib.crc32(key.encode()) % n
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if key in self._owner:
+                # being processed RIGHT NOW (possibly on a retired shard):
+                # coalesce — done() re-queues it exactly once. This is the
+                # cross-shard half of the dirty-while-processing contract.
+                self._redirty.add(key)
+                return
+            q = self._shards[zlib.crc32(key.encode()) % len(self._shards)]
+            # under the parent lock: an add racing rebalance()'s shard swap
+            # must not land on a retired shard after its drain already ran
+            q.add(key)
+
+    def get(self, timeout: Optional[float] = None,
+            shard: int = 0) -> Optional[str]:
+        """A key from this worker's home shard (``shard`` % N), or — when
+        the home shard is empty — from the first non-empty shard found in
+        a sweep; parks on the home shard's condition up to ``timeout``
+        otherwise. Returns None on timeout/shutdown.
+
+        The pop and its ``_owner`` record happen ATOMICALLY under the
+        parent lock (the same lock ``add()`` routes under): a pop whose
+        ownership were recorded late could race an ``add()`` of the same
+        key across a ``rebalance()`` shard swap onto a different live
+        shard — two workers holding one key. Blocking therefore rides
+        :meth:`RateLimitingQueue.wait_for_item` (observe-only, no pop)
+        and loops back to the atomic sweep."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._shutdown and not any(len(q) for q in self._shards):
+                    return None
+                shards = list(self._shards)
+                n = len(shards)
+                for i in range(n):
+                    q = shards[(shard + i) % n]
+                    key = q.try_get()
+                    if key is not None:
+                        self._owner[key] = q
+                        return key
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return None
+            # park on the HOME shard's condition (per-shard wakeups — no
+            # global condvar herd); a key landing on another shard is
+            # picked up by the next sweep when this wait times out, and a
+            # wait parked on a shard rebalance() just retired simply
+            # times out and re-sweeps the new layout
+            shards[shard % n].wait_for_item(
+                0.2 if remaining is None else min(remaining, 0.2)
+            )
+
+    def done(self, key: str) -> None:
+        with self._lock:
+            q = self._owner.pop(key, None)
+            redo = key in self._redirty
+            self._redirty.discard(key)
+        if q is not None:
+            q.done(key)
+            with self._lock:
+                retired = q not in self._shards
+            if retired:
+                # a shard-level dirty re-queue (the pre-owner-record add
+                # window) can land on a shard rebalance() already drained:
+                # sweep it onto the live layout so no key strands there
+                for k in q.drain_pending():
+                    self.add(k)
+        if redo:
+            self.add(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            shards = list(self._shards)
+            redirty = len(self._redirty)
+        return sum(len(q) for q in shards) + redirty
+
+    # -- rate limiting (parent-level: failure counts survive rebalance) ----
+
+    def num_requeues(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self._base * (2 ** n), self._cap)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        t = threading.Timer(delay, self.add, args=(key,))
+        t.daemon = True
+        with self._lock:
+            if self._shutdown:
+                return
+            self._timers.append(t)
+            self._timers = [
+                x for x in self._timers
+                if x.is_alive() or not x.finished.is_set()
+            ]
+        t.start()
+
+    # -- rebalance ----------------------------------------------------------
+
+    def rebalance(self, shards: int) -> int:
+        """Re-hash every PENDING key over ``shards`` fresh shards (the
+        worker-count-change path: shard count tracks threadiness so
+        dispatch parallelism matches the pool). Keys being processed keep
+        their owning (possibly now-retired) shard until done(), whose
+        re-queue rides the parent ``add()`` and lands on the new layout —
+        no key is lost or handed out twice across the transition. Returns
+        the number of keys migrated."""
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        with self._lock:
+            if self._shutdown:
+                return 0
+            old = self._shards
+            self._shards = [
+                RateLimitingQueue(self._base, self._cap)
+                for _ in range(shards)
+            ]
+        moved = 0
+        for q in old:
+            for key in q.drain_pending():
+                moved += 1
+                self.add(key)
+        return moved
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+            shards = list(self._shards)
+            owners = set(self._owner.values())
+        for q in shards:
+            q.shut_down()
+        for q in owners - set(shards):
+            q.shut_down()  # retired shards with in-flight keys
 
     @property
     def shutting_down(self) -> bool:

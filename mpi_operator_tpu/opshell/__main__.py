@@ -78,6 +78,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="for --serve-store: file of 'node-name:token' "
                          "lines — per-agent SCOPED credentials (reads + own "
                          "Node + pods bound to its node only)")
+    ap.add_argument("--fair-queue", default=None, metavar="SPEC",
+                    help="APF-style per-tenant fair queuing for "
+                         "--serve-store: 'inflight=16,queue=64,rate=200,"
+                         "burst=400' (any subset; rate in req/s per "
+                         "tenant). One noisy tenant's list storm can no "
+                         "longer starve another tenant's writes or watch "
+                         "pump; over-limit requests get 429. Default: "
+                         "open admission")
+    ap.add_argument("--quota-file", default=None, metavar="PATH",
+                    help="namespace quota admission for --serve-store: "
+                         'JSON {"namespace": {"max_jobs": N, "max_chips": '
+                         'M}}; over-quota TPUJob creates get a typed 403 '
+                         "QuotaExceeded")
     ap.add_argument("--tls-cert", default=None,
                     help="serve --serve-store over TLS with this certificate "
                          "(PEM; ≙ kube-apiserver's TLS on the same seam)")
@@ -198,6 +211,17 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"error: --serve-store: {e}", file=sys.stderr)
             return 2
+        from mpi_operator_tpu.machinery.fairqueue import (
+            load_quota_file,
+            parse_fair_queue,
+        )
+
+        try:
+            fairness = parse_fair_queue(args.fair_queue)
+            quota = load_quota_file(args.quota_file)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         store_server = StoreServer(
             store, host, port, token=token, read_token=read_token,
             agent_tokens=agent_tokens,
@@ -205,6 +229,7 @@ def main(argv=None) -> int:
             # standalone tpu-store entry point, which does the same)
             auth_reads=read_token is not None,
             tls_cert=args.tls_cert, tls_key=args.tls_key,
+            fairness=fairness, quota=quota,
         ).start()
         logging.info("store serving on %s", store_server.url)
     recorder = EventRecorder(store)

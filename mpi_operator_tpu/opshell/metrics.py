@@ -476,6 +476,21 @@ store_writes_elided = REGISTRY.counter(
     "(no-op write elision, by component) — the write-side twin of the "
     "informer cache's zero-read guarantee",
 )
+store_tenant_queued = REGISTRY.counter(
+    "tpu_operator_store_tenant_queued_total",
+    "Requests that had to WAIT for a fair-queue seat, by tenant "
+    "(machinery/fairqueue.py) — a persistently queued tenant is either "
+    "noisy (expected: its own load) or starved (check the noisy "
+    "neighbor's rejected counter and the per-tenant queue snapshot)",
+)
+store_tenant_rejected = REGISTRY.counter(
+    "tpu_operator_store_tenant_rejected_total",
+    "Requests load-shed with 429 TooManyRequests by tenant and reason "
+    "(rate = over its token bucket, queue-full = bounded wait queue "
+    "overflow, timeout = waited max_wait without a seat) — nonzero for "
+    "a noisy tenant is the fair queue WORKING, nonzero for everyone is "
+    "an undersized max_inflight",
+)
 events_pruned = REGISTRY.counter(
     "tpu_operator_events_pruned_total",
     "Events deleted by the controller's TTL sweep (kube prunes its events "

@@ -395,7 +395,7 @@ class GangScheduler:
         nodes: Optional[List] = None
         node_used: Dict[str, int] = {}
         if self.inventory is None:
-            all_nodes = self.read.list("Node", NODE_NAMESPACE)
+            all_nodes = self._list_nodes_readonly()
             if self.require_nodes:
                 # heal any 'local'-sentinel bindings (pre-upgrade state or a
                 # misconfigured operator). In a node-mode deployment no
@@ -855,7 +855,7 @@ class GangScheduler:
         through evict/restart until backoffLimit kills the job. Hosts with
         no registered agent stay schedulable (pure-inventory deployments
         carry no Node objects at all)."""
-        all_nodes = self.read.list("Node", NODE_NAMESPACE)
+        all_nodes = self._list_nodes_readonly()
         if not all_nodes:
             return
         live = {n.metadata.name for n in self._live_nodes(all_nodes)}
@@ -867,6 +867,16 @@ class GangScheduler:
                 occ.setdefault(parsed[0], set()).add(parsed[1])
 
     # -- scalar node mode ---------------------------------------------------
+
+    def _list_nodes_readonly(self) -> List:
+        """Node snapshot for scoring — READ-ONLY by contract. Through the
+        informer this skips the per-object deepcopy (10k-job round: 1k
+        Nodes × 5 passes/s of copying dominated the leader's GIL; the
+        scheduler only reads capacity/ready/heartbeat off Nodes and never
+        mutates or retains them). Raw-store reads keep their own copies."""
+        if self.cache is not None:
+            return self.read.list("Node", NODE_NAMESPACE, copy=False)
+        return self.read.list("Node", NODE_NAMESPACE)
 
     def _live_nodes(self, all_nodes: List) -> List:
         """Ready nodes with a fresh heartbeat (or static: heartbeat 0),

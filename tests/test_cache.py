@@ -9,6 +9,7 @@ disconnect — kill and restart the watch mid-stream, no missed and no
 duplicated events (ISSUE 1 acceptance).
 """
 
+import json
 import random
 import threading
 import time
@@ -326,8 +327,16 @@ def test_resume_protocol_replays_ring_tail():
         deadline = time.time() + 5
         while srv._log.head < 6 and time.time() < deadline:
             time.sleep(0.01)
+        def as_dict(payload):
+            # event payloads come back PREENCODED (cached wire bytes,
+            # byte-joined per watcher); decode for assertions
+            if hasattr(payload, "assemble"):
+                return json.loads(payload.assemble())
+            return payload
+
         code, r = srv._handle(
             "GET", f"/v1/watch?after=-1&resource_version={anchor}", {})
+        r = as_dict(r)
         assert code == 200 and "relist" not in r
         assert [e["object"]["metadata"]["name"] for e in r["events"]] == [
             "p3", "p4", "p5"]
@@ -336,11 +345,13 @@ def test_resume_protocol_replays_ring_tail():
         # an anchor below this incarnation's base (history the ring never
         # saw) cannot prove completeness → relist (the 410 Gone fallback)
         code, r = srv._handle("GET", "/v1/watch?after=-1&resource_version=1", {})
+        r = as_dict(r)
         assert code == 200 and "relist" in r
         # a caught-up anchor is a valid EMPTY resume, not a relist
         top = pods[-1].metadata.resource_version
         code, r = srv._handle(
             "GET", f"/v1/watch?after=-1&resource_version={top}", {})
+        r = as_dict(r)
         assert code == 200 and "relist" not in r and r["events"] == []
     finally:
         srv.stop()

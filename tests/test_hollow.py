@@ -1,0 +1,303 @@
+"""Hollow node agents (ISSUE 10): kubemark-style scale testing.
+
+The hollow executor fakes ONLY the process launch; everything the
+control plane sees — bind pickup, status patch-batches, heartbeats,
+terminal phases — rides the real agent machinery. These tests pin that
+claim: a hollow trail must satisfy the SAME safety invariants
+(tests/invariants.py) the chaos suite asserts over real executions, the
+scripted failure path must drive the real gang-restart machinery, and an
+eviction must kill the scripted timeline exactly like a SIGKILL kills a
+process.
+"""
+
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.types import (
+    Container,
+    ObjectMeta,
+    PodTemplate,
+    ReplicaSpec,
+    RunPolicy,
+    SliceSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from mpi_operator_tpu.controller.controller import (
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.executor.agent import NodeAgent
+from mpi_operator_tpu.executor.hollow import (
+    HollowExecutor,
+    HollowFleet,
+    HollowTimeline,
+)
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import PodPhase, evict_pod
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+from invariants import Trail, check_invariants
+
+
+def make_job(name, ns="hollow", replicas=2, restart_policy="Never",
+             backoff=None):
+    rp = RunPolicy(clean_pod_policy="None")
+    if backoff is not None:
+        rp.backoff_limit = backoff
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TPUJobSpec(
+            slots_per_worker=1,
+            run_policy=rp,
+            worker=ReplicaSpec(
+                replicas=replicas,
+                restart_policy=restart_policy,
+                template=PodTemplate(
+                    container=Container(image="x", command=["true"])
+                ),
+            ),
+            slice=SliceSpec(accelerator="cpu", chips_per_host=1),
+        ),
+    )
+
+
+class HollowCluster:
+    """Controller + scheduler + one hollow NodeAgent over an ObjectStore
+    (the real agent loop — the `--hollow` CLI shape, in-process)."""
+
+    def __init__(self, timeline, node="hollow-n0", chips=64):
+        self.store = ObjectStore()
+        self.trail = Trail(self.store)
+        self.controller = TPUJobController(
+            self.store, EventRecorder(self.store),
+            ControllerOptions(threadiness=2, queue_shards=2),
+        )
+        self.scheduler = GangScheduler(self.store, EventRecorder(self.store))
+        self.agent = NodeAgent(
+            self.store, node, capacity_chips=chips,
+            heartbeat_interval=0.2, hollow=timeline,
+        )
+        self._stop = threading.Event()
+        self._sched_thread = threading.Thread(
+            target=self._sched_loop, daemon=True
+        )
+
+    def _sched_loop(self):
+        while not self._stop.is_set():
+            self.scheduler.sync()
+            self._stop.wait(0.05)
+
+    def start(self):
+        self.agent.start()
+        self.controller.run()
+        self._sched_thread.start()
+        return self
+
+    def wait_all(self, predicate, ns="hollow", timeout=30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            jobs = self.store.list("TPUJob", ns)
+            if jobs and all(predicate(j) for j in jobs):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def stop_and_check(self):
+        self._stop.set()
+        self.controller.stop()
+        self.agent.stop()
+        self.trail.stop()
+        check_invariants(self.trail)
+
+
+def test_hollow_agent_trail_satisfies_safety_invariants():
+    """THE tier-1 gate for the hollow plane: jobs driven end-to-end by a
+    hollow NodeAgent (real watch/bind/batch/heartbeat loop) produce an
+    event trail that passes every chaos-suite safety check — orphans,
+    single gang generation, terminal write-once, condition machine,
+    restart and rv monotonicity."""
+    cluster = HollowCluster(HollowTimeline(run_s=0.15, seed=3)).start()
+    try:
+        for i in range(4):
+            cluster.store.create(make_job(f"hj-{i}"))
+        assert cluster.wait_all(lambda j: cond.is_succeeded(j.status)), (
+            "hollow jobs never converged: "
+            + str([(j.metadata.name,
+                    [c.type for c in j.status.conditions if c.status])
+                   for j in cluster.store.list("TPUJob", "hollow")])
+        )
+    finally:
+        cluster.stop_and_check()
+
+
+def test_hollow_scripted_failure_drives_real_failure_path():
+    """failure_rate=1.0: every pod exits Failed with the configured exit
+    code, and the job walks the REAL fail-vs-restart machinery to Failed
+    (restart policy Never) — the trail stays invariant-clean."""
+    cluster = HollowCluster(
+        HollowTimeline(run_s=0.1, failure_rate=1.0, failure_exit_code=3,
+                       seed=4),
+    ).start()
+    try:
+        cluster.store.create(make_job("doomed", replicas=1))
+        assert cluster.wait_all(lambda j: cond.is_finished(j.status))
+        job = cluster.store.get("TPUJob", "hollow", "doomed")
+        assert cond.is_failed(job.status)
+        pod_events = [
+            ev for ev in cluster.trail.snapshot_events()
+            if ev.kind == "Pod" and ev.obj.status.phase == PodPhase.FAILED
+        ]
+        assert pod_events, "no Failed pod phase ever hit the store"
+        assert pod_events[-1].obj.status.exit_code == 3
+    finally:
+        cluster.stop_and_check()
+
+
+def test_hollow_eviction_kills_scripted_timeline():
+    """An eviction mid-run must cancel the pending Succeeded transition —
+    the hollow 'process' dies with the eviction exactly like a SIGKILL'd
+    real one; terminal write-once must hold on the trail."""
+    timeline = HollowTimeline(run_s=2.0, seed=5)  # long: we evict mid-run
+    cluster = HollowCluster(timeline).start()
+    try:
+        cluster.store.create(make_job("victim", replicas=1))
+        deadline = time.time() + 10
+        pod = None
+        while time.time() < deadline:
+            pods = cluster.store.list("Pod", "hollow")
+            if pods and pods[0].status.phase == PodPhase.RUNNING:
+                pod = pods[0]
+                break
+            time.sleep(0.05)
+        assert pod is not None, "pod never reached Running"
+        evict_pod(cluster.store, pod, "test eviction")
+        # past the scripted run_s: the cancelled timeline must NOT have
+        # flipped the evicted pod to Succeeded (write-once holds)
+        time.sleep(2.5)
+        cur = cluster.store.try_get("Pod", "hollow", pod.metadata.name)
+        if cur is not None and cur.metadata.uid == pod.metadata.uid:
+            assert cur.status.phase == PodPhase.FAILED
+    finally:
+        cluster.stop_and_check()
+
+
+def test_hollow_executor_dedups_replayed_deliveries():
+    """Relist replays (MODIFIED of an already-claimed pod) must not mint
+    a second timeline — exactly one Running and one terminal mirror per
+    incarnation."""
+    store = ObjectStore()
+    mirrors = []
+
+    class Sink:
+        def enqueue(self, ns, name, uid, rv, changes):
+            mirrors.append((name, uid, changes["phase"]))
+
+    ex = HollowExecutor(
+        store, node_name="n0", timeline=HollowTimeline(run_s=0.1),
+        status_sink=Sink(), external_events=True,
+    )
+    ex.start()
+    try:
+        from mpi_operator_tpu.machinery.objects import Pod, PodSpec
+
+        pod = store.create(Pod(
+            metadata=ObjectMeta(name="p0", namespace="x"),
+            spec=PodSpec(node_name="n0"),
+        ))
+        for _ in range(5):  # replay storm
+            ex.observe(pod)
+        assert ex.wait_idle(10.0)
+        phases = [p for (_, _, p) in mirrors]
+        assert phases == [PodPhase.RUNNING, PodPhase.SUCCEEDED], mirrors
+    finally:
+        ex.stop()
+
+
+def test_hollow_adopts_already_running_pods_to_terminal():
+    """A restarted hollow agent/fleet sees its prior claims as RUNNING on
+    first observation: it must arm the TERMINAL transition (skipping the
+    redundant Running mirror), or adopted pods would stay Running forever
+    and the run would wedge short of its job count."""
+    store = ObjectStore()
+    mirrors = []
+
+    class Sink:
+        def enqueue(self, ns, name, uid, rv, changes):
+            mirrors.append((name, changes["phase"]))
+
+    ex = HollowExecutor(
+        store, node_name="n0", timeline=HollowTimeline(run_s=0.1),
+        status_sink=Sink(), external_events=True,
+    )
+    ex.start()
+    try:
+        from mpi_operator_tpu.machinery.objects import Pod, PodSpec
+
+        pod = Pod(
+            metadata=ObjectMeta(name="adopted", namespace="x"),
+            spec=PodSpec(node_name="n0"),
+        )
+        pod.status.phase = PodPhase.RUNNING
+        pod = store.create(pod)
+        ex.observe(pod)
+        assert ex.wait_idle(10.0)
+        assert mirrors == [("adopted", PodPhase.SUCCEEDED)], mirrors
+    finally:
+        ex.stop()
+
+
+def test_hollow_fleet_smoke():
+    """A small fleet (many nodes, one process, shared watch + chunked
+    batch flushes) converges a burst of jobs against an in-process store
+    — the seconds-scale version of BENCH_CP_MODES=scale."""
+    store = ObjectStore()
+    trail = Trail(store)
+    controller = TPUJobController(
+        store, EventRecorder(store),
+        ControllerOptions(threadiness=4, queue_shards=4),
+    )
+    scheduler = GangScheduler(store, EventRecorder(store))
+    fleet = HollowFleet(
+        store, 25, timeline=HollowTimeline(run_s=0.1, seed=6),
+        capacity_chips=8, heartbeat_interval=2.0,
+    ).start()
+    stop = threading.Event()
+
+    def sched_loop():
+        while not stop.is_set():
+            scheduler.sync()
+            stop.wait(0.05)
+
+    st = threading.Thread(target=sched_loop, daemon=True)
+    controller.run()
+    st.start()
+    try:
+        for i in range(30):
+            store.create(make_job(f"fleet-{i:02d}", replicas=2))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            jobs = store.list("TPUJob", "hollow")
+            if len(jobs) == 30 and all(
+                cond.is_succeeded(j.status) for j in jobs
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            done = sum(1 for j in store.list("TPUJob", "hollow")
+                       if cond.is_succeeded(j.status))
+            pytest.fail(f"fleet converged only {done}/30 jobs")
+        # the fleet actually batched: far fewer batch requests than
+        # mirrors+heartbeats shipped
+        assert fleet.stats["mirrors"] >= 120  # 30 jobs × 2 pods × 2 phases
+        assert fleet.stats["batches"] < fleet.stats["mirrors"]
+    finally:
+        stop.set()
+        controller.stop()
+        fleet.stop()
+        trail.stop()
+        check_invariants(trail)

@@ -9,6 +9,7 @@ watches with relist recovery — and the operator stack runs unchanged over
 it (leader election, typed TPUJobClient submit).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -288,14 +289,23 @@ def test_stale_instance_relists_even_when_seqs_overlap():
         deadline = time.time() + 5
         while srv._log.head < 5 and time.time() < deadline:
             time.sleep(0.01)
+        def as_dict(payload):
+            # event payloads come back PREENCODED (the O(events) fan-out
+            # path assembles cached wire bytes); decode for assertions
+            if hasattr(payload, "assemble"):
+                return json.loads(payload.assemble())
+            return payload
+
         # a cursor numerically inside the window but from another incarnation
         code, r = srv._handle("GET", "/v1/watch?after=2&instance=dead-beef", {})
+        r = as_dict(r)
         assert code == 200 and "relist" in r
         assert r["instance"] == srv.instance
         # same cursor with the right instance streams events, no relist
         code, r = srv._handle(
             "GET", f"/v1/watch?after=2&instance={srv.instance}", {}
         )
+        r = as_dict(r)
         assert code == 200 and "relist" not in r
         assert [e["seq"] for e in r["events"]] == [3, 4, 5]
     finally:
@@ -1549,3 +1559,122 @@ def test_agent_patch_cannot_hit_pod_recreated_after_authz(monkeypatch):
     finally:
         agent_a.close()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: O(events) fan-out (preencoded wire bytes) + re-poll jitter
+# ---------------------------------------------------------------------------
+
+
+def test_preencoded_and_legacy_watch_payloads_are_wire_identical():
+    """The preencoded-segments path must produce byte-compatible JSON with
+    the legacy per-watcher re-encode — clients cannot tell the difference
+    (only the server's encode CPU can)."""
+    from mpi_operator_tpu.machinery.http_store import StoreServer
+
+    def collect(preencode):
+        srv = StoreServer(ObjectStore(), "127.0.0.1", 0,
+                          preencode=preencode).start()
+        try:
+            c = HttpStoreClient(srv.url, watch_poll_timeout=0.5)
+            q = c.watch("Pod")
+            for i in range(5):
+                c.create(Pod(metadata=ObjectMeta(name=f"w{i}",
+                                                 namespace="eq")))
+            out = []
+            for _ in range(5):
+                ev = q.get(timeout=10.0)
+                out.append((ev.type, ev.obj.metadata.name,
+                            ev.obj.metadata.resource_version))
+            c.close()
+            return out
+        finally:
+            srv.stop()
+
+    assert collect(True) == collect(False)
+
+
+def test_preencode_encodes_each_event_exactly_once():
+    """With N watchers on one stream, the per-event json.dumps runs ONCE
+    (at append) — the O(events) claim the fanout bench quantifies."""
+    from mpi_operator_tpu.machinery.http_store import (
+        StoreServer,
+        reset_watch_encode_stats,
+        watch_encode_stats,
+    )
+
+    srv = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    clients = [HttpStoreClient(srv.url, watch_poll_timeout=0.5)
+               for _ in range(4)]
+    try:
+        queues = [c.watch("Pod") for c in clients]
+        reset_watch_encode_stats()
+        writer = clients[0]
+        for i in range(6):
+            writer.create(Pod(metadata=ObjectMeta(name=f"once{i}",
+                                                  namespace="eq")))
+        for q in queues:
+            for _ in range(6):
+                assert q.get(timeout=10.0) is not None
+        stats = watch_encode_stats()
+        assert stats["events_encoded"] == 6  # once per event, NOT per watcher
+        assert stats["payloads"] >= 4  # every watcher still got served
+    finally:
+        for c in clients:
+            c.close()
+        srv.stop()
+
+
+def test_watch_repoll_jitter_spreads_a_severed_herd():
+    """ISSUE 10 satellite: N clients severed together must NOT re-poll in
+    lockstep. The jittered delay is seeded per client: bounded inside
+    [0.5, 1.5]×base, spread across the window, and non-constant within
+    one client's successive retries."""
+    clients = [HttpStoreClient("http://127.0.0.1:9")  # never dialed
+               for _ in range(20)]
+    try:
+        delays = [c._watch_retry_delay() for c in clients]
+        base = clients[0].watch_retry_base
+        assert all(0.5 * base <= d <= 1.5 * base for d in delays), delays
+        # a herd of 20 spreads: at least 15 distinct delays
+        assert len({round(d, 6) for d in delays}) >= 15, delays
+        # successive retries of ONE client vary too (no per-client lockstep)
+        series = [clients[0]._watch_retry_delay() for _ in range(8)]
+        assert len({round(d, 6) for d in series}) >= 6, series
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_tenant_classification():
+    """Fairness tenants: namespace for tenant-tier object routes (creates
+    classify by body namespace), node identity for agent tokens, and the
+    ADMIN tier outranking namespace attribution — the controller's writes
+    into a noisy tenant's namespace must not land in that tenant's bucket
+    (≙ kube APF's exempt system flow schemas), or the tenant's own client
+    could rate-starve its jobs' reconciliation."""
+    from mpi_operator_tpu.machinery.http_store import StoreServer
+
+    srv = StoreServer(
+        ObjectStore(), "127.0.0.1", 0, token="adm",
+        read_token="view", agent_tokens={"agtok": "node-7"},
+    )
+    try:
+        t = srv._tenant_of
+        # anonymous / read-tier traffic attributes to the namespace
+        assert t("GET", "/v1/objects/Pod/team-a/p0", "") == "ns:team-a"
+        assert t("GET", "/v1/objects/Pod?namespace=team-b", "Bearer view") \
+            == "ns:team-b"
+        assert t("POST", "/v1/objects", "",
+                 {"object": {"metadata": {"namespace": "team-c"}}}) == \
+            "ns:team-c"
+        # agent identity wins even on a namespaced route
+        assert t("PATCH", "/v1/objects/Pod/team-a/p0/status",
+                 "Bearer agtok") == "node:node-7"
+        # admin = system traffic, exempt from namespace buckets
+        assert t("GET", "/v1/objects/Pod/team-a/p0", "Bearer adm") == "admin"
+        assert t("GET", "/v1/objects/Pod", "Bearer adm") == "admin"
+        assert t("GET", "/v1/objects/Pod", "Bearer view") == "read"
+        assert t("GET", "/v1/objects/Pod", "") == "anon"
+    finally:
+        srv._httpd.server_close()

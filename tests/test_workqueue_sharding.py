@@ -1,0 +1,194 @@
+"""Sharded workqueue invariants (ISSUE 10 satellite).
+
+The 10k-key dispatch bottleneck fix must not weaken the single queue's
+contract, so these tests pin the invariants sharding could plausibly
+break:
+
+- **never-concurrent**: the same key is never handed to two workers at
+  once, even when its adds race its processing across shard boundaries;
+- **per-key ordering/coalescing**: adds during processing coalesce into
+  exactly one re-queue (the dirty contract), in the same shard;
+- **rebalance loses no keys**: re-hashing pending keys over a new shard
+  count — including while keys are mid-processing on shards that get
+  retired — neither drops nor duplicates work.
+"""
+
+import threading
+import time
+
+from mpi_operator_tpu.machinery.workqueue import (
+    RateLimitingQueue,
+    ShardedRateLimitingQueue,
+)
+
+
+def drain_all(q, workers=4, per_get_timeout=0.05):
+    """Pull every currently-available key (multi-worker shaped)."""
+    got = []
+    while True:
+        key = q.get(timeout=per_get_timeout, shard=len(got) % max(1, workers))
+        if key is None:
+            return got
+        got.append(key)
+        q.done(key)
+
+
+def test_stable_placement_and_dedup():
+    q = ShardedRateLimitingQueue(shards=4)
+    keys = [f"ns/job-{i}" for i in range(64)]
+    for k in keys:
+        assert 0 <= q.shard_of(k) < 4
+        assert q.shard_of(k) == q.shard_of(k)  # stable
+    for k in keys:
+        q.add(k)
+        q.add(k)  # duplicate while queued coalesces
+    assert len(q) == len(keys)
+    got = drain_all(q)
+    assert sorted(got) == sorted(keys)  # exactly once each
+
+
+def test_same_key_never_processed_concurrently():
+    """N workers hammering adds of a handful of keys: instrumented
+    processing sections must never overlap for the same key (the
+    controller's per-job serialization guarantee)."""
+    q = ShardedRateLimitingQueue(shards=4)
+    keys = [f"k-{i}" for i in range(8)]
+    inflight = {k: 0 for k in keys}
+    overlap = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def worker(i):
+        while not stop.is_set():
+            key = q.get(timeout=0.05, shard=i)
+            if key is None:
+                continue
+            with lock:
+                inflight[key] += 1
+                if inflight[key] > 1:
+                    overlap.append(key)
+            time.sleep(0.002)  # widen the race window
+            with lock:
+                inflight[key] -= 1
+            q.done(key)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for round_ in range(50):
+        for k in keys:
+            q.add(k)  # many re-adds WHILE keys are being processed
+        time.sleep(0.002)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert not overlap, f"keys processed concurrently: {set(overlap)}"
+
+
+def test_add_during_processing_requeues_exactly_once():
+    q = ShardedRateLimitingQueue(shards=3)
+    q.add("a")
+    key = q.get(timeout=1.0, shard=q.shard_of("a"))
+    assert key == "a"
+    q.add("a")  # dirty while processing
+    q.add("a")  # coalesces
+    assert q.get(timeout=0.05) is None  # NOT handed out concurrently
+    q.done("a")
+    assert q.get(timeout=1.0, shard=q.shard_of("a")) == "a"  # exactly once
+    q.done("a")
+    assert q.get(timeout=0.05) is None
+
+
+def test_cross_shard_sweep_serves_unparked_shards():
+    """A single worker parked on shard 0 still drains keys hashed to
+    other shards (threadiness < shards must not strand work)."""
+    q = ShardedRateLimitingQueue(shards=8)
+    keys = [f"sweep-{i}" for i in range(20)]
+    for k in keys:
+        q.add(k)
+    got = []
+    for _ in range(len(keys)):
+        k = q.get(timeout=0.5, shard=0)  # always the same home shard
+        assert k is not None
+        got.append(k)
+        q.done(k)
+    assert sorted(got) == sorted(keys)
+
+
+def test_rebalance_loses_no_pending_keys():
+    q = ShardedRateLimitingQueue(shards=2)
+    keys = [f"reb-{i}" for i in range(40)]
+    for k in keys:
+        q.add(k)
+    moved = q.rebalance(7)
+    assert moved == len(keys)
+    assert q.shards == 7
+    got = drain_all(q, workers=7)
+    assert sorted(got) == sorted(keys)
+
+
+def test_rebalance_with_keys_mid_processing():
+    """Keys being processed when the shard layout changes: their done()
+    lands on the retired shard, and a re-add during processing must still
+    surface exactly once — on the NEW layout."""
+    q = ShardedRateLimitingQueue(shards=2)
+    q.add("inflight")
+    for i in range(10):
+        q.add(f"pending-{i}")
+    key = None
+    # claim "inflight" specifically (sweep from its home shard)
+    claimed = []
+    while key != "inflight":
+        key = q.get(timeout=1.0, shard=q.shard_of("inflight"))
+        assert key is not None
+        if key != "inflight":
+            claimed.append(key)
+    q.rebalance(5)
+    q.add("inflight")  # dirty while processing across the rebalance
+    q.done("inflight")
+    for k in claimed:
+        q.done(k)
+    got = drain_all(q, workers=5)
+    expected = {f"pending-{i}" for i in range(10)} | {"inflight"}
+    expected -= set(claimed)
+    assert sorted(got) == sorted(expected | set())
+    # nothing left anywhere
+    assert q.get(timeout=0.05) is None
+
+
+def test_rate_limit_state_survives_rebalance():
+    q = ShardedRateLimitingQueue(shards=2, base_delay=0.01, max_delay=1.0)
+    q.add_rate_limited("flappy")
+    q.add_rate_limited("flappy")
+    assert q.num_requeues("flappy") == 2
+    q.rebalance(4)
+    assert q.num_requeues("flappy") == 2  # failure counts are parent-level
+    q.forget("flappy")
+    assert q.num_requeues("flappy") == 0
+
+
+def test_shutdown_unblocks_workers():
+    q = ShardedRateLimitingQueue(shards=3)
+    results = []
+
+    def blocked():
+        results.append(q.get(timeout=5.0, shard=1))
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.1)
+    q.shut_down()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results == [None]
+    q.add("late")  # post-shutdown adds are dropped
+    assert len(q) == 0
+
+
+def test_single_queue_accepts_shard_kwarg():
+    """The worker loop drives both queue shapes through one signature."""
+    q = RateLimitingQueue()
+    q.add("x")
+    assert q.get(timeout=1.0, shard=3) == "x"
+    q.done("x")

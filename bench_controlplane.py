@@ -19,12 +19,19 @@ measuring per-sync latency and the server's read counters. Run it via::
 
 Knobs: BENCH_CP_JOBS, BENCH_CP_PODS, BENCH_CP_ROUNDS, BENCH_CP_MODES
 ("store", "informer", "write", "replica", "hist", "traceoverhead",
-"scale", "fanout", or a comma list). No jax required — this is the pure-
-python control plane. The **scale** mode (ISSUE 10) drives a hollow-node
-fleet (BENCH_CP_SCALE_NODES × simulated nodes, BENCH_CP_SCALE_JOBS jobs)
-against the sharded+fair-queued stack and reads p50/p99 out of the PR 9
-histograms with p99 SLOs as the tripwire; **fanout** proves watch fan-out
-encode cost is O(events), not O(watchers×events).
+"scale", "serve", "fanout", or a comma list). No jax required — this is
+the pure-python control plane. The **scale** mode (ISSUE 10) drives a
+hollow-node fleet (BENCH_CP_SCALE_NODES × simulated nodes,
+BENCH_CP_SCALE_JOBS jobs) against the sharded+fair-queued stack and reads
+p50/p99 out of the PR 9 histograms with p99 SLOs as the tripwire;
+**fanout** proves watch fan-out encode cost is O(events), not
+O(watchers×events). The **serve** mode (ISSUE 11) runs the serving
+workload class on a hollow fleet: a diurnal+spike offered-load curve
+against an autoscaled TPUServe sharing the cluster with a batch backlog —
+asserting the autoscaler tracks the curve (≥4× spike, scale-to-zero), a
+mid-run rolling update opens zero unready windows, serve-readiness p99
+meets its SLO, and the batch backlog still completes via
+preempt-then-free-restart (visible in `ctl trace`).
 The **hist** mode proves the exported latency histograms (ISSUE 9) agree
 with the direct timers within bucket resolution; **traceoverhead** bounds
 the tracing tax (reconcile p50 traced vs untraced, acceptance ≤5%).
@@ -779,6 +786,307 @@ def run_scale_mode(nodes: int, jobs: int, pods: int) -> dict:
                     proc.kill()
 
 
+def run_serve_mode() -> dict:
+    """The serving workload class under traffic (BENCH_CP_MODES=serve,
+    ISSUE 11): a hollow fleet hosts ONE autoscaled TPUServe sharing the
+    cluster with a batch backlog, driven by a diurnal-plus-spike offered-
+    load curve through the closed loop the autoscaler actually lives in
+    (ServeLoadModel: more replicas → lower per-pod load → lower latency).
+
+    Asserted (the slo block):
+    - the autoscaler TRACKS the curve: peak ready replicas >= 4× the
+      baseline, and the quiet tail scales to ZERO;
+    - a mid-run rolling update completes with ZERO unready windows
+      (ready gangs never dip below desired while rolling);
+    - serve-readiness p99 (creation → every member ready, from the PR 9
+      histogram) within BENCH_CP_SLO_SERVE_READY_P99_MS;
+    - the batch backlog still FINISHES: serving scale-up preempts batch
+      gangs (priority high > default), preempted jobs restart for free
+      and reach Succeeded — the preempt+resume visible in `ctl trace`.
+    """
+    import io
+    import contextlib
+    import threading
+
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.api.client import TPUServeClient
+    from mpi_operator_tpu.controller.autoscaler import (
+        ANNOTATION_OFFERED_QPS,
+        ServeAutoscaler,
+    )
+    from mpi_operator_tpu.controller.serve import (
+        LABEL_SERVE_NAME,
+        TPUServeController,
+        group_replicas,
+        replica_ready,
+    )
+    from mpi_operator_tpu.executor.hollow import (
+        HollowFleet,
+        HollowTimeline,
+        ServeLoadModel,
+    )
+    from mpi_operator_tpu.machinery import trace
+    from mpi_operator_tpu.opshell import ctl, metrics
+
+    nodes = int(os.environ.get("BENCH_CP_SERVE_NODES", "10"))
+    batch_jobs = int(os.environ.get("BENCH_CP_SERVE_BATCH_JOBS", "24"))
+    batch_pods = int(os.environ.get("BENCH_CP_SERVE_BATCH_PODS", "4"))
+    batch_run_s = float(os.environ.get("BENCH_CP_SERVE_BATCH_RUN_S", "4.0"))
+    spike_qps = float(os.environ.get("BENCH_CP_SERVE_SPIKE_QPS", "1200"))
+    base_qps = float(os.environ.get("BENCH_CP_SERVE_BASE_QPS", "80"))
+    slo_ready_p99_ms = float(os.environ.get(
+        "BENCH_CP_SLO_SERVE_READY_P99_MS", "10000"))
+
+    tmp = tempfile.mkdtemp(prefix="bench-cp-serve-")
+    trace_dir = os.path.join(tmp, "traces")
+    trace.TRACER.configure("bench-serve", dir=trace_dir)
+    backing = SqliteStore(os.path.join(tmp, "store.db"))
+    server = StoreServer(backing, "127.0.0.1", 0,
+                         log_capacity=65536).start()
+    client = HttpStoreClient(server.url, timeout=30.0,
+                             watch_poll_timeout=2.0)
+    fleet_client = HttpStoreClient(server.url, timeout=30.0,
+                                   watch_poll_timeout=2.0)
+    load = ServeLoadModel(capacity_qps=150.0, base_ms=20.0)
+    timeline = HollowTimeline(
+        pending_s=0.05, run_s=batch_run_s, seed=11,
+        serve_warmup_s=0.4, serve_stats_interval_s=0.25, load=load,
+    )
+    snaps = {"ready": metrics.serve_ready_latency.snapshot()}
+    preempted0 = metrics.gangs_preempted.get()
+    cache = InformerCache(client).start()
+    recorder = EventRecorder(client)
+    controller = TPUJobController(
+        client, recorder, ControllerOptions(threadiness=4), cache=cache)
+    serve_controller = TPUServeController(client, recorder, cache=cache)
+    scheduler = GangScheduler(client, recorder, cache=cache,
+                              preemption_grace=0.5)
+    autoscaler = ServeAutoscaler(client, recorder, cache=cache,
+                                 interval=0.5)
+    fleet = None
+    serve_key = "bench/svc"
+    samples = []          # (t, offered, desired, ready)
+    rollout_dips = []
+    try:
+        if not cache.wait_for_sync(30.0):
+            raise RuntimeError("informer cache never synced")
+        fleet = HollowFleet(fleet_client, nodes, timeline=timeline,
+                            capacity_chips=4,
+                            heartbeat_interval=2.0).start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(cache.list("Node")) >= nodes:
+                break
+            time.sleep(0.1)
+        controller.run()
+        serve_controller.run()
+        scheduler.start()
+        autoscaler.start()
+
+        sc = TPUServeClient(client, namespace="bench")
+        sc.create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "bench"},
+            "spec": {
+                "replicas": 1,
+                "workers_per_replica": 1,
+                "slice": {"accelerator": "cpu", "chips_per_host": 2},
+                "autoscale": {
+                    "min_replicas": 0, "max_replicas": 12,
+                    "target_qps_per_replica": 100.0,
+                    "scale_up_stabilization_s": 0.0,
+                    "scale_down_stabilization_s": 3.0,
+                    "scale_to_zero_after_s": 6.0,
+                    "cold_start_grace_s": 2.0,
+                },
+            },
+        })
+        # the batch backlog, submitted up front: it must share the
+        # cluster AND eventually finish despite the serving spike
+        for i in range(batch_jobs):
+            job = _make_job(i, batch_pods, clean="All")
+            job.spec.slice.chips_per_host = 2
+            job.spec.slots_per_worker = 2
+            job.spec.worker.restart_policy = "OnFailure"
+            client.create(job)
+
+        def offered(qps: float) -> None:
+            load.set_offered(serve_key, qps)
+            client.patch("TPUServe", "bench", "svc", {"metadata": {
+                "annotations": {ANNOTATION_OFFERED_QPS: str(qps)}}})
+
+        def serve_counts():
+            pods = [p for p in client.list(
+                "Pod", "bench", selector={LABEL_SERVE_NAME: "svc"})
+                if not p.is_finished()]
+            ready = sum(1 for m in group_replicas(pods).values()
+                        if replica_ready(m, 1))
+            serve = client.get("TPUServe", "bench", "svc")
+            return serve, ready
+
+        def observe(tag: str, qps: float) -> int:
+            serve, ready = serve_counts()
+            samples.append({
+                "t": round(time.time() - t0, 1), "phase": tag,
+                "offered_qps": qps,
+                "desired": serve.spec.replicas, "ready": ready,
+            })
+            return ready
+
+        t0 = time.time()
+        # --- phase 1: diurnal baseline ---
+        offered(base_qps)
+        while time.time() - t0 < 8.0:
+            observe("baseline", base_qps)
+            time.sleep(0.5)
+        baseline_ready = max(1, observe("baseline", base_qps))
+        # --- phase 2: the spike (serving must displace batch) ---
+        offered(spike_qps)
+        peak_ready = 0
+        while time.time() - t0 < 30.0:
+            peak_ready = max(peak_ready, observe("spike", spike_qps))
+            time.sleep(0.5)
+        # --- phase 3: settle to a mid plateau, then roll the template ---
+        offered(300.0)
+        plateau_deadline = time.time() + 20
+        while time.time() < plateau_deadline:
+            serve, ready = serve_counts()
+            if serve.spec.replicas == 3 and ready == 3 \
+                    and serve.status.updated_replicas == 3:
+                break
+            observe("settle", 300.0)
+            time.sleep(0.5)
+        rollout_desired = 3
+        s2 = sc.get("svc")
+        s2.spec.template.container.env = {"MODEL": "v2"}
+        sc.update(s2)
+        rollout_deadline = time.time() + 30
+        rollout_converged = False
+        while time.time() < rollout_deadline:
+            serve, ready = serve_counts()
+            observe("rollout", 300.0)
+            if ready < min(rollout_desired, serve.spec.replicas or 0):
+                rollout_dips.append({"t": round(time.time() - t0, 1),
+                                     "ready": ready,
+                                     "desired": serve.spec.replicas})
+            st = serve.status
+            if (st.serve_generation == 1
+                    and st.updated_replicas == (serve.spec.replicas or 0)
+                    and st.replicas == (serve.spec.replicas or 0)
+                    and ready == (serve.spec.replicas or 0)):
+                rollout_converged = True
+                break
+            time.sleep(0.25)
+        # --- phase 4: traffic dies; scale-to-zero ---
+        offered(0.0)
+        zero_deadline = time.time() + 30
+        scaled_to_zero = False
+        while time.time() < zero_deadline:
+            serve, ready = serve_counts()
+            observe("quiet", 0.0)
+            if (serve.spec.replicas or 0) == 0 and serve.status.replicas == 0:
+                scaled_to_zero = True
+                break
+            time.sleep(0.5)
+        # --- batch must still finish (preempted gangs resumed) ---
+        batch_deadline = time.time() + float(os.environ.get(
+            "BENCH_CP_SERVE_BATCH_DEADLINE_S", "120"))
+        done = 0
+        while time.time() < batch_deadline:
+            done = sum(
+                1 for j in client.list("TPUJob", "bench")
+                if cond.is_succeeded(j.status)
+            )
+            if done >= batch_jobs:
+                break
+            time.sleep(1.0)
+        elapsed = time.time() - t0
+
+        preempted = metrics.gangs_preempted.get() - preempted0
+        # the preempt→restart causality, straight from the span trail: a
+        # FREE gang restart is the resume half of a preemption
+        trace.TRACER.flush()
+        spans = trace.load_spans(trace_dir)
+        free_restarts = [
+            s for s in spans if s.get("name") == "controller.gang_restart"
+            and (s.get("attrs") or {}).get("free")
+        ]
+        ctl_trace_rc = None
+        ctl_trace_has_restart = False
+        if free_restarts:
+            job_key = free_restarts[0]["attrs"]["job"]
+            job_name = job_key.split("/", 1)[1]
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                ctl_trace_rc = ctl.main([
+                    "--store", server.url, "-n", "bench",
+                    "trace", job_name, "--trace-dir", trace_dir,
+                ])
+            ctl_trace_has_restart = "gang_restart" in buf.getvalue()
+
+        ready_p99_ms = round(_hist_quantile_delta(
+            metrics.serve_ready_latency, 0.99, snaps["ready"],
+            metrics.serve_ready_latency.snapshot()) * 1e3, 1)
+        ready_latencies = sorted(
+            round(float((s.get("attrs") or {}).get("ready_latency_s", 0)), 2)
+            for s in spans if s.get("name") == "serve.replica_ready"
+        )
+        out = {
+            "metric": "controlplane_serve",
+            "nodes": nodes,
+            "chips": nodes * 4,
+            "batch_jobs": batch_jobs,
+            "batch_pods_per_job": batch_pods,
+            "baseline_ready": baseline_ready,
+            "peak_ready": peak_ready,
+            "spike_factor": round(peak_ready / max(1, baseline_ready), 1),
+            "rollout_converged": rollout_converged,
+            "rollout_unready_windows": len(rollout_dips),
+            "scaled_to_zero": scaled_to_zero,
+            "batch_succeeded": done,
+            "gangs_preempted": int(preempted),
+            "free_gang_restarts": len(free_restarts),
+            "ctl_trace_rc": ctl_trace_rc,
+            "ctl_trace_shows_restart": ctl_trace_has_restart,
+            "serve_ready_p99_ms": ready_p99_ms,
+            "ready_latencies_s": ready_latencies,
+            "elapsed_s": round(elapsed, 1),
+            "timeline": samples[-60:],
+        }
+        out["slo"] = {
+            "spike_factor_min": 4.0,
+            "serve_ready_p99_ms": slo_ready_p99_ms,
+            "rollout_unready_windows": 0,
+        }
+        out["slo_ok"] = bool(
+            out["spike_factor"] >= 4.0
+            and rollout_converged
+            and not rollout_dips
+            and scaled_to_zero
+            and done >= batch_jobs
+            and preempted > 0
+            and ready_p99_ms <= slo_ready_p99_ms
+            and ctl_trace_rc == 0
+            and ctl_trace_has_restart
+        )
+        return out
+    finally:
+        for comp in (autoscaler, serve_controller, controller):
+            try:
+                comp.stop()
+            except Exception:
+                pass
+        scheduler.stop()
+        if fleet is not None:
+            fleet.stop()
+        cache.stop()
+        client.close()
+        fleet_client.close()
+        server.stop()
+        backing.close()
+        trace.TRACER.disable()
+
+
 def run_fanout_mode() -> dict:
     """The O(events) fan-out proof (BENCH_CP_MODES=fanout): a fixed event
     stream delivered to 10 vs ``BENCH_CP_FANOUT_WATCHERS`` (default 500)
@@ -912,6 +1220,8 @@ def main() -> None:
                 int(os.environ.get("BENCH_CP_SCALE_JOBS", "10000")),
                 int(os.environ.get("BENCH_CP_SCALE_PODS", "1")),
             )
+        elif mode == "serve":
+            r = run_serve_mode()
         elif mode == "fanout":
             r = run_fanout_mode()
         else:

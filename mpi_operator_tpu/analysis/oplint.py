@@ -194,6 +194,15 @@ RULES: Dict[str, Rule] = {
             "`with start_span(...)`",
         ),
         Rule(
+            "OBS002", "error",
+            "controller-loop span without a latency histogram observation",
+            "ISSUE 11: span-close sites ARE the histogram instrumentation "
+            "points — a reconcile/sync loop that opens its span but never "
+            "observes a histogram has latency PERF.md and the SLO "
+            "tripwires cannot see; observe a metrics histogram in the "
+            "same function the loop span closes in",
+        ),
+        Rule(
             "REP001", "error",
             "direct store write on a follower/standby handle",
             "ISSUE 8: every mutation routes through the leased leader "
@@ -597,6 +606,64 @@ def _check_obs001(ctx: _FileCtx, call: ast.Call,
     )
 
 
+# span names that mark a CONTROLLER LOOP (the per-pass work of a
+# level-triggered reconciler): these are the latencies PERF tracks and the
+# SLO tripwires read, so their span-close function must observe a histogram
+_LOOP_SPAN_RE = re.compile(r"\.(reconcile|sync)$")
+
+
+def _check_obs002(ctx: _FileCtx, tree: ast.Module) -> None:
+    """Every ``with start_span("<x>.reconcile"|"<x>.sync")`` must share a
+    function with a histogram ``.observe(...)`` call — the OBS001
+    companion: the with-form keeps the span honest, this keeps the
+    span-close site instrumented (the pattern every controller loop since
+    ISSUE 9 follows; a new loop that forgets is invisible to /metrics)."""
+
+    def has_observe(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "observe"
+            ):
+                return True
+        return False
+
+    def visit(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        if isinstance(node, (ast.With, ast.AsyncWith)) and fn is not None:
+            for item in node.items:
+                call = item.context_expr
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if name != "start_span" or not call.args:
+                    continue
+                arg = call.args[0]
+                if not (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and _LOOP_SPAN_RE.search(arg.value)
+                ):
+                    continue
+                if not has_observe(fn):
+                    ctx.report(
+                        "OBS002", call,
+                        f"controller-loop span {arg.value!r} closes in a "
+                        f"function with no histogram .observe(...) — the "
+                        f"span-close site is the instrumentation point "
+                        f"(/metrics cannot see this loop's latency)",
+                    )
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn)
+
+    visit(tree, None)
+
+
 def _is_lock_expr(expr: ast.AST) -> bool:
     """Does a with-item context expression look like a lock? Matched on the
     LAST dotted component (`self._lock`, `self._mu`, `cache.lock`,
@@ -768,6 +835,7 @@ def lint_source(
     for fn in _iter_functions(tree):
         _check_rmw001(ctx, fn)
         _check_term001(ctx, fn)
+    _check_obs002(ctx, tree)
 
     # pre-pass for OBS001: the set of Call nodes that ARE a with item's
     # context expression (the blessed span shape)

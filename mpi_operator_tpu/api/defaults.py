@@ -22,6 +22,7 @@ from mpi_operator_tpu.api.types import (
     CleanPodPolicy,
     RestartPolicy,
     TPUJob,
+    TPUServe,
     family_chips_per_host,
 )
 
@@ -29,6 +30,22 @@ DEFAULT_SLOTS_PER_WORKER = 1
 DEFAULT_WORKER_REPLICAS = 1
 DEFAULT_RESTART_POLICY = RestartPolicy.NEVER
 DEFAULT_ACCELERATOR = "cpu"
+
+# TPUServe defaults: serving outranks batch by default (the workload-class
+# distinction — see TPUServeSpec), one-host gangs, a Deployment-shaped
+# (surge 1 / unavailable 0) zero-unready-window rollout, and conservative
+# HPA stabilization (instant up, 30s down, 15s cold-start hold).
+DEFAULT_SERVE_REPLICAS = 1
+DEFAULT_SERVE_WORKERS = 1
+DEFAULT_SERVE_PRIORITY = "high"
+DEFAULT_SERVE_MAX_SURGE = 1
+DEFAULT_SERVE_MAX_UNAVAILABLE = 0
+DEFAULT_AUTOSCALE_MIN = 1
+DEFAULT_AUTOSCALE_MAX = 8
+DEFAULT_TARGET_QPS_PER_REPLICA = 100.0
+DEFAULT_SCALE_UP_STABILIZATION_S = 0.0
+DEFAULT_SCALE_DOWN_STABILIZATION_S = 30.0
+DEFAULT_COLD_START_GRACE_S = 15.0
 
 
 def set_defaults(job: TPUJob) -> TPUJob:
@@ -63,3 +80,52 @@ def set_defaults(job: TPUJob) -> TPUJob:
         if spec.elastic.max_replicas is None:
             spec.elastic.max_replicas = spec.worker.replicas
     return job
+
+
+def set_serve_defaults(serve: TPUServe) -> TPUServe:
+    """Idempotent in-place defaulting for TPUServe (same contract as
+    ``set_defaults``: the controller re-defaults every reconcile; stored
+    specs stay exactly what the user wrote)."""
+    spec = serve.spec
+    if not spec.slice.accelerator:
+        spec.slice.accelerator = DEFAULT_ACCELERATOR
+    if spec.slice.chips_per_host is None:
+        spec.slice.chips_per_host = (
+            family_chips_per_host(spec.slice.accelerator)
+            or DEFAULT_SLOTS_PER_WORKER
+        )
+    if spec.workers_per_replica is None:
+        spec.workers_per_replica = DEFAULT_SERVE_WORKERS
+    if spec.priority_class is None:
+        spec.priority_class = DEFAULT_SERVE_PRIORITY
+    if spec.max_surge is None:
+        spec.max_surge = DEFAULT_SERVE_MAX_SURGE
+    if spec.max_unavailable is None:
+        spec.max_unavailable = DEFAULT_SERVE_MAX_UNAVAILABLE
+    asc = spec.autoscale
+    if asc is not None:
+        if asc.min_replicas is None:
+            asc.min_replicas = DEFAULT_AUTOSCALE_MIN
+        if asc.max_replicas is None:
+            asc.max_replicas = max(DEFAULT_AUTOSCALE_MAX,
+                                   asc.min_replicas,
+                                   spec.replicas or 0)
+        if asc.target_qps_per_replica is None:
+            asc.target_qps_per_replica = DEFAULT_TARGET_QPS_PER_REPLICA
+        if asc.scale_up_stabilization_s is None:
+            asc.scale_up_stabilization_s = DEFAULT_SCALE_UP_STABILIZATION_S
+        if asc.scale_down_stabilization_s is None:
+            asc.scale_down_stabilization_s = (
+                DEFAULT_SCALE_DOWN_STABILIZATION_S
+            )
+        if asc.cold_start_grace_s is None:
+            asc.cold_start_grace_s = DEFAULT_COLD_START_GRACE_S
+    if spec.replicas is None:
+        # an autoscaled serve starts at its floor (never below 1 — the
+        # scale-to-zero decision belongs to the autoscaler's zero-traffic
+        # window, not to defaulting)
+        spec.replicas = (
+            max(1, asc.min_replicas) if asc is not None
+            else DEFAULT_SERVE_REPLICAS
+        )
+    return serve

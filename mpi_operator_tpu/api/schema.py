@@ -30,6 +30,7 @@ from mpi_operator_tpu.api.types import (
     ObjectMeta,
     PodTemplate,
     TPUJob,
+    TPUServe,
 )
 
 
@@ -65,6 +66,7 @@ _FREEFORM = {
 # Extra accepted spellings beyond the automatic camelCase of each field.
 _EXTRA_ALIASES: Dict[Type, Dict[str, str]] = {
     TPUJob: {"apiVersion": "api_version"},
+    TPUServe: {"apiVersion": "api_version"},
     PodTemplate: {"containers": "container"},
 }
 
@@ -183,13 +185,17 @@ def _check_obj(cls: Type, d: Dict[str, Any], path: str, errors: List[str]) -> Di
     return out
 
 
-def check_manifest(d: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
-    """Strictly check a TPUJob manifest; returns (normalized snake_case
-    manifest, errors). Unknown fields at any depth are errors."""
+def check_manifest(
+    d: Dict[str, Any], root: Type = TPUJob
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Strictly check a manifest against ``root``'s dataclass schema
+    (TPUJob by default; TPUServe for serving manifests); returns
+    (normalized snake_case manifest, errors). Unknown fields at any depth
+    are errors."""
     errors: List[str] = []
     if not isinstance(d, dict):
         return {}, ["manifest must be a mapping"]
-    norm = _check_obj(TPUJob, d, "$", errors)
+    norm = _check_obj(root, d, "$", errors)
     return norm, errors
 
 
@@ -200,6 +206,15 @@ def parse_tpujob(d: Dict[str, Any]) -> TPUJob:
     if errors:
         raise ManifestError(errors)
     return TPUJob.from_dict(norm)
+
+
+def parse_tpuserve(d: Dict[str, Any]) -> TPUServe:
+    """normalize → strict-check → TPUServe (the serving workload class's
+    admission twin of parse_tpujob; same strictness)."""
+    norm, errors = check_manifest(d, root=TPUServe)
+    if errors:
+        raise ManifestError(errors)
+    return TPUServe.from_dict(norm)
 
 
 # ---------------------------------------------------------------------------
@@ -244,11 +259,12 @@ def _obj_schema(cls: Type, seen: Tuple[Type, ...] = ()) -> Dict[str, Any]:
     }
 
 
-def json_schema() -> Dict[str, Any]:
+def json_schema(root: Type = TPUJob) -> Dict[str, Any]:
     """The structural schema artifact (≙ crd.yaml's openAPIV3Schema). Both
     camelCase and snake_case spellings are admitted, mirroring
-    check_manifest; everything else is rejected."""
-    sch = _obj_schema(TPUJob)
+    check_manifest; everything else is rejected. ``root`` picks the
+    workload class (TPUJob or TPUServe)."""
+    sch = _obj_schema(root)
     sch["$schema"] = "https://json-schema.org/draft/2020-12/schema"
-    sch["title"] = "TPUJob (tpujob.dev/v1)"
+    sch["title"] = f"{root.__name__} (tpujob.dev/v1)"
     return sch

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 API_VERSION = "tpujob.dev/v1"
 KIND_TPUJOB = "TPUJob"
+KIND_TPUSERVE = "TPUServe"
 
 # Per-family host geometry: the block of the chip mesh owned by one host.
 # This is physical knowledge the whole stack shares (defaulting, validation,
@@ -612,3 +613,205 @@ class TPUJob(_Dictable):
         `<job>-worker-i.<job>-worker` (reference newConfigMap,
         v2/pkg/controller/mpi_job_controller.go:1088-1113)."""
         return f"{self.worker_name(index)}.{self.service_name()}"
+
+
+# ---------------------------------------------------------------------------
+# TPUServe: the second workload class — long-lived autoscaled inference gangs
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AutoscalePolicy(_Dictable):
+    """HPA-style autoscaling knobs for a TPUServe.
+
+    The decision function (controller/autoscaler.py recommend()) maps a
+    window of observed metrics — aggregate QPS, per-pod queue depth, p99
+    latency — to a replica count:
+
+    - ``target_qps_per_replica`` is the primary signal: desired =
+      ceil(total_qps / target).
+    - ``target_p99_ms`` / ``target_queue_depth`` are breach escalators:
+      a window whose worst sample exceeds them bumps desired above the
+      QPS answer even when QPS alone looks fine (a hot replica saturating
+      on long sequences shows up in latency before throughput).
+    - ``scale_up_stabilization_s`` / ``scale_down_stabilization_s`` are
+      the HPA stabilization windows: scale-up takes the SMALLEST
+      recommendation over its (short) window, scale-down the LARGEST over
+      its (long) window — flapping is suppressed structurally, not by a
+      cooldown timer alone.
+    - ``scale_to_zero_after_s`` (requires ``min_replicas == 0``): a serve
+      whose window shows zero traffic for this long releases every chip.
+      Scale-FROM-zero needs an arrival-rate signal no pod can report —
+      the front door stamps ``tpujob.dev/offered-qps`` on the TPUServe
+      (the KEDA-shaped contract) and the autoscaler honors it.
+    - ``cold_start_grace_s``: after any scale-UP, scale-down is held this
+      long — freshly launched replicas serve no traffic while compiling/
+      warming, and their zero-QPS samples would otherwise immediately
+      argue the scale-up back down (the classic cold-start flap).
+
+    ``None`` fields take defaults at reconcile time (api/defaults.py), so
+    stored specs stay exactly what the user wrote.
+    """
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    target_p99_ms: Optional[float] = None
+    target_queue_depth: Optional[float] = None
+    scale_up_stabilization_s: Optional[float] = None
+    scale_down_stabilization_s: Optional[float] = None
+    scale_to_zero_after_s: Optional[float] = None
+    cold_start_grace_s: Optional[float] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AutoscalePolicy":
+        return AutoscalePolicy(
+            min_replicas=d.get("min_replicas"),
+            max_replicas=d.get("max_replicas"),
+            target_qps_per_replica=d.get("target_qps_per_replica"),
+            target_p99_ms=d.get("target_p99_ms"),
+            target_queue_depth=d.get("target_queue_depth"),
+            scale_up_stabilization_s=d.get("scale_up_stabilization_s"),
+            scale_down_stabilization_s=d.get("scale_down_stabilization_s"),
+            scale_to_zero_after_s=d.get("scale_to_zero_after_s"),
+            cold_start_grace_s=d.get("cold_start_grace_s"),
+        )
+
+
+@dataclass
+class TPUServeSpec(_Dictable):
+    """A long-lived inference service: ``replicas`` identical serving
+    GANGS of ``workers_per_replica`` hosts each, rolled forward by
+    generation when the pod-affecting spec changes, autoscaled when
+    ``autoscale`` is set (the autoscaler then owns ``replicas``; the
+    user-set value is the starting point).
+
+    Serving defaults to ``priority_class: high`` — a serving scale-up
+    that cannot place preempts batch gangs (scheduler/gang.py priority
+    preemption), which resume from checkpoint when room frees. That
+    asymmetry IS the workload-class distinction: batch tolerates
+    displacement, serving traffic does not.
+    """
+
+    replicas: Optional[int] = None
+    workers_per_replica: Optional[int] = None
+    template: PodTemplate = field(default_factory=PodTemplate)
+    slice: SliceSpec = field(default_factory=SliceSpec)
+    autoscale: Optional[AutoscalePolicy] = None
+    priority_class: Optional[str] = None
+    # rolling-update shape (kube Deployment semantics): surge replicas
+    # above desired while rolling; never more than max_unavailable ready
+    # replicas below desired — the default (1, 0) is the zero-unready-
+    # window rollout the serve bench asserts
+    max_surge: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TPUServeSpec":
+        asc = d.get("autoscale")
+        return TPUServeSpec(
+            replicas=d.get("replicas"),
+            workers_per_replica=d.get("workers_per_replica"),
+            template=PodTemplate.from_dict(d.get("template", {})),
+            slice=SliceSpec.from_dict(d.get("slice", {})),
+            autoscale=AutoscalePolicy.from_dict(asc) if asc else None,
+            priority_class=d.get("priority_class"),
+            max_surge=d.get("max_surge"),
+            max_unavailable=d.get("max_unavailable"),
+        )
+
+
+class ServeConditionType:
+    """TPUServe condition types (Deployment-shaped, not Job-shaped —
+    a serve has no terminal success):
+
+    Available   — ready_replicas >= desired - max_unavailable
+    Progressing — a rollout or scale is in flight
+    ScaledToZero — desired == 0 and nothing is live (autoscaler idle state)
+    """
+
+    AVAILABLE = "Available"
+    PROGRESSING = "Progressing"
+    SCALED_TO_ZERO = "ScaledToZero"
+
+    ALL_VALUES = (AVAILABLE, PROGRESSING, SCALED_TO_ZERO)
+
+
+@dataclass
+class TPUServeStatus(_Dictable):
+    """Mirrors the Deployment status shape the rollout machinery needs:
+    counts by readiness and generation, plus the serve generation itself —
+    the serving generalization of TPUJob's ``restart_generation`` (there a
+    generation is a gang RELAUNCH; here it is a template REVISION, and the
+    same ``tpujob.dev/generation`` pod label carries it, so the
+    single-generation trail invariants keep holding over serve gangs)."""
+
+    conditions: List[Condition] = field(default_factory=list)
+    replicas: int = 0          # live (non-failed) replica gangs observed
+    ready_replicas: int = 0    # gangs with every pod Running AND ready
+    updated_replicas: int = 0  # live gangs at the current generation
+    # template revision counter: bumps when the pod-affecting spec hash
+    # changes; stamped on pods as tpujob.dev/generation
+    serve_generation: int = 0
+    template_hash: str = ""
+    # monotonic replica-id allocator — ids are NEVER reused, so a trail
+    # can always tell generations' gangs apart by name alone
+    next_replica_id: int = 0
+    # the autoscaler's latest target (observability; spec.replicas is the
+    # authoritative desired count it writes)
+    desired_replicas: Optional[int] = None
+    last_scale_up_time: Optional[float] = None
+    last_scale_down_time: Optional[float] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TPUServeStatus":
+        return TPUServeStatus(
+            conditions=[Condition.from_dict(c) for c in d.get("conditions", [])],
+            replicas=d.get("replicas", 0),
+            ready_replicas=d.get("ready_replicas", 0),
+            updated_replicas=d.get("updated_replicas", 0),
+            serve_generation=d.get("serve_generation", 0),
+            template_hash=d.get("template_hash", ""),
+            next_replica_id=d.get("next_replica_id", 0),
+            desired_replicas=d.get("desired_replicas"),
+            last_scale_up_time=d.get("last_scale_up_time"),
+            last_scale_down_time=d.get("last_scale_down_time"),
+        )
+
+
+@dataclass
+class TPUServe(_Dictable):
+    api_version: str = API_VERSION
+    kind: str = KIND_TPUSERVE
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUServeSpec = field(default_factory=TPUServeSpec)
+    status: TPUServeStatus = field(default_factory=TPUServeStatus)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TPUServe":
+        return TPUServe(
+            api_version=d.get("api_version", d.get("apiVersion", API_VERSION)),
+            kind=d.get("kind", KIND_TPUSERVE),
+            metadata=ObjectMeta.from_dict(d.get("metadata", {})),
+            spec=TPUServeSpec.from_dict(d.get("spec", {})),
+            status=TPUServeStatus.from_dict(d.get("status", {})),
+        )
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    # -- naming: one replica gang = one schedulable unit -------------------
+
+    def gang_name(self, replica_id: int) -> str:
+        """The replica gang's name — doubles as its PodGroup name and the
+        ``tpujob.dev/job-name`` gang-grouping label value, so the gang
+        scheduler admits serving replicas with the exact machinery it
+        admits batch gangs with."""
+        return f"{self.metadata.name}-r{replica_id}"
+
+    def pod_name(self, replica_id: int, index: int) -> str:
+        return f"{self.gang_name(replica_id)}-w{index}"

@@ -26,6 +26,7 @@ from mpi_operator_tpu.api.types import (
     ElasticPolicy,
     RestartPolicy,
     TPUJob,
+    TPUServe,
     compute_host_mesh,
     family_chips_per_host,
     host_block_for,
@@ -246,5 +247,164 @@ def validate_tpujob(job: TPUJob) -> List[str]:
 
 def validate_or_raise(job: TPUJob) -> None:
     errs = validate_tpujob(job)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_tpuserve(serve: TPUServe) -> List[str]:
+    """Field-path errors for a TPUServe; empty means valid. Same posture
+    as validate_tpujob: enum membership, generated-name DNS legality, and
+    slice/gang geometry coherence checked at admission — a serve that
+    passes here can always be placed."""
+    errs: List[str] = []
+    spec = serve.spec
+
+    name = serve.metadata.name
+    if not name:
+        errs.append("metadata.name: required")
+    else:
+        # replica ids are an unbounded monotonic counter: budget the worst
+        # generated pod name for a 6-digit id so a long-lived serve can
+        # never roll itself into an illegal hostname
+        workers = spec.workers_per_replica or 1
+        worst = f"{name}-r999999-w{max(workers - 1, 0)}"
+        if not _DNS1035.match(worst) or len(worst) > _MAX_LABEL:
+            errs.append(
+                f"metadata.name: generated pod name {worst!r} is not a valid "
+                f"DNS-1035 label (lowercase alphanumeric/'-', start with "
+                f"letter, <= {_MAX_LABEL} chars incl. replica suffix budget)"
+            )
+
+    if spec.replicas is not None and spec.replicas < 0:
+        errs.append("spec.replicas: must be >= 0")
+    wpr = spec.workers_per_replica
+    if wpr is not None and wpr < 1:
+        errs.append("spec.workers_per_replica: must be >= 1")
+    if spec.max_surge is not None and spec.max_surge < 1:
+        # surge 0 would deadlock the zero-unavailable rollout: nothing may
+        # launch above desired AND nothing ready may drain
+        errs.append("spec.max_surge: must be >= 1")
+    if spec.max_unavailable is not None and spec.max_unavailable < 0:
+        errs.append("spec.max_unavailable: must be >= 0")
+
+    if spec.priority_class:
+        from mpi_operator_tpu.scheduler.gang import (
+            PRIORITY_CLASSES,
+            resolve_priority_class,
+        )
+
+        if resolve_priority_class(spec.priority_class) is None:
+            errs.append(
+                f"spec.priority_class: unknown class "
+                f"{spec.priority_class!r}; expected one of "
+                f"{sorted(k for k in PRIORITY_CLASSES if k)} or an integer"
+            )
+
+    acc = spec.slice.accelerator
+    if acc and acc not in KNOWN_ACCELERATORS:
+        errs.append(
+            f"spec.slice.accelerator: unsupported value {acc!r}, "
+            f"expected one of {sorted(KNOWN_ACCELERATORS)}"
+        )
+    cph = spec.slice.chips_per_host
+    if cph is not None and cph < 1:
+        errs.append("spec.slice.chips_per_host: must be >= 1")
+    block = (
+        host_block_for(acc, cph) if acc in KNOWN_ACCELERATORS else None
+    )
+    if acc in KNOWN_ACCELERATORS and cph and block is None:
+        errs.append(
+            f"spec.slice.chips_per_host: {cph} chips per host is not a "
+            f"legal {acc} host configuration (full block "
+            f"{'x'.join(map(str, HOST_BLOCK[acc]))}, sub-host values 1 or 2)"
+        )
+    fam_cph = family_chips_per_host(acc)
+    if (
+        fam_cph is not None
+        and cph
+        and cph != fam_cph
+        and (wpr or 0) > 1
+    ):
+        errs.append(
+            f"spec.slice.chips_per_host: multi-host {acc} gangs have "
+            f"{fam_cph} chips per host, got {cph} — sub-host slices are "
+            f"single-worker"
+        )
+    if spec.slice.topology:
+        dims = _validate_topology(spec.slice.topology)
+        if dims is None:
+            errs.append(
+                f"spec.slice.topology: malformed {spec.slice.topology!r}, "
+                f"expected e.g. '4x4x4'"
+            )
+        elif wpr and block is not None:
+            mesh = compute_host_mesh(tuple(dims), block)
+            if mesh is None:
+                errs.append(
+                    f"spec.slice.topology: {spec.slice.topology!r} is not "
+                    f"divisible into {acc} host blocks of "
+                    f"{'x'.join(map(str, block))}"
+                )
+            else:
+                hosts = 1
+                for m in mesh:
+                    hosts *= m
+                if hosts != wpr:
+                    errs.append(
+                        f"spec.slice.topology: topology "
+                        f"{spec.slice.topology!r} holds {hosts} hosts but "
+                        f"each serving replica has {wpr} workers"
+                    )
+    if spec.slice.num_slices != 1:
+        # a serving REPLICA is one gang on one slice; horizontal scale is
+        # what replicas are for — a multi-slice single replica would hide
+        # the scaling unit from the autoscaler
+        errs.append(
+            "spec.slice.num_slices: serving replicas are single-slice "
+            "gangs (scale horizontally via replicas/autoscale)"
+        )
+
+    asc = spec.autoscale
+    if asc is not None:
+        if asc.min_replicas is not None and asc.min_replicas < 0:
+            errs.append("spec.autoscale.min_replicas: must be >= 0")
+        if asc.max_replicas is not None and asc.max_replicas < 1:
+            errs.append("spec.autoscale.max_replicas: must be >= 1")
+        if (
+            asc.min_replicas is not None
+            and asc.max_replicas is not None
+            and asc.min_replicas > asc.max_replicas
+        ):
+            errs.append(
+                "spec.autoscale: min_replicas must be <= max_replicas"
+            )
+        if (
+            asc.target_qps_per_replica is not None
+            and asc.target_qps_per_replica <= 0
+        ):
+            errs.append(
+                "spec.autoscale.target_qps_per_replica: must be > 0"
+            )
+        for fname in ("target_p99_ms", "target_queue_depth",
+                      "scale_up_stabilization_s",
+                      "scale_down_stabilization_s", "cold_start_grace_s"):
+            v = getattr(asc, fname)
+            if v is not None and v < 0:
+                errs.append(f"spec.autoscale.{fname}: must be >= 0")
+        if asc.scale_to_zero_after_s is not None:
+            if asc.scale_to_zero_after_s < 0:
+                errs.append(
+                    "spec.autoscale.scale_to_zero_after_s: must be >= 0"
+                )
+            if asc.min_replicas is not None and asc.min_replicas > 0:
+                errs.append(
+                    "spec.autoscale.scale_to_zero_after_s: requires "
+                    "min_replicas = 0 (the floor forbids reaching zero)"
+                )
+    return errs
+
+
+def validate_serve_or_raise(serve: TPUServe) -> None:
+    errs = validate_tpuserve(serve)
     if errs:
         raise ValidationError(errs)

@@ -1,0 +1,390 @@
+"""HPA-style autoscaler for TPUServe: metrics window in → replica count out.
+
+The decision core, :func:`recommend`, is a PURE function — a window of
+:class:`Sample` observations, the current replica count, the (defaulted)
+policy targets and a clock go in; a :class:`Decision` comes out. Every
+behavior the serving SLO depends on is therefore unit-testable without a
+cluster (tests/test_autoscale.py sweeps it property-style):
+
+- **Primary signal**: desired = ceil(total_qps / target_qps_per_replica).
+- **Breach escalation**: a window whose worst p99 / queue depth exceeds
+  its target argues for one MORE replica than QPS alone — saturation
+  shows in latency before throughput.
+- **Stabilization windows** (the HPA flap suppressors): scale-up takes
+  the SMALLEST recommendation over the up window (every recent sample
+  must agree the load is real), scale-down the LARGEST over the down
+  window (one quiet sample never sheds capacity a recent spike needed).
+- **Cold-start guard**: after any scale-up, scale-down holds for
+  ``cold_start_grace_s`` — fresh replicas serve nothing while warming,
+  and their zero-QPS samples would otherwise immediately argue the
+  scale-up back down.
+- **Scale-to-zero** (min_replicas == 0 only): the window must show zero
+  traffic continuously for ``scale_to_zero_after_s``. Scale FROM zero
+  needs an arrival signal no pod can emit — the front door stamps the
+  ``tpujob.dev/offered-qps`` annotation on the TPUServe (the KEDA-shaped
+  contract) and the sampler folds it in.
+
+The :class:`ServeAutoscaler` wrapper is the small impure shell: it samples
+pod ``status.serve_stats`` through the informer, keeps the per-serve
+window, and writes the verdict to ``spec.replicas`` (uid-pinned patch) —
+exactly how the HPA writes a Deployment's scale subresource.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional
+
+from mpi_operator_tpu.api.defaults import set_serve_defaults
+from mpi_operator_tpu.api.types import TPUServe
+from mpi_operator_tpu.controller.serve import (
+    LABEL_SERVE_NAME,
+    group_replicas,
+    replica_ready,
+)
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.events import NORMAL, EventRecorder
+from mpi_operator_tpu.machinery.objects import PodPhase
+from mpi_operator_tpu.machinery.store import Conflict, NotFound, ObjectStore
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.autoscaler")
+
+# the scale-from-zero arrival hint (stamped by the ingress/front door;
+# nothing inside the cluster can observe offered load with zero replicas)
+ANNOTATION_OFFERED_QPS = "tpujob.dev/offered-qps"
+
+EVENT_SCALE_UP = "ScaleUp"
+EVENT_SCALE_DOWN = "ScaleDown"
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One observation of a serve's load (aggregated across its pods)."""
+
+    t: float
+    qps: float          # total offered/served QPS
+    queue_depth: float  # worst per-pod queue depth
+    p99_ms: float       # worst per-pod p99 latency
+    ready: int          # ready replicas at sample time
+
+
+@dataclass(frozen=True)
+class Targets:
+    """The defaulted AutoscalePolicy, flattened for the pure core."""
+
+    min_replicas: int
+    max_replicas: int
+    target_qps_per_replica: float
+    target_p99_ms: Optional[float] = None
+    target_queue_depth: Optional[float] = None
+    up_window_s: float = 0.0
+    down_window_s: float = 30.0
+    scale_to_zero_after_s: Optional[float] = None
+    cold_start_grace_s: float = 15.0
+
+    @staticmethod
+    def from_policy(asc) -> "Targets":
+        return Targets(
+            min_replicas=asc.min_replicas,
+            max_replicas=asc.max_replicas,
+            target_qps_per_replica=asc.target_qps_per_replica,
+            target_p99_ms=asc.target_p99_ms,
+            target_queue_depth=asc.target_queue_depth,
+            up_window_s=asc.scale_up_stabilization_s,
+            down_window_s=asc.scale_down_stabilization_s,
+            scale_to_zero_after_s=asc.scale_to_zero_after_s,
+            cold_start_grace_s=asc.cold_start_grace_s,
+        )
+
+
+@dataclass(frozen=True)
+class Decision:
+    replicas: int
+    reason: str
+
+
+def _raw_desired(s: Sample, t: Targets, current: int) -> int:
+    """The per-sample recommendation before any stabilization."""
+    if s.qps <= 0:
+        base = 0
+    else:
+        base = max(1, math.ceil(s.qps / t.target_qps_per_replica))
+    # breach escalators: saturation argues for one more than we have,
+    # even when raw QPS says the fleet is sized right
+    if t.target_p99_ms is not None and s.p99_ms > t.target_p99_ms:
+        base = max(base, max(current, s.ready) + 1)
+    if (
+        t.target_queue_depth is not None
+        and s.queue_depth > t.target_queue_depth
+    ):
+        base = max(base, max(current, s.ready) + 1)
+    return base
+
+
+def recommend(
+    samples: List[Sample],
+    current: int,
+    targets: Targets,
+    now: float,
+    last_scale_up_t: Optional[float] = None,
+) -> Decision:
+    """The pure decision: newest-sample-inclusive stabilization windows,
+    cold-start guard, scale-to-zero grace, [min, max] clamping. ``samples``
+    must be time-ordered (oldest first); an empty window holds."""
+    # every verdict is clamped to [min, max] — HPA semantics: a serve
+    # manually scaled below its floor (ctl serve scale, a hand-edited
+    # spec) self-heals on the next tick instead of parking there until
+    # traffic happens to argue it back up
+    if current < targets.min_replicas:
+        return Decision(
+            min(targets.min_replicas, targets.max_replicas),
+            f"raise to the min_replicas floor ({targets.min_replicas})",
+        )
+    if current > targets.max_replicas:
+        return Decision(
+            targets.max_replicas,
+            f"lower to the max_replicas cap ({targets.max_replicas})",
+        )
+    if not samples:
+        return Decision(current, "no-samples")
+    latest = samples[-1]
+
+    def window(w: float) -> List[Sample]:
+        out = [s for s in samples if s.t >= now - w]
+        return out or [latest]
+
+    recs_up = [_raw_desired(s, targets, current) for s in window(
+        targets.up_window_s)]
+    recs_down = [_raw_desired(s, targets, current) for s in window(
+        targets.down_window_s)]
+    candidate_up = min(recs_up)
+    candidate_down = max(recs_down)
+    floor = max(0, targets.min_replicas)
+    cap = targets.max_replicas
+
+    if candidate_up > current:
+        return Decision(
+            min(max(candidate_up, floor), cap),
+            f"scale-up: window agrees on >= {candidate_up} "
+            f"(qps {latest.qps:g})",
+        )
+
+    if candidate_down >= current:
+        return Decision(current, "steady")
+
+    # --- scale-down path, guarded ---
+    if (
+        last_scale_up_t is not None
+        and now - last_scale_up_t < targets.cold_start_grace_s
+    ):
+        return Decision(
+            current,
+            f"hold: cold-start grace ({targets.cold_start_grace_s:g}s "
+            f"after scale-up)",
+        )
+    target = candidate_down
+    if target <= 0:
+        # zero only via the explicit zero-traffic grace
+        zero_ok = (
+            targets.min_replicas == 0
+            and targets.scale_to_zero_after_s is not None
+        )
+        if zero_ok:
+            horizon = now - targets.scale_to_zero_after_s
+            covered = samples[0].t <= horizon
+            quiet = all(s.qps <= 0 for s in samples if s.t >= horizon)
+            zero_ok = covered and quiet
+        if not zero_ok:
+            target = max(1, floor)
+            if target >= current:
+                return Decision(current, "hold: zero-traffic grace not met")
+            return Decision(
+                min(target, cap),
+                "scale-down to floor (zero grace pending)",
+            )
+        return Decision(0, "scale-to-zero: zero traffic past the grace")
+    target = min(max(target, max(1, floor) if target > 0 else floor), cap)
+    if target >= current:
+        return Decision(current, "steady")
+    return Decision(
+        target,
+        f"scale-down: down-window max is {candidate_down}",
+    )
+
+
+class _ServeState:
+    __slots__ = ("window", "last_scale_up_t", "last_scale_down_t", "key")
+
+    def __init__(self, key: str = ""):
+        self.window: Deque[Sample] = deque(maxlen=512)
+        self.last_scale_up_t: Optional[float] = None
+        self.last_scale_down_t: Optional[float] = None
+        self.key = key
+
+
+class ServeAutoscaler:
+    """The impure shell: sample → window → recommend → patch
+    ``spec.replicas``. Runs leader-only next to the serve controller;
+    ``tick()`` is public so tests and the bench can drive it with their
+    own clock."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        cache: Optional["InformerCache"] = None,
+        namespace: Optional[str] = None,
+        interval: float = 2.0,
+    ):
+        self.store = store
+        self.cache = cache
+        self.read = cache if cache is not None else store
+        self.recorder = recorder or EventRecorder(
+            store, component="tpuserve-autoscaler"
+        )
+        self.namespace = namespace
+        self.interval = interval
+        self._states: Dict[str, _ServeState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServeAutoscaler":
+        self._thread = threading.Thread(
+            target=self._run, name="tpuserve-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("autoscaler tick failed; next tick retries")
+
+    # ------------------------------------------------------------------
+
+    def sample(self, serve: TPUServe, now: float) -> Sample:
+        """Aggregate the serve's pod-reported serve_stats plus the
+        front-door arrival hint into one observation."""
+        pods = self.read.list(
+            "Pod", serve.namespace, selector={LABEL_SERVE_NAME: serve.name}
+        )
+        live = [p for p in pods if not p.is_finished()]
+        qps = 0.0
+        queue_depth = 0.0
+        p99 = 0.0
+        for p in live:
+            stats = p.status.serve_stats or {}
+            if p.status.phase != PodPhase.RUNNING:
+                continue
+            qps += float(stats.get("qps", 0.0))
+            queue_depth = max(queue_depth, float(stats.get("queue_depth",
+                                                           0.0)))
+            p99 = max(p99, float(stats.get("p99_ms", 0.0)))
+        hint = serve.metadata.annotations.get(ANNOTATION_OFFERED_QPS)
+        if hint:
+            try:
+                qps = max(qps, float(hint))
+            except ValueError:
+                pass  # a malformed hint must not break the loop
+        workers = serve.spec.workers_per_replica or 1
+        ready = sum(
+            1 for members in group_replicas(live).values()
+            if replica_ready(members, workers)
+        )
+        return Sample(t=now, qps=qps, queue_depth=queue_depth, p99_ms=p99,
+                      ready=ready)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One decision pass over every autoscaled serve."""
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+        with trace.start_span("autoscaler.sync"):
+            seen = set()
+            for serve in self.read.list("TPUServe", self.namespace):
+                seen.add(serve.metadata.uid)
+                try:
+                    self._tick_serve(serve, now)
+                except (Conflict, NotFound):
+                    continue  # stale read; next tick re-reads
+            for uid in [u for u in self._states if u not in seen]:
+                # deleted serve: drop its window AND its gauge series (a
+                # per-object gauge must not export its last value forever)
+                state = self._states.pop(uid)
+                if state.key:
+                    metrics.serve_desired_replicas.remove(serve=state.key)
+        metrics.autoscaler_sync_latency.observe(time.perf_counter() - t0)
+
+    def _tick_serve(self, stored: TPUServe, now: float) -> None:
+        serve = set_serve_defaults(stored.deepcopy())
+        asc = serve.spec.autoscale
+        if asc is None:
+            return
+        state = self._states.setdefault(
+            serve.metadata.uid, _ServeState(serve.metadata.key())
+        )
+        if len(self._states) > 4096:
+            self._states.pop(next(iter(self._states)))
+        state.window.append(self.sample(serve, now))
+        # age out samples beyond the longest horizon anyone consults
+        horizon = max(
+            asc.scale_up_stabilization_s, asc.scale_down_stabilization_s,
+            asc.scale_to_zero_after_s or 0.0,
+        ) + 10.0
+        while state.window and state.window[0].t < now - horizon:
+            state.window.popleft()
+        current = serve.spec.replicas
+        decision = recommend(
+            list(state.window), current, Targets.from_policy(asc), now,
+            last_scale_up_t=state.last_scale_up_t,
+        )
+        metrics.serve_desired_replicas.set(
+            decision.replicas,
+            serve=f"{serve.namespace}/{serve.name}",
+        )
+        if decision.replicas == current:
+            return
+        direction = "up" if decision.replicas > current else "down"
+        with trace.start_span(
+            "autoscaler.scale",
+            trace_id=serve.metadata.annotations.get(
+                trace.ANNOTATION_TRACE_ID),
+            attrs={
+                "serve": serve.metadata.key(),
+                "from": current, "to": decision.replicas,
+                "reason": decision.reason,
+            },
+        ):
+            # uid-pinned like every identity-sensitive write: a recreated
+            # same-name serve must not inherit the old one's scale verdict
+            self.store.patch(
+                "TPUServe", serve.namespace, serve.name,
+                {"spec": {"replicas": decision.replicas},
+                 "metadata": {"uid": serve.metadata.uid}},
+            )
+        if direction == "up":
+            state.last_scale_up_t = now
+        else:
+            state.last_scale_down_t = now
+        metrics.serve_scale_events.inc(direction=direction)
+        self.recorder.event(
+            serve, NORMAL,
+            EVENT_SCALE_UP if direction == "up" else EVENT_SCALE_DOWN,
+            f"replicas {current} → {decision.replicas} ({decision.reason})",
+        )
+        log.info("%s: replicas %d → %d (%s)", serve.metadata.key(),
+                 current, decision.replicas, decision.reason)

@@ -1,0 +1,799 @@
+"""TPUServe controller: the serving workload class's reconcile loop.
+
+Batch (TPUJob) runs to completion; serving runs until told otherwise. This
+controller manages N long-lived inference GANGS ("replicas", each a
+``workers_per_replica``-host gang with its own PodGroup, admitted by the
+SAME gang scheduler that admits batch — at serving priority), with:
+
+- **Readiness gates**: a replica serves only when every member pod is
+  Running AND ready (``pod.status.ready`` — the executor flips it after
+  model load/warmup, the kubelet-readiness-probe equivalent). Replica
+  readiness drives Available/Progressing conditions and the rollout below.
+- **Rolling generation-based updates** — the serving generalization of
+  TPUJob's ``restart_generation``: a hash of the pod-affecting spec
+  (template + slice + gang size) names a GENERATION; when it changes the
+  controller surges a new-generation replica (up to ``max_surge`` above
+  desired), waits for it to pass the readiness gate, and only then drains
+  an old-generation replica — ready count never dips below
+  ``desired - max_unavailable`` (0 by default: zero unready windows, the
+  serve bench's tripwire). Pods carry the generation in the SAME
+  ``tpujob.dev/generation`` label batch gangs use, so the trail
+  invariants (one generation per gang, monotone) hold unchanged.
+- **Self-healing**: a replica with a terminal pod (node loss eviction,
+  crash, preemption) is torn down whole — gang coherence, same argument
+  as the batch controller's gang-scoped restarts — and a fresh replica
+  (new id, current generation) replaces it.
+- **Replica ids are monotonic and never reused**, so `ctl trace` and the
+  invariant checkers can tell every gang apart by name alone.
+
+Scale decisions live elsewhere: the autoscaler (controller/autoscaler.py)
+writes ``spec.replicas``; this loop only makes the world match it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.defaults import set_serve_defaults
+from mpi_operator_tpu.api.types import (
+    Container,
+    ObjectMeta,
+    OwnerReference,
+    ServeConditionType,
+    TPUServe,
+)
+from mpi_operator_tpu.api.validation import validate_tpuserve
+from mpi_operator_tpu.controller.controller import (
+    ENV_ACCELERATOR,
+    ENV_CHIPS_PER_HOST,
+    ENV_COORDINATOR,
+    ENV_HOST_COORD,
+    ENV_HOST_ID,
+    ENV_HOST_MESH,
+    ENV_NAMESPACE,
+    ENV_NUM_HOSTS,
+    ENV_TOPOLOGY,
+    LABEL_GENERATION,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_ROLE,
+)
+from mpi_operator_tpu.controller.placement import PlacementError, place_workers
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    PodPhase,
+    PodSpec,
+)
+from mpi_operator_tpu.machinery.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    WatchEvent,
+    diff_merge_patch,
+)
+from mpi_operator_tpu.machinery.workqueue import RateLimitingQueue
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.serve")
+
+# serving-pod labels (the batch labels plus the serve identity pair)
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
+LABEL_SERVE_REPLICA = "tpujob.dev/serve-replica"
+ROLE_SERVE = "serve"
+
+# rendezvous env additions for serving gangs (batch's TPUJOB_* contract
+# carries the gang geometry; these carry the serving identity)
+ENV_SERVE_NAME = "TPUSERVE_NAME"
+ENV_SERVE_REPLICA = "TPUSERVE_REPLICA"
+ENV_SERVE_GENERATION = "TPUSERVE_GENERATION"
+
+# per-replica rendezvous port: serving gangs are placed by replica id, so a
+# deterministic hash slot suffices (two replicas of one serve never share a
+# coordinator; cross-serve collisions are as harmless as batch's hash probe
+# misses — the executor binds per-process)
+SERVE_PORT_BASE = 8600
+SERVE_PORT_RANGE = 1024
+
+EVENT_VALIDATION_ERROR = "ValidationError"
+EVENT_PLACEMENT_ERROR = "PlacementError"
+EVENT_ROLLOUT = "RolloutStarted"
+EVENT_REPLICA_FAILED = "ReplicaFailed"
+EVENT_SCALED_TO_ZERO = "ScaledToZero"
+
+
+def compute_template_hash(serve: TPUServe) -> str:
+    """The generation fingerprint: everything that lands in a pod. Computed
+    over the DEFAULTED spec so an explicit default and an omitted field
+    hash identically (no phantom rollouts)."""
+    payload = json.dumps(
+        {
+            "template": serve.spec.template.to_dict(),
+            "slice": serve.spec.slice.to_dict(),
+            "workers": serve.spec.workers_per_replica,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+def serve_port(replica_id: int) -> int:
+    return SERVE_PORT_BASE + replica_id % SERVE_PORT_RANGE
+
+
+def group_replicas(pods: List[Pod]) -> Dict[int, List[Pod]]:
+    """Pods → replica-id → member pods (label-driven, level-triggered:
+    observed state is the only input)."""
+    out: Dict[int, List[Pod]] = {}
+    for p in pods:
+        rid = p.metadata.labels.get(LABEL_SERVE_REPLICA)
+        if rid is None:
+            continue
+        try:
+            out.setdefault(int(rid), []).append(p)
+        except ValueError:
+            continue
+    for members in out.values():
+        members.sort(
+            key=lambda p: int(p.metadata.labels.get(LABEL_REPLICA_INDEX, "0"))
+        )
+    return out
+
+
+def replica_ready(members: List[Pod], workers: int) -> bool:
+    """The readiness gate: full gang, every pod Running AND ready."""
+    return len(members) >= workers and all(
+        p.status.phase == PodPhase.RUNNING and p.status.ready
+        for p in members
+    )
+
+
+def replica_generation(members: List[Pod]) -> int:
+    """The generation a replica's pods were stamped with (uniform by
+    construction; the min is the safe read if a heal ever mixed them)."""
+    gens = []
+    for p in members:
+        try:
+            gens.append(int(p.metadata.labels.get(LABEL_GENERATION, "0")))
+        except ValueError:
+            pass
+    return min(gens) if gens else 0
+
+
+@dataclass
+class ServeControllerOptions:
+    namespace: Optional[str] = None
+    threadiness: int = 1
+
+
+class TPUServeController:
+    """Level-triggered reconciler for TPUServe over an ObjectStore —
+    deliberately the same shape as TPUJobController (watch/informer pump →
+    rate-limited workqueue → sync_handler) so the operational story
+    (leader-only, informer reads, uid-pinned status patches) is uniform
+    across both workload classes."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        options: Optional[ServeControllerOptions] = None,
+        cache: Optional["InformerCache"] = None,
+    ):
+        self.store = store
+        self.cache = cache
+        self.read = cache if cache is not None else store
+        self.options = options or ServeControllerOptions()
+        self.recorder = recorder or EventRecorder(
+            store, component="tpuserve-controller"
+        )
+        self.queue = RateLimitingQueue()
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._watch_q = None
+        self._write_status = self._default_write_status
+        self._lock = threading.Lock()
+        # serve key → causal parent of the next reconcile (watch origin)
+        self._trace_links: Dict[str, object] = {}
+        # serve uid → trace id stamped by this controller (informer-lag memo)
+        self._stamped_traces: Dict[str, str] = {}
+        # (serve uid, replica id) already announced ready — the
+        # serve.replica_ready span and its readiness-latency observation
+        # fire once per gang
+        self._ready_noted: set = set()
+        # serve uid → last effective desired (stamps last_scale_*_time)
+        self._last_desired: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # run loop
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        if self.cache is not None:
+            self.cache.add_event_handler(lambda etype, obj: self._pump_obj(obj))
+        else:
+            self._watch_q = self.store.watch(None)
+            pump = threading.Thread(
+                target=self._pump, name="tpuserve-watch-pump", daemon=True
+            )
+            pump.start()
+            self._threads.append(pump)
+        for i in range(self.options.threadiness):
+            t = threading.Thread(
+                target=self._run_worker, name=f"tpuserve-worker-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        prime = threading.Thread(
+            target=self._prime, name="tpuserve-prime", daemon=True
+        )
+        prime.start()
+        self._threads.append(prime)
+
+    def _wait_cache_synced(self) -> bool:
+        if self.cache is None:
+            return True
+        while not self._stop.is_set():
+            if self.cache.wait_for_sync(0.2):
+                return True
+        return False
+
+    def _prime(self) -> None:
+        if not self._wait_cache_synced():
+            return
+        for serve in self.read.list("TPUServe", self.options.namespace):
+            self.enqueue(serve.metadata.key())
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        if self._watch_q is not None:
+            self.store.stop_watch(self._watch_q)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ev: WatchEvent = self._watch_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if ev.kind == "Event":
+                continue
+            trace.set_delivery(getattr(ev, "trace", None))
+            try:
+                self._pump_obj(ev.obj)
+            finally:
+                trace.clear_delivery()
+
+    def _pump_obj(self, obj) -> None:
+        ns = obj.metadata.namespace
+        if self.options.namespace is not None and ns != self.options.namespace:
+            return
+        if obj.kind == "TPUServe":
+            self._note_trigger(obj.metadata.key())
+            self.enqueue(obj.metadata.key())
+            return
+        owner = self._controller_owner(obj)
+        if owner is not None:
+            self._note_trigger(f"{ns}/{owner.name}")
+            self.enqueue(f"{ns}/{owner.name}")
+
+    def _note_trigger(self, key: str) -> None:
+        link = trace.get_delivery()
+        if link is not None:
+            with self._lock:
+                self._trace_links[key] = link
+
+    @staticmethod
+    def _controller_owner(obj) -> Optional[OwnerReference]:
+        for ref in obj.metadata.owner_references:
+            if ref.controller and ref.kind == "TPUServe":
+                return ref
+        return None
+
+    def _run_worker(self) -> None:
+        if not self._wait_cache_synced():
+            return
+        while True:
+            key = self.queue.get(timeout=0.2)
+            if key is None:
+                if self._stop.is_set() or self.queue.shutting_down:
+                    return
+                continue
+            try:
+                ok = self.sync_handler(key)
+            except Exception:
+                log.exception("serve sync %s failed", key)
+                ok = False
+            if ok:
+                self.queue.forget(key)
+            else:
+                self.queue.add_rate_limited(key)
+            self.queue.done(key)
+
+    # ------------------------------------------------------------------
+    # reconcile
+    # ------------------------------------------------------------------
+
+    def sync_handler(self, key: str) -> bool:
+        with self._lock:
+            link = self._trace_links.pop(key, None)
+        t0 = time.perf_counter()
+        try:
+            with trace.start_span(
+                "serve.reconcile", parent=link, attrs={"serve": key}
+            ):
+                return self._sync(key)
+        except (Conflict, AlreadyExists):
+            return False  # stale cached read: requeue past the watch echo
+        except RuntimeError as e:
+            log.warning("serve sync %s: %s", key, e)
+            return False
+        finally:
+            metrics.serve_reconcile_latency.observe(time.perf_counter() - t0)
+
+    def _sync(self, key: str) -> bool:
+        namespace, name = key.split("/", 1)
+        serve = self.read.try_get("TPUServe", namespace, name)
+        if serve is None:
+            self._reap_orphans(namespace, name)
+            # a deleted serve's per-object gauges must stop exporting
+            # their last values (and churn must not grow the registry)
+            metrics.serve_replicas_ready.remove(serve=key)
+            metrics.serve_desired_replicas.remove(serve=key)
+            return True
+        set_serve_defaults(serve)
+        errs = validate_tpuserve(serve)
+        if errs:
+            self.recorder.event(
+                serve, WARNING, EVENT_VALIDATION_ERROR, "; ".join(errs)
+            )
+            return True
+        self._ensure_trace_id(serve)
+
+        # --- generation: the rolling-update trigger -------------------
+        h = compute_template_hash(serve)
+        if serve.status.template_hash and serve.status.template_hash != h:
+            old_gen = serve.status.serve_generation
+            serve.status.serve_generation += 1
+            self.recorder.event(
+                serve, NORMAL, EVENT_ROLLOUT,
+                f"template changed: rolling generation {old_gen} → "
+                f"{serve.status.serve_generation}",
+            )
+            # the rollout anchor span `ctl trace <serve>` renders: the
+            # per-replica launch/ready/drain spans that execute the
+            # rollout all follow it in the serve's trace
+            with trace.start_span(
+                "serve.rollout",
+                trace_id=self._trace_id(serve),
+                attrs={
+                    "serve": key,
+                    "from_generation": old_gen,
+                    "to_generation": serve.status.serve_generation,
+                },
+            ):
+                pass
+        serve.status.template_hash = h
+        gen = serve.status.serve_generation
+
+        desired = serve.spec.replicas or 0
+        workers = serve.spec.workers_per_replica
+        try:
+            placement = place_workers(serve.spec.slice, workers)
+        except PlacementError as e:
+            self.recorder.event(serve, WARNING, EVENT_PLACEMENT_ERROR, str(e))
+            return True
+
+        pods = self.read.list(
+            "Pod", namespace, selector={LABEL_SERVE_NAME: name}
+        )
+        replicas = group_replicas(pods)
+
+        # --- tear down failed gangs (gang coherence, as in batch) ------
+        live: Dict[int, List[Pod]] = {}
+        for rid, members in sorted(replicas.items()):
+            if any(p.is_finished() for p in members):
+                first = next(p for p in members if p.is_finished())
+                self.recorder.event(
+                    serve, WARNING, EVENT_REPLICA_FAILED,
+                    f"replica {rid}: pod {first.metadata.name} "
+                    f"{first.status.phase} "
+                    f"({first.status.reason or 'Error'}); tearing the gang "
+                    f"down for replacement",
+                )
+                self._drain_replica(serve, rid, members, reason="failed")
+                continue
+            live[rid] = members
+
+        ready_ids = {
+            rid for rid, members in live.items()
+            if replica_ready(members, workers)
+        }
+        self._note_ready(serve, live, ready_ids, gen)
+        new_gen = {
+            rid for rid, members in live.items()
+            if replica_generation(members) == gen
+        }
+
+        # --- heal partial gangs (crash mid-create) --------------------
+        for rid, members in live.items():
+            if len(members) < workers:
+                have = {
+                    int(p.metadata.labels.get(LABEL_REPLICA_INDEX, "0"))
+                    for p in members
+                }
+                rgen = replica_generation(members)
+                for j in range(workers):
+                    if j not in have:
+                        self._create_pod(serve, rid, j, rgen, placement)
+
+        # --- surge new-generation gangs up to desired ------------------
+        need = desired - len(new_gen)
+        budget = desired + serve.spec.max_surge - len(live)
+        for _ in range(max(0, min(need, budget))):
+            rid = serve.status.next_replica_id
+            serve.status.next_replica_id += 1
+            self._launch_replica(serve, rid, gen, workers, placement)
+            live[rid] = []  # counts against desired/surge this pass
+            new_gen.add(rid)
+
+        # --- drain: old generations and scale-down excess --------------
+        # One rule serves both rollout and scale-down: while more gangs
+        # are live than desired, retire the best victim whose removal
+        # keeps ready_total >= desired - max_unavailable. Old-generation
+        # gangs go first (unready before ready), then the newest
+        # new-generation ids. A ready victim is only retired when the
+        # readiness floor survives it — that is the zero-unready-window
+        # guarantee.
+        floor = desired - serve.spec.max_unavailable
+        ready_total = len(ready_ids)
+        while len(live) > desired:
+            victim = self._pick_victim(live, new_gen, ready_ids)
+            if victim is None:
+                break
+            if victim in ready_ids and ready_total - 1 < floor:
+                break  # draining now would open an unready window
+            members = live.pop(victim)
+            if victim in ready_ids:
+                ready_ids.discard(victim)
+                ready_total -= 1
+            new_gen.discard(victim)
+            self._drain_replica(
+                serve, victim, members,
+                reason=("rollout" if members
+                        and replica_generation(members) != gen
+                        else "scale-down"),
+            )
+
+        # --- status mirror --------------------------------------------
+        self._update_status(serve, live, ready_ids, new_gen, desired)
+        return self._write_status(serve)
+
+    # ------------------------------------------------------------------
+    # dependents
+    # ------------------------------------------------------------------
+
+    def _trace_id(self, serve: TPUServe) -> Optional[str]:
+        return serve.metadata.annotations.get(trace.ANNOTATION_TRACE_ID)
+
+    def _ensure_trace_id(self, serve: TPUServe) -> None:
+        tid = self._trace_id(serve)
+        if not tid:
+            with self._lock:
+                tid = self._stamped_traces.get(serve.metadata.uid)
+        if not tid:
+            tid = trace.new_trace_id()
+            try:
+                self.store.patch(
+                    "TPUServe", serve.namespace, serve.name,
+                    {"metadata": {
+                        "uid": serve.metadata.uid,
+                        "annotations": {trace.ANNOTATION_TRACE_ID: tid},
+                    }},
+                )
+            except (NotFound, Conflict):
+                return
+            with self._lock:
+                self._stamped_traces[serve.metadata.uid] = tid
+                while len(self._stamped_traces) > 4096:
+                    self._stamped_traces.pop(next(iter(self._stamped_traces)))
+        serve.metadata.annotations[trace.ANNOTATION_TRACE_ID] = tid
+        sp = trace.TRACER.current_span()
+        if sp is not None:
+            sp.adopt_trace(tid)
+
+    def _owner_ref(self, serve: TPUServe) -> OwnerReference:
+        return OwnerReference(
+            kind="TPUServe", name=serve.name, uid=serve.metadata.uid,
+            controller=True,
+        )
+
+    def _reap_orphans(self, namespace: str, name: str) -> None:
+        """Cascade delete for a deleted serve (kube GC semantics), guarded
+        by the controller owner ref exactly like the batch reaper."""
+        for kind in ("Pod", "PodGroup"):
+            for obj in self.read.list(
+                kind, namespace, selector={LABEL_SERVE_NAME: name}
+            ):
+                owner = self._controller_owner(obj)
+                if owner is None or owner.name != name:
+                    continue
+                self.store.try_delete(kind, namespace, obj.metadata.name)
+
+    def _launch_replica(self, serve: TPUServe, rid: int, gen: int,
+                        workers: int, placement) -> None:
+        """One new serving gang: PodGroup (the gang-scheduler admission
+        unit, at serving priority) + every member pod, under a
+        serve.replica_launch span in the serve's trace."""
+        with trace.start_span(
+            "serve.replica_launch",
+            trace_id=self._trace_id(serve),
+            attrs={
+                "serve": serve.metadata.key(), "replica": rid,
+                "generation": gen, "workers": workers,
+            },
+        ):
+            gang = serve.gang_name(rid)
+            pg = PodGroup(
+                metadata=ObjectMeta(
+                    name=gang,
+                    namespace=serve.namespace,
+                    labels={
+                        LABEL_JOB_NAME: gang,
+                        LABEL_SERVE_NAME: serve.name,
+                        LABEL_SERVE_REPLICA: str(rid),
+                    },
+                    owner_references=[self._owner_ref(serve)],
+                ),
+                spec=PodGroupSpec(
+                    min_member=workers,
+                    priority_class=serve.spec.priority_class,
+                ),
+            )
+            try:
+                self.store.create(pg)
+            except AlreadyExists:
+                pass  # level-triggered retry after a half-done pass
+            for j in range(workers):
+                self._create_pod(serve, rid, j, gen, placement)
+
+    def _create_pod(self, serve: TPUServe, rid: int, index: int, gen: int,
+                    placement) -> None:
+        tmpl = serve.spec.template
+        container = Container.from_dict(tmpl.container.to_dict())
+        env = dict(container.env)
+        gang = serve.gang_name(rid)
+        env.update({
+            ENV_SERVE_NAME: serve.name,
+            ENV_SERVE_REPLICA: str(rid),
+            ENV_SERVE_GENERATION: str(gen),
+            ENV_NAMESPACE: serve.namespace,
+            ENV_COORDINATOR: f"{serve.pod_name(rid, 0)}:{serve_port(rid)}",
+            ENV_NUM_HOSTS: str(serve.spec.workers_per_replica),
+            ENV_HOST_ID: str(index),
+            ENV_CHIPS_PER_HOST: str(serve.spec.slice.chips_per_host),
+            ENV_ACCELERATOR: serve.spec.slice.accelerator,
+            ENV_TOPOLOGY: "x".join(map(str, placement.topology)),
+            ENV_HOST_MESH: "x".join(map(str, placement.host_mesh)),
+            ENV_HOST_COORD: "x".join(map(str, placement.host_coords[index])),
+        })
+        container.env = env
+        labels = dict(tmpl.labels)
+        labels.update({
+            LABEL_JOB_NAME: gang,  # the gang scheduler's grouping key
+            LABEL_SERVE_NAME: serve.name,
+            LABEL_SERVE_REPLICA: str(rid),
+            LABEL_ROLE: ROLE_SERVE,
+            LABEL_REPLICA_INDEX: str(index),
+            LABEL_GENERATION: str(gen),
+        })
+        annotations = dict(tmpl.annotations)
+        annotations.update(placement.annotations_for(index))
+        tid = self._trace_id(serve)
+        if tid:
+            annotations[trace.ANNOTATION_TRACE_ID] = tid
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=serve.pod_name(rid, index),
+                namespace=serve.namespace,
+                labels=labels,
+                annotations=annotations,
+                owner_references=[self._owner_ref(serve)],
+            ),
+            spec=PodSpec(
+                container=container,
+                hostname=serve.pod_name(rid, index),
+                restart_policy="Never",  # the controller owns replacement
+                node_selector=dict(tmpl.node_selector),
+                scheduler_name=tmpl.scheduler_name,
+                priority_class=tmpl.priority_class
+                or serve.spec.priority_class,
+            ),
+        )
+        try:
+            self.store.create(pod)
+        except AlreadyExists:
+            pass  # informer lag on our own create; the echo reconciles
+
+    def _drain_replica(self, serve: TPUServe, rid: int, members: List[Pod],
+                       *, reason: str) -> None:
+        """Retire one gang whole: delete its pods + PodGroup under a
+        serve.replica_drain span (the rollout timeline's drain edge)."""
+        with trace.start_span(
+            "serve.replica_drain",
+            trace_id=self._trace_id(serve),
+            attrs={
+                "serve": serve.metadata.key(), "replica": rid,
+                "generation": replica_generation(members) if members else -1,
+                "reason": reason,
+            },
+        ):
+            for p in members:
+                self.store.try_delete("Pod", p.metadata.namespace,
+                                      p.metadata.name)
+            self.store.try_delete("PodGroup", serve.namespace,
+                                  serve.gang_name(rid))
+        # the ready-noted memo is deliberately NOT dropped here: replica
+        # ids are never reused, and a cached read lagging these deletes
+        # can still show the gang ready for a few reconciles — dropping
+        # the mark would re-note it with its ORIGINAL creation timestamp,
+        # polluting the readiness-latency histogram with a bogus
+        # lifetime-length observation (caught by BENCH_CP_MODES=serve)
+
+    def _note_ready(self, serve: TPUServe, live: Dict[int, List[Pod]],
+                    ready_ids: set, gen: int) -> None:
+        """First observation of a gang passing the readiness gate: the
+        serve.replica_ready span + the serve-readiness latency histogram
+        (creation → ready, the serving SLO the bench tripwires)."""
+        now = time.time()
+        for rid in sorted(ready_ids):
+            mark = (serve.metadata.uid, rid)
+            if mark in self._ready_noted:
+                continue
+            self._ready_noted.add(mark)
+            created = [
+                p.metadata.creation_timestamp
+                for p in live.get(rid, [])
+                if p.metadata.creation_timestamp
+            ]
+            latency = max(0.0, now - min(created)) if created else 0.0
+            with trace.start_span(
+                "serve.replica_ready",
+                trace_id=self._trace_id(serve),
+                attrs={
+                    "serve": serve.metadata.key(), "replica": rid,
+                    "generation": replica_generation(live.get(rid, [])),
+                    "ready_latency_s": round(latency, 3),
+                },
+            ):
+                pass
+            metrics.serve_ready_latency.observe(latency)
+        if len(self._ready_noted) > 8192:
+            # bounded memo; a re-note after eviction is a harmless extra span
+            self._ready_noted.clear()
+
+    # ------------------------------------------------------------------
+    # drain victim selection + status
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _pick_victim(live: Dict[int, List[Pod]], new_gen: set,
+                     ready_ids: set) -> Optional[int]:
+        """Preference order: unready old-gen, ready old-gen, unready
+        newest new-gen, ready newest new-gen."""
+        old = [rid for rid in live if rid not in new_gen]
+        for pool, prefer_new in ((old, False), (list(new_gen & set(live)),
+                                                True)):
+            if not pool:
+                continue
+            unready = [r for r in pool if r not in ready_ids]
+            if unready:
+                return max(unready) if prefer_new else min(unready)
+            return max(pool) if prefer_new else min(pool)
+        return None
+
+    def _update_status(self, serve: TPUServe, live: Dict[int, List[Pod]],
+                       ready_ids: set, new_gen: set, desired: int) -> None:
+        st = serve.status
+        st.replicas = len(live)
+        st.ready_replicas = len(ready_ids)
+        st.updated_replicas = len(new_gen & set(live))
+        st.desired_replicas = desired
+        metrics.serve_replicas_ready.set(
+            st.ready_replicas, serve=serve.metadata.key()
+        )
+        prev = self._last_desired.get(serve.metadata.uid)
+        now = time.time()
+        if prev is not None and desired != prev:
+            if desired > prev:
+                st.last_scale_up_time = now
+            else:
+                st.last_scale_down_time = now
+        self._last_desired[serve.metadata.uid] = desired
+        if len(self._last_desired) > 4096:
+            self._last_desired.pop(next(iter(self._last_desired)))
+
+        floor = max(0, desired - serve.spec.max_unavailable)
+        available = desired > 0 and st.ready_replicas >= max(1, floor)
+        cond.set_condition(st, _serve_condition(
+            ServeConditionType.AVAILABLE, available,
+            "MinimumReplicasReady" if available else "WaitingForReplicas",
+            f"{st.ready_replicas}/{desired} serving replicas ready",
+        ))
+        settled = (
+            st.updated_replicas == desired
+            and st.replicas == desired
+            and st.ready_replicas >= desired
+        )
+        cond.set_condition(st, _serve_condition(
+            ServeConditionType.PROGRESSING, not settled,
+            "Rolling" if not settled else "Stable",
+            (f"{st.updated_replicas}/{desired} at generation "
+             f"{st.serve_generation}" if not settled
+             else f"all replicas at generation {st.serve_generation}"),
+        ))
+        zero = desired == 0 and not live
+        if zero and not cond.has_condition(
+            st, ServeConditionType.SCALED_TO_ZERO
+        ):
+            self.recorder.event(
+                serve, NORMAL, EVENT_SCALED_TO_ZERO,
+                "no traffic: every serving replica released its chips",
+            )
+        cond.set_condition(st, _serve_condition(
+            ServeConditionType.SCALED_TO_ZERO, zero,
+            "NoTraffic" if zero else "Active",
+            "scaled to zero" if zero else "replicas live",
+        ))
+
+    # ------------------------------------------------------------------
+    # status write (uid-pinned subresource merge patch, as in batch)
+    # ------------------------------------------------------------------
+
+    def _default_write_status(self, serve: TPUServe) -> bool:
+        stored = self.read.try_get("TPUServe", serve.namespace, serve.name)
+        if stored is None:
+            return True
+        if stored.metadata.uid != serve.metadata.uid:
+            return True  # recreated under us: never cross-stamp
+        old, new = stored.status.to_dict(), serve.status.to_dict()
+        if old == new:
+            metrics.store_writes_elided.inc(component="serve-controller")
+            return True
+        try:
+            self.store.patch(
+                "TPUServe", serve.namespace, serve.name,
+                {"status": diff_merge_patch(old, new),
+                 "metadata": {"uid": serve.metadata.uid}},
+                subresource="status",
+            )
+        except NotFound:
+            return True
+        except Conflict:
+            return False
+        return True
+
+
+def _serve_condition(ctype: str, active: bool, reason: str, message: str):
+    from mpi_operator_tpu.api.types import Condition
+
+    return Condition.new(ctype, active, reason, message)

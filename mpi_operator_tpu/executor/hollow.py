@@ -70,6 +70,14 @@ class HollowTimeline:
     ``failure_rate``: probability the terminal phase is Failed with
     ``failure_exit_code`` (drawn from a PER-POD rng seeded by ``seed`` +
     the pod's identity, so a rerun of the same fleet is deterministic).
+
+    Serving pods (label ``tpujob.dev/job-role: serve``) follow a SECOND
+    timeline: Pending → Running (ready=False) → ready after
+    ``serve_warmup_s`` (the readiness gate — scripted model load) → stay
+    Running forever, mirroring synthetic ``status.serve_stats`` samples
+    every ``serve_stats_interval_s`` drawn from ``load`` (a shared
+    :class:`ServeLoadModel`). No terminal transition: long-lived is the
+    point.
     """
 
     pending_s: float = 0.0
@@ -78,9 +86,88 @@ class HollowTimeline:
     failure_rate: float = 0.0
     failure_exit_code: int = 1
     seed: int = 0
+    serve_warmup_s: float = 0.2
+    serve_stats_interval_s: float = 0.5
+    load: Optional["ServeLoadModel"] = None
 
     def pod_rng(self, namespace: str, name: str, uid: str) -> random.Random:
         return random.Random(f"{self.seed}:{namespace}/{name}:{uid}")
+
+
+# serving-pod identity labels (duplicated string constants — the executor
+# deliberately does not import the controller packages, same posture as
+# the agent; controller/serve.py's tests pin the values stay identical)
+LABEL_ROLE = "tpujob.dev/job-role"
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
+ROLE_SERVE = "serve"
+
+
+class ServeLoadModel:
+    """Synthetic closed-loop serving load for hollow fleets.
+
+    The bench's traffic generator declares OFFERED aggregate QPS per serve
+    (``set_offered``); running hollow serving pods register themselves and
+    draw their share (offered / registered pods) plus derived queue depth
+    and p99 from an M/M/1-shaped utilization curve against
+    ``capacity_qps`` per pod. The loop this closes is the real one the
+    autoscaler lives in: more replicas → lower per-pod utilization →
+    lower latency/queue → scale-down pressure, and vice versa — so a
+    BENCH_CP_MODES=serve run exercises the actual feedback dynamics, not
+    a canned metrics tape.
+    """
+
+    def __init__(self, *, capacity_qps: float = 100.0,
+                 base_ms: float = 20.0):
+        self.capacity_qps = capacity_qps
+        self.base_ms = base_ms
+        self._lock = threading.Lock()
+        self._offered: Dict[str, float] = {}      # serve key → total QPS
+        self._pods: Dict[str, set] = {}           # serve key → pod keys
+
+    def set_offered(self, serve_key: str, qps: float) -> None:
+        with self._lock:
+            self._offered[serve_key] = max(0.0, qps)
+
+    def offered(self, serve_key: str) -> float:
+        with self._lock:
+            return self._offered.get(serve_key, 0.0)
+
+    def register(self, serve_key: str, pod_key: str) -> None:
+        with self._lock:
+            self._pods.setdefault(serve_key, set()).add(pod_key)
+
+    def unregister(self, serve_key: str, pod_key: str) -> None:
+        with self._lock:
+            pods = self._pods.get(serve_key)
+            if pods is not None:
+                pods.discard(pod_key)
+                if not pods:
+                    del self._pods[serve_key]
+
+    def serving_pods(self, serve_key: str) -> int:
+        with self._lock:
+            return len(self._pods.get(serve_key, ()))
+
+    def sample(self, serve_key: str) -> Dict[str, float]:
+        """One pod's current stats: its share of the offered load and the
+        utilization-derived queue/latency (clamped — an overloaded pod
+        reports a deep-but-finite queue, like a bounded request queue)."""
+        with self._lock:
+            offered = self._offered.get(serve_key, 0.0)
+            n = len(self._pods.get(serve_key, ()))
+        per_pod = offered / n if n else 0.0
+        u = per_pod / self.capacity_qps if self.capacity_qps > 0 else 0.0
+        if u < 0.95:
+            queue = u / (1.0 - u)
+        else:
+            queue = 19.0 + (u - 0.95) * 200.0  # saturated: queue blows up
+        queue = min(queue, 500.0)
+        p99 = self.base_ms * (1.0 + 3.0 * u + queue)
+        return {
+            "qps": round(per_pod, 3),
+            "queue_depth": round(queue, 3),
+            "p99_ms": round(p99, 3),
+        }
 
 
 class _TimerWheel:
@@ -194,6 +281,11 @@ class HollowExecutor:
         self._seen: Dict[str, str] = {}
         # pod key → live wheel handles (cancelled on delete/evict)
         self._handles: Dict[str, List[Dict[str, Any]]] = {}
+        # serving pods: pod key → its serve key (for load-model
+        # unregistration) and pod key → the CURRENT recurring stats-tick
+        # handle (replaced on every re-arm so handle lists stay bounded)
+        self._serve_keys: Dict[str, str] = {}
+        self._stats_handles: Dict[str, Dict[str, Any]] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._watch_q = None
@@ -221,7 +313,9 @@ class HollowExecutor:
             self.store.stop_watch(self._watch_q)
         with self._lock:
             handles = [h for hs in self._handles.values() for h in hs]
+            handles += list(self._stats_handles.values())
             self._handles.clear()
+            self._stats_handles.clear()
         for h in handles:
             _TimerWheel.cancel(h)
         if self._own_wheel:
@@ -283,8 +377,14 @@ class HollowExecutor:
             with self._lock:
                 self._seen[key] = uid
                 handles = self._handles.pop(key, [])
+                stats = self._stats_handles.pop(key, None)
+                serve_key = self._serve_keys.pop(key, None)
             for h in handles:
                 _TimerWheel.cancel(h)
+            if stats is not None:
+                _TimerWheel.cancel(stats)
+            if serve_key is not None and self.timeline.load is not None:
+                self.timeline.load.unregister(serve_key, key)
             return
         if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
             return
@@ -308,13 +408,22 @@ class HollowExecutor:
         with self._lock:
             self._seen.pop(key, None)
             handles = self._handles.pop(key, [])
+            stats = self._stats_handles.pop(key, None)
+            serve_key = self._serve_keys.pop(key, None)
         for h in handles:
             _TimerWheel.cancel(h)
+        if stats is not None:
+            _TimerWheel.cancel(stats)
+        if serve_key is not None and self.timeline.load is not None:
+            self.timeline.load.unregister(serve_key, key)
 
     # -- the scripted lifecycle ---------------------------------------------
 
     def _schedule_timeline(self, pod: Pod, key: str, uid: str,
                            already_running: bool = False) -> None:
+        if pod.metadata.labels.get(LABEL_ROLE) == ROLE_SERVE:
+            self._schedule_serve_timeline(pod, key, uid, already_running)
+            return
         tl = self.timeline
         rng = tl.pod_rng(pod.metadata.namespace, pod.metadata.name, uid)
         run_s = tl.run_s + rng.uniform(0.0, tl.run_jitter_s)
@@ -362,6 +471,72 @@ class HollowExecutor:
                 self._handles[key].extend(handles)
             else:
                 # evicted/deleted between scheduling and recording
+                for h in handles:
+                    _TimerWheel.cancel(h)
+
+    def _schedule_serve_timeline(self, pod: Pod, key: str, uid: str,
+                                 already_running: bool = False) -> None:
+        """The long-lived serving lifecycle: Running (not ready) →
+        readiness gate after warmup → recurring synthetic serve_stats
+        mirrors, forever. Termination only ever comes from OUTSIDE
+        (eviction, drain, controller teardown) — handled by observe()'s
+        finish branch like any kubelet kill."""
+        tl = self.timeline
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        rv = pod.metadata.resource_version or 0
+        serve_key = f"{ns}/{pod.metadata.labels.get(LABEL_SERVE_NAME, '')}"
+        with self._lock:
+            self._serve_keys[key] = serve_key
+
+        def stats_tick():
+            with self._lock:
+                if self._seen.get(key) != uid or self._stop.is_set():
+                    return  # evicted/replaced: the recurrence dies here
+            stats = (
+                tl.load.sample(serve_key) if tl.load is not None
+                else {"qps": 0.0, "queue_depth": 0.0, "p99_ms": 0.0}
+            )
+            # rv=0: no precondition — a stats mirror may always apply to
+            # the live incarnation (patch_pod_status still enforces the
+            # uid + write-once-terminal guards on the re-read path)
+            self._mirror(ns, name, uid, 0, {"serve_stats": stats})
+            handle = self._wheel.schedule(tl.serve_stats_interval_s,
+                                          stats_tick)
+            with self._lock:
+                if self._seen.get(key) == uid:
+                    self._stats_handles[key] = handle
+                else:
+                    _TimerWheel.cancel(handle)
+
+        def to_running():
+            self._mirror(ns, name, uid, rv, {
+                "phase": PodPhase.RUNNING, "ready": False, "reason": "",
+                "pod_ip": "127.0.0.1",
+            })
+
+        def to_ready():
+            with self._lock:
+                if self._seen.get(key) != uid:
+                    return
+            if tl.load is not None:
+                tl.load.register(serve_key, key)
+            self._mirror(ns, name, uid, 0,
+                         {"phase": PodPhase.RUNNING, "ready": True})
+            stats_tick()
+
+        handles = []
+        if not already_running:
+            handles.append(self._wheel.schedule(tl.pending_s, to_running))
+            handles.append(self._wheel.schedule(
+                tl.pending_s + tl.serve_warmup_s, to_ready))
+        else:
+            # adopted mid-serve (restarted fleet): the model is loaded;
+            # re-register and resume the stats stream after one warmup
+            handles.append(self._wheel.schedule(tl.serve_warmup_s, to_ready))
+        with self._lock:
+            if self._seen.get(key) == uid and key in self._handles:
+                self._handles[key].extend(handles)
+            else:
                 for h in handles:
                     _TimerWheel.cancel(h)
 

@@ -60,11 +60,13 @@ log = logging.getLogger("tpujob.cache")
 # controller/controller.py so machinery stays import-light; the controller
 # tests assert the two never drift)
 LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
 
 # default kind set mirrors machinery.objects.KINDS minus Event: events are
 # an append-only audit stream nobody ever gets/lists on the hot path, and
 # caching them would grow the cache without bound
-DEFAULT_KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Node")
+DEFAULT_KINDS = ("TPUJob", "TPUServe", "Pod", "Service", "ConfigMap",
+                 "PodGroup", "Node")
 
 
 class _Relist:
@@ -239,7 +241,10 @@ class InformerCache:
         self,
         store: Any,
         kinds: Tuple[str, ...] = DEFAULT_KINDS,
-        index_labels: Tuple[str, ...] = (LABEL_JOB_NAME,),
+        # both workload classes' gang-grouping labels are indexed: the
+        # serve controller's and autoscaler's per-serve pod lists must be
+        # index hits, not O(all cached pods) scans per tick
+        index_labels: Tuple[str, ...] = (LABEL_JOB_NAME, LABEL_SERVE_NAME),
     ):
         self.store = store
         self.kinds = tuple(kinds)

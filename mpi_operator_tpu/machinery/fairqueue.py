@@ -21,6 +21,16 @@ Two pieces:
   load-shed with :class:`~mpi_operator_tpu.machinery.store.TooManyRequests`
   (429 on the wire) instead of being allowed to park forever — the APF
   posture: reject the noisy tenant, never starve the quiet one.
+
+  WITHIN a tenant's turn, requests carry a priority **level**
+  (``LEVEL_SERVE`` > ``LEVEL_BATCH``): when the rotation hands the
+  tenant a seat, its highest-level waiter runs first (FIFO inside a
+  level). Round-robin alone makes tenants fair to EACH OTHER — it does
+  nothing when one tenant's own batch submission storm fills its own
+  queue ahead of its serving control traffic; the level split is what
+  keeps a tenant's inference plane responsive under its own batch
+  backlog. Rejection semantics are UNCHANGED (typed 429s; the queue
+  bound is per tenant across levels).
 - :class:`NamespaceQuota` — create-time admission caps per namespace
   (max live jobs, max requested chips), rejecting with
   :class:`~mpi_operator_tpu.machinery.store.QuotaExceeded` (403, typed).
@@ -41,6 +51,12 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from mpi_operator_tpu.machinery.store import QuotaExceeded, TooManyRequests
+
+# request priority levels inside one tenant's seat: serving-class control
+# traffic (TPUServe routes — the autoscaler/rollout plane whose latency IS
+# user-facing) outranks batch submission/reconcile traffic
+LEVEL_BATCH = 0
+LEVEL_SERVE = 1
 
 
 class _Seat:
@@ -84,8 +100,9 @@ class FairQueue:
         self.burst = float(burst if burst is not None else (rate or 0) * 2)
         self._lock = threading.Lock()
         self._inflight = 0
-        # tenant → FIFO of parked threading.Events (a seat handoff sets one)
-        self._waiting: Dict[str, deque] = {}
+        # tenant → level → FIFO of parked threading.Events (a seat handoff
+        # sets one; higher levels pop first when the tenant's turn comes)
+        self._waiting: Dict[str, Dict[int, deque]] = {}
         # tenant → (tokens, last_refill_monotonic)
         self._buckets: Dict[str, tuple] = {}
         self._last_tenant = ""
@@ -95,12 +112,17 @@ class FairQueue:
 
     # -- admission ----------------------------------------------------------
 
-    def admit(self, tenant: str) -> _Seat:
+    def admit(self, tenant: str, level: int = LEVEL_BATCH) -> _Seat:
         """Take a seat for ``tenant`` (blocking fairly, bounded), or raise
         :class:`TooManyRequests`. Use as a context manager::
 
-            with fq.admit(tenant):
+            with fq.admit(tenant, level=LEVEL_SERVE):
                 ... handle the request ...
+
+        ``level`` orders waiters WITHIN the tenant's turn (serve above
+        batch; FIFO inside a level) — cross-tenant fairness stays pure
+        round-robin, so one tenant's serving traffic never taxes another
+        tenant's seat share.
 
         The ``admin`` tenant (the operator's own system traffic) is
         exempt from the token bucket — kube APF exempts the system flow
@@ -110,7 +132,7 @@ class FairQueue:
         concurrency), where round-robin guarantees it a turn."""
         if tenant != "admin":
             self._take_token(tenant)
-        self._acquire_seat(tenant)
+        self._acquire_seat(tenant, level)
         return _Seat(self)
 
     def throttle(self, tenant: str) -> None:
@@ -162,22 +184,34 @@ class FairQueue:
                 f"({self.rate:g} req/s, burst {self.burst:g})",
             )
 
-    def _acquire_seat(self, tenant: str) -> None:
+    @staticmethod
+    def _depth(levels: Dict[int, deque]) -> int:
+        return sum(len(q) for q in levels.values())
+
+    def _acquire_seat(self, tenant: str, level: int = LEVEL_BATCH) -> None:
         from mpi_operator_tpu.opshell import metrics
 
         parked = None
         with self._lock:
-            q = self._waiting.get(tenant)
-            if self._inflight < self.max_inflight and not q:
-                # free seat and no same-tenant waiters to overtake
+            levels = self._waiting.get(tenant)
+            depth = self._depth(levels) if levels else 0
+            # a free seat is taken directly only when no same-tenant waiter
+            # AT OR ABOVE this level would be overtaken (a serve request
+            # may overtake the tenant's own parked batch backlog — that is
+            # the level split working — but never a parked peer or senior)
+            ahead = (
+                sum(len(q) for lv, q in levels.items() if lv >= level)
+                if levels else 0
+            )
+            if self._inflight < self.max_inflight and not ahead:
                 self._inflight += 1
                 self.stats["admitted"] += 1
                 return
-            if q is None:
-                q = self._waiting[tenant] = deque()
-            if len(q) < self.queue_limit:
+            if levels is None:
+                levels = self._waiting[tenant] = {}
+            if depth < self.queue_limit:
                 parked = threading.Event()
-                q.append(parked)
+                levels.setdefault(level, deque()).append(parked)
                 self.stats["queued"] += 1
                 metrics.store_tenant_queued.inc(tenant=tenant)
         if parked is None:
@@ -196,7 +230,7 @@ class FairQueue:
                 self.stats["admitted"] += 1
                 return
             try:
-                self._waiting[tenant].remove(parked)
+                self._waiting[tenant][level].remove(parked)
             except (KeyError, ValueError):
                 pass
         self._reject(
@@ -209,10 +243,12 @@ class FairQueue:
             # hand the seat to the next tenant in rotation (round-robin by
             # tenant name, starting strictly after the last one served) —
             # the fairness core: a tenant with a deep queue gets ONE seat
-            # per rotation, same as a tenant with one waiter. Drained
-            # tenants' empty deques are pruned here (same unbounded-
-            # tenant-string concern as the token buckets).
-            for t in [t for t, q in self._waiting.items() if not q]:
+            # per rotation, same as a tenant with one waiter. WITHIN the
+            # chosen tenant, the highest level pops first (serve > batch).
+            # Drained tenants' empty structures are pruned here (same
+            # unbounded-tenant-string concern as the token buckets).
+            for t in [t for t, levels in self._waiting.items()
+                      if not self._depth(levels)]:
                 del self._waiting[t]
             tenants = sorted(self._waiting)
             if not tenants:
@@ -221,7 +257,11 @@ class FairQueue:
             after = [t for t in tenants if t > self._last_tenant]
             chosen = after[0] if after else tenants[0]
             self._last_tenant = chosen
-            self._waiting[chosen].popleft().set()  # seat transferred
+            levels = self._waiting[chosen]
+            top = max(lv for lv, q in levels.items() if q)
+            levels[top].popleft().set()  # seat transferred
+            if not levels[top]:
+                del levels[top]
 
     def snapshot(self) -> Dict[str, Any]:
         """Queue depths + counters (the runbook's 'tenant starved?' probe)."""
@@ -229,7 +269,11 @@ class FairQueue:
             return {
                 "inflight": self._inflight,
                 "max_inflight": self.max_inflight,
-                "waiting": {t: len(q) for t, q in self._waiting.items() if q},
+                "waiting": {
+                    t: self._depth(levels)
+                    for t, levels in self._waiting.items()
+                    if self._depth(levels)
+                },
                 **self.stats,
             }
 
@@ -238,11 +282,22 @@ class NamespaceQuota:
     """Create-time namespace quota admission (max jobs / max chips).
 
     ``quotas`` maps namespace → ``{"max_jobs": N, "max_chips": M}`` (either
-    key optional). Checked against the backing store's LIVE (non-finished)
-    jobs at create time; a concurrent pair of creates can overshoot by the
-    race window — the same eventually-consistent posture as kube's quota
-    controller, acceptable because the cap defends capacity, not
-    invariants. Namespaces without an entry are unlimited.
+    key optional). ``max_jobs`` counts the namespace's LIVE (non-finished)
+    TPUJobs. ``max_chips`` counts chips actually HELD — the namespace's
+    bound, non-finished pods — not chips *requested*: a preempted or
+    pending gang holds nothing, and charging its request would
+    double-bill the namespace exactly when the scheduler displaced it to
+    make room (the PR 10 over-charge this fixes; regression-pinned in
+    tests/test_fairness.py). Two charges keep that honest: the incoming
+    object itself is charged at its REQUEST (its pods don't exist yet),
+    and so is every live workload the controller has not materialized
+    pods for — otherwise a burst of creates inside the
+    create-to-first-pod window would each see zero held chips and sail
+    past the cap N-fold. A concurrent pair of creates can still overshoot
+    by the (now pod-creation-latency-sized) race window — the same
+    eventually-consistent posture as kube's quota controller, acceptable
+    because the cap defends capacity, not invariants. Namespaces without
+    an entry are unlimited.
     """
 
     def __init__(self, quotas: Dict[str, Dict[str, int]]):
@@ -265,18 +320,74 @@ class NamespaceQuota:
         self.quotas = {ns: dict(q) for ns, q in quotas.items()}
 
     @staticmethod
-    def _job_chips(job: Any) -> int:
-        spec = getattr(job, "spec", None)
-        worker = getattr(spec, "worker", None)
+    def _requested_chips(obj: Any) -> int:
+        """Chips the incoming workload asks for: workers × chips/host for
+        a TPUJob, replicas × gang size × chips/host for a TPUServe (an
+        unset serve replica count charges what defaulting will start it
+        at — max(1, autoscale floor); an explicit 0 charges nothing)."""
+        spec = getattr(obj, "spec", None)
         slice_ = getattr(spec, "slice", None)
-        replicas = getattr(worker, "replicas", 0) or 0
         chips = getattr(slice_, "chips_per_host", 1) or 1
+        if getattr(obj, "kind", "") == "TPUServe":
+            replicas = getattr(spec, "replicas", None)
+            if replicas is None:
+                # mirror set_serve_defaults: an autoscaled serve starts
+                # at max(1, min_replicas), a plain one at 1
+                asc = getattr(spec, "autoscale", None)
+                floor = getattr(asc, "min_replicas", None) if asc else None
+                replicas = max(1, floor if floor is not None else 1)
+            workers = getattr(spec, "workers_per_replica", 1) or 1
+            return replicas * workers * chips
+        worker = getattr(spec, "worker", None)
+        replicas = getattr(worker, "replicas", 0) or 0
         return replicas * chips
 
+    @classmethod
+    def _chips_held_or_inflight(cls, backing: Any, ns: str) -> int:
+        """Chips the namespace holds or is guaranteed about to hold:
+
+        - bound, non-finished pods' costs (the scheduler's own
+          accounting unit — pod_cost reads the chips-per-host env the
+          controller stamped): what is actually RUNNING;
+        - plus the REQUESTS of live workloads that have NO pods at all
+          yet — freshly admitted creates the controller has not
+          materialized. Without this, a burst of creates inside the
+          create-to-first-pod window would all see zero held chips and
+          sail past the cap N-fold.
+
+        A workload whose pods EXIST but are unbound/terminal (a pending
+        gang queued behind capacity, a preempted gang awaiting restart)
+        deliberately charges only what its pods hold — that is the PR 10
+        over-charge this accounting removes."""
+        from mpi_operator_tpu.api.conditions import is_finished
+        from mpi_operator_tpu.scheduler.gang import pod_cost
+
+        held = 0
+        job_names_with_pods = set()
+        serve_names_with_pods = set()
+        for p in backing.list("Pod", ns):
+            labels = p.metadata.labels
+            if "tpujob.dev/job-name" in labels:
+                job_names_with_pods.add(labels["tpujob.dev/job-name"])
+            if "tpujob.dev/serve-name" in labels:
+                serve_names_with_pods.add(labels["tpujob.dev/serve-name"])
+            if p.spec.node_name and not p.is_finished():
+                held += pod_cost(p)
+        for j in backing.list("TPUJob", ns):
+            if is_finished(j.status):
+                continue
+            if j.metadata.name not in job_names_with_pods:
+                held += cls._requested_chips(j)
+        for s in backing.list("TPUServe", ns):
+            if s.metadata.name not in serve_names_with_pods:
+                held += cls._requested_chips(s)
+        return held
+
     def check_create(self, backing: Any, obj: Any) -> None:
-        """Raise :class:`QuotaExceeded` when creating ``obj`` (a TPUJob)
-        would exceed its namespace's caps; no-op for other kinds."""
-        if getattr(obj, "kind", "") != "TPUJob":
+        """Raise :class:`QuotaExceeded` when creating ``obj`` (a TPUJob or
+        TPUServe) would exceed its namespace's caps; no-op otherwise."""
+        kind = getattr(obj, "kind", "")
+        if kind not in ("TPUJob", "TPUServe"):
             return
         ns = obj.metadata.namespace
         quota = self.quotas.get(ns)
@@ -284,24 +395,27 @@ class NamespaceQuota:
             return
         from mpi_operator_tpu.api.conditions import is_finished
 
-        live: List[Any] = [
-            j for j in backing.list("TPUJob", ns)
-            if not is_finished(j.status)
-        ]
         max_jobs = quota.get("max_jobs")
-        if max_jobs is not None and len(live) >= max_jobs:
-            raise QuotaExceeded(
-                f"namespace {ns!r} quota: {len(live)}/{max_jobs} live jobs "
-                f"(delete or finish one, or raise the quota)"
-            )
+        if max_jobs is not None and kind == "TPUJob":
+            live: List[Any] = [
+                j for j in backing.list("TPUJob", ns)
+                if not is_finished(j.status)
+            ]
+            if len(live) >= max_jobs:
+                raise QuotaExceeded(
+                    f"namespace {ns!r} quota: {len(live)}/{max_jobs} live "
+                    f"jobs (delete or finish one, or raise the quota)"
+                )
         max_chips = quota.get("max_chips")
         if max_chips is not None:
-            used = sum(self._job_chips(j) for j in live)
-            want = self._job_chips(obj)
+            used = self._chips_held_or_inflight(backing, ns)
+            want = self._requested_chips(obj)
             if used + want > max_chips:
                 raise QuotaExceeded(
-                    f"namespace {ns!r} quota: job wants {want} chips but "
-                    f"{used}/{max_chips} are already requested"
+                    f"namespace {ns!r} quota: {kind} wants {want} chips "
+                    f"but {used}/{max_chips} are already bound+running "
+                    f"or in-flight (preempted/pending gangs hold nothing "
+                    f"and are not charged)"
                 )
 
 

@@ -647,18 +647,24 @@ class StoreServer:
                         # json.loads before its 429 (oversized bodies
                         # classify by token tier instead).
                         try:
+                            cls_body = (
+                                body()
+                                if method == "POST"
+                                and len(raw) <= _TENANT_PARSE_CAP
+                                else None
+                            )
                             tenant = server._tenant_of(
                                 method, self.path,
-                                body=(
-                                    body()
-                                    if method == "POST"
-                                    and len(raw) <= _TENANT_PARSE_CAP
-                                    else None
-                                ),
+                                body=cls_body,
                                 tier=self._tier,
                             )
                             if server._fair_gated(method, self.path):
-                                seat = server.fairness.admit(tenant)
+                                seat = server.fairness.admit(
+                                    tenant,
+                                    level=server._level_of(
+                                        self.path, cls_body
+                                    ),
+                                )
                             elif _route_parts(self.path) == ["v1", "watch"]:
                                 # long-polls skip the seat pool (they park
                                 # by design) but a reconnect/relist storm
@@ -871,6 +877,27 @@ class StoreServer:
         if parts == ["v1", "watch"] and method == "GET":
             return False
         return True
+
+    @staticmethod
+    def _level_of(path: str,
+                  body: Optional[Dict[str, Any]] = None) -> int:
+        """Priority LEVEL within a tenant's seat (fairqueue.LEVEL_*):
+        TPUServe routes — the serving control plane, whose write latency
+        is user-facing — classify as serve; everything else is batch.
+        ``body`` is the already-parsed (size-capped) POST body the tenant
+        classifier produced; a create's kind rides it."""
+        from mpi_operator_tpu.machinery.fairqueue import (
+            LEVEL_BATCH,
+            LEVEL_SERVE,
+        )
+
+        parts = _route_parts(path)
+        if parts[:3] == ["v1", "objects", "TPUServe"]:
+            return LEVEL_SERVE
+        if parts == ["v1", "objects"] and isinstance(body, dict) \
+                and body.get("kind") == "TPUServe":
+            return LEVEL_SERVE
+        return LEVEL_BATCH
 
     def _tenant_of(self, method: str, path: str,
                    auth_header: Optional[str] = None,

@@ -57,6 +57,12 @@ class PodStatus(_Dictable):
     # with a .err suffix) — the kubelet-log-dir equivalent that `ctl logs`
     # reads; the path is local to the node named in spec.node_name
     log_path: str = ""
+    # serving-pod telemetry the executor mirrors alongside the phase
+    # (qps / queue_depth / p99_ms): the per-pod sample stream the serve
+    # autoscaler aggregates — kubelet resource-metrics shaped, carried in
+    # status so it rides the existing patch-batch machinery and watch
+    # fan-out instead of needing a second metrics pipeline
+    serve_stats: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -298,4 +304,5 @@ def evict_pod(store, pod: "Pod", message: str, *,
     ) is not None
 
 
-KINDS = ("TPUJob", "Pod", "Service", "ConfigMap", "PodGroup", "Event", "Node")
+KINDS = ("TPUJob", "TPUServe", "Pod", "Service", "ConfigMap", "PodGroup",
+         "Event", "Node")

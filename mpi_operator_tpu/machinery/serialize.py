@@ -18,7 +18,7 @@ import dataclasses
 import typing
 from typing import Any, Dict, Type
 
-from mpi_operator_tpu.api.types import TPUJob
+from mpi_operator_tpu.api.types import TPUJob, TPUServe
 from mpi_operator_tpu.machinery import objects as mo
 
 
@@ -57,6 +57,7 @@ def decode_dataclass(cls: Type, d: Dict[str, Any]) -> Any:
 
 KIND_CLASSES: Dict[str, Type] = {
     "TPUJob": TPUJob,
+    "TPUServe": TPUServe,
     "Pod": mo.Pod,
     "Service": mo.Service,
     "ConfigMap": mo.ConfigMap,

@@ -139,6 +139,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "'kill'/'term' actions on target 'self' crash this "
                          "process at a deterministic offset into its reign — "
                          "the scripted half of the crash-recovery e2e suite")
+    ap.add_argument("--no-serving", action="store_true",
+                    help="disable the TPUServe controller + autoscaler "
+                         "(batch-only operator; the serving workload "
+                         "class is on by default)")
+    ap.add_argument("--autoscale-interval", type=float, default=2.0,
+                    help="seconds between serve-autoscaler decision "
+                         "passes (sample pod serve_stats → recommend → "
+                         "write spec.replicas)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
@@ -334,6 +342,28 @@ def main(argv=None) -> int:
     # agents stop heartbeating, so gang restarts land on live nodes
     monitor = NodeMonitor(store, recorder, grace=args.node_grace, cache=cache)
 
+    # the serving workload class (leader-only, like every reconciler):
+    # the TPUServe controller drives replica gangs + rollouts, the
+    # autoscaler writes their spec.replicas from observed load
+    serve_controller = None
+    autoscaler = None
+    if not args.no_serving:
+        from mpi_operator_tpu.controller.autoscaler import ServeAutoscaler
+        from mpi_operator_tpu.controller.serve import (
+            ServeControllerOptions,
+            TPUServeController,
+        )
+
+        serve_controller = TPUServeController(
+            store, recorder,
+            ServeControllerOptions(namespace=args.namespace),
+            cache=cache,
+        )
+        autoscaler = ServeAutoscaler(
+            store, recorder, cache=cache, namespace=args.namespace,
+            interval=args.autoscale_interval,
+        )
+
     chaos_script = None
     if args.chaos_script:
         from mpi_operator_tpu.machinery.chaos import (
@@ -373,6 +403,10 @@ def main(argv=None) -> int:
         if cache is not None:
             cache.start()
         controller.run()
+        if serve_controller is not None:
+            serve_controller.run()
+        if autoscaler is not None:
+            autoscaler.start()
         if scheduler:
             scheduler.start()
         if executor:
@@ -396,6 +430,10 @@ def main(argv=None) -> int:
         # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
         # stops reconciling immediately
         controller.stop()
+        if autoscaler is not None:
+            autoscaler.stop()
+        if serve_controller is not None:
+            serve_controller.stop()
         if scheduler:
             scheduler.stop()
         if executor:

@@ -615,6 +615,187 @@ def cmd_store(client: TPUJobClient, args) -> int:
     return rc
 
 
+def _serve_client(client: TPUJobClient):
+    from mpi_operator_tpu.api.client import TPUServeClient
+
+    return TPUServeClient(client.store, namespace=client.namespace)
+
+
+def cmd_serve(client: TPUJobClient, args) -> int:
+    """`ctl serve <action>`: the serving workload class's day-2 surface —
+    create/get/status/scale/delete over TPUServe objects. `status` is the
+    operator's view of a rollout/scale in flight: desired vs ready vs
+    updated replicas, generation, autoscaler posture, and the per-gang
+    table."""
+    sc = _serve_client(client)
+    action = args.action
+    if action == "create" and not args.filename:
+        print("error: serve create requires -f <manifest>", file=sys.stderr)
+        return 2
+    if action in ("status", "scale", "delete") and not args.name:
+        print(f"error: serve {action} requires a name", file=sys.stderr)
+        return 2
+    if action == "scale" and args.replicas is None:
+        print("error: serve scale requires --replicas", file=sys.stderr)
+        return 2
+    if action == "scale" and args.replicas < 0:
+        print("error: --replicas must be >= 0", file=sys.stderr)
+        return 2
+    if action == "create":
+        import yaml
+
+        try:
+            with open(args.filename) as f:
+                doc = yaml.safe_load(f)
+        except (OSError, yaml.YAMLError) as e:
+            print(f"error: {args.filename}: {e}", file=sys.stderr)
+            return 1
+        try:
+            serve = sc.create(doc)
+        except (ManifestError, ValidationRejected, AlreadyExists) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"tpuserve.tpujob.dev/{serve.metadata.name} created")
+        return 0
+    if action == "get":
+        if args.name:
+            try:
+                serves = [sc.get(args.name)]
+            except NotFound as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+        else:
+            serves = sc.list()
+        if args.output == "json":
+            docs = [s.to_dict() for s in serves]
+            print(json.dumps(docs[0] if args.name else docs, indent=2))
+            return 0
+        if not serves:
+            print("No tpuserves found.")
+            return 0
+        rows = [
+            [
+                s.metadata.name,
+                f"{s.status.ready_replicas}/{s.spec.replicas or 0}",
+                s.status.updated_replicas,
+                s.status.serve_generation,
+                "on" if s.spec.autoscale else "off",
+                _age(s.metadata.creation_timestamp),
+            ]
+            for s in serves
+        ]
+        print(_table(rows, ["NAME", "READY", "UPDATED", "GEN",
+                            "AUTOSCALE", "AGE"]))
+        return 0
+    if action == "delete":
+        try:
+            sc.delete(args.name)
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"tpuserve.tpujob.dev/{args.name} deleted")
+        return 0
+    if action == "scale":
+        try:
+            serve = sc.get(args.name)
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if serve.spec.autoscale is not None:
+            print(
+                f"warning: {args.name} is autoscaled; the autoscaler may "
+                f"override this manual scale on its next decision",
+                file=sys.stderr,
+            )
+        client.store.patch(
+            "TPUServe", serve.namespace, serve.name,
+            {"spec": {"replicas": args.replicas},
+             "metadata": {"uid": serve.metadata.uid}},
+        )
+        print(f"tpuserve.tpujob.dev/{args.name} scaled to "
+              f"{args.replicas} replicas")
+        return 0
+    # action == "status"
+    try:
+        serve = sc.get(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    from mpi_operator_tpu.controller.serve import (
+        LABEL_SERVE_NAME,
+        LABEL_SERVE_REPLICA,
+    )
+
+    m, sp, st = serve.metadata, serve.spec, serve.status
+    lines = [
+        f"Name:        {m.name}",
+        f"Namespace:   {m.namespace}",
+        f"Created:     {_age(m.creation_timestamp)} ago",
+        f"Replicas:    {st.ready_replicas} ready / "
+        f"{sp.replicas or 0} desired "
+        f"({st.updated_replicas} at generation {st.serve_generation})",
+        f"Gang size:   {sp.workers_per_replica or 1} worker(s) x "
+        f"{sp.slice.chips_per_host or 1} chip(s)",
+        f"Priority:    {sp.priority_class or 'high'}",
+    ]
+    asc = sp.autoscale
+    if asc is not None:
+        from mpi_operator_tpu.api.defaults import (
+            DEFAULT_AUTOSCALE_MAX,
+            DEFAULT_AUTOSCALE_MIN,
+            DEFAULT_TARGET_QPS_PER_REPLICA,
+        )
+
+        lo = (asc.min_replicas if asc.min_replicas is not None
+              else DEFAULT_AUTOSCALE_MIN)
+        hi = (asc.max_replicas if asc.max_replicas is not None
+              else DEFAULT_AUTOSCALE_MAX)
+        tgt = (asc.target_qps_per_replica
+               if asc.target_qps_per_replica is not None
+               else DEFAULT_TARGET_QPS_PER_REPLICA)
+        zero = (f", scale-to-zero after {asc.scale_to_zero_after_s:g}s"
+                if asc.scale_to_zero_after_s is not None else "")
+        lines.append(
+            f"Autoscale:   {lo}..{hi} at {tgt:g} qps/replica{zero}"
+        )
+    lines.append("Conditions:")
+    for c in st.conditions:
+        lines.append(
+            f"  {c.type:<13} {str(bool(c.status)):<6} {c.reason} — "
+            f"{c.message}"
+        )
+    pods = client.store.list(
+        "Pod", m.namespace, selector={LABEL_SERVE_NAME: m.name}
+    )
+    by_replica = {}
+    for p in pods:
+        rid = p.metadata.labels.get(LABEL_SERVE_REPLICA, "?")
+        by_replica.setdefault(rid, []).append(p)
+    if by_replica:
+        rows = []
+        for rid in sorted(by_replica, key=lambda r: int(r) if r.isdigit()
+                          else -1):
+            members = by_replica[rid]
+            gen = members[0].metadata.labels.get("tpujob.dev/generation",
+                                                 "?")
+            ready = sum(1 for p in members if p.status.ready)
+            qps = sum(
+                float((p.status.serve_stats or {}).get("qps", 0.0))
+                for p in members
+            )
+            nodes = ",".join(sorted({
+                p.spec.node_name or "<unbound>" for p in members
+            }))
+            rows.append([f"r{rid}", gen, f"{ready}/{len(members)}",
+                         f"{qps:g}", nodes])
+        lines.append("Replicas:")
+        lines.append("  " + _table(
+            rows, ["GANG", "GEN", "READY", "QPS", "NODES"]
+        ).replace("\n", "\n  "))
+    print("\n".join(lines))
+    return 0
+
+
 def cmd_trace(client: TPUJobClient, args) -> int:
     """`ctl trace <job>` / `ctl trace --last-incident`: the causal
     timeline of a job's lifecycle (submit → scheduled → launched →
@@ -660,21 +841,46 @@ def cmd_trace(client: TPUJobClient, args) -> int:
                 f"generation={job.status.restart_generation}"
             )
     except NotFound:
-        # deleted jobs still have their spans; fall back to the newest
-        # trace that names the job in a span attribute. Pod attrs match
-        # on the worker-name shape ("<ns>/<job>-worker-N"), never a bare
-        # prefix — job "train" must not adopt job "train2"'s trace.
-        tid = None
-        header = [f"TPUJob {client.namespace}/{args.name} (deleted; "
-                  f"reconstructing from spans)"]
-        needle = f"{client.namespace}/{args.name}"
-        pod_prefix = f"{needle}-worker-"
-        for s in spans:
-            attrs = s.get("attrs") or {}
-            if attrs.get("job") == needle or str(
-                attrs.get("pod", "")
-            ).startswith(pod_prefix):
-                tid = s.get("trace_id")
+        serve = None
+        try:
+            serve = _serve_client(client).get(args.name)
+        except NotFound:
+            pass
+        if serve is not None:
+            # the serving workload class: `ctl trace <serve>` renders the
+            # rollout timeline (serve.rollout → replica_launch →
+            # replica_ready → replica_drain) the serve controller exported
+            tid = serve.metadata.annotations.get(tr.ANNOTATION_TRACE_ID)
+            st = serve.status
+            header = [f"TPUServe {serve.metadata.namespace}/"
+                      f"{serve.metadata.name}"]
+            for c in st.conditions:
+                header.append(
+                    f"  {c.type:<13} {str(bool(c.status)):<6} {c.reason}"
+                )
+            header.append(
+                f"  replicas: {st.ready_replicas} ready / "
+                f"{st.replicas} live, generation {st.serve_generation}"
+            )
+        else:
+            # deleted jobs/serves still have their spans; fall back to the
+            # newest trace that names the object in a span attribute. Pod
+            # attrs match on the worker-name shape
+            # ("<ns>/<job>-worker-N"), never a bare prefix — job "train"
+            # must not adopt job "train2"'s trace.
+            tid = None
+            header = [f"{client.namespace}/{args.name} (deleted; "
+                      f"reconstructing from spans)"]
+            needle = f"{client.namespace}/{args.name}"
+            pod_prefix = f"{needle}-worker-"
+            for s in spans:
+                attrs = s.get("attrs") or {}
+                if (
+                    attrs.get("job") == needle
+                    or attrs.get("serve") == needle
+                    or str(attrs.get("pod", "")).startswith(pod_prefix)
+                ):
+                    tid = s.get("trace_id")
     if not tid:
         print(f"error: job {args.name} carries no trace id (created "
               "before tracing, or by an old client) and no span "
@@ -813,6 +1019,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("action", choices=["status"])
     p.add_argument("-o", "--output", choices=["table", "json"],
                    default="table")
+    p = sub.add_parser("serve", help="the serving workload class "
+                                     "(TPUServe): create/get/status/"
+                                     "scale/delete autoscaled inference "
+                                     "gangs")
+    p.add_argument("action",
+                   choices=["create", "get", "status", "scale", "delete"])
+    p.add_argument("name", nargs="?",
+                   help="serve name (required for status/scale/delete)")
+    p.add_argument("-f", "--filename", default=None,
+                   help="TPUServe manifest (create)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="target replica count (scale)")
+    p.add_argument("-o", "--output", choices=["table", "json"],
+                   default="table")
     p = sub.add_parser("trace", help="render a job's causal span timeline "
                                      "(submit → scheduled → launched → "
                                      "restarts → terminal) from the "
@@ -877,6 +1097,7 @@ def main(argv=None) -> int:
             "uncordon": cmd_uncordon,
             "drain": cmd_drain,
             "store": cmd_store,
+            "serve": cmd_serve,
             "trace": cmd_trace,
         }[args.verb](client, args)
     except Forbidden as e:

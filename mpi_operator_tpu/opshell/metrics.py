@@ -89,6 +89,13 @@ class _Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled series (a per-object gauge whose object was
+        deleted must stop exporting its last value forever — and a churn
+        of uniquely-named objects must not grow the registry unboundedly)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def render(self) -> str:
         lines = [
             f"# HELP {self.name} {escape_help(self.help)}",
@@ -497,6 +504,26 @@ events_pruned = REGISTRY.counter(
     "the same way; without this the store grows without bound)",
 )
 
+# --- the serving workload class (ISSUE 11) ---------------------------------
+
+serve_scale_events = REGISTRY.counter(
+    "tpu_operator_serve_scale_events_total",
+    "Autoscaler replica-count changes by direction (up/down) — a high "
+    "rate with alternating directions is flapping the stabilization "
+    "windows should be absorbing (widen scale_down_stabilization_s)",
+)
+serve_desired_replicas = REGISTRY.gauge(
+    "tpu_operator_serve_desired_replicas",
+    "The autoscaler's latest replica target per serve (labeled "
+    "serve=<ns>/<name>) — compare against ready replicas in `ctl serve "
+    "status` to see convergence",
+)
+serve_replicas_ready = REGISTRY.gauge(
+    "tpu_operator_serve_replicas_ready",
+    "Ready serving replicas per serve (every gang member Running AND "
+    "ready) — the supply side of the autoscaler's loop",
+)
+
 # --- the histogram catalog (ISSUE 9): latencies at the span-close sites ----
 
 reconcile_latency = REGISTRY.histogram(
@@ -521,6 +548,12 @@ scheduler_bind_latency = REGISTRY.histogram(
     "Gang-scheduler pod-binding write latency (the admission hot path); "
     "observed where the scheduler.bind span closes",
 )
+scheduler_sync_latency = REGISTRY.histogram(
+    "tpu_operator_scheduler_sync_latency_seconds",
+    "Gang-scheduler full admission pass wall time (list, order, place, "
+    "bind, preempt) — the per-pass cost ROADMAP's 100k-pod item needs a "
+    "baseline for; observed where the scheduler.sync span closes",
+)
 replication_ship_latency = REGISTRY.histogram(
     "tpu_operator_replication_ship_latency_seconds",
     "Leader commit-to-majority-ack time per replicated write (the HA "
@@ -537,4 +570,28 @@ agent_tick_latency = REGISTRY.histogram(
     "tpu_operator_agent_tick_latency_seconds",
     "Node-agent tick (heartbeat + batched pod mirrors, one patch-batch) "
     "round-trip time; observed where the agent.tick span closes",
+)
+serve_reconcile_latency = REGISTRY.histogram(
+    "tpu_operator_serve_reconcile_latency_seconds",
+    "TPUServe controller sync wall time per reconcile (the serving "
+    "control loop's headline latency); observed where the serve.reconcile "
+    "span closes — every controller loop registers its histogram at the "
+    "span-close site (oplint OBS002)",
+)
+serve_ready_latency = REGISTRY.histogram(
+    "tpu_operator_serve_ready_latency_seconds",
+    "Serving-replica creation-to-ready time (gang create → every member "
+    "Running AND ready): THE serving cold-start SLO — the autoscaler's "
+    "reaction to a spike is only as good as this plus the decision lag; "
+    "observed where the serve.replica_ready span closes",
+    # serving readiness spans model-load/warmup territory: sub-second
+    # hollow gangs through multi-minute real compile+load
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0),
+)
+autoscaler_sync_latency = REGISTRY.histogram(
+    "tpu_operator_autoscaler_sync_latency_seconds",
+    "Autoscaler decision-pass wall time (sample every serve, run the "
+    "pure recommendation, write changed scales); observed where the "
+    "autoscaler.sync span closes",
 )

@@ -348,9 +348,11 @@ class GangScheduler:
             self._dirty = True
             return
         link, self._wake_link = self._wake_link, None
+        t0 = time.perf_counter()
         with trace.start_span("scheduler.sync", parent=link):
             with self._lock:
                 self._sync_locked()
+        metrics.scheduler_sync_latency.observe(time.perf_counter() - t0)
 
     def _overlay_assumed(self, pods: List[Pod], retire: bool = True) -> None:
         """Apply not-yet-echoed bindings onto the cached pod snapshot and

@@ -10,8 +10,8 @@ def blessed_with_form(self):
 
 
 def blessed_no_alias(self):
-    with trace.start_span("scheduler.sync"):
-        self.sync_locked()
+    with trace.start_span("scheduler.bind"):
+        self.bind_one()
 
 
 def blessed_nested(self, tracer):

@@ -1,0 +1,26 @@
+# oplint fixture: OBS002 must fire on a controller-loop span
+# (*.reconcile / *.sync) whose enclosing function never observes a
+# histogram — the span-close site is the instrumentation point.
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.opshell import metrics
+
+
+def uninstrumented_reconcile(self, key):
+    with trace.start_span("controller.reconcile", attrs={"job": key}):  # expect: OBS002
+        return self._sync(key)
+
+
+def uninstrumented_sync_on_tracer(self, tracer):
+    with tracer.start_span("scheduler.sync"):  # expect: OBS002
+        self._sync_locked()
+
+
+def observe_in_sibling_does_not_count(self, key):
+    # the .observe lives in ANOTHER function: this loop's latency is
+    # still invisible at its own span-close site
+    with trace.start_span("serve.reconcile"):  # expect: OBS002
+        self._sync(key)
+
+
+def the_sibling(self, dt):
+    metrics.reconcile_latency.observe(dt)

@@ -1,0 +1,43 @@
+# oplint fixture: blessed OBS002 shapes — the loop span's function also
+# observes a histogram (before, inside, or in a finally), non-loop spans
+# are exempt, and the reasoned suppression works.
+import time
+
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.opshell import metrics
+
+
+def blessed_observe_in_finally(self, key):
+    t0 = time.perf_counter()
+    try:
+        with trace.start_span("controller.reconcile", attrs={"job": key}):
+            return self._sync(key)
+    finally:
+        metrics.reconcile_latency.observe(time.perf_counter() - t0)
+
+
+def blessed_observe_after_with(self):
+    t0 = time.perf_counter()
+    with trace.start_span("scheduler.sync"):
+        self._sync_locked()
+    metrics.scheduler_sync_latency.observe(time.perf_counter() - t0)
+
+
+def non_loop_spans_exempt(self, pod):
+    # bind/launch/ship spans are per-OPERATION, not per-loop: their
+    # functions may observe elsewhere or not at all
+    with trace.start_span("scheduler.bind", attrs={"pod": pod}):
+        self._bind(pod)
+
+
+# module level: no enclosing function, nothing to anchor the requirement
+# to (fixtures are linted, never imported, so this never executes)
+with trace.start_span("harness.sync"):
+    pass
+
+
+def exempted_with_reason(self, key):
+    # oplint: disable=OBS002 — bench-internal dry-run loop: its latency
+    # is measured by the bench's own wall clock, not /metrics
+    with trace.start_span("bench.reconcile"):
+        self._sync(key)

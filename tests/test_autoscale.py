@@ -1,0 +1,323 @@
+"""Autoscaler decision logic (ISSUE 11): the pure function
+controller.autoscaler.recommend — metrics window in → replica count out —
+plus the ServeAutoscaler shell's sampling/patching behavior.
+
+The pure core is where every serving-SLO behavior lives (flap
+suppression, scale-to-zero grace, cold-start guard), so it gets the
+property-style sweep: seeded random load curves, invariants asserted on
+every single decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from mpi_operator_tpu.controller.autoscaler import (
+    ANNOTATION_OFFERED_QPS,
+    Decision,
+    Sample,
+    ServeAutoscaler,
+    Targets,
+    recommend,
+)
+
+
+def S(t, qps, ready=1, queue=0.0, p99=0.0):
+    return Sample(t=t, qps=qps, queue_depth=queue, p99_ms=p99, ready=ready)
+
+
+T = Targets(
+    min_replicas=0, max_replicas=10, target_qps_per_replica=100.0,
+    up_window_s=0.0, down_window_s=10.0, scale_to_zero_after_s=30.0,
+    cold_start_grace_s=5.0,
+)
+
+
+# ---------------------------------------------------------------------------
+# direct decision behavior
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_tracks_qps():
+    assert recommend([S(100, 450)], 1, T, 100).replicas == 5
+    assert recommend([S(100, 100)], 1, T, 100).replicas == 1
+    assert recommend([S(100, 101)], 1, T, 100).replicas == 2
+
+
+def test_scale_up_clamped_to_max():
+    assert recommend([S(100, 1e6)], 1, T, 100).replicas == T.max_replicas
+
+
+def test_empty_window_holds():
+    assert recommend([], 3, T, 100) == Decision(3, "no-samples")
+
+
+def test_up_stabilization_takes_window_minimum():
+    """A one-sample blip must not scale up when the up window disagrees:
+    with up_window_s=5, every sample in the window must support the new
+    level."""
+    t = Targets(min_replicas=1, max_replicas=10, target_qps_per_replica=100,
+                up_window_s=5.0, down_window_s=10.0)
+    blip = [S(96, 100), S(98, 100), S(100, 900)]
+    assert recommend(blip, 1, t, 100).replicas == 1
+    sustained = [S(96, 900), S(98, 900), S(100, 900)]
+    assert recommend(sustained, 1, t, 100).replicas == 9
+
+
+def test_down_stabilization_takes_window_maximum():
+    """Scale-down honors the BUSIEST sample in the down window: one quiet
+    sample never sheds capacity a recent spike needed (flap suppression)."""
+    spike_then_quiet = [S(95, 500, ready=5), S(100, 50, ready=5)]
+    assert recommend(spike_then_quiet, 5, T, 100).replicas == 5
+    # once the spike ages past the window, down-scaling happens
+    aged = [S(t, 50, ready=5) for t in range(89, 101)]
+    assert recommend(aged, 5, T, 100).replicas == 1
+
+
+def test_no_flap_on_alternating_load():
+    """Alternating 1-vs-2-replica load inside the down window must not
+    oscillate: decisions may go up but never down while the window still
+    holds a busy sample."""
+    cur = 1
+    decisions = []
+    samples = []
+    for i in range(40):
+        qps = 180 if i % 2 == 0 else 80  # argues 2 vs 1 replicas
+        samples.append(S(100 + i, qps, ready=cur))
+        window = [s for s in samples if s.t >= 100 + i - 12]
+        d = recommend(window, cur, T, 100 + i)
+        decisions.append(d.replicas)
+        cur = d.replicas
+    assert 2 in decisions  # it did scale up for the busy phase
+    assert decisions[5:] == [2] * len(decisions[5:])  # then held, no flap
+
+
+def test_cold_start_guard_blocks_scale_down():
+    quiet = [S(t, 50, ready=5) for t in range(85, 101)]
+    held = recommend(quiet, 5, T, 100, last_scale_up_t=97)
+    assert held.replicas == 5
+    assert "cold-start" in held.reason
+    # guard expired → the down verdict lands
+    assert recommend(quiet, 5, T, 100, last_scale_up_t=90).replicas == 1
+
+
+def test_scale_to_zero_requires_covered_quiet_window():
+    # quiet, but the window doesn't span the grace yet → hold at 1
+    short = [S(t, 0, ready=1) for t in range(95, 101)]
+    assert recommend(short, 1, T, 100).replicas == 1
+    # grace covered with zero traffic → 0
+    covered = [S(t, 0, ready=1) for t in range(65, 101)]
+    assert recommend(covered, 1, T, 100).replicas == 0
+    # any traffic inside the grace window resets the verdict
+    blip = [S(t, 0 if t != 90 else 5, ready=1) for t in range(65, 101)]
+    assert recommend(blip, 1, T, 100).replicas == 1
+
+
+def test_scale_to_zero_disabled_without_zero_floor():
+    t = Targets(min_replicas=1, max_replicas=10,
+                target_qps_per_replica=100, down_window_s=5.0,
+                scale_to_zero_after_s=None)
+    covered = [S(t_, 0, ready=1) for t_ in range(60, 101)]
+    assert recommend(covered, 1, t, 100).replicas == 1
+
+
+def test_scale_from_zero_on_traffic():
+    """The KEDA-shaped wakeup: at zero replicas an arrival-rate sample
+    (from the offered-qps annotation) must scale up immediately with the
+    default instant up window."""
+    assert recommend([S(100, 30)], 0, T, 100).replicas == 1
+    assert recommend([S(100, 350)], 0, T, 100).replicas == 4
+
+
+def test_floor_and_cap_self_heal_on_every_path():
+    """HPA clamps every verdict to [min, max] — a serve manually scaled
+    below its floor (ctl serve scale) or above its cap must self-heal on
+    the next tick even when the load verdict says 'steady' (the hold
+    paths previously returned `current` unclamped)."""
+    t = Targets(min_replicas=2, max_replicas=5,
+                target_qps_per_replica=100, down_window_s=5.0)
+    # below the floor with zero traffic: raised to the floor, not parked
+    assert recommend([S(100, 0, ready=0)], 0, t, 100).replicas == 2
+    # below the floor with light load whose raw desired is 1: still 2
+    assert recommend([S(100, 80, ready=1)], 1, t, 100).replicas == 2
+    # even with no samples at all, a floor violation heals
+    assert recommend([], 0, t, 100).replicas == 2
+    # above the cap: lowered, regardless of load arguing higher
+    assert recommend([S(100, 5000, ready=9)], 9, t, 100).replicas == 5
+
+
+def test_deleted_serve_drops_gauge_and_window_state():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.opshell import metrics
+
+    store = ObjectStore()
+    _mk_serve(store, min_replicas=1, max_replicas=4)
+    asc = ServeAutoscaler(store, interval=999)
+    asc.tick(now=100.0)
+    assert asc._states
+    assert metrics.serve_desired_replicas.get(serve="default/svc") >= 1
+    store.delete("TPUServe", "default", "svc")
+    asc.tick(now=101.0)
+    assert not asc._states
+    assert metrics.serve_desired_replicas.get(serve="default/svc") == 0.0
+
+
+def test_latency_and_queue_breach_escalate():
+    t = Targets(min_replicas=1, max_replicas=10,
+                target_qps_per_replica=100, target_p99_ms=200.0,
+                target_queue_depth=10.0, down_window_s=5.0)
+    # QPS says 1 replica is fine, p99 says it is drowning
+    assert recommend([S(100, 90, ready=1, p99=900)], 1, t, 100).replicas == 2
+    assert recommend([S(100, 90, ready=1, queue=50)], 1, t, 100).replicas == 2
+    # healthy latency: no escalation
+    assert recommend([S(100, 90, ready=1, p99=100)], 1, t, 100).replicas == 1
+
+
+# ---------------------------------------------------------------------------
+# property-style sweep: invariants over seeded random load curves
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_invariants_hold_over_random_load_curves():
+    """For 30 seeded random traffic traces driven through the decision
+    loop tick by tick:
+
+    - the verdict always lands in [0, max_replicas], 0 only when zero
+      traffic covered the scale-to-zero grace;
+    - scale-down NEVER happens inside the cold-start grace of the last
+      scale-up, and never below ceil(busiest-down-window-qps / target);
+    - under sustained overload the fleet reaches the demanded size
+      within the up window.
+    """
+    for seed in range(30):
+        rng = random.Random(seed)
+        t = Targets(
+            min_replicas=0, max_replicas=16,
+            target_qps_per_replica=100.0,
+            up_window_s=rng.choice([0.0, 2.0]),
+            down_window_s=rng.choice([5.0, 10.0]),
+            scale_to_zero_after_s=rng.choice([8.0, 15.0]),
+            cold_start_grace_s=rng.choice([0.0, 3.0]),
+        )
+        cur = rng.randint(0, 4)
+        samples = []
+        last_up = None
+        qps = 0.0
+        for step in range(120):
+            now = float(step)
+            # random walk with occasional spikes and dead-quiet phases
+            r = rng.random()
+            if r < 0.08:
+                qps = rng.uniform(800, 1500)
+            elif r < 0.2:
+                qps = 0.0
+            else:
+                qps = max(0.0, qps + rng.uniform(-120, 120))
+            samples.append(S(now, qps, ready=cur))
+            horizon = max(t.up_window_s, t.down_window_s,
+                          t.scale_to_zero_after_s) + 5
+            samples = [s for s in samples if s.t >= now - horizon]
+            d = recommend(samples, cur, t, now, last_scale_up_t=last_up)
+            assert 0 <= d.replicas <= t.max_replicas, (seed, step, d)
+            if d.replicas == 0 and cur > 0:
+                grace = [s for s in samples
+                         if s.t >= now - t.scale_to_zero_after_s]
+                assert samples[0].t <= now - t.scale_to_zero_after_s, (
+                    seed, step, "zero before the grace window was covered")
+                assert all(s.qps <= 0 for s in grace), (seed, step)
+            if d.replicas < cur:
+                if last_up is not None:
+                    assert now - last_up >= t.cold_start_grace_s, (
+                        seed, step, "scale-down inside cold-start grace")
+                busiest = max(
+                    s.qps for s in samples if s.t >= now - t.down_window_s
+                )
+                if d.replicas > 0:
+                    assert d.replicas >= min(
+                        t.max_replicas,
+                        math.ceil(busiest / t.target_qps_per_replica)
+                    ), (seed, step, "shed below the busiest window sample")
+            if d.replicas > cur:
+                last_up = now
+            cur = d.replicas
+
+
+# ---------------------------------------------------------------------------
+# the impure shell: sampling + spec.replicas writes
+# ---------------------------------------------------------------------------
+
+
+def _mk_serve(store, name="svc", **autoscale):
+    from mpi_operator_tpu.api.client import TPUServeClient
+
+    spec = {"replicas": 1}
+    if autoscale is not None:
+        spec["autoscale"] = dict(autoscale)
+    return TPUServeClient(store).create(
+        {"kind": "TPUServe", "metadata": {"name": name}, "spec": spec}
+    )
+
+
+def test_autoscaler_patches_spec_replicas_from_annotation_hint():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    store = ObjectStore()
+    _mk_serve(store, min_replicas=1, max_replicas=6,
+              target_qps_per_replica=100)
+    store.patch("TPUServe", "default", "svc",
+                {"metadata": {"annotations": {ANNOTATION_OFFERED_QPS: "450"}}})
+    asc = ServeAutoscaler(store, interval=999)
+    asc.tick(now=100.0)
+    serve = store.get("TPUServe", "default", "svc")
+    assert serve.spec.replicas == 5
+    # second tick at the same load: no further change (steady)
+    asc.tick(now=101.0)
+    assert store.get("TPUServe", "default", "svc").spec.replicas == 5
+
+
+def test_autoscaler_ignores_serves_without_policy():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.api.client import TPUServeClient
+
+    store = ObjectStore()
+    TPUServeClient(store).create(
+        {"kind": "TPUServe", "metadata": {"name": "plain"},
+         "spec": {"replicas": 2}}
+    )
+    store.patch("TPUServe", "default", "plain",
+                {"metadata": {"annotations": {ANNOTATION_OFFERED_QPS: "900"}}})
+    asc = ServeAutoscaler(store, interval=999)
+    asc.tick(now=100.0)
+    assert store.get("TPUServe", "default", "plain").spec.replicas == 2
+
+
+def test_autoscaler_aggregates_pod_serve_stats():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.machinery.objects import Pod, PodPhase
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.controller.serve import (
+        LABEL_SERVE_NAME,
+        LABEL_SERVE_REPLICA,
+    )
+
+    store = ObjectStore()
+    _mk_serve(store, min_replicas=1, max_replicas=8,
+              target_qps_per_replica=100)
+    for i in range(2):
+        p = Pod(metadata=ObjectMeta(
+            name=f"svc-r{i}-w0", namespace="default",
+            labels={LABEL_SERVE_NAME: "svc", LABEL_SERVE_REPLICA: str(i),
+                    "tpujob.dev/replica-index": "0"},
+        ))
+        p.status.phase = PodPhase.RUNNING
+        p.status.ready = True
+        p.status.serve_stats = {"qps": 160.0, "queue_depth": 1.0,
+                                "p99_ms": 40.0}
+        store.create(p)
+    asc = ServeAutoscaler(store, interval=999)
+    sample = asc.sample(store.get("TPUServe", "default", "svc"), now=50.0)
+    assert sample.qps == 320.0
+    assert sample.ready == 2
+    asc.tick(now=100.0)
+    assert store.get("TPUServe", "default", "svc").spec.replicas == 4

@@ -1,0 +1,500 @@
+"""TPUServe — the serving workload class (ISSUE 11): API admission,
+the serve controller's replica-gang reconcile (readiness gates, rolling
+generation updates with zero unready windows, failed-gang replacement,
+cascade delete), serving-vs-batch priority preemption, and the hollow
+serving timeline that feeds the autoscaler.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.client import (
+    TPUServeClient,
+    ValidationRejected,
+)
+from mpi_operator_tpu.api.defaults import set_serve_defaults
+from mpi_operator_tpu.api.schema import ManifestError, parse_tpuserve
+from mpi_operator_tpu.api.types import TPUServe
+from mpi_operator_tpu.api.validation import validate_tpuserve
+from mpi_operator_tpu.controller.serve import (
+    LABEL_SERVE_NAME,
+    LABEL_SERVE_REPLICA,
+    ROLE_SERVE,
+    TPUServeController,
+    compute_template_hash,
+    group_replicas,
+    replica_ready,
+)
+from mpi_operator_tpu.machinery.objects import PodPhase, evict_pod
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+LABEL_GENERATION = "tpujob.dev/generation"
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+
+
+def make_serve(name="svc", **spec):
+    doc = {"kind": "TPUServe", "metadata": {"name": name},
+           "spec": {"replicas": 2, **spec}}
+    return doc
+
+
+def serve_pods(store, name="svc", ns="default"):
+    return store.list("Pod", ns, selector={LABEL_SERVE_NAME: name})
+
+
+def mark_ready(store, pods):
+    for p in pods:
+        if p.status.phase == PodPhase.PENDING:
+            store.patch(
+                "Pod", p.metadata.namespace, p.metadata.name,
+                {"status": {"phase": PodPhase.RUNNING, "ready": True}},
+                subresource="status",
+            )
+
+
+def wait_until(fn, timeout=8.0, every=0.03):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(every)
+    raise AssertionError("condition not reached within timeout")
+
+
+@pytest.fixture
+def plane():
+    """store + serve controller + gang scheduler, torn down in order."""
+    store = ObjectStore()
+    ctrl = TPUServeController(store)
+    sched = GangScheduler(store)
+    ctrl.run()
+    sched.start()
+    yield store, ctrl, sched
+    ctrl.stop()
+    sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# API: schema / defaults / validation
+# ---------------------------------------------------------------------------
+
+
+def test_schema_rejects_unknown_fields():
+    with pytest.raises(ManifestError) as ei:
+        parse_tpuserve({"kind": "TPUServe", "metadata": {"name": "x"},
+                        "spec": {"replicaz": 3}})
+    assert "replicaz" in str(ei.value)
+    # camelCase is normalized like the batch schema
+    s = parse_tpuserve({"kind": "TPUServe", "metadata": {"name": "x"},
+                        "spec": {"workersPerReplica": 2,
+                                 "autoscale": {"minReplicas": 0,
+                                               "maxReplicas": 4,
+                                               "scaleToZeroAfterS": 30}}})
+    assert s.spec.workers_per_replica == 2
+    assert s.spec.autoscale.scale_to_zero_after_s == 30
+
+
+def test_defaults_are_idempotent_and_serving_priority():
+    s = parse_tpuserve(make_serve())
+    set_serve_defaults(s)
+    once = s.to_dict()
+    set_serve_defaults(s)
+    assert s.to_dict() == once
+    assert s.spec.priority_class == "high"
+    assert s.spec.max_surge == 1 and s.spec.max_unavailable == 0
+    assert s.spec.workers_per_replica == 1
+
+
+def test_validation_catches_bad_specs():
+    s = set_serve_defaults(parse_tpuserve(make_serve()))
+    assert validate_tpuserve(s) == []
+    bad = parse_tpuserve(make_serve(
+        autoscale={"min_replicas": 3, "max_replicas": 2}))
+    set_serve_defaults(bad)
+    assert any("min_replicas must be <=" in e for e in validate_tpuserve(bad))
+    z = parse_tpuserve(make_serve(
+        autoscale={"min_replicas": 1, "scale_to_zero_after_s": 10}))
+    set_serve_defaults(z)
+    assert any("requires min_replicas = 0" in e
+               for e in validate_tpuserve(z))
+    surge = set_serve_defaults(parse_tpuserve(make_serve()))
+    surge.spec.max_surge = 0
+    assert any("max_surge" in e for e in validate_tpuserve(surge))
+    pri = set_serve_defaults(parse_tpuserve(make_serve()))
+    pri.spec.priority_class = "no-such-class"
+    assert any("priority_class" in e for e in validate_tpuserve(pri))
+
+
+def test_client_validates_defaulted_copy_but_stores_raw():
+    store = ObjectStore()
+    client = TPUServeClient(store)
+    with pytest.raises(ValidationRejected):
+        client.create(make_serve(workers_per_replica=0))
+    client.create(make_serve())
+    stored = store.get("TPUServe", "default", "svc")
+    assert stored.spec.priority_class is None  # raw spec, not defaulted
+    assert stored.metadata.annotations.get("tpujob.dev/trace-id")
+
+
+def test_template_hash_stable_under_defaulting():
+    a = set_serve_defaults(parse_tpuserve(make_serve()))
+    b = set_serve_defaults(parse_tpuserve(make_serve(priority_class="high")))
+    assert compute_template_hash(a) == compute_template_hash(b)
+    c = set_serve_defaults(parse_tpuserve(make_serve(
+        template={"container": {"env": {"MODEL": "v2"}}})))
+    assert compute_template_hash(a) != compute_template_hash(c)
+
+
+def test_hollow_label_constants_match_controller():
+    """The hollow executor duplicates the label strings on purpose (no
+    controller import from the executor plane); they must never drift."""
+    from mpi_operator_tpu.executor import hollow
+    from mpi_operator_tpu.controller import controller as cc
+    from mpi_operator_tpu.controller import serve as sc
+
+    assert hollow.LABEL_ROLE == cc.LABEL_ROLE
+    assert hollow.LABEL_SERVE_NAME == sc.LABEL_SERVE_NAME
+    assert hollow.ROLE_SERVE == sc.ROLE_SERVE
+
+
+# ---------------------------------------------------------------------------
+# controller: create / readiness / status
+# ---------------------------------------------------------------------------
+
+
+def test_create_launches_replica_gangs_with_podgroups(plane):
+    store, ctrl, sched = plane
+    TPUServeClient(store).create(make_serve(workers_per_replica=2))
+    pods = wait_until(lambda: len(serve_pods(store)) == 4
+                      and serve_pods(store))
+    groups = group_replicas(pods)
+    assert sorted(groups) == [0, 1]
+    for rid, members in groups.items():
+        assert [p.metadata.labels[LABEL_JOB_NAME] for p in members] == \
+            [f"svc-r{rid}"] * 2
+        pg = store.get("PodGroup", "default", f"svc-r{rid}")
+        assert pg.spec.min_member == 2
+        assert pg.spec.priority_class == "high"  # serving outranks batch
+        assert pg.metadata.owner_references[0].kind == "TPUServe"
+    # gang-scheduler admission binds whole gangs
+    wait_until(lambda: all(p.spec.node_name for p in serve_pods(store)))
+    # readiness gate: Running alone is not ready
+    for p in serve_pods(store):
+        store.patch("Pod", "default", p.metadata.name,
+                    {"status": {"phase": PodPhase.RUNNING, "ready": False}},
+                    subresource="status")
+    time.sleep(0.3)
+    s = store.get("TPUServe", "default", "svc")
+    assert s.status.ready_replicas == 0
+    mark = serve_pods(store)
+    for p in mark:
+        store.patch("Pod", "default", p.metadata.name,
+                    {"status": {"ready": True}}, subresource="status")
+    wait_until(lambda: store.get("TPUServe", "default", "svc")
+               .status.ready_replicas == 2)
+    s = store.get("TPUServe", "default", "svc")
+    assert s.status.replicas == 2 and s.status.updated_replicas == 2
+    types = {c.type: c.status for c in s.status.conditions}
+    assert types["Available"] and not types["Progressing"]
+
+
+def test_failed_gang_is_replaced_with_fresh_replica_id(plane):
+    store, ctrl, sched = plane
+    TPUServeClient(store).create(make_serve(replicas=1))
+    pods = wait_until(lambda: serve_pods(store))
+    mark_ready(store, pods)
+    wait_until(lambda: store.get("TPUServe", "default", "svc")
+               .status.ready_replicas == 1)
+    victim = serve_pods(store)[0]
+    assert evict_pod(store, victim, "node lost")
+    # the gang is torn down whole and a NEW id replaces it
+    def replaced():
+        ps = [p for p in serve_pods(store) if not p.is_finished()]
+        return ps and all(
+            p.metadata.labels[LABEL_SERVE_REPLICA] != "0" for p in ps
+        ) and ps
+    ps = wait_until(replaced)
+    assert {p.metadata.labels[LABEL_SERVE_REPLICA] for p in ps} == {"1"}
+    # old podgroup reaped, new one exists
+    wait_until(lambda: store.try_get("PodGroup", "default", "svc-r0") is None)
+    assert store.get("PodGroup", "default", "svc-r1")
+
+
+def test_scale_down_prefers_unready_and_respects_floor(plane):
+    store, ctrl, sched = plane
+    client = TPUServeClient(store)
+    client.create(make_serve(replicas=3))
+    pods = wait_until(lambda: len(serve_pods(store)) == 3 and
+                      serve_pods(store))
+    # only replicas 0 and 1 become ready; 2 stays pending
+    for p in pods:
+        if p.metadata.labels[LABEL_SERVE_REPLICA] in ("0", "1"):
+            store.patch("Pod", "default", p.metadata.name,
+                        {"status": {"phase": PodPhase.RUNNING,
+                                    "ready": True}}, subresource="status")
+    wait_until(lambda: store.get("TPUServe", "default", "svc")
+               .status.ready_replicas == 2)
+    store.patch("TPUServe", "default", "svc", {"spec": {"replicas": 2}})
+    # the unready replica 2 is the victim; both ready gangs survive
+    wait_until(lambda: len([p for p in serve_pods(store)
+                            if not p.is_finished()]) == 2)
+    left = {p.metadata.labels[LABEL_SERVE_REPLICA] for p in serve_pods(store)}
+    assert left == {"0", "1"}
+
+
+def test_drained_replica_is_never_re_noted_ready():
+    """Regression (found by BENCH_CP_MODES=serve): an informer-lagged
+    reconcile can still see a just-drained gang as ready — the
+    once-per-replica ready mark must survive the drain, or the replica is
+    re-noted with its ORIGINAL creation timestamp and the readiness-SLO
+    histogram absorbs a bogus lifetime-length observation."""
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.opshell import metrics
+
+    store = ObjectStore()
+    serve = TPUServeClient(store).create(make_serve(replicas=1))
+    serve = store.get("TPUServe", "default", "svc")
+    ctrl = TPUServeController(store)
+    old = Pod(metadata=ObjectMeta(
+        name="svc-r0-w0", namespace="default",
+        labels={LABEL_SERVE_NAME: "svc", LABEL_SERVE_REPLICA: "0",
+                "tpujob.dev/replica-index": "0", LABEL_GENERATION: "0"},
+        creation_timestamp=time.time() - 3600,  # an hour-old gang
+    ))
+    old.status.phase = PodPhase.RUNNING
+    old.status.ready = True
+    live = {0: [old]}
+    before = metrics.serve_ready_latency.count()
+    ctrl._note_ready(serve, live, {0}, 0)
+    assert metrics.serve_ready_latency.count() == before + 1
+    ctrl._drain_replica(serve, 0, [old], reason="rollout")
+    # the lagged next pass still observes the gang ready: no second note
+    ctrl._note_ready(serve, live, {0}, 0)
+    assert metrics.serve_ready_latency.count() == before + 1
+
+
+def test_delete_cascades_to_pods_and_podgroups(plane):
+    store, ctrl, sched = plane
+    client = TPUServeClient(store)
+    client.create(make_serve(replicas=2))
+    wait_until(lambda: len(serve_pods(store)) == 2)
+    client.delete("svc")
+    wait_until(lambda: not serve_pods(store)
+               and not store.list("PodGroup", "default",
+                                  selector={LABEL_SERVE_NAME: "svc"}))
+
+
+# ---------------------------------------------------------------------------
+# rolling updates: generation-based, zero unready windows
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_update_never_dips_below_desired_ready(plane):
+    store, ctrl, sched = plane
+    client = TPUServeClient(store)
+    client.create(make_serve(replicas=2))
+    pods = wait_until(lambda: len(serve_pods(store)) == 2 and
+                      serve_pods(store))
+    mark_ready(store, pods)
+    wait_until(lambda: store.get("TPUServe", "default", "svc")
+               .status.ready_replicas == 2)
+
+    # watch ready counts during the whole rollout from the store trail
+    dips = []
+
+    def ready_now():
+        workers = 1
+        live = [p for p in serve_pods(store) if not p.is_finished()]
+        return sum(
+            1 for members in group_replicas(live).values()
+            if replica_ready(members, workers)
+        )
+
+    s2 = client.get("svc")
+    s2.spec.template.container.env = {"MODEL": "v2"}
+    client.update(s2)
+
+    deadline = time.time() + 10
+    done = False
+    while time.time() < deadline:
+        live = [p for p in serve_pods(store) if not p.is_finished()]
+        if ready_now() < 2:
+            dips.append([p.metadata.name for p in live])
+        # the executor stand-in: make pending pods ready as they appear
+        mark_ready(store, live)
+        gens = {p.metadata.labels[LABEL_GENERATION] for p in live}
+        st = store.get("TPUServe", "default", "svc").status
+        if gens == {"1"} and len(live) == 2 and st.updated_replicas == 2 \
+                and st.ready_replicas == 2:
+            done = True
+            break
+        time.sleep(0.03)
+    assert done, "rollout did not converge"
+    assert dips == [], f"ready dipped below desired during rollout: {dips}"
+    st = store.get("TPUServe", "default", "svc").status
+    assert st.serve_generation == 1
+    # replica ids were NOT reused across the generation boundary
+    ids = {int(p.metadata.labels[LABEL_SERVE_REPLICA])
+           for p in serve_pods(store) if not p.is_finished()}
+    assert min(ids) >= 2
+
+
+def test_rollout_surges_at_most_max_surge_above_desired(plane):
+    store, ctrl, sched = plane
+    client = TPUServeClient(store)
+    client.create(make_serve(replicas=3))
+    pods = wait_until(lambda: len(serve_pods(store)) == 3 and
+                      serve_pods(store))
+    mark_ready(store, pods)
+    wait_until(lambda: store.get("TPUServe", "default", "svc")
+               .status.ready_replicas == 3)
+    s2 = client.get("svc")
+    s2.spec.template.container.env = {"MODEL": "v2"}
+    client.update(s2)
+    # while the new-gen replica is NOT ready, live gangs never exceed 4
+    # (desired 3 + surge 1) and the three old ready gangs all survive
+    saw_surge = False
+    deadline = time.time() + 4
+    while time.time() < deadline:
+        live = [p for p in serve_pods(store) if not p.is_finished()]
+        groups = group_replicas(live)
+        assert len(groups) <= 4, f"surged past the cap: {sorted(groups)}"
+        old_ready = [rid for rid, m in groups.items()
+                     if m and m[0].metadata.labels[LABEL_GENERATION] == "0"
+                     and replica_ready(m, 1)]
+        if len(groups) == 4:
+            saw_surge = True
+            assert len(old_ready) == 3  # nothing drained before new ready
+        time.sleep(0.02)
+    assert saw_surge
+
+
+# ---------------------------------------------------------------------------
+# serving outranks batch: priority preemption on scale-up
+# ---------------------------------------------------------------------------
+
+
+def test_serving_scale_up_preempts_batch_gang():
+    """A serving gang that cannot place preempts a running batch gang
+    (priority high > default 0) through the EXISTING scheduler machinery;
+    the batch pods go terminal with reason=Preempted (free restart)."""
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.objects import (
+        Pod,
+        PodGroup,
+        PodGroupSpec,
+    )
+
+    store = ObjectStore()
+    # a running batch gang holding all 4 chips
+    store.create(PodGroup(
+        metadata=ObjectMeta(name="batch", namespace="default",
+                            labels={LABEL_JOB_NAME: "batch"}),
+        spec=PodGroupSpec(min_member=2, priority_class=""),
+    ))
+    for i in range(2):
+        p = Pod(metadata=ObjectMeta(
+            name=f"batch-worker-{i}", namespace="default",
+            labels={LABEL_JOB_NAME: "batch",
+                    "tpujob.dev/replica-index": str(i)},
+        ))
+        p.spec.node_name = "local"
+        p.spec.container.env = {"TPUJOB_CHIPS_PER_HOST": "2"}
+        p.status.phase = PodPhase.RUNNING
+        store.create(p)
+
+    sched = GangScheduler(store, chips=4, preemption_grace=0.05)
+    ctrl = TPUServeController(store)
+    ctrl.run()
+    try:
+        TPUServeClient(store).create(make_serve(
+            replicas=1, workers_per_replica=2,
+            slice={"accelerator": "cpu", "chips_per_host": 2},
+        ))
+        wait_until(lambda: len(serve_pods(store)) == 2)
+        sched.sync()  # observes the blocked serving gang (starts its clock)
+        time.sleep(0.1)  # preemption grace elapses
+        sched.sync()  # preempts the batch gang
+        batch = store.list("Pod", "default",
+                           selector={LABEL_JOB_NAME: "batch"})
+        assert all(p.status.phase == PodPhase.FAILED
+                   and p.status.reason == "Preempted" for p in batch)
+        sched.sync()  # the freed chips admit the serving gang
+        assert all(p.spec.node_name for p in serve_pods(store))
+    finally:
+        ctrl.stop()
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# hollow serving timeline
+# ---------------------------------------------------------------------------
+
+
+def test_hollow_serve_pod_warms_up_then_streams_stats():
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.executor.hollow import (
+        HollowExecutor,
+        HollowTimeline,
+        ServeLoadModel,
+    )
+    from mpi_operator_tpu.machinery.objects import Pod
+
+    store = ObjectStore()
+    load = ServeLoadModel(capacity_qps=100.0)
+    load.set_offered("default/svc", 80.0)
+    ex = HollowExecutor(
+        store, node_name="n1",
+        timeline=HollowTimeline(serve_warmup_s=0.1,
+                                serve_stats_interval_s=0.05, load=load),
+    )
+    ex.start()
+    try:
+        p = Pod(metadata=ObjectMeta(
+            name="svc-r0-w0", namespace="default",
+            labels={"tpujob.dev/job-role": ROLE_SERVE,
+                    LABEL_SERVE_NAME: "svc", LABEL_SERVE_REPLICA: "0",
+                    "tpujob.dev/replica-index": "0"},
+        ))
+        p.spec.node_name = "n1"
+        store.create(p)
+        # Running arrives before ready (the warmup IS the readiness gate)
+        wait_until(lambda: store.get("Pod", "default", "svc-r0-w0")
+                   .status.phase == PodPhase.RUNNING)
+        cur = store.get("Pod", "default", "svc-r0-w0")
+        wait_until(lambda: store.get("Pod", "default", "svc-r0-w0")
+                   .status.ready)
+        # stats stream: the pod reports its share of the offered load
+        stats = wait_until(lambda: store.get("Pod", "default", "svc-r0-w0")
+                           .status.serve_stats)
+        assert stats["qps"] == 80.0
+        assert stats["p99_ms"] > 0
+        assert load.serving_pods("default/svc") == 1
+        # eviction kills the stream and unregisters the pod
+        cur = store.get("Pod", "default", "svc-r0-w0")
+        assert evict_pod(store, cur, "drain")
+        wait_until(lambda: load.serving_pods("default/svc") == 0)
+    finally:
+        ex.stop()
+
+
+def test_load_model_closes_the_loop():
+    from mpi_operator_tpu.executor.hollow import ServeLoadModel
+
+    m = ServeLoadModel(capacity_qps=100.0, base_ms=20.0)
+    m.set_offered("d/s", 300.0)
+    m.register("d/s", "d/p0")
+    hot = m.sample("d/s")
+    for i in range(1, 4):
+        m.register("d/s", f"d/p{i}")
+    cold = m.sample("d/s")
+    # more replicas → lower per-pod load → lower latency and queue
+    assert cold["qps"] < hot["qps"]
+    assert cold["p99_ms"] < hot["p99_ms"]
+    assert cold["queue_depth"] < hot["queue_depth"]

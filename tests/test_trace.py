@@ -491,6 +491,96 @@ def test_ctl_trace_renders_job_timeline(tracer, tmp_path, capsys):
     assert "no incident spans" in out2
 
 
+def test_serve_rollout_renders_drain_after_ready(tracer, tmp_path, capsys):
+    """The serving rollout timeline (ISSUE 11): a template change exports
+    serve.rollout → serve.replica_launch (new generation) →
+    serve.replica_ready → serve.replica_drain (old generation), all in
+    the serve's ONE trace, and the old gang's drain strictly follows the
+    new gang's readiness (the zero-unready-window ordering). `ctl trace
+    <serve>` renders it."""
+    from mpi_operator_tpu.api.client import TPUServeClient
+    from mpi_operator_tpu.controller.serve import (
+        LABEL_SERVE_NAME,
+        TPUServeController,
+    )
+    from mpi_operator_tpu.machinery.objects import PodPhase
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+    from mpi_operator_tpu.opshell import ctl
+
+    db = tmp_path / "store.db"
+    store = SqliteStore(str(db))
+
+    def pods():
+        return store.list("Pod", "default",
+                          selector={LABEL_SERVE_NAME: "svc"})
+
+    def mark_ready():
+        for p in pods():
+            if p.status.phase == PodPhase.PENDING:
+                store.patch(
+                    "Pod", "default", p.metadata.name,
+                    {"status": {"phase": PodPhase.RUNNING, "ready": True}},
+                    subresource="status",
+                )
+
+    try:
+        client = TPUServeClient(store)
+        serve = client.create({"kind": "TPUServe",
+                               "metadata": {"name": "svc"},
+                               "spec": {"replicas": 1}})
+        tid = serve.metadata.annotations[trace.ANNOTATION_TRACE_ID]
+        ctrl = TPUServeController(store)
+        assert ctrl.sync_handler("default/svc")
+        mark_ready()
+        assert ctrl.sync_handler("default/svc")  # replica 0 ready
+        s2 = client.get("svc")
+        s2.spec.template.container.env = {"MODEL": "v2"}
+        client.update(s2)
+        # drive the rollout to convergence by hand (deterministic)
+        for _ in range(10):
+            assert ctrl.sync_handler("default/svc")
+            mark_ready()
+            live = [p for p in pods() if not p.is_finished()]
+            st = store.get("TPUServe", "default", "svc").status
+            if (
+                len(live) == 1 and st.updated_replicas == 1
+                and st.serve_generation == 1 and st.ready_replicas == 1
+            ):
+                break
+        else:
+            raise AssertionError("rollout did not converge")
+    finally:
+        store.close()
+    spans = trace.load_spans(tracer)
+    mine = [s for s in spans if s.get("trace_id") == tid]
+    names = {s["name"] for s in mine}
+    assert {"client.submit", "serve.reconcile", "serve.rollout",
+            "serve.replica_launch", "serve.replica_ready",
+            "serve.replica_drain"} <= names
+    rollout = next(s for s in mine if s["name"] == "serve.rollout")
+    assert rollout["attrs"]["to_generation"] == 1
+    launch1 = next(s for s in mine if s["name"] == "serve.replica_launch"
+                   and s["attrs"]["generation"] == 1)
+    ready1 = next(s for s in mine if s["name"] == "serve.replica_ready"
+                  and s["attrs"]["replica"] == launch1["attrs"]["replica"])
+    drain0 = next(s for s in mine if s["name"] == "serve.replica_drain"
+                  and s["attrs"]["reason"] == "rollout")
+    assert drain0["attrs"]["generation"] == 0
+    # the zero-unready-window ordering, visible in the trace itself:
+    # old-generation drain starts only after the new generation was ready
+    assert rollout["start"] <= launch1["start"] <= ready1["start"] \
+        <= drain0["start"]
+    # ctl renders the rollout timeline for a live serve
+    rc = ctl.main(["--store", f"sqlite:{db}", "trace", "svc",
+                   "--trace-dir", tracer])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert tid in out
+    for needle in ("TPUServe default/svc", "serve.rollout",
+                   "serve.replica_ready", "serve.replica_drain"):
+        assert needle in out
+
+
 def test_ctl_trace_without_dir_fails_with_hint(tmp_path, capsys,
                                               monkeypatch):
     from mpi_operator_tpu.opshell import ctl

@@ -544,12 +544,16 @@ _STORE_VERBS = {
 _MUTATION_VERBS = {
     "create", "update", "patch", "patch_batch", "delete", "try_delete",
 }
-_FOLLOWER_COMPONENT_RE = re.compile(r"(^|_)(follower|standby|replica)s?$")
+_FOLLOWER_COMPONENT_RE = re.compile(
+    r"(^|_)(follower|standby|replica|peer|joiner)s?$"
+)
 # functions that ARE the replication apply seam (and subclass overrides
-# ending in these names): direct follower writes are their whole job
+# ending in these names): direct follower writes are their whole job.
+# _handle_replica is the WIRE seam's server-side dispatcher (ISSUE 12);
+# _pull_snapshot assembles the chunked transfer load_snapshot applies.
 _REPLICATION_APPLY_FNS = {
     "apply_replicated", "install_snapshot", "append_entries",
-    "load_snapshot",
+    "load_snapshot", "_handle_replica", "_pull_snapshot",
 }
 
 

@@ -759,7 +759,7 @@ def _mk_mutant_replica_ack_before_majority() -> Harness:
     stay fast under ddmin re-execution."""
     rset, client, teardown = _mk_replica_parts()
     # the leader commits locally, ships nothing, acks
-    rset.nodes["n0"]._replicate = lambda epoch: None
+    rset.nodes["n0"]._replicate = lambda epoch, traced=False: None
     return Harness("mutant-replica-ack-before-majority", client,
                    teardown=teardown)
 

@@ -175,6 +175,15 @@ def _route_parts(path: str) -> List[str]:
     return [urllib.parse.unquote(p) for p in parsed.path.split("/") if p]
 
 
+def _is_peer_route(path: str) -> bool:
+    """Replication RPC routes (peer-token tier; /v1/replica/status stays
+    a public probe). ONE parse shared by auth, fair-queue gating, and
+    dispatch, so the three can never classify a path differently."""
+    parts = _route_parts(path)
+    return (len(parts) == 3 and parts[:2] == ["v1", "replica"]
+            and parts[2] != "status")
+
+
 def check_bearer(header: str, tokens) -> Optional[str]:
     """THE bearer-token check (constant-time compare), shared by the store
     server and the agent's log endpoint so the two security checks can
@@ -445,8 +454,22 @@ class StoreServer:
                  agent_tokens: Optional[Dict[str, str]] = None,
                  preencode: bool = True,
                  fairness: Optional[Any] = None,
-                 quota: Optional[Any] = None):
+                 quota: Optional[Any] = None,
+                 peer_token: Optional[str] = None):
         self.backing = backing
+        # PEER tier: replication RPCs between replica-set members
+        # (/v1/replica/* minus the public status probe). A dedicated
+        # secret — replication traffic can rewrite history wholesale, so
+        # neither the NODE nor the READ tier (nor even ADMIN: clients
+        # mutate through the store verbs, never the replication seam) is
+        # accepted there; see _peer_denied.
+        self.peer_token = peer_token
+        if peer_token is not None and not hasattr(backing, "append_entries"):
+            raise ValueError(
+                "peer_token configured but the backing store has no "
+                "replication seam (append_entries); a peer tier that "
+                "routes nowhere would silently advertise HA"
+            )
         # APF-style per-tenant admission (machinery/fairqueue.FairQueue):
         # None = open admission (the pre-scale-out behavior). Watch
         # long-polls and probes bypass the seat gate (they park by design).
@@ -471,11 +494,19 @@ class StoreServer:
             # matches the admin tier first, so an agent-tokens entry that
             # reuses the admin token would silently grant that node full
             # admin — the opposite of the scoped posture
-            if tok in (token, read_token):
+            if tok in (token, read_token, peer_token):
                 raise ValueError(
                     f"agent token for node {node!r} duplicates the "
-                    f"admin/read token; every tier needs a distinct secret"
+                    f"admin/read/peer token; every tier needs a distinct "
+                    f"secret"
                 )
+        if peer_token is not None and peer_token in (token, read_token):
+            # a peer token misconfigured to the admin/read value would
+            # grant that tier the replication seam (history rewrites)
+            raise ValueError(
+                "peer token duplicates the admin/read token; every tier "
+                "needs a distinct secret"
+            )
         if read_token is not None and read_token == token:
             # same fail-closed rule as the agent tier: check_bearer matches
             # the admin entry first, so a read token misconfigured to the
@@ -560,6 +591,14 @@ class StoreServer:
                 1k-entry agent-tokens file that second scan would double
                 the auth cost of every admitted request."""
                 self._tier = None
+                if _is_peer_route(self.path):
+                    # BEFORE the open-server early-out: peer replication
+                    # routes fail closed even on an otherwise
+                    # unauthenticated store — anyone who can dial the
+                    # port must not be able to rewrite replicated history
+                    return server._peer_denied(
+                        self.headers.get("Authorization", "")
+                    )
                 if server.token is None and not server.agent_tokens:
                     return None
                 if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
@@ -872,7 +911,12 @@ class StoreServer:
         the healthz/replica-status probes (liveness must not queue behind
         tenant load — a starved probe reads as a dead store)."""
         parts = _route_parts(path)
-        if parts == ["healthz"] or parts == ["v1", "replica", "status"]:
+        if parts == ["healthz"] or parts[:2] == ["v1", "replica"]:
+            # replica routes cover the status probe AND the peer RPCs:
+            # replication is system-plane traffic — a ship queued behind
+            # a tenant's seat wait would add tenant latency to EVERY
+            # write's majority ack (and could deadlock a leader whose
+            # own seat pool is saturated by the tenants it serves)
             return False
         if parts == ["v1", "watch"] and method == "GET":
             return False
@@ -953,6 +997,21 @@ class StoreServer:
         return "anon"
 
     # -- authorization ------------------------------------------------------
+
+    def _peer_denied(self, header: str) -> Optional[Tuple[int, str]]:
+        """The PEER tier's gate: replication RPCs accept EXACTLY the peer
+        token. Missing, wrong, or any OTHER tier's token (admin, read,
+        node — none of them is a replication identity) is a typed 403;
+        with no peer token configured the routes are disabled outright.
+        Always fail closed: replication traffic rewrites history."""
+        if self.peer_token is None:
+            return (403, "replica peer routes are disabled on this "
+                         "server (run with --peer-token-file)")
+        if check_bearer(header, (self.peer_token,)) is not None:
+            return None
+        return (403, "replica peer routes require the peer token "
+                     "(the admin/read/node tiers are not replication "
+                     "identities)")
 
     def _agent_denied(
         self, method: str, path: str, body: Dict[str, Any], node: str
@@ -1218,6 +1277,13 @@ class StoreServer:
         backing call, so the backing's watch event captures THIS span as
         the write's origin; the request latency lands in the verb×backend
         histogram where the span closes."""
+        if _is_peer_route(path):
+            if method != "POST":
+                return 404, {"error": "NotFound",
+                             "message": "replica peer routes are POST"}
+            return self._handle_replica(
+                _route_parts(path)[2], body, traceparent,
+            )
         verb = self._route_verb(method, path)
         if verb is None:
             return self._handle(method, path, body)
@@ -1235,6 +1301,61 @@ class StoreServer:
             verb=verb, backend=self._backend_label,
         )
         return code, payload
+
+    # peer RPC route → the ReplicaNode handler it dispatches to (the
+    # whole deployed replication protocol, ISSUE 12). Epoch fencing runs
+    # server-side IN the handler — StaleEpoch crosses back as a typed
+    # 409 the peer fabric re-raises, so fencing is transport-agnostic.
+    _PEER_ROUTE_METHODS = {
+        "request-vote": "request_vote",
+        "append-entries": "append_entries",
+        "fetch-entries": "fetch_entries",
+        "install-snapshot": "install_snapshot",
+        "snapshot-chunk": "snapshot_chunk",
+        "snapshot-done": "snapshot_done",
+    }
+
+    def _handle_replica(
+        self, route: str, body: Dict[str, Any], traceparent: str
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Dispatch one peer replication RPC into the backing replica
+        node (auth already passed the peer gate in _auth_error). The
+        server-side span parents on the caller's traceparent, so a
+        shipped write's apply on the follower lands in the WRITE's trace
+        — the anchor a later election links through (`ctl trace
+        --last-incident` failover continuity)."""
+        from mpi_operator_tpu.machinery.replicated_store import (
+            PeerUnreachable,
+            StaleEpoch,
+            UnknownTransfer,
+        )
+
+        meth = self._PEER_ROUTE_METHODS.get(route)
+        fn = getattr(self.backing, meth, None) if meth else None
+        if fn is None:
+            return 404, {"error": "NotFound",
+                         "message": f"no replica route {route!r}"}
+        args = body.get("args")
+        if not isinstance(args, list):
+            return 400, {"error": "BadRequest",
+                         "message": "peer RPC body needs an args list"}
+        parent = trace.parse_traceparent(traceparent)
+        try:
+            with trace.start_span(
+                "replica." + meth, parent=parent,
+                attrs={"src": str(body.get("src", "?"))},
+            ):
+                return 200, {"result": fn(*args)}
+        except StaleEpoch as e:
+            return 409, {"error": "StaleEpoch",
+                         "epoch": e.current_epoch, "message": str(e)}
+        except UnknownTransfer as e:
+            return 404, {"error": "UnknownTransfer", "message": str(e)}
+        except PeerUnreachable as e:
+            return 503, {"error": "PeerUnreachable", "message": str(e)}
+        except TypeError as e:
+            # malformed args from a skewed peer: a 400, not a 500
+            return 400, {"error": "BadRequest", "message": str(e)}
 
     def _handle(
         self, method: str, path: str, body: Dict[str, Any]
@@ -1554,7 +1675,8 @@ class HttpStoreClient:
                  conn_refused_retries: int = 5,
                  retry_base_delay: float = 0.1,
                  not_leader_redirects: int = 3,
-                 watch_retry_base: float = 0.5):
+                 watch_retry_base: float = 0.5,
+                 replication_unavailable_retries: int = 2):
         urls = url.split(",") if isinstance(url, str) else list(url)
         self._endpoints = [u.strip().rstrip("/") for u in urls if u.strip()]
         if not self._endpoints:
@@ -1581,6 +1703,16 @@ class HttpStoreClient:
         self.conn_refused_retries = conn_refused_retries
         self.retry_base_delay = retry_base_delay
         self.not_leader_redirects = not_leader_redirects
+        # a 503 ReplicationUnavailable is INDETERMINATE (the leader lost
+        # its majority mid-ship), NOT a routing error: the client retries
+        # with backoff on the SAME endpoint — rotating would park it on a
+        # follower whose 421 just points back (a redirect loop) and whose
+        # lagging read could miss the maybe-committed write. By protocol
+        # the 503 sender has stepped down, so the retry resolves through
+        # its 421 hint to the new leader, where rv/uid preconditions turn
+        # a survived first attempt into a typed Conflict/AlreadyExists
+        # instead of a silent duplicate. 0 disables (surface immediately).
+        self.replication_unavailable_retries = replication_unavailable_retries
         # watch re-poll backoff base: the actual delay is JITTERED per
         # client (see _watch_retry_delay) — N watchers severed together by
         # one server restart must NOT re-poll in lockstep, or every
@@ -1590,7 +1722,8 @@ class HttpStoreClient:
         # observable by tests/benches: how often each failover path fired
         self.retry_stats = {"conn_refused_retries": 0,
                             "endpoint_rotations": 0,
-                            "not_leader_redirects": 0}
+                            "not_leader_redirects": 0,
+                            "replication_unavailable_retries": 0}
         # https:// store with a self-signed cert: pin it (or its CA) here —
         # certificate verification stays ON; we only change the trust root.
         # None = system trust store.
@@ -1667,6 +1800,8 @@ class HttpStoreClient:
         attempt = 0
         redirects = 0
         refused_in_cycle = 0
+        ru_attempts = 0
+        ru_delay = self.retry_base_delay
         while True:
             req = urllib.request.Request(
                 self.url + path, data=data, method=method, headers=headers,
@@ -1697,6 +1832,24 @@ class HttpStoreClient:
                         continue
                     raise NotLeader(payload.get("message", str(e)),
                                     leader=leader) from None
+                if cls is ReplicationUnavailable:
+                    # indeterminate, not a routing error: retry with
+                    # backoff on the SAME endpoint (no rotation — see
+                    # __init__). The sender stepped down, so the retry
+                    # lands on its 421 hint toward the new leader.
+                    if ru_attempts < self.replication_unavailable_retries:
+                        ru_attempts += 1
+                        self.retry_stats[
+                            "replication_unavailable_retries"] += 1
+                        jittered = ru_delay * (
+                            1 + self._retry_rng.uniform(0, 0.25)
+                        )
+                        if not self._stop.wait(jittered):
+                            ru_delay = min(ru_delay * 2, 2.0)
+                            continue
+                    raise ReplicationUnavailable(
+                        payload.get("message", str(e))
+                    ) from None
                 if cls is not None:
                     raise cls(payload.get("message", str(e))) from None
                 raise
@@ -1729,28 +1882,59 @@ class HttpStoreClient:
     def replica_status(self) -> List[Dict[str, Any]]:
         """Per-endpoint /v1/replica/status (best-effort: an unreachable
         replica reports as such instead of failing the survey) — the
-        `ctl store status` data source."""
+        `ctl store status` data source. The survey FOLLOWS each answer's
+        ``peers`` hints (node id → advertised URL), so the full
+        membership resolves from ANY single endpoint on the command line
+        — the operator triaging leader loss should not need all three
+        addresses at hand. Discovered rows are marked ``discovered``;
+        the probe count is bounded so a corrupt hint map cannot spider.
+        The bearer token goes ONLY to operator-configured endpoints —
+        peer hints ride an unauthenticated probe, so a compromised
+        replica (or an on-path attacker on the plaintext seam) hinting
+        an attacker URL can never harvest the admin credential; the
+        status route serves without auth anyway except under
+        --auth-reads, where an unauthenticated discovered row reads as
+        unreachable (add the endpoint to the configured list to probe
+        it with credentials)."""
         out: List[Dict[str, Any]] = []
         with self._ep_lock:
-            endpoints = list(self._endpoints)
-        for ep in endpoints:
+            configured = [ep.rstrip("/") for ep in self._endpoints]
+        pending = list(configured)
+        seen: set = set()
+        while pending and len(seen) < 16:
+            ep = pending.pop(0).rstrip("/")
+            if ep in seen:
+                continue
+            seen.add(ep)
             headers = {}
-            if self.token:
+            if self.token and ep in configured:
                 headers["Authorization"] = f"Bearer {self.token}"
             req = urllib.request.Request(
                 ep + "/v1/replica/status", headers=headers,
             )
+            row: Dict[str, Any]
             try:
                 with urllib.request.urlopen(
                     req, timeout=self.timeout, context=self._ssl_ctx
                 ) as r:
-                    out.append(dict(json.loads(r.read()), endpoint=ep))
+                    row = dict(json.loads(r.read()), endpoint=ep)
             except Exception as e:
                 # the survey must render a dead replica, not die with it
                 log.debug("replica status probe failed for %s", ep,
                           exc_info=True)
-                out.append({"endpoint": ep, "role": "unreachable",
-                            "error": str(e)})
+                row = {"endpoint": ep, "role": "unreachable",
+                       "error": str(e)}
+            if ep not in configured:
+                row["discovered"] = True
+            out.append(row)
+            for hint in (row.get("peers") or {}).values():
+                if not isinstance(hint, str) or not hint.startswith(
+                    ("http://", "https://")
+                ):
+                    continue  # in-process sets hint bare node ids
+                hint = hint.rstrip("/")
+                if hint not in seen and hint not in pending:
+                    pending.append(hint)
         return out
 
     # -- CRUD (same contracts as ObjectStore) -------------------------------
@@ -2073,13 +2257,45 @@ def main(argv=None) -> int:
     ap.add_argument("--tls-key", default=None,
                     help="private key for --tls-cert (PEM; omit when the "
                          "cert file bundles the key)")
+    ap.add_argument("--replica-id", default=None, metavar="ID",
+                    help="run as ONE member of a wire-replicated set "
+                         "(requires --store sqlite: and --peers/"
+                         "--peer-token-file); this process elects, ships "
+                         "the log, and serves reads locally — mutations "
+                         "on a follower answer 421 with the leader hint")
+    ap.add_argument("--peers", default=None, metavar="MAP",
+                    help="full replica membership as 'id=http://host:port' "
+                         "comma list (must include --replica-id); the "
+                         "DIAL map for replication RPCs")
+    ap.add_argument("--advertise", default=None, metavar="MAP",
+                    help="public 'id=url' map clients are hinted at "
+                         "(NotLeader redirects, `ctl store status` "
+                         "membership discovery); defaults to --peers — "
+                         "set it when peers dial through proxies")
+    ap.add_argument("--peer-token-file", default=None,
+                    help="file holding the PEER bearer token replication "
+                         "RPCs authenticate with; required with "
+                         "--replica-id, and every /v1/replica/* RPC "
+                         "without it is a typed 403 (fail closed)")
+    ap.add_argument("--replica-lease-duration", type=float, default=2.0,
+                    help="leader lease in seconds (failover takes ~2 "
+                         "leases; lower it only for testing)")
+    ap.add_argument("--replica-retry-period", type=float, default=0.25,
+                    help="seconds between the replica ticker's renew/"
+                         "campaign passes")
+    ap.add_argument("--replica-seed", type=int, default=0,
+                    help="seed for the ticker's campaign jitter (chaos "
+                         "harness determinism)")
     args = ap.parse_args(argv)
     if args.tls_key and not args.tls_cert:
         raise SystemExit("error: --tls-key requires --tls-cert")
+    # a server process logs its lifecycle (elections, step-downs, snapshot
+    # transfers) — the runbook's first stop when a replica misbehaves
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
     trace.configure_from_env("store")
-    from mpi_operator_tpu.opshell.__main__ import build_store
-
-    backing = build_store(args.store)
     try:
         host, port = parse_listen(args.listen)
     except ValueError as e:
@@ -2088,8 +2304,52 @@ def main(argv=None) -> int:
         token = read_token_file(args.token_file)
         read_token = read_token_file(args.read_token_file)
         agent_tokens = read_agent_tokens_file(args.agent_tokens_file)
+        peer_token = read_token_file(args.peer_token_file)
     except (OSError, ValueError) as e:
         raise SystemExit(f"error: token file: {e}") from None
+    ticker = None
+    if args.replica_id:
+        # the wire-replicated shape: this process is ONE replica-set
+        # member; its backing is a ReplicaNode over an HTTP peer fabric
+        if not args.store.startswith("sqlite:"):
+            raise SystemExit(
+                "error: --replica-id requires --store sqlite:PATH (the "
+                "replication log rides the sqlite commit seam)"
+            )
+        if not args.peers:
+            raise SystemExit("error: --replica-id requires --peers")
+        if peer_token is None:
+            raise SystemExit(
+                "error: --replica-id requires --peer-token-file "
+                "(peer RPCs fail closed without a replication identity)"
+            )
+        from mpi_operator_tpu.machinery.replica_wire import (
+            build_wire_replica,
+            parse_peer_map,
+        )
+
+        try:
+            peers = parse_peer_map(args.peers)
+            advertise = (parse_peer_map(args.advertise, "--advertise")
+                         if args.advertise else None)
+            backing, ticker = build_wire_replica(
+                args.replica_id, args.store[len("sqlite:"):], peers,
+                peer_token, advertise=advertise,
+                lease_duration=args.replica_lease_duration,
+                retry_period=args.replica_retry_period,
+                seed=args.replica_seed,
+            )
+        except ValueError as e:
+            raise SystemExit(f"error: {e}") from None
+    else:
+        if args.peers or args.peer_token_file or args.advertise:
+            raise SystemExit(
+                "error: --peers/--advertise/--peer-token-file require "
+                "--replica-id (a standalone store has no peer seam)"
+            )
+        from mpi_operator_tpu.opshell.__main__ import build_store
+
+        backing = build_store(args.store)
     from mpi_operator_tpu.machinery.fairqueue import (
         load_quota_file,
         parse_fair_queue,
@@ -2113,13 +2373,22 @@ def main(argv=None) -> int:
         auth_reads=args.auth_reads or read_token is not None,
         read_token=read_token, agent_tokens=agent_tokens,
         tls_cert=args.tls_cert, tls_key=args.tls_key,
-        fairness=fairness, quota=quota,
+        fairness=fairness, quota=quota, peer_token=peer_token,
     ).start()
-    print(f"store serving on {server.url}", flush=True)
+    if ticker is not None:
+        # the server must be listening BEFORE the ticker campaigns: a
+        # won election heartbeats every peer immediately
+        ticker.start()
+        print(f"replica {args.replica_id} serving on {server.url}",
+              flush=True)
+    else:
+        print(f"store serving on {server.url}", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
+    if ticker is not None:
+        ticker.stop()
     server.stop()
     return 0
 

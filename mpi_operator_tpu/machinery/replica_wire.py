@@ -1,0 +1,524 @@
+"""Wire replication fabric: the deployed twin of the in-process PeerHub.
+
+PR 8 proved the replica set as a *store* — but its replication RPCs rode
+:class:`~mpi_operator_tpu.machinery.replicated_store.PeerHub`, synchronous
+method dispatch inside one process. This module closes the in-process/
+deployed gap (ROADMAP item 3): :class:`HttpPeerFabric` duck-types the hub's
+``call(src, dst, method, *args)`` surface over real sockets, so
+``ReplicaNode`` runs UNCHANGED — the same election, lease, ship, and
+snapshot-resync code paths the analysis gates (storecheck / linearize /
+``crash --replica``) exercise in-process are the ones three ``tpu-store``
+processes run in production.
+
+Deployment shape (one process per replica; see README "Replicated store")::
+
+    tpu-store --store sqlite:/var/lib/tpujob/n0.db --listen 0.0.0.0:8475 \\
+        --replica-id n0 \\
+        --peers n0=http://a:8475,n1=http://b:8475,n2=http://c:8475 \\
+        --peer-token-file /etc/tpujob/peer.token
+
+Protocol notes:
+
+- Peer RPCs are POSTs to ``/v1/replica/{request-vote,append-entries,
+  fetch-entries,install-snapshot,snapshot-chunk,snapshot-done}`` carrying
+  ``{"src": <node>, "args": [...]}``; the server dispatches into its local
+  node's handler (epoch fencing therefore runs SERVER-SIDE, in the
+  handler, exactly as in-process) and answers ``{"result": ...}``.
+- Auth is a dedicated PEER token tier: every peer route fails closed with
+  a typed 403 for a missing/wrong token, and the admin/read/node tiers
+  are explicitly NOT replication identities (StoreServer._peer_denied).
+  The token rides the Authorization header only — never URLs or logs.
+- Every RPC has a bounded per-peer timeout plus a small jittered retry:
+  a hung peer costs a bounded slice of one ship and degrades the write
+  to majority-only instead of wedging it (the PeerUnreachable contract).
+- ``StaleEpoch`` crosses the wire as a typed 409 and is re-raised, so
+  fencing works identically over sockets.
+- Snapshots move as size-bounded chunks (replicated_store.snapshot_offer/
+  snapshot_chunk): the receiving node PULLS them back through this same
+  fabric, hash-verifies the assembled payload, and applies atomically —
+  resumable at chunk granularity after a dropped connection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import logging
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.replicated_store import (
+    PeerUnreachable,
+    ReplicaNode,
+    StaleEpoch,
+    UnknownTransfer,
+    tick_node,
+)
+
+log = logging.getLogger("tpujob.replica.wire")
+
+# RPC method → wire route. replica_status is deliberately absent: it is
+# served by the public GET /v1/replica/status probe, not the peer tier.
+PEER_ROUTES = {
+    "request_vote": "request-vote",
+    "append_entries": "append-entries",
+    "fetch_entries": "fetch-entries",
+    "install_snapshot": "install-snapshot",
+    "snapshot_chunk": "snapshot-chunk",
+    "snapshot_done": "snapshot-done",
+}
+
+
+def parse_peer_map(spec: str, flag: str = "--peers") -> Dict[str, str]:
+    """``'n0=http://a:8475,n1=http://b:8475'`` → {id: url}. Fails fast on
+    malformed entries — a typo'd peer URL silently dropped would shrink
+    the set's majority without anyone noticing."""
+    out: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        nid, sep, url = part.partition("=")
+        nid, url = nid.strip(), url.strip().rstrip("/")
+        if not sep or not nid or not url.startswith(("http://", "https://")):
+            raise ValueError(
+                f"{flag} entries are 'id=http://host:port', got {part!r}"
+            )
+        if nid in out:
+            raise ValueError(f"{flag}: duplicate replica id {nid!r}")
+        out[nid] = url
+    if len(out) < 2:
+        raise ValueError(
+            f"{flag} needs at least two entries (a replica set of one is "
+            f"a standalone store — drop --replica-id instead)"
+        )
+    return out
+
+
+class WireMembership:
+    """The static-membership 'set view' a standalone wire replica needs:
+    :class:`ReplicaNode` reads ``node_ids`` (peers + majority),
+    ``advertise`` (dialable NotLeader hints + the `ctl store status`
+    membership discovery), and records won elections. The deployed twin
+    of :class:`ReplicaSet` minus the in-process node registry."""
+
+    def __init__(self, node_ids: Iterable[str],
+                 advertise: Dict[str, str]):
+        self.node_ids = sorted(node_ids)
+        self.advertise = dict(advertise)
+        self.leadership_log: List[Tuple[int, str]] = []
+        self._log_lock = threading.Lock()
+
+    def _record_leader(self, epoch: int, node_id: str) -> None:
+        with self._log_lock:
+            self.leadership_log.append((epoch, node_id))
+
+
+class HttpPeerFabric:
+    """PeerHub's ``call`` surface over HTTP. One instance per process,
+    owning the local node and the dial map to every peer."""
+
+    def __init__(self, node_id: str, peer_urls: Dict[str, str],
+                 peer_token: str, *, rpc_timeout: float = 3.0,
+                 install_timeout: float = 120.0, retries: int = 1,
+                 retry_base: float = 0.05, seed: int = 0):
+        if not peer_token:
+            # fail closed: an unauthenticated peer fabric would let anyone
+            # who can dial the port rewrite the replicated history
+            raise ValueError("HttpPeerFabric requires a peer token")
+        self.node_id = node_id
+        self.peer_urls = {
+            nid: url.rstrip("/") for nid, url in peer_urls.items()
+            if nid != node_id
+        }
+        self._token = peer_token
+        self.rpc_timeout = rpc_timeout
+        # install_snapshot blocks while the RECEIVER pulls the chunked
+        # payload back through its own fabric — budget for the transfer,
+        # not one round-trip (the caller runs it OFF the ship gate, so a
+        # long transfer blocks only the resync pass, never writes)
+        self.install_timeout = install_timeout
+        self.retries = retries
+        self.retry_base = retry_base
+        self._rng = random.Random(f"fabric:{seed}:{node_id}")
+        self._down = False
+        self._local: Optional[ReplicaNode] = None
+        self._stop = threading.Event()
+        # peers whose auth rejection was already warned about: a token
+        # misconfiguration must surface ONCE at WARNING per peer, not
+        # drown as debug-level "unreachable" noise
+        self._warned_auth: set = set()
+
+    # -- hub surface ---------------------------------------------------------
+
+    def register(self, node: ReplicaNode) -> None:
+        self._local = node
+
+    def set_down(self, node_id: str, down: bool) -> None:
+        """Local crash semantics only (ReplicaNode.crash/reopen call this
+        on themselves); a REMOTE peer's death is observed as connection
+        refused, exactly like a real SIGKILL."""
+        if node_id == self.node_id:
+            self._down = down
+
+    def call(self, src: str, dst: str, method: str, *args) -> Any:
+        if self._down:
+            raise PeerUnreachable(f"{self.node_id} is down")
+        if dst == self.node_id:
+            # a node pulling chunks may be handed its own id by a
+            # confused config; dispatch locally rather than dialing self
+            if self._local is None:
+                raise PeerUnreachable(f"{dst} has no local node")
+            return getattr(self._local, method)(*args)
+        route = PEER_ROUTES.get(method)
+        if route is None:
+            raise ValueError(f"{method!r} is not a peer RPC")
+        url = self.peer_urls.get(dst)
+        if url is None:
+            raise PeerUnreachable(f"unknown peer {dst!r}")
+        body = json.dumps({"src": src, "args": list(args)}).encode()
+        headers = {
+            "Content-Type": "application/json",
+            # the peer token rides ONLY this header — never a URL or a
+            # log line (SEC001; pinned by the wire-capture test)
+            "Authorization": "Bearer " + self._token,
+        }
+        traceparent = trace.inject()
+        if traceparent:
+            headers[trace.TRACEPARENT_HEADER] = traceparent
+        timeout = (self.install_timeout if method == "install_snapshot"
+                   else self.rpc_timeout)
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                url + "/v1/replica/" + route, data=body, method="POST",
+                headers=headers,
+            )
+            try:
+                # the bounded timeout is the hung-peer fence: a stalled
+                # socket costs at most (retries+1)×timeout per ship and
+                # the write degrades to majority-only
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())["result"]
+            except urllib.error.HTTPError as e:
+                payload: Dict[str, Any] = {}
+                try:
+                    payload = json.loads(e.read())
+                except (ValueError, OSError):
+                    pass  # non-JSON error body: generic unreachable below
+                err = payload.get("error", "")
+                if err == "StaleEpoch":
+                    # the fence crosses the wire typed: the caller steps
+                    # down exactly as it would in-process
+                    raise StaleEpoch(int(payload.get("epoch", 0))) from None
+                if err == "UnknownTransfer":
+                    raise UnknownTransfer(
+                        payload.get("message", "transfer gone")
+                    ) from None
+                if e.code in (401, 403) and dst not in self._warned_auth:
+                    # an auth rejection is a CONFIGURATION fault, not a
+                    # network one — without this line a mismatched
+                    # --peer-token-file reads exactly like a dead fabric
+                    # (no leader ever elected, nothing above debug level)
+                    self._warned_auth.add(dst)
+                    log.warning(
+                        "peer %s rejected this node's peer token (%s %s):"
+                        " check --peer-token-file on both ends",
+                        dst, e.code, payload.get("error", ""),
+                    )
+                last = PeerUnreachable(
+                    f"peer {dst} answered {e.code} "
+                    f"{payload.get('error', '')}".strip()
+                )
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                # refused / reset / timed out: indistinguishable from a
+                # dead or partitioned peer — PeerUnreachable, the same
+                # signal PeerHub raises
+                last = PeerUnreachable(f"peer {dst} unreachable: {e}")
+            if attempt < self.retries:
+                # small jittered retry: a transient reset heals without
+                # failing the ship; the budget stays bounded
+                if self._stop.wait(
+                    self.retry_base * (1 + self._rng.random())
+                ):
+                    break
+        raise last if last is not None else PeerUnreachable(
+            f"peer {dst} unreachable"
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class ReplicaTicker:
+    """Per-process auto mode: the same renew-or-campaign loop
+    :class:`ReplicaSet` runs in-process, for the ONE local node."""
+
+    def __init__(self, node: ReplicaNode, *, retry_period: float = 0.25,
+                 seed: int = 0):
+        self.node = node
+        self.retry_period = retry_period
+        self._index = node.rset.node_ids.index(node.node_id)
+        self._rng = random.Random(f"{seed}:{node.node_id}")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"replica-tick-{node.node_id}",
+            daemon=True,
+        )
+
+    def start(self) -> "ReplicaTicker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.retry_period):
+            try:
+                tick_node(self.node, self._rng, self._index,
+                          self.retry_period, self._stop)
+            except Exception:
+                # a dead ticker would silently end failover; survive
+                # transient RPC errors (a peer dying mid-call)
+                log.debug("replica ticker error", exc_info=True)
+
+
+def build_wire_replica(
+    replica_id: str, db_path: str, peers: Dict[str, str],
+    peer_token: str, *, advertise: Optional[Dict[str, str]] = None,
+    lease_duration: float = 2.0, retry_period: float = 0.25,
+    poll_interval: float = 0.05, seed: int = 0,
+    rpc_timeout: float = 3.0,
+) -> Tuple[ReplicaNode, ReplicaTicker]:
+    """Assemble one wire replica: membership view + HTTP fabric + node +
+    ticker. ``peers`` is the DIAL map (may route through chaos proxies);
+    ``advertise`` is the PUBLIC map clients should be hinted at (defaults
+    to ``peers``)."""
+    if replica_id not in peers:
+        raise ValueError(
+            f"--replica-id {replica_id!r} is not in the --peers map "
+            f"({sorted(peers)})"
+        )
+    membership = WireMembership(peers, dict(advertise or peers))
+    fabric = HttpPeerFabric(
+        replica_id, peers, peer_token, rpc_timeout=rpc_timeout, seed=seed,
+    )
+    node = ReplicaNode(
+        replica_id, db_path, fabric, membership,
+        lease_duration=lease_duration, poll_interval=poll_interval,
+    )
+    fabric.register(node)
+    ticker = ReplicaTicker(node, retry_period=retry_period, seed=seed)
+    return node, ticker
+
+
+# ---------------------------------------------------------------------------
+# smoke: 3 real processes, one failover, one cold join (<30 s)
+# ---------------------------------------------------------------------------
+
+
+def free_ports(n: int) -> List[int]:
+    """Reserve ``n`` distinct loopback ports: every socket stays OPEN
+    until all are bound (sequential bind-close pairs can be handed the
+    same ephemeral port twice). Shared by the smoke and the torture
+    bench."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def probe_replica_status(url: str, timeout: float = 2.0
+                         ) -> Optional[Dict[str, Any]]:
+    """Best-effort /v1/replica/status probe (None when unreachable) —
+    shared by the smoke, the torture bench, and anything else that needs
+    to find the leader among known endpoints without a full client."""
+    try:
+        with urllib.request.urlopen(
+            url + "/v1/replica/status", timeout=timeout
+        ) as r:
+            return json.loads(r.read())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def wait_for_wire_leader(urls: Dict[str, str], timeout: float = 20.0
+                         ) -> Optional[str]:
+    """Poll ``{node_id: url}`` until some node reports itself leader;
+    returns its id (None on timeout)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for nid, url in urls.items():
+            st = probe_replica_status(url)
+            if st and st.get("role") == "leader":
+                return nid
+        time.sleep(0.05)
+    return None
+
+
+def smoke(keep_dir: Optional[str] = None) -> Dict[str, Any]:
+    """The wire-replica smoke the verify gate runs: spawn three real
+    ``tpu-store`` replica processes, write through the multi-endpoint
+    client, SIGKILL the leader (every acked write must survive failover
+    at its exact rv), then COLD-JOIN the killed node — db wiped — and
+    wait for it to converge to the leader's exact rv (snapshot or tail
+    catch-up over the wire). Prints nothing; returns the result dict."""
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+    from mpi_operator_tpu.machinery.objects import ConfigMap
+    from mpi_operator_tpu.api.types import ObjectMeta
+
+    tmp = keep_dir or tempfile.mkdtemp(prefix="replica-smoke-")
+    tok_path = os.path.join(tmp, "peer.token")
+    with open(tok_path, "w") as f:
+        f.write("smoke-peer-secret\n")
+    ports = free_ports(3)
+    ids = [f"n{i}" for i in range(3)]
+    urls = {nid: f"http://127.0.0.1:{p}" for nid, p in zip(ids, ports)}
+    peers = ",".join(f"{nid}={urls[nid]}" for nid in ids)
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH",
+                   os.path.dirname(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__)))))
+    procs: Dict[str, subprocess.Popen] = {}
+
+    def spawn(nid: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+             "--store", f"sqlite:{os.path.join(tmp, nid + '.db')}",
+             "--listen", f"127.0.0.1:{ports[ids.index(nid)]}",
+             "--replica-id", nid, "--peers", peers,
+             "--peer-token-file", tok_path,
+             "--replica-lease-duration", "0.5",
+             "--replica-retry-period", "0.05"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    out: Dict[str, Any] = {"metric": "replica_wire_smoke", "ok": False}
+    client = None
+    try:
+        for nid in ids:
+            procs[nid] = spawn(nid)
+        lead = wait_for_wire_leader(urls, 15.0)
+        if lead is None:
+            out["error"] = "no initial leader"
+            return out
+        client = HttpStoreClient(
+            list(urls.values()), timeout=5.0, conn_refused_retries=10,
+        )
+        acked: Dict[str, int] = {}
+        for i in range(20):
+            o = client.create(ConfigMap(metadata=ObjectMeta(
+                name=f"smoke-{i:02d}", namespace="smoke")))
+            acked[o.metadata.name] = o.metadata.resource_version
+        # SIGKILL the leader mid-set; the survivors must elect and ack
+        procs[lead].send_signal(signal.SIGKILL)
+        procs[lead].wait()
+        t0 = time.monotonic()
+        post = 0
+        deadline = time.monotonic() + 20.0
+        while post < 5 and time.monotonic() < deadline:
+            try:
+                o = client.create(ConfigMap(metadata=ObjectMeta(
+                    name=f"post-{post:02d}", namespace="smoke")))
+                acked[o.metadata.name] = o.metadata.resource_version
+                post += 1
+            except Exception:
+                # the leaderless window: refused/421/503 until a survivor
+                # takes the lease — that wait IS what the smoke measures
+                log.debug("post-failover write not yet acked",
+                          exc_info=True)
+                time.sleep(0.1)
+        out["failover_ms"] = round((time.monotonic() - t0) * 1e3, 1)
+        new_lead = wait_for_wire_leader(urls, 15.0)
+        if new_lead is None or new_lead == lead or post < 5:
+            out["error"] = f"failover failed (leader={new_lead}, post={post})"
+            return out
+        # every acked write present at its exact rv on the new leader
+        for name, rv in acked.items():
+            got = client.get("ConfigMap", "smoke", name)
+            if got.metadata.resource_version != rv:
+                out["error"] = (f"{name}: acked rv {rv}, "
+                                f"got {got.metadata.resource_version}")
+                return out
+        # cold join: wipe the killed node's db and respawn — it must
+        # converge to the leader's exact rv over the wire
+        for suffix in ("", "-wal", "-shm"):
+            p = os.path.join(tmp, lead + ".db" + suffix)
+            if os.path.exists(p):
+                os.unlink(p)
+        t1 = time.monotonic()
+        procs[lead] = spawn(lead)
+        lead_rv = None
+        joined = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st_new = probe_replica_status(urls[new_lead])
+            st_join = probe_replica_status(urls[lead])
+            if st_new and st_join:
+                lead_rv = st_new.get("applied_rv")
+                if (st_join.get("role") == "follower"
+                        and st_join.get("applied_rv") == lead_rv):
+                    joined = True
+                    break
+            time.sleep(0.05)
+        out["cold_join_ms"] = round((time.monotonic() - t1) * 1e3, 1)
+        if not joined:
+            out["error"] = "cold join never converged"
+            return out
+        out.update(ok=True, writes=len(acked), leader_killed=lead,
+                   new_leader=new_lead, converged_rv=lead_rv)
+        return out
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if keep_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="replica-wire",
+        description="Wire-replica utilities (the deployed HA fabric).",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="spawn 3 real tpu-store replica processes, "
+                         "SIGKILL the leader, cold-join it back with a "
+                         "wiped db; exit 0 iff every acked write survived "
+                         "at its exact rv and the joiner converged")
+    args = ap.parse_args(argv)
+    if not args.smoke:
+        ap.print_help()
+        return 2
+    out = smoke()
+    print(json.dumps(out), flush=True)
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,11 +55,15 @@ backend to them.
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import json
 import logging
 import os
 import random
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mpi_operator_tpu.machinery import trace
@@ -82,6 +86,43 @@ FOLLOWER = "follower"
 
 class PeerUnreachable(ConnectionError):
     """The transport could not deliver (node down / link partitioned)."""
+
+
+class UnknownTransfer(KeyError):
+    """A snapshot chunk referenced a transfer the sender no longer holds
+    (sender restarted, or the bounded outbox evicted it): the puller must
+    restart from a fresh offer — resuming blind would splice two different
+    snapshots' bytes."""
+
+
+# size bounds for one shipped append_entries batch: a cold joiner's full
+# history must arrive as many bounded requests, not one body the wire's
+# 8 MiB request cap rejects (which would permanently wedge its catch-up).
+# BOTH bounds apply — count alone is not enough: 512 × 20 KiB manifests
+# is already a 10 MiB body. A single entry larger than the byte budget
+# ships alone (entries are atomic; object bodies are themselves capped
+# by the same 8 MiB client-request limit, so a lone entry always fits).
+SHIP_BATCH_ENTRIES = 512
+SHIP_BATCH_BYTES = 2 << 20
+
+# chunked snapshot transfer: size-bounded chunks (well under the wire's
+# request cap), whole-payload sha256 verified before the atomic
+# load_snapshot, resumable at chunk granularity after a dropped connection
+SNAPSHOT_CHUNK_BYTES = 256 << 10
+
+# after a ship attempt finds a peer unreachable, skip shipping to it for
+# this long (the next heartbeat/ship after the window re-probes): without
+# the window a DEAD peer taxes EVERY write the full dial-timeout+retry
+# cost INSIDE the serialized ship gate — measured 7→83 ms per ship (a
+# ~12 writes/s ceiling) in the torture run after the leader kill
+PEER_DOWN_BACKOFF = 1.0
+
+# how many of a fresh reign's first majority-acked ships emit a
+# `replica.reign` bridge span (trace continuity: the bridge lives in the
+# winning election's trace with its parent edge in the shipped write's
+# trace, so `ctl trace --last-incident` connects write → ship → election
+# → the first post-failover reconciles whose writes ride those ships)
+REIGN_BRIDGE_SHIPS = 64
 
 
 class StaleEpoch(RuntimeError):
@@ -114,6 +155,14 @@ class PeerHub:
     def set_down(self, node_id: str, down: bool) -> None:
         with self._lock:
             self._down[node_id] = down
+            nodes = list(self._nodes.values()) if not down else []
+        # a REVIVED node is immediately shippable again: clear every
+        # peer's down-window for it so the manual-mode harnesses' very
+        # next synchronous renew() reaches it (the hub lock is released
+        # first — a shipping node holds its ship lock while briefly
+        # taking ours, so nesting the other way would deadlock)
+        for node in nodes:
+            node._clear_peer_down(node_id)
 
     # -- the chaos fabric surface (ChaosController(fabric=hub)) -------------
 
@@ -127,10 +176,20 @@ class PeerHub:
     def heal(self, a: str, b: str) -> None:
         with self._lock:
             self._cuts.discard(frozenset((a, b)))
+            pair = [n for nid, n in self._nodes.items() if nid in (a, b)]
+        for node in pair:  # healed link: re-probe without the window
+            for other in (a, b):
+                if other != node.node_id:
+                    node._clear_peer_down(other)
 
     def heal_all(self) -> None:
         with self._lock:
             self._cuts.clear()
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            for other in nodes:
+                if other is not node:
+                    node._clear_peer_down(other.node_id)
 
     def call(self, src: str, dst: str, method: str, *args) -> Any:
         with self._lock:
@@ -147,6 +206,33 @@ def _monotonic() -> float:
     return time.monotonic()
 
 
+def tick_node(node: "ReplicaNode", rng: random.Random, index: int,
+              retry_period: float, stop: threading.Event) -> None:
+    """ONE auto-mode tick for one node: a leader renews its lease, a
+    follower whose leader's lease expired campaigns after a node-skewed
+    jittered wait (keeps concurrent candidates from split-voting forever
+    and makes the FIRST winner deterministic per seed). Shared by
+    :meth:`ReplicaSet._tick_loop` (in-process auto mode) and the wire
+    deployment's per-process ticker (machinery/replica_wire.py)."""
+    with node._state_lock:
+        crashed, role = node.crashed, node.role
+        expired = _monotonic() > node._lease_until
+    if crashed:
+        return
+    if role == LEADER:
+        node.renew()
+    elif expired:
+        delay = index * retry_period / 2 + rng.uniform(0, retry_period / 2)
+        if stop.wait(delay):
+            return
+        with node._state_lock:
+            still = (not node.crashed
+                     and node.role == FOLLOWER
+                     and _monotonic() > node._lease_until)
+        if still:
+            node.campaign()
+
+
 class ReplicaNode:
     """One replica-set member: a SqliteStore plus the replication role.
 
@@ -159,13 +245,19 @@ class ReplicaNode:
 
     def __init__(self, node_id: str, path: str, hub: PeerHub, rset:
                  "ReplicaSet", *, lease_duration: float,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05,
+                 snapshot_chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
+                 ship_batch_entries: int = SHIP_BATCH_ENTRIES,
+                 ship_batch_bytes: int = SHIP_BATCH_BYTES):
         self.node_id = node_id
         self.path = path
         self.hub = hub
         self.rset = rset
         self.lease_duration = lease_duration
         self.poll_interval = poll_interval
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
+        self.ship_batch_entries = ship_batch_entries
+        self.ship_batch_bytes = ship_batch_bytes
         self.backing = SqliteStore(path, poll_interval=poll_interval)
         # durable election state: adopting an epoch IS this node's one
         # vote in it (rule 1); survives crash/restart via replica_meta
@@ -182,12 +274,38 @@ class ReplicaNode:
         self._ship_lock = threading.Lock()
         self._shipped_rv = self.backing.current_rv()
         self._peer_rv: Dict[str, int] = {}
+        # peer → monotonic deadline until which ships SKIP it (set on an
+        # unreachable attempt, cleared on any success); guarded by
+        # _ship_lock like the cursor it modulates
+        self.peer_down_backoff = PEER_DOWN_BACKOFF
+        self._peer_down_until: Dict[str, float] = {}
+        # peers needing a full snapshot resync (divergent suffix / log
+        # truncated): the SHIP path only MARKS them (the write degrades
+        # to majority-only), renew() runs the transfer OUTSIDE the ship
+        # gate — a multi-second wire transfer inside the gate would block
+        # every write AND the heartbeats, expiring the healthy peers'
+        # leases and dethroning the leader mid-join. Guarded by
+        # _ship_lock; _resync_active keeps the transfer single-flight.
+        self._resync_pending: set = set()
+        self._resync_active: set = set()
         # serializes the WHOLE fence-check→apply window of incoming
         # append_entries/install_snapshot: without it a stale leader's
         # delayed append could pass the epoch fence, stall, and then
         # interleave its dead-epoch rows into a newer leader's apply
         # (duplicate-rv IntegrityError or a gapped follower history)
         self._apply_lock = threading.Lock()
+        # chunked snapshot outbox: transfer id → encoded snapshot bytes.
+        # Bounded (the newest few transfers); an evicted/unknown id raises
+        # UnknownTransfer and the puller restarts from a fresh offer.
+        self._transfer_lock = threading.Lock()
+        self._transfers: Dict[str, bytes] = {}
+        # trace continuity across failover: the span context of the last
+        # NON-EMPTY ship applied here (the write whose history this node
+        # would extend if elected) anchors this node's election span, and
+        # a fresh reign's first ships carry a bridge back to the election
+        self._last_ship_ctx: Optional[Tuple[str, str]] = None
+        self._reign_ctx: Optional[Tuple[str, str]] = None
+        self._reign_bridges = REIGN_BRIDGE_SHIPS
 
     # -- small helpers -------------------------------------------------------
 
@@ -198,6 +316,12 @@ class ReplicaNode:
     @property
     def majority(self) -> int:
         return len(self.rset.node_ids) // 2 + 1
+
+    def _clear_peer_down(self, peer: str) -> None:
+        """Forget a peer's unreachable-window (it revived / the link
+        healed): the next ship reaches it immediately."""
+        with self._ship_lock:
+            self._peer_down_until.pop(peer, None)
 
     def _leader_hint(self) -> Optional[str]:
         with self._state_lock:
@@ -248,6 +372,17 @@ class ReplicaNode:
         gate serializes writers so the ship stream is exactly the commit
         stream; store errors (Conflict/NotFound/...) raise before any
         commit and ship nothing — they stay DEFINITE failures."""
+        # is this write part of a LARGER trace (a traced client sent a
+        # traceparent, or an in-process component holds an app span)?
+        # Only such writes spend the reign-bridge budget: bridging an
+        # untraced root write (a bare CLI create) connects the election
+        # to nothing, and a burst of them after failover would exhaust
+        # the budget before the first post-failover reconcile ships.
+        cur = trace.TRACER.current_span()
+        traced = cur is not None and (
+            cur.parent_id is not None
+            or getattr(cur, "name", "store.request") != "store.request"
+        )
         with self._ship_lock:
             epoch = self._require_leader()
             result = fn()
@@ -261,13 +396,13 @@ class ReplicaNode:
                 "replica.ship",
                 attrs={"node": self.node_id, "epoch": epoch},
             ):
-                self._replicate(epoch)
+                self._replicate(epoch, traced)
             metrics.replication_ship_latency.observe(
                 time.perf_counter() - t0
             )
             return result
 
-    def _replicate(self, epoch: int) -> None:
+    def _replicate(self, epoch: int, traced: bool = False) -> None:
         tail = self.backing.log_tail(self._shipped_rv)
         if not tail:
             # an empty tail after fn() is normally just an all-failure
@@ -302,6 +437,20 @@ class ReplicaNode:
                         self._lease_deadline,
                         _monotonic() + self.lease_duration,
                     )
+            if (traced and self._reign_ctx is not None
+                    and self._reign_bridges > 0):
+                self._reign_bridges -= 1
+                # trace bridge: lives in the WINNING ELECTION's trace,
+                # parent edge = this write's ship span — the edge that
+                # makes write → ship → election → first post-failover
+                # reconcile ONE connected component for `ctl trace
+                # --last-incident` (each bridge closes immediately; only
+                # the first REIGN_BRIDGE_SHIPS ships of a reign pay it)
+                with trace.start_span(
+                    "replica.reign", trace_id=self._reign_ctx[0],
+                    attrs={"node": self.node_id, "epoch": epoch},
+                ):
+                    pass
             return
         self._step_down("write could not reach a majority")
         raise ReplicationUnavailable(
@@ -310,35 +459,79 @@ class ReplicaNode:
             f"— re-read before retrying"
         )
 
+    def _append_to(self, peer: str, epoch: int, prev_rv: int,
+                   entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """append_entries in size-bounded slices: the wire caps request
+        bodies (8 MiB), so a cold joiner's full-history tail must arrive
+        as many bounded appends — one giant request would be rejected and
+        permanently wedge its catch-up. Slices are bounded by COUNT and
+        by BYTES (count alone is not enough: 512 × 20 KiB manifests is a
+        10 MiB body). Each slice's prev-hash comes from the slice before
+        it (the entries are in hand), keeping the divergence check
+        intact at every boundary."""
+        prev_hash = self.backing.tail_hash(prev_rv)
+        if not entries:
+            return self.hub.call(
+                self.node_id, peer, "append_entries",
+                epoch, self.node_id, prev_rv, prev_hash, [],
+            )
+        res: Dict[str, Any] = {}
+        i = 0
+        while i < len(entries):
+            batch, nbytes = [], 0
+            while i < len(entries) and len(batch) < self.ship_batch_entries:
+                cost = len(entries[i]["data"]) + 256  # rough envelope
+                if batch and nbytes + cost > self.ship_batch_bytes:
+                    break  # an over-budget entry ships ALONE, never split
+                batch.append(entries[i])
+                nbytes += cost
+                i += 1
+            res = self.hub.call(
+                self.node_id, peer, "append_entries",
+                epoch, self.node_id, prev_rv, prev_hash, batch,
+            )
+            applied = res.get("applied")
+            if applied is None or applied < batch[-1]["rv"]:
+                return res  # behind/divergent: the caller resolves it
+            prev_rv = batch[-1]["rv"]
+            prev_hash = entry_hash(batch[-1])
+        return res
+
     def _ship_to(self, peer: str, epoch: int, prev_rv: int,
                  entries: List[Dict[str, Any]]) -> bool:
         """Push a tail to one follower, walking it through lag catch-up
         (``behind``) and divergent-suffix truncation (``divergent`` →
-        snapshot install). Returns True when the follower's applied rv
-        reaches the tail's end."""
+        chunked snapshot install). Returns True when the follower's
+        applied rv reaches the tail's end.
+
+        A peer that was unreachable moments ago is SKIPPED until its
+        down-window lapses (the next heartbeat re-probes): a dead peer
+        must cost the write path one probe per window, not a dial
+        timeout per write inside the serialized ship gate."""
+        if self._peer_down_until.get(peer, 0.0) > _monotonic():
+            return False
         target_rv = entries[-1]["rv"] if entries else prev_rv
         try:
             for _ in range(4):  # behind/divergent round-trips, bounded
-                res = self.hub.call(
-                    self.node_id, peer, "append_entries",
-                    epoch, self.node_id, prev_rv,
-                    self.backing.tail_hash(prev_rv), entries,
-                )
+                res = self._append_to(peer, epoch, prev_rv, entries)
                 applied = res.get("applied")
                 if applied is not None and applied >= target_rv:
                     self._peer_rv[peer] = applied
+                    self._peer_down_until.pop(peer, None)
                     return True
                 if "behind" in res:
                     prev_rv = res["behind"]
                 elif res.get("divergent"):
-                    snap = self.backing.snapshot_state()
-                    res2 = self.hub.call(
-                        self.node_id, peer, "install_snapshot",
-                        epoch, self.node_id, snap,
-                    )
-                    self._peer_rv[peer] = prev_rv = res2["applied"]
-                    if prev_rv >= target_rv:
-                        return True
+                    # divergent suffix / truncated log: the follower
+                    # needs a FULL snapshot resync. Never run it here —
+                    # the caller holds the serialized ship gate, and a
+                    # multi-second wire transfer inside it would stall
+                    # every write and heartbeat (expiring healthy peers'
+                    # leases → a spurious failover per cold join). Mark
+                    # it; renew() transfers outside the gate; this ship
+                    # degrades to majority-only.
+                    self._resync_pending.add(peer)
+                    return False
                 else:
                     return False
                 try:
@@ -349,6 +542,9 @@ class ReplicaNode:
                     continue
             return False
         except PeerUnreachable:
+            self._peer_down_until[peer] = (
+                _monotonic() + self.peer_down_backoff
+            )
             return False
         except StaleEpoch as e:
             self._step_down(f"fenced by epoch {e.current_epoch}")
@@ -385,16 +581,29 @@ class ReplicaNode:
 
     def renew(self) -> None:
         """Leader tick: heartbeat; renew the local deadline on majority,
-        step down once it passes without one."""
+        step down once it passes without one; then run any pending
+        snapshot resyncs OUTSIDE the ship gate — on a worker joined for
+        a BOUNDED slice of the lease: in-process transfers finish inside
+        the join (the manual-mode harnesses still converge right after
+        renew()), while a slow wire transfer DETACHES so the next ticks
+        keep heartbeating — an idle cluster must not let one long
+        cold-join starve the healthy follower's lease into a spurious
+        election that would discard the transfer (single-flight via
+        _resync_active either way)."""
         with self._state_lock:
             if self.role != LEADER or self.crashed:
                 return
             epoch = self.epoch
         with self._ship_lock:
             acks = self._heartbeat(epoch)
+            pending = [p for p in self._resync_pending
+                       if p not in self._resync_active]
+            self._resync_active.update(pending)
         now = _monotonic()
         with self._state_lock:
             if self.role != LEADER or self.epoch != epoch:
+                with self._ship_lock:
+                    self._resync_active.difference_update(pending)
                 return
             if acks >= self.majority:
                 self._lease_deadline = max(
@@ -402,6 +611,57 @@ class ReplicaNode:
                 )
             elif now > self._lease_deadline:
                 self._step_down("lease renewal lost its majority")
+        if pending:
+            worker = threading.Thread(
+                target=self._run_resyncs, args=(pending, epoch),
+                name=f"replica-resync-{self.node_id}", daemon=True,
+            )
+            worker.start()
+            worker.join(min(2.0, self.lease_duration / 4))
+
+    def _run_resyncs(self, pending: List[str], epoch: int) -> None:
+        try:
+            for peer in pending:
+                self._resync_peer(peer, epoch)
+        finally:
+            with self._ship_lock:
+                self._resync_active.difference_update(pending)
+
+    def _resync_peer(self, peer: str, epoch: int) -> None:
+        """Full snapshot resync of one divergent/truncated follower, off
+        the ship gate: offer a snapshot, let the follower PULL it in
+        chunks (it dials back through its own fabric), record the
+        result. Failure leaves the peer pending — the next renew
+        retries; concurrent writes meanwhile ack on the majority and
+        their ships to this peer keep answering divergent (benign: the
+        set already dedups)."""
+        with self._ship_lock:
+            if self._peer_down_until.get(peer, 0.0) > _monotonic():
+                return  # unreachable moments ago; stay pending
+        offer = self.snapshot_offer()
+        try:
+            res = self.hub.call(
+                self.node_id, peer, "install_snapshot",
+                epoch, self.node_id, {"offer": offer},
+            )
+        except PeerUnreachable:
+            with self._ship_lock:
+                self._peer_down_until[peer] = (
+                    _monotonic() + self.peer_down_backoff
+                )
+            return
+        except StaleEpoch as e:
+            self._step_down(f"fenced by epoch {e.current_epoch} mid-resync")
+            with self._ship_lock:
+                self._resync_pending.discard(peer)
+            return
+        applied = res.get("applied")
+        with self._ship_lock:
+            if applied is not None:
+                self._peer_rv[peer] = max(self._peer_rv.get(peer, 0),
+                                          applied)
+                self._resync_pending.discard(peer)
+                self._peer_down_until.pop(peer, None)
 
     # -- election ------------------------------------------------------------
 
@@ -411,13 +671,22 @@ class ReplicaNode:
         round 8 clocked by hand — now a histogram + a ``replica.election``
         span (`ctl trace --last-incident` anchors on it)."""
         t0 = _monotonic()
+        # anchor the election on the last applied ship's span: the write
+        # whose history this candidate extends is the election's causal
+        # parent, so the failover trace reads write → ship → election
+        anchor = self._last_ship_ctx
         with trace.start_span(
-            "replica.election", attrs={"node": self.node_id}
+            "replica.election", parent=anchor,
+            attrs={"node": self.node_id},
         ) as sp:
             won = self._campaign()
             sp.set_attr("won", won)
             if won:
                 sp.set_attr("epoch", self.epoch)
+                ctx = sp.context()
+                if ctx is not None:
+                    self._reign_ctx = (ctx.trace_id, ctx.span_id)
+                    self._reign_bridges = REIGN_BRIDGE_SHIPS
         if won:
             metrics.failover_duration.observe(_monotonic() - t0)
         return won
@@ -519,6 +788,7 @@ class ReplicaNode:
             # would ship from a stale cursor
             self._shipped_rv = self.backing.current_rv()
             self._peer_rv = {}
+            self._resync_pending.clear()  # the new reign re-evaluates
         with self._state_lock:
             if self.epoch != target:
                 return False  # a higher epoch appeared mid-election
@@ -539,10 +809,120 @@ class ReplicaNode:
             self.node_id, peer, "fetch_entries",
             after_rv, self.backing.tail_hash(after_rv),
         )
-        if "snapshot" in res:
+        if "snapshot_offer" in res:
+            self.backing.load_snapshot(
+                self._pull_snapshot(peer, res["snapshot_offer"])
+            )
+        elif "snapshot" in res:  # inline snapshot (direct-call harnesses)
             self.backing.load_snapshot(res["snapshot"])
         else:
             self.backing.apply_replicated(res["entries"])
+
+    # -- chunked snapshot transfer (the cold-join / resync payload) ----------
+
+    def snapshot_offer(self) -> Dict[str, Any]:
+        """Register a full-state snapshot for chunked pull and return its
+        descriptor (id, size, whole-payload sha256). The receiver pulls
+        size-bounded chunks via :meth:`snapshot_chunk`, verifies the hash
+        over the assembled bytes, and applies atomically through
+        ``load_snapshot`` — so a torn transfer can never half-apply."""
+        blob = json.dumps(self.backing.snapshot_state()).encode()
+        tid = uuid.uuid4().hex
+        with self._transfer_lock:
+            self._transfers[tid] = blob
+            while len(self._transfers) > 4:  # bounded outbox, FIFO evict
+                self._transfers.pop(next(iter(self._transfers)))
+        return {
+            "id": tid,
+            "size": len(blob),
+            "sha256": hashlib.sha256(blob).hexdigest(),
+        }
+
+    def snapshot_chunk(self, transfer_id: str, offset: int
+                       ) -> Dict[str, Any]:
+        """One size-bounded chunk of a registered transfer. Offsets are
+        caller-chosen, so a puller that lost its connection mid-transfer
+        RESUMES at the byte it stopped at — re-requesting the same offset
+        is idempotent."""
+        with self._state_lock:
+            if self.crashed:
+                raise PeerUnreachable(f"{self.node_id} is down")
+        with self._transfer_lock:
+            blob = self._transfers.get(transfer_id)
+        if blob is None:
+            raise UnknownTransfer(
+                f"snapshot transfer {transfer_id} is gone (sender "
+                f"restarted or outbox evicted it); restart from a fresh "
+                f"offer"
+            )
+        offset = max(0, int(offset))
+        data = blob[offset:offset + self.snapshot_chunk_bytes]
+        return {
+            "data": base64.b64encode(data).decode(),
+            "eof": offset + len(data) >= len(blob),
+        }
+
+    def snapshot_done(self, transfer_id: str) -> Dict[str, Any]:
+        with self._transfer_lock:
+            self._transfers.pop(transfer_id, None)
+        return {"ok": True}
+
+    def _pull_snapshot(self, peer: str, offer: Dict[str, Any]
+                       ) -> Dict[str, Any]:
+        """Pull an offered snapshot from ``peer`` in bounded chunks.
+        A dropped connection surfaces as PeerUnreachable for ONE chunk;
+        the bounded retry re-requests the SAME offset, so the transfer
+        resumes where it stopped instead of starting over. The assembled
+        bytes must match the offer's sha256 before they are decoded —
+        a truncated or spliced transfer is rejected, never applied."""
+        size = int(offer["size"])
+        buf = bytearray()
+        chunks = 0
+        with trace.start_span(
+            "replica.snapshot",
+            attrs={"node": self.node_id, "from": peer, "bytes": size},
+        ) as sp:
+            while len(buf) < size:
+                last: Optional[Exception] = None
+                for attempt in range(5):
+                    try:
+                        res = self.hub.call(
+                            self.node_id, peer, "snapshot_chunk",
+                            offer["id"], len(buf),
+                        )
+                        break
+                    except PeerUnreachable as e:
+                        # resume path: same offset, jittered wait (the
+                        # severed connection is the common chaos fault)
+                        last = e
+                        time.sleep(0.02 * (attempt + 1))  # bounded, linear
+                else:
+                    raise last if last is not None else PeerUnreachable(
+                        f"snapshot pull from {peer} stalled"
+                    )
+                data = base64.b64decode(res["data"])
+                if not data and not res.get("eof"):
+                    raise PeerUnreachable(
+                        f"snapshot pull from {peer} made no progress at "
+                        f"offset {len(buf)}/{size}"
+                    )
+                buf += data
+                chunks += 1
+                metrics.replication_snapshot_bytes.inc(len(data))
+                if res.get("eof"):
+                    break
+            if hashlib.sha256(bytes(buf)).hexdigest() != offer["sha256"]:
+                raise UnknownTransfer(
+                    f"snapshot transfer {offer['id']} content hash "
+                    f"mismatch after {len(buf)} bytes; restart from a "
+                    f"fresh offer"
+                )
+            sp.set_attr("chunks", chunks)
+        try:
+            self.hub.call(self.node_id, peer, "snapshot_done", offer["id"])
+        except (PeerUnreachable, UnknownTransfer):
+            pass  # best-effort cleanup; the bounded outbox evicts anyway
+        return json.loads(bytes(buf))
 
     # -- RPC handlers (invoked through the hub) ------------------------------
 
@@ -617,24 +997,41 @@ class ReplicaNode:
                 return {"divergent": True}  # dead-epoch suffix at my tail
         if entries:
             self.backing.apply_replicated(entries)
+            # remember the delivering ship's span (the wire route's
+            # server-side span, or — in-process — the leader's ship span
+            # itself, since hub dispatch is synchronous on its thread):
+            # a later election anchors on it for trace continuity
+            ctx = trace.current_ids()
+            if ctx is not None:
+                self._last_ship_ctx = ctx
         return {"applied": self.backing.current_rv()}
 
     def fetch_entries(self, after_rv: int,
                       after_hash: Optional[str]) -> Dict[str, Any]:
+        """Tail (or snapshot OFFER — the payload itself moves as bounded
+        chunks, never one giant response) for a catching-up candidate."""
         with self._state_lock:
             if self.crashed:
                 raise PeerUnreachable(f"{self.node_id} is down")
         if after_rv > 0 and after_hash is not None:
             mine = self.backing.tail_hash(after_rv)
             if mine is not None and mine != after_hash:
-                return {"snapshot": self.backing.snapshot_state()}
+                return {"snapshot_offer": self.snapshot_offer()}
         try:
             return {"entries": self.backing.log_tail(after_rv)}
         except LogTruncated:
-            return {"snapshot": self.backing.snapshot_state()}
+            return {"snapshot_offer": self.snapshot_offer()}
 
     def install_snapshot(self, epoch: int, leader_id: str,
                          snap: Dict[str, Any]) -> Dict[str, Any]:
+        """Full-state resync. ``snap`` is either an inline snapshot dict
+        (direct-call harnesses) or ``{"offer": ...}`` — the normal path:
+        this node PULLS the payload from ``leader_id`` in bounded,
+        hash-verified, resumable chunks, then applies it atomically via
+        ``load_snapshot``. A failed pull returns ``{"failed": ...}`` so
+        the sender degrades that ship to majority-only (and re-offers on
+        its next heartbeat) instead of erroring the write it was
+        shipping."""
         with self._apply_lock:
             with self._state_lock:
                 if self.crashed:
@@ -646,6 +1043,21 @@ class ReplicaNode:
                 self.role = FOLLOWER
                 self.leader_id = leader_id
                 self._lease_until = _monotonic() + self.lease_duration
+            if isinstance(snap, dict) and "offer" in snap:
+                try:
+                    snap = self._pull_snapshot(leader_id, snap["offer"])
+                except (PeerUnreachable, UnknownTransfer, ValueError,
+                        KeyError) as e:
+                    log.warning("%s: snapshot pull from %s failed: %s",
+                                self.node_id, leader_id, e)
+                    return {"failed": f"{type(e).__name__}: {e}"}
+                with self._state_lock:
+                    # the pull took wall time: a newer reign may have
+                    # superseded the sender mid-transfer, and applying
+                    # the dead reign's snapshot now could truncate acked
+                    # writes the new reign already shipped us
+                    if epoch < self.epoch:
+                        raise StaleEpoch(self.epoch)
             return {"applied": self.backing.load_snapshot(snap)}
 
     def replica_status(self) -> Dict[str, Any]:
@@ -662,6 +1074,11 @@ class ReplicaNode:
                                else self.backing.current_rv()),
                 "lease_remaining_s": round(max(0.0, lease), 3),
                 "leader": self._leader_hint(),
+                # full-membership hint: `ctl store status` resolves the
+                # whole set from ANY one endpoint by following these
+                # (node id → advertised URL; non-URL entries are
+                # in-process sets, which the client skips)
+                "peers": dict(self.rset.advertise),
             }
             if self.role == LEADER and not self.crashed:
                 head = self.backing.current_rv()
@@ -758,6 +1175,13 @@ class ReplicaNode:
             self.crashed = False
             self._lease_until = 0.0
         self._shipped_rv = self.backing.current_rv()
+        self._peer_down_until = {}
+        self._resync_pending = set()
+        self._resync_active = set()
+        with self._transfer_lock:
+            self._transfers = {}
+        self._last_ship_ctx = None
+        self._reign_ctx = None
         self.hub.set_down(self.node_id, False)
 
     def close(self) -> None:
@@ -884,28 +1308,7 @@ class ReplicaSet:
                    index: int) -> None:
         while not self._stop.wait(self.retry_period):
             try:
-                with node._state_lock:
-                    crashed, role = node.crashed, node.role
-                    expired = _monotonic() > node._lease_until
-                if crashed:
-                    continue
-                if role == LEADER:
-                    node.renew()
-                elif expired:
-                    # node-skewed jittered wait before campaigning keeps
-                    # concurrent candidates from split-voting forever and
-                    # makes the FIRST winner deterministic per seed
-                    delay = index * self.retry_period / 2 + rng.uniform(
-                        0, self.retry_period / 2
-                    )
-                    if self._stop.wait(delay):
-                        return
-                    with node._state_lock:
-                        still = (not node.crashed
-                                 and node.role == FOLLOWER
-                                 and _monotonic() > node._lease_until)
-                    if still:
-                        node.campaign()
+                tick_node(node, rng, index, self.retry_period, self._stop)
             except Exception:
                 # a ticker must survive transient errors (a peer crashing
                 # mid-RPC); a dead ticker would silently end failover

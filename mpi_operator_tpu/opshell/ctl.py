@@ -575,7 +575,11 @@ def cmd_store(client: TPUJobClient, args) -> int:
     """`ctl store status`: replica-set roles, lease time, applied rv and
     per-follower lag — the day-2 view of the HA store (≙ `etcdctl
     endpoint status`). Works against any store: non-replicated backends
-    report one honest 'standalone' row."""
+    report one honest 'standalone' row. Against a wire-replicated set,
+    ONE endpoint on the command line is enough — the survey follows each
+    answer's peer hints to the full membership (discovered rows are
+    marked '+'), and the leaderless-exit-1 contract holds in both output
+    formats."""
     store = client.store
     status_fn = getattr(store, "replica_status", None)
     if callable(status_fn):
@@ -597,7 +601,8 @@ def cmd_store(client: TPUJobClient, args) -> int:
         if s.get("role") == "leader":
             worst_lag = s.get("lag_entries") or {}
         rows.append([
-            s.get("endpoint") or s.get("node", "-"),
+            (s.get("endpoint") or s.get("node", "-"))
+            + ("+" if s.get("discovered") else ""),
             s.get("role", "?"),
             s.get("epoch", "-"),
             s.get("applied_rv", "-"),

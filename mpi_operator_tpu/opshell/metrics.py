@@ -477,6 +477,13 @@ store_replication_failovers = REGISTRY.counter(
     "is exactly 1 (the initial election); every increment after that is "
     "a leader loss the runbook's 'leader loss' row explains",
 )
+replication_snapshot_bytes = REGISTRY.counter(
+    "tpu_operator_replication_snapshot_bytes_total",
+    "Bytes pulled over chunked snapshot transfers (cold follower joins, "
+    "divergent-suffix resyncs) — steady state is FLAT; a climbing rate "
+    "means some follower keeps falling off the log-retention window and "
+    "resyncing (see the runbook's 'snapshot transfer stuck' row)",
+)
 store_writes_elided = REGISTRY.counter(
     "tpu_operator_store_writes_elided_total",
     "Writes skipped because the intended object matched the lister's copy "

@@ -20,3 +20,15 @@ def drop_via_follower(self):
 
 def batch_on_standby(node_standby, items):
     node_standby.patch_batch(items)  # expect: REP001
+
+
+def poke_peer_handle(peer, obj):
+    # the wire fabric's peer handles (ISSUE 12) are follower-like too:
+    # a peer-route helper writing a peer's store directly forks history
+    peer.update(obj)  # expect: REP001
+
+
+def seed_joiner_directly(self, obj):
+    # a cold JOINER is caught up by the leader's ship/snapshot path,
+    # never by hand-writing its store
+    self.joiner.backing.create(obj)  # expect: REP001

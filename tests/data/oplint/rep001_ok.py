@@ -23,6 +23,18 @@ def install_snapshot(self, follower, snap):
     follower.create(snap)
 
 
+def read_peer_status(peer):
+    # peer reads (status probes, chunk pulls) are not mutations
+    return peer.get("Pod", "default", "p")
+
+
+def _handle_replica(self, joiner, entries):
+    # the wire peer-route dispatcher IS the seam: the writes it routes
+    # into the local node are replication applies by definition
+    for e in entries:
+        joiner.update(e)
+
+
 def repair_tool(follower, obj):
     # a break-glass repair writing a follower directly must say why
     # oplint: disable=REP001 — offline fsck utility: the node is

@@ -1,0 +1,319 @@
+"""Operator e2e + partition/leader-kill chaos over the WIRE set (ISSUE 12).
+
+The PR 8 chaos scenario re-run in the DEPLOYED shape: three ReplicaNodes
+served by real StoreServers over loopback sockets, peer RPCs routed
+through per-directed-pair ChaosProxies (``NamedProxyFabric`` gives the
+scripted ``partition`` fault its fabric), auto tickers owning failover,
+and the full operator stack — controller, gang scheduler, node monitor,
+informer cache, hollow fleet — riding one multi-endpoint HttpStoreClient.
+
+A seeded ChaosScript partitions the leader from one follower, then kills
+the leader mid-run (server down + node crashed = SIGKILL semantics). The
+bar, on BOTH runs of one seed:
+
+- no acked write lost — every marker create the writer saw succeed is in
+  the final state at exactly its acked rv;
+- ALL jobs reach Succeeded post-failover (the operator stack survived);
+- rv monotone from the healthy follower's watch; one leader per epoch;
+- ONE connected trace spanning a pre-kill write → its replication ship →
+  the winning election → a post-failover reconcile, and
+  ``ctl trace --last-incident`` renders it rc=0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.types import ObjectMeta
+from mpi_operator_tpu.controller.controller import (
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+from mpi_operator_tpu.executor.hollow import HollowFleet, HollowTimeline
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.cache import InformerCache
+from mpi_operator_tpu.machinery.chaos import (
+    ChaosController,
+    ChaosProxy,
+    ChaosScript,
+    NamedProxyFabric,
+)
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+from mpi_operator_tpu.machinery.objects import ConfigMap
+from mpi_operator_tpu.machinery.replica_wire import ReplicaTicker
+from mpi_operator_tpu.machinery.replicated_store import LEADER
+from mpi_operator_tpu.scheduler import GangScheduler
+
+from tests.invariants import Trail, resource_versions_monotonic, violations
+from tests.test_hollow import make_job
+from tests.test_replica_wire import PEER_TOKEN, WireSet
+
+pytestmark = pytest.mark.slow
+
+SEED = 1207
+JOBS = 10
+
+
+class ProxiedWireSet(WireSet):
+    """WireSet whose peer fabrics dial through per-directed-pair chaos
+    proxies — the multi-process partition shape. Client traffic keeps
+    using the DIRECT urls; only replication RPCs ride the proxies,
+    exactly like a switch fault between replica racks."""
+
+    def __init__(self, tmpdir, seed):
+        super().__init__(tmpdir, 3, lease_duration=0.5, poll_interval=0.01)
+        self.proxies = {}
+        for src in self.ids:
+            for dst in self.ids:
+                if src == dst:
+                    continue
+                proxy = ChaosProxy(self.urls[dst], seed=seed).start()
+                self.proxies[f"{src}->{dst}"] = proxy
+                self.fabrics[src].peer_urls[dst] = proxy.url
+        self.named_fabric = NamedProxyFabric(self.proxies)
+        self.tickers = [
+            ReplicaTicker(self.nodes[nid], retry_period=0.05, seed=seed)
+            for nid in self.ids
+        ]
+
+    def start_tickers(self):
+        for t in self.tickers:
+            t.start()
+
+    def kill(self, nid):
+        """SIGKILL semantics for an in-process wire node: the server
+        stops answering (clients + peers see refused connections) and
+        the node hard-crashes (no clean shutdown)."""
+        self.servers[nid].stop()
+        self.nodes[nid].crash()
+
+    def leadership(self):
+        out = []
+        for m in self.memberships.values():
+            out.extend(m.leadership_log)
+        return sorted(out)
+
+    def stop(self):
+        for t in self.tickers:
+            t.stop()
+        for p in self.proxies.values():
+            p.stop()
+        super().stop()
+
+
+class LeaderTarget:
+    """ChaosController process-target adapter: 'kill the current leader'
+    resolved at fire time (the wire twin of replicated_store.NodeTarget)."""
+
+    def __init__(self, ws: ProxiedWireSet):
+        self.ws = ws
+        self.killed = None
+
+    def kill(self):
+        lead = self.ws.leader()
+        if lead is None:
+            raise RuntimeError("no leader to kill")
+        self.killed = lead.node_id
+        self.ws.kill(lead.node_id)
+
+    def term(self):
+        self.kill()
+
+
+def _marker(i):
+    return ConfigMap(metadata=ObjectMeta(name=f"m{i:04d}",
+                                         namespace="torture"))
+
+
+def _run_operator_chaos(tmp_dir, seed, trace_dir):
+    trace.configure("wiretest", dir=str(trace_dir))
+    ws = ProxiedWireSet(tmp_dir, seed)
+    stop_writer = threading.Event()
+    acked = {}
+    controller = cache = monitor = fleet = None
+    client = wclient = fclient = None
+    stop = threading.Event()
+    try:
+        assert ws.nodes["n0"].campaign()
+        ws.start_tickers()
+        trail = Trail(ws.nodes["n2"])  # the healthy-side vantage point
+        urls = list(ws.urls.values())
+        client = HttpStoreClient(urls, conn_refused_retries=20,
+                                 retry_base_delay=0.05,
+                                 watch_poll_timeout=2.0)
+        wclient = HttpStoreClient(urls, conn_refused_retries=20,
+                                  retry_base_delay=0.05)
+        fclient = HttpStoreClient(urls, conn_refused_retries=20,
+                                  retry_base_delay=0.05,
+                                  watch_poll_timeout=2.0)
+        cache = InformerCache(client).start()
+        assert cache.wait_for_sync(10.0)
+        recorder = EventRecorder(client)
+        controller = TPUJobController(
+            client, recorder,
+            ControllerOptions(threadiness=2, queue_shards=2), cache=cache,
+        )
+        scheduler = GangScheduler(client, recorder, cache=cache)
+        monitor = NodeMonitor(client, recorder, grace=30.0, cache=cache)
+        fleet = HollowFleet(
+            fclient, 6, timeline=HollowTimeline(run_s=0.1, seed=seed),
+            capacity_chips=8, heartbeat_interval=2.0,
+        ).start()
+        controller.run()
+        monitor.start()
+
+        def sched_loop():
+            while not stop.is_set():
+                try:
+                    scheduler.sync()
+                except Exception:
+                    pass  # failover window; the next pass heals
+                stop.wait(0.1)
+
+        st = threading.Thread(target=sched_loop, daemon=True)
+        st.start()
+
+        def writer():
+            i = 0
+            while not stop_writer.is_set():
+                try:
+                    o = wclient.create(_marker(i))
+                    acked[o.metadata.name] = o.metadata.resource_version
+                except Exception:
+                    pass  # indeterminate/leaderless: name burned
+                i += 1
+                stop_writer.wait(0.02)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+        for i in range(JOBS):
+            client.create(make_job(f"torture-{i:02d}", replicas=2))
+
+        script = ChaosScript.parse({
+            "seed": seed,
+            "actions": [
+                {"at": 0.8, "fault": "partition", "a": "n0", "b": "n1",
+                 "duration": 2.5},
+                {"at": 1.4, "fault": "kill", "target": "leader"},
+            ],
+        })
+        target = LeaderTarget(ws)
+        chaos = ChaosController(
+            script, targets={"leader": target}, fabric=ws.named_fabric,
+        ).arm()
+        chaos.join(15.0)
+        assert [e for _, _, e in chaos.executed] == [None, None, None], (
+            chaos.executed
+        )
+        kill_time = time.time()
+
+        # every job must converge post-failover
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            jobs = [j for j in cache.list("TPUJob", "hollow")]
+            if len(jobs) == JOBS and all(
+                cond.is_succeeded(j.status) for j in jobs
+            ):
+                break
+            time.sleep(0.3)
+        else:
+            done = sum(1 for j in cache.list("TPUJob", "hollow")
+                       if cond.is_succeeded(j.status))
+            pytest.fail(f"only {done}/{JOBS} jobs succeeded post-failover")
+
+        # keep writing a bit past convergence, then settle
+        stop_writer.set()
+        wt.join(5.0)
+        lead = ws.leader()
+        assert lead is not None and lead.node_id != target.killed, \
+            "no failover happened"
+        assert ws.converged(10.0)
+        trail.stop()
+        return {
+            "ws": ws,
+            "acked": dict(acked),
+            "final": {o.metadata.name: o.metadata.resource_version
+                      for o in lead.list("ConfigMap", "torture")},
+            "trail": trail,
+            "leadership": ws.leadership(),
+            "killed": target.killed,
+            "new_leader": lead.node_id,
+            "kill_time": kill_time,
+        }
+    finally:
+        stop_writer.set()
+        stop.set()
+        if controller is not None:
+            controller.stop()
+        if monitor is not None:
+            monitor.stop()
+        if fleet is not None:
+            fleet.stop()
+        if cache is not None:
+            cache.stop()
+        for c in (client, wclient, fclient):
+            if c is not None:
+                c.close()
+        ws.stop()
+
+
+@pytest.mark.parametrize("run", [1, 2], ids=["run1", "run2"])
+def test_operator_survives_partition_plus_leader_kill_on_the_wire(
+    tmp_path, run, monkeypatch
+):
+    trace_dir = tmp_path / "traces"
+    try:
+        out = _run_operator_chaos(tmp_path, SEED, trace_dir)
+    finally:
+        trace.TRACER.disable()
+    # progress on both sides of the kill
+    assert len(out["acked"]) >= 10, out["acked"]
+    # no acked write lost, at its exact rv
+    for name, rv in out["acked"].items():
+        assert name in out["final"], \
+            f"ACKED write {name} (rv {rv}) lost across failover"
+        assert out["final"][name] == rv, (name, rv, out["final"][name])
+    # rv monotone from the surviving follower's watch
+    bad = violations(out["trail"], checks=(resource_versions_monotonic,))
+    assert bad == [], bad
+    # exactly one leader per epoch across every membership's log
+    epochs = [e for e, _ in out["leadership"]]
+    assert len(set(epochs)) == len(epochs), out["leadership"]
+    assert out["new_leader"] != out["killed"]
+
+    # --- the connected failover trace ------------------------------------
+    spans = trace.load_spans(str(trace_dir))
+    elections = [s for s in spans if s.get("name") == "replica.election"
+                 and (s.get("attrs") or {}).get("won")]
+    assert elections, "no winning election span exported"
+    win = max(elections, key=lambda s: s.get("start") or 0)
+    assert win.get("parent_id"), \
+        "election span not anchored on the last applied ship"
+    comps = trace.connected_components(spans, link_traces=True)
+    comp = next(c for c in comps if win["span_id"] in c)
+    in_comp = [s for s in spans if s["span_id"] in comp]
+    names = {s["name"] for s in in_comp}
+    assert "replica.ship" in names, "no ship span connected"
+    assert "store.request" in names, "no write span connected"
+    post_reconciles = [
+        s for s in in_comp
+        if s["name"] == "controller.reconcile"
+        and (s.get("start") or 0) > out["kill_time"]
+    ]
+    assert post_reconciles, \
+        "no post-failover reconcile joined the failover trace"
+
+    # and the operator-facing renderer agrees: rc=0 on the incident
+    from mpi_operator_tpu.opshell import ctl
+
+    monkeypatch.setenv(trace.ENV_TRACE_DIR, str(trace_dir))
+    url = out["ws"].urls[out["new_leader"]]
+    rc = ctl.main(["--store", url, "trace", "--last-incident"])
+    assert rc == 0

@@ -15,6 +15,7 @@ import subprocess
 import sys
 import threading
 import time
+from http.server import BaseHTTPRequestHandler
 import urllib.parse
 
 import pytest
@@ -1678,3 +1679,105 @@ def test_tenant_classification():
         assert t("GET", "/v1/objects/Pod", "") == "anon"
     finally:
         srv._httpd.server_close()
+
+
+class _ScriptedReplicaHandler(BaseHTTPRequestHandler):
+    """A store endpoint whose mutation route answers a scripted sequence
+    of (status, payload) — the 503-ReplicationUnavailable pin needs a
+    leader that fails indeterminately N times then recovers."""
+
+    script = []  # class attr, set per test
+    hits = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        raw = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).hits.append(self.path)
+        n = len(type(self).hits) - 1
+        step = type(self).script[min(n, len(type(self).script) - 1)]
+        if step == "ok":
+            obj = json.loads(raw)["object"]
+            obj.setdefault("metadata", {})["resource_version"] = 7
+            self._reply(200, {"object": obj})
+        else:
+            self._reply(503, {"error": "ReplicationUnavailable",
+                              "message": "majority unreachable mid-ship"})
+
+
+def _scripted_server(script):
+    from http.server import ThreadingHTTPServer
+
+    handler = type("H", (_ScriptedReplicaHandler,),
+                   {"script": script, "hits": []})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, handler, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_503_replication_unavailable_retries_same_leader_not_rotation():
+    """ISSUE 12 satellite bugfix pin: a 503 ReplicationUnavailable is
+    INDETERMINATE, not a routing error — the client retries with backoff
+    on the SAME endpoint (never rotating into a follower's 421 loop) and
+    recovers when the leader does."""
+    httpd, handler, url = _scripted_server(["503", "503", "ok"])
+    follower = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    client = HttpStoreClient([url, follower.url], retry_base_delay=0.01,
+                             replication_unavailable_retries=3)
+    try:
+        created = client.create(Pod(metadata=ObjectMeta(name="p")))
+        assert created.metadata.resource_version == 7
+        # all three attempts hit the SAME (leader) endpoint
+        assert len(handler.hits) == 3
+        assert client.retry_stats["replication_unavailable_retries"] == 2
+        assert client.retry_stats["endpoint_rotations"] == 0
+        assert client.url == url  # still pinned to the leader
+    finally:
+        client.close()
+        follower.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_503_budget_exhausted_surfaces_typed_without_rotation():
+    """Past the bounded retry budget the indeterminate outcome SURFACES
+    as the typed error (the caller owns the re-read) — and the endpoint
+    cursor still never moved off the leader."""
+    from mpi_operator_tpu.machinery.store import ReplicationUnavailable
+
+    httpd, handler, url = _scripted_server(["503"])  # 503 forever
+    follower = StoreServer(ObjectStore(), "127.0.0.1", 0).start()
+    client = HttpStoreClient([url, follower.url], retry_base_delay=0.01,
+                             replication_unavailable_retries=2)
+    try:
+        with pytest.raises(ReplicationUnavailable):
+            client.create(Pod(metadata=ObjectMeta(name="p")))
+        assert len(handler.hits) == 3  # 1 + 2 bounded retries
+        assert client.retry_stats["endpoint_rotations"] == 0
+        assert client.url == url
+        # retries are disableable: 0 = surface immediately (old contract)
+        handler.hits.clear()
+        c2 = HttpStoreClient([url, follower.url],
+                             replication_unavailable_retries=0)
+        try:
+            with pytest.raises(ReplicationUnavailable):
+                c2.create(Pod(metadata=ObjectMeta(name="p")))
+            assert len(handler.hits) == 1
+            assert c2.retry_stats["endpoint_rotations"] == 0
+        finally:
+            c2.close()
+    finally:
+        client.close()
+        follower.stop()
+        httpd.shutdown()
+        httpd.server_close()

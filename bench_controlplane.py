@@ -19,8 +19,13 @@ measuring per-sync latency and the server's read counters. Run it via::
 
 Knobs: BENCH_CP_JOBS, BENCH_CP_PODS, BENCH_CP_ROUNDS, BENCH_CP_MODES
 ("store", "informer", "write", "replica", "hist", "traceoverhead",
-"scale", "serve", "fanout", or a comma list). No jax required — this is
-the pure-python control plane. The **scale** mode (ISSUE 10) drives a
+"scale", "serve", "fanout", "slo", or a comma list). No jax required —
+this is the pure-python control plane. The **slo** mode (ISSUE 13) is
+the alerting plane's acceptance run: a seeded store-latency fault must
+fire the matching burn-rate alert within its documented detection bound,
+clear after heal, dump a flight-recorder bundle `ctl trace
+--last-incident` renders rc=0, and the monitor's scrape tax must stay
+≤2% of reconcile p50 — detection run TWICE on one seed. The **scale** mode (ISSUE 10) drives a
 hollow-node fleet (BENCH_CP_SCALE_NODES × simulated nodes,
 BENCH_CP_SCALE_JOBS jobs) against the sharded+fair-queued stack and reads
 p50/p99 out of the PR 9 histograms with p99 SLOs as the tripwire;
@@ -109,6 +114,18 @@ def _percentile(sorted_vals, p):
         return 0.0
     k = min(len(sorted_vals) - 1, max(0, int(round(p * (len(sorted_vals) - 1)))))
     return sorted_vals[k]
+
+
+def _slo_ms(name: str, scale: float = 1.0) -> float:
+    """A p99 tripwire threshold in ms from THE SLO config file
+    (controller/slo_defaults.json or $TPUJOB_SLO_CONFIG) — the same file
+    the runtime burn-rate monitor evaluates, so bench and monitor can
+    never disagree on an objective. The entry's env knob (e.g.
+    BENCH_CP_SLO_RECONCILE_P99_MS) still overrides, ABSOLUTE (it beats
+    ``scale`` — a deployment that exported a bound meant exactly it)."""
+    from mpi_operator_tpu.controller.slo_monitor import load_slo_config
+
+    return load_slo_config().threshold_ms(name, scale=scale)
 
 
 def run_mode(mode: str, jobs: int, pods: int, rounds: int) -> dict:
@@ -622,14 +639,14 @@ def run_scale_mode(nodes: int, jobs: int, pods: int) -> dict:
     run_s = float(os.environ.get("BENCH_CP_SCALE_RUN_S", "0.2"))
     wave = int(os.environ.get("BENCH_CP_SCALE_WAVE", "500"))
     threadiness = int(os.environ.get("BENCH_CP_SCALE_WORKERS", "8"))
-    # p99 SLO tripwires, calibrated on this sandbox's round-10 run
-    # (measured 570 / 225 / 4404 ms at 1k nodes / 10k jobs) with ~2×
-    # headroom for run-to-run drift — a regression that blows these is a
-    # scalability bug, not noise. Override per deployment via env.
-    slo_reconcile = float(os.environ.get("BENCH_CP_SLO_RECONCILE_P99_MS",
-                                         "1000"))
-    slo_bind = float(os.environ.get("BENCH_CP_SLO_BIND_P99_MS", "500"))
-    slo_lag = float(os.environ.get("BENCH_CP_SLO_WATCHLAG_P99_MS", "7500"))
+    # p99 SLO tripwires from the ONE config file the runtime monitor
+    # evaluates (controller/slo_defaults.json; calibrated on this
+    # sandbox's round-10 run — 570 / 225 / 4404 ms at 1k nodes / 10k
+    # jobs — with ~2× headroom). A regression that blows these is a
+    # scalability bug, not noise. Env overrides preserved per entry.
+    slo_reconcile = _slo_ms("reconcile-latency")
+    slo_bind = _slo_ms("scheduler-bind")
+    slo_lag = _slo_ms("watch-lag")
     chips = max(2, math.ceil(jobs * pods / max(1, nodes)) + 2)
 
     tmp = tempfile.mkdtemp(prefix="bench-cp-scale-")
@@ -827,14 +844,15 @@ def run_torture_mode(nodes: int, jobs: int, pods: int, seed: int) -> dict:
     run_s = float(os.environ.get("BENCH_CP_TORTURE_RUN_S", "0.2"))
     wave = int(os.environ.get("BENCH_CP_TORTURE_WAVE", "200"))
     threadiness = int(os.environ.get("BENCH_CP_SCALE_WORKERS", "4"))
-    # the reconcile tripwire is 2× the scale mode's: a DELIBERATE leader
-    # SIGKILL puts the ~2-lease failover window's reconciles into p99 by
-    # design — the bar is that the window stays bounded (sub-2s), not
-    # that chaos is free (measured 955 ms at 100×500 with one kill)
-    slo_reconcile = float(os.environ.get("BENCH_CP_SLO_RECONCILE_P99_MS",
-                                         "2000"))
-    slo_bind = float(os.environ.get("BENCH_CP_SLO_BIND_P99_MS", "500"))
-    slo_lag = float(os.environ.get("BENCH_CP_SLO_WATCHLAG_P99_MS", "7500"))
+    # tripwires from THE SLO config file (same source as the runtime
+    # monitor + scale mode). The reconcile bar is 2× the config's: a
+    # DELIBERATE leader SIGKILL puts the ~2-lease failover window's
+    # reconciles into p99 by design — the bar is that the window stays
+    # bounded (sub-2s), not that chaos is free (measured 955 ms at
+    # 100×500 with one kill). An env override stays absolute.
+    slo_reconcile = _slo_ms("reconcile-latency", scale=2.0)
+    slo_bind = _slo_ms("scheduler-bind")
+    slo_lag = _slo_ms("watch-lag")
     chips = max(2, math.ceil(jobs * pods / max(1, nodes)) + 2)
 
     tmp = tempfile.mkdtemp(prefix="bench-cp-torture-")
@@ -1232,8 +1250,10 @@ def run_serve_mode() -> dict:
     batch_run_s = float(os.environ.get("BENCH_CP_SERVE_BATCH_RUN_S", "4.0"))
     spike_qps = float(os.environ.get("BENCH_CP_SERVE_SPIKE_QPS", "1200"))
     base_qps = float(os.environ.get("BENCH_CP_SERVE_BASE_QPS", "80"))
-    slo_ready_p99_ms = float(os.environ.get(
-        "BENCH_CP_SLO_SERVE_READY_P99_MS", "10000"))
+    # the cold-start bar from THE SLO config file (serve-ready entry;
+    # env override preserved) — the same objective the runtime monitor
+    # burn-rate-alerts on
+    slo_ready_p99_ms = _slo_ms("serve-ready")
 
     tmp = tempfile.mkdtemp(prefix="bench-cp-serve-")
     trace_dir = os.path.join(tmp, "traces")
@@ -1485,6 +1505,345 @@ def run_serve_mode() -> dict:
         trace.TRACER.disable()
 
 
+def run_slo_overhead(jobs: int, pods: int, rounds: int) -> dict:
+    """The monitor-tax bound (half of BENCH_CP_MODES=slo): interleaved
+    off/on informer reconcile storms — 'on' runs a live SLOMonitor at a
+    DENSE 0.25s scrape+evaluate cadence against this process's real
+    /metrics endpoint (the registry the storm's controller is writing
+    into), 60× denser than the production 15s default — best-of-two per
+    mode so run-to-run drift cancels. Acceptance (ISSUE 13): the
+    monitor's scrape overhead stays ≤2% of reconcile p50 even at that
+    cadence."""
+    from mpi_operator_tpu.controller.slo_monitor import (
+        SLOMonitor,
+        load_slo_config,
+    )
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.machinery.telemetry import ScrapeTarget
+    from mpi_operator_tpu.opshell.server import OpsServer
+
+    ops = OpsServer(0)
+    ops.start()
+    results = {"off": [], "on": []}
+    monitor = None
+    try:
+        for _ in range(2):
+            results["off"].append(run_mode("informer", jobs, pods, rounds))
+            monitor = SLOMonitor(
+                ObjectStore(),
+                [ScrapeTarget("operator",
+                              f"http://127.0.0.1:{ops.port}/metrics")],
+                load_slo_config(), interval=0.25,
+            ).start()
+            try:
+                results["on"].append(
+                    run_mode("informer", jobs, pods, rounds))
+            finally:
+                monitor.stop()
+    finally:
+        ops.stop()
+    off = min(results["off"], key=lambda r: r["sync_p50_ms"])
+    on = min(results["on"], key=lambda r: r["sync_p50_ms"])
+    pct = round((on["sync_p50_ms"] - off["sync_p50_ms"])
+                / max(1e-9, off["sync_p50_ms"]) * 100.0, 1)
+    return {
+        "metric": "controlplane_slo_overhead",
+        "jobs": jobs, "pods_per_job": pods, "rounds": rounds,
+        "scrape_interval_s": 0.25,
+        "sync_p50_ms_monitor_off": off["sync_p50_ms"],
+        "sync_p50_ms_monitor_on": on["sync_p50_ms"],
+        "p50_overhead_pct": pct,
+        "overhead_ok": bool(pct <= 2.0),
+    }
+
+
+def run_slo_detection(seed: int) -> dict:
+    """The detection e2e (BENCH_CP_MODES=slo, ISSUE 13): the deployed
+    shape — a `tpu-store` process and a hollow-fleet process (both
+    exporting /metrics via --monitoring-port), the operator plane in
+    THIS process behind a ChaosProxy on its store seam, and the SLO
+    monitor scraping all three over real HTTP with compressed burn
+    windows (scale 1/600: fast 0.5s/6s, slow 3s/36s).
+
+    A seeded chaos fault — 0.6s injected store latency for 10s, the
+    'store seam degraded' incident — must blow the reconcile-latency
+    objective past its 1s good-event bound; the bar:
+
+    - NO false positive during the clean baseline;
+    - the matching alert FIRES within the documented detection bound
+      (fast_long + 2 evaluation periods + scrape slack);
+    - it CLEARS after the heal within the clear bound (windows drain +
+      clean hold);
+    - the firing carries a flight-recorder bundle and
+      `ctl trace --last-incident` renders it rc=0.
+
+    The caller runs this TWICE on one seed (chaos determinism)."""
+    import io
+    import contextlib
+    import shutil
+    import subprocess
+    import threading
+
+    from mpi_operator_tpu.controller.slo_monitor import (
+        SLOMonitor,
+        load_slo_config,
+    )
+    from mpi_operator_tpu.machinery import trace
+    from mpi_operator_tpu.machinery.chaos import (
+        ChaosController,
+        ChaosProxy,
+        ChaosScript,
+    )
+    from mpi_operator_tpu.machinery.replica_wire import free_ports
+    from mpi_operator_tpu.machinery.telemetry import ScrapeTarget
+    from mpi_operator_tpu.opshell import ctl
+    from mpi_operator_tpu.opshell.server import OpsServer
+
+    nodes = int(os.environ.get("BENCH_CP_SLO_NODES", "16"))
+    fault_s = float(os.environ.get("BENCH_CP_SLO_FAULT_S", "10"))
+    delay_s = 0.6
+    scale = 1.0 / 600.0
+    config = load_slo_config().scaled(scale)
+    interval = 0.25
+    # the documented detection-latency bound: the fast pair's LONG
+    # window must fill past the burn threshold, plus two evaluation
+    # periods and scrape slack
+    detect_bound_s = config.policy.fast[1] + 2 * interval + 2.0
+    # the clear bound: every window drains the breach, then the hold
+    clear_bound_s = (config.policy.slow[1] + config.policy.clear_hold_s
+                     + 2 * interval + 4.0)
+
+    tmp = tempfile.mkdtemp(prefix="bench-cp-slo-")
+    trace_dir = os.path.join(tmp, "traces")
+    incident_dir = os.path.join(tmp, "incidents")
+    trace.TRACER.configure("bench-slo", dir=trace_dir)
+    ports = free_ports(3)
+    store_port, store_mon, fleet_mon = ports
+    store_url = f"http://127.0.0.1:{store_port}"
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.abspath(__file__)), TPUJOB_TRACE_DIR=trace_dir)
+    out: dict = {"metric": "controlplane_slo_detection", "seed": seed,
+                 "nodes": nodes, "ok": False,
+                 "detect_bound_s": round(detect_bound_s, 1),
+                 "clear_bound_s": round(clear_bound_s, 1)}
+    store_proc = fleet_proc = None
+    proxy = None
+    monitor = None
+    cache = controller = None
+    ops = None
+    clients = []
+    stop = threading.Event()
+    try:
+        store_proc = subprocess.Popen(
+            [sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+             "--store", f"sqlite:{os.path.join(tmp, 'store.db')}",
+             "--listen", f"127.0.0.1:{store_port}",
+             "--log-capacity", "16384",
+             "--monitoring-port", str(store_mon)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=open(os.path.join(tmp, "store.log"), "w"),
+        )
+        direct = HttpStoreClient(store_url, timeout=30.0,
+                                 conn_refused_retries=20,
+                                 watch_poll_timeout=2.0)
+        clients.append(direct)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                direct.list("Node")
+                break
+            except Exception:
+                time.sleep(0.2)
+        fleet_proc = subprocess.Popen(
+            [sys.executable, "-m", "mpi_operator_tpu.executor.hollow",
+             "--store", store_url, "--nodes", str(nodes),
+             "--chips", "8", "--run-s", "0.2", "--heartbeat", "5",
+             "--seed", str(seed), "--monitoring-port", str(fleet_mon)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=open(os.path.join(tmp, "fleet.log"), "w"),
+        )
+        # the operator plane reaches the store THROUGH the chaos seam:
+        # injected latency lands exactly where a degraded store would
+        proxy = ChaosProxy(store_url, seed=seed).start()
+        pclient = HttpStoreClient(proxy.url, timeout=60.0,
+                                  conn_refused_retries=20,
+                                  watch_poll_timeout=2.0)
+        clients.append(pclient)
+        cache = InformerCache(pclient).start()
+        if not cache.wait_for_sync(30.0):
+            raise RuntimeError("informer cache never synced")
+        recorder = EventRecorder(pclient)
+        controller = TPUJobController(
+            pclient, recorder, ControllerOptions(threadiness=4),
+            cache=cache)
+        scheduler = GangScheduler(pclient, recorder, cache=cache)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(cache.list("Node")) >= nodes:
+                break
+            time.sleep(0.2)
+        controller.run()
+
+        def sched_loop():
+            while not stop.is_set():
+                try:
+                    scheduler.sync()
+                except Exception:
+                    pass  # transient conflicts; next pass heals
+                stop.wait(0.2)
+
+        threading.Thread(target=sched_loop, daemon=True).start()
+
+        # a steady job stream keeps reconcile events flowing through
+        # every phase (burn windows need event mass to judge)
+        submitted = [0]
+
+        def job_stream():
+            while not stop.is_set():
+                try:
+                    direct.create(_make_job(submitted[0], 1, clean="All"))
+                    submitted[0] += 1
+                except Exception:
+                    pass  # the stream is load, not the bar
+                stop.wait(0.3)
+
+        threading.Thread(target=job_stream, daemon=True).start()
+
+        # the monitor: scrapes operator (this process, over real HTTP),
+        # store, fleet; writes alerts through the DIRECT client — the
+        # alerting plane must not ride the seam it is alerting about
+        ops = OpsServer(0)
+        ops.start()
+        monitor = SLOMonitor(
+            direct,
+            [ScrapeTarget("operator",
+                          f"http://127.0.0.1:{ops.port}/metrics"),
+             ScrapeTarget("store",
+                          f"http://127.0.0.1:{store_mon}/metrics"),
+             ScrapeTarget("fleet",
+                          f"http://127.0.0.1:{fleet_mon}/metrics")],
+            config, interval=interval, incident_dir=incident_dir,
+        ).start()
+
+        def firing_alerts():
+            try:
+                return sorted(
+                    a.metadata.name for a in direct.list(
+                        "Alert", "monitoring")
+                    if a.is_firing()
+                )
+            except Exception:
+                return []
+
+        # --- clean baseline: no false positives --------------------------
+        baseline_s = max(8.0, config.policy.slow[0] + 2.0)
+        t0 = time.time()
+        false_positives = set()
+        while time.time() - t0 < baseline_s:
+            false_positives.update(firing_alerts())
+            time.sleep(0.5)
+        out["false_positives"] = sorted(false_positives)
+
+        # --- the seeded fault --------------------------------------------
+        script = ChaosScript.parse({
+            "seed": seed,
+            "actions": [{"at": 0.0, "fault": "delay",
+                         "seconds": delay_s, "duration": fault_s}],
+        })
+        fault_at = time.time()
+        chaos = ChaosController(script, proxy=proxy).arm()
+        fired_at = None
+        deadline = fault_at + detect_bound_s + 2.0
+        while time.time() < deadline and fired_at is None:
+            if "reconcile-latency" in firing_alerts():
+                fired_at = time.time()
+            time.sleep(0.2)
+        chaos.join(5.0)
+        out["fired"] = fired_at is not None
+        out["detection_s"] = (round(fired_at - fault_at, 2)
+                              if fired_at else None)
+        out["also_firing"] = [n for n in firing_alerts()
+                              if n != "reconcile-latency"]
+        if fired_at is None:
+            out["error"] = "alert never fired"
+            return out
+        alert = direct.get("Alert", "monitoring", "reconcile-latency")
+        out["window"] = alert.status.window
+        out["burn"] = alert.status.burn
+        bundle = alert.status.incident
+        out["bundle_ok"] = bool(bundle and os.path.exists(bundle))
+
+        # --- heal: the alert must clear --------------------------------
+        heal_at = fault_at + fault_s
+        resolved_at = None
+        deadline = heal_at + clear_bound_s + 10.0
+        while time.time() < deadline and resolved_at is None:
+            if "reconcile-latency" not in firing_alerts():
+                a = direct.get("Alert", "monitoring", "reconcile-latency")
+                if a.status.state == "Resolved":
+                    resolved_at = time.time()
+                    break
+            time.sleep(0.25)
+        out["resolved"] = resolved_at is not None
+        out["clear_s"] = (round(resolved_at - heal_at, 2)
+                          if resolved_at else None)
+
+        # --- ctl renders the incident (bundle linked) rc=0 --------------
+        trace.TRACER.flush()
+        old_inc = os.environ.get("TPUJOB_INCIDENT_DIR")
+        os.environ["TPUJOB_INCIDENT_DIR"] = incident_dir
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = ctl.main(["--store", store_url, "trace",
+                               "--last-incident", "--trace-dir", trace_dir])
+        finally:
+            if old_inc is None:
+                os.environ.pop("TPUJOB_INCIDENT_DIR", None)
+            else:
+                os.environ["TPUJOB_INCIDENT_DIR"] = old_inc
+        out["ctl_trace_rc"] = rc
+        out["ctl_trace_links_bundle"] = "incident bundle:" in buf.getvalue()
+        out["jobs_submitted"] = submitted[0]
+        out["ok"] = bool(
+            not false_positives
+            and out["fired"]
+            and out["detection_s"] <= detect_bound_s
+            and out["bundle_ok"]
+            and out["resolved"]
+            and out["clear_s"] <= clear_bound_s
+            and rc == 0
+            and out["ctl_trace_links_bundle"]
+        )
+        return out
+    finally:
+        stop.set()
+        if monitor is not None:
+            monitor.stop()
+        if controller is not None:
+            controller.stop()
+        if cache is not None:
+            cache.stop()
+        if ops is not None:
+            ops.stop()
+        for c in clients:
+            c.close()
+        if proxy is not None:
+            proxy.stop()
+        for proc in (fleet_proc, store_proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        trace.TRACER.disable()
+        if os.environ.get("BENCH_CP_SLO_KEEP"):
+            print(f"slo dir kept: {tmp}", file=sys.stderr)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_fanout_mode() -> dict:
     """The O(events) fan-out proof (BENCH_CP_MODES=fanout): a fixed event
     stream delivered to 10 vs ``BENCH_CP_FANOUT_WATCHERS`` (default 500)
@@ -1637,6 +1996,26 @@ def main() -> None:
             }
         elif mode == "serve":
             r = run_serve_mode()
+        elif mode == "slo":
+            # TWO detection runs on ONE seed (chaos determinism) + the
+            # monitor-overhead A/B, one verdict (ISSUE 13 acceptance)
+            seed = int(os.environ.get("BENCH_CP_SLO_SEED", "1307"))
+            overhead = run_slo_overhead(
+                int(os.environ.get("BENCH_CP_SLO_OVERHEAD_JOBS", "100")),
+                4, 2)
+            runs = [
+                run_slo_detection(seed)
+                for _ in range(int(os.environ.get("BENCH_CP_SLO_RUNS",
+                                                  "2")))
+            ]
+            r = {
+                "metric": "controlplane_slo",
+                "seed": seed,
+                "overhead": overhead,
+                "runs": runs,
+                "ok": bool(overhead["overhead_ok"]
+                           and all(x.get("ok") for x in runs)),
+            }
         elif mode == "fanout":
             r = run_fanout_mode()
         else:

@@ -203,6 +203,19 @@ RULES: Dict[str, Rule] = {
             "same function the loop span closes in",
         ),
         Rule(
+            "OBS003", "error",
+            "metric registered without HELP, or SLO objective on an "
+            "unknown metric family",
+            "ISSUE 13: the SLO monitor validates objectives against the "
+            "registry catalog and `ctl top`/dashboards render HELP text — "
+            "a counter/gauge/histogram registered with empty HELP is "
+            "unreadable at triage time, and an Objective(...) naming a "
+            "family the registry never registers would silently watch "
+            "nothing (the config loader fails closed at runtime; this "
+            "catches it at diff time)",
+            scope="all",
+        ),
+        Rule(
             "REP001", "error",
             "direct store write on a follower/standby handle",
             "ISSUE 8: every mutation routes through the leased leader "
@@ -610,6 +623,94 @@ def _check_obs001(ctx: _FileCtx, call: ast.Call,
     )
 
 
+# OBS003: metric registration + SLO-objective hygiene. The catalog is
+# parsed (AST, never imported) from the canonical registry module next to
+# this package, so lint stays side-effect free; registrations made in the
+# linted file itself also count (fixtures and future modules registering
+# their own families).
+_REGISTRY_COMPONENTS = ("REGISTRY", "registry")
+_METRIC_REG_VERBS = {"counter", "gauge", "histogram"}
+_CATALOG_CACHE: Optional[Set[str]] = None
+
+
+def _collect_registrations(tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_REG_VERBS
+            and _last_component(_dotted(node.func.value))
+            in _REGISTRY_COMPONENTS
+        ):
+            name = _const(node.args[0]) if node.args else None
+            if isinstance(name, str):
+                out.add(name)
+    return out
+
+
+def _registry_catalog() -> Optional[Set[str]]:
+    """Family names the canonical registry (opshell/metrics.py) registers,
+    AST-parsed once per process. None when the module cannot be found/
+    parsed — the Objective half of OBS003 then stands down rather than
+    false-firing on every objective."""
+    global _CATALOG_CACHE
+    if _CATALOG_CACHE is not None:
+        return _CATALOG_CACHE
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opshell", "metrics.py",
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    _CATALOG_CACHE = _collect_registrations(tree)
+    return _CATALOG_CACHE
+
+
+def _check_obs003(ctx: _FileCtx, call: ast.Call,
+                  file_catalog: Set[str]) -> None:
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr in _METRIC_REG_VERBS
+        and _last_component(_dotted(f.value)) in _REGISTRY_COMPONENTS
+    ):
+        name = _const(call.args[0]) if call.args else None
+        help_arg = call.args[1] if len(call.args) > 1 else (
+            _kwarg(call, "help_") or _kwarg(call, "help")
+        )
+        help_const = _const(help_arg)
+        if help_arg is None or (isinstance(help_const, str)
+                                and not help_const.strip()):
+            ctx.report(
+                "OBS003", call,
+                f"{f.attr} {name or '?'!r} registered without non-empty "
+                f"HELP text — the exposition's HELP line is what `ctl "
+                f"top` and dashboards render at triage time",
+            )
+        return
+    if isinstance(f, ast.Name) and f.id == "Objective":
+        metric = _const(_kwarg(call, "metric"))
+        if metric is None and len(call.args) > 1:
+            metric = _const(call.args[1])
+        if not isinstance(metric, str):
+            return
+        catalog = _registry_catalog()
+        if catalog is None:
+            return
+        if metric not in catalog and metric not in file_catalog:
+            ctx.report(
+                "OBS003", call,
+                f"SLO objective references metric family {metric!r} "
+                f"absent from the registry catalog — it would silently "
+                f"watch nothing (the config loader fails closed on this "
+                f"at runtime)",
+            )
+
+
 # span names that mark a CONTROLLER LOOP (the per-pass work of a
 # level-triggered reconciler): these are the latencies PERF tracks and the
 # SLO tripwires read, so their span-close function must observe a histogram
@@ -841,6 +942,10 @@ def lint_source(
         _check_term001(ctx, fn)
     _check_obs002(ctx, tree)
 
+    # pre-pass for OBS003: families this file registers itself count
+    # toward the catalog (a module may register and reference its own)
+    file_catalog = _collect_registrations(tree)
+
     # pre-pass for OBS001: the set of Call nodes that ARE a with item's
     # context expression (the blessed span shape)
     with_context_calls: Set[int] = set()
@@ -867,6 +972,7 @@ def lint_source(
             _check_dur001(ctx, node, fn_stack)
             _check_rep001(ctx, node, fn_stack)
             _check_obs001(ctx, node, with_context_calls)
+            _check_obs003(ctx, node, file_catalog)
             if lock_depth > 0:
                 _check_lck001(ctx, node)
         if isinstance(node, ast.ExceptHandler):

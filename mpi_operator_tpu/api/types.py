@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 API_VERSION = "tpujob.dev/v1"
 KIND_TPUJOB = "TPUJob"
 KIND_TPUSERVE = "TPUServe"
+KIND_ALERT = "Alert"
 
 # Per-family host geometry: the block of the chip mesh owned by one host.
 # This is physical knowledge the whole stack shares (defaulting, validation,
@@ -815,3 +816,107 @@ class TPUServe(_Dictable):
 
     def pod_name(self, replica_id: int, index: int) -> str:
         return f"{self.gang_name(replica_id)}-w{index}"
+
+
+# ---------------------------------------------------------------------------
+# Alert: the SLO plane's watchable firing state (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+# alerts live in one well-known namespace (like Nodes' pseudo-namespace):
+# they are cluster-scoped monitoring state, not tenant objects
+ALERT_NAMESPACE = "monitoring"
+
+
+class AlertState:
+    """Alert lifecycle: Firing → Resolved → (a later breach re-fires the
+    SAME object, bumping fired_count). There is no terminal state — an
+    alert object is the durable history of one objective's breaches."""
+
+    FIRING = "Firing"
+    RESOLVED = "Resolved"
+
+    ALL_VALUES = (FIRING, RESOLVED)
+
+
+@dataclass
+class AlertSpec(_Dictable):
+    """What the alert is ABOUT — a copy of the objective's identity at
+    fire time, so `ctl alerts` renders without the SLO config in hand
+    (and an alert outlives a config edit that renamed its objective)."""
+
+    objective: str = ""
+    metric: str = ""
+    severity: str = "page"   # page | ticket
+    description: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AlertSpec":
+        return AlertSpec(
+            objective=d.get("objective", ""),
+            metric=d.get("metric", ""),
+            severity=d.get("severity", "page"),
+            description=d.get("description", ""),
+        )
+
+
+@dataclass
+class AlertStatus(_Dictable):
+    """The monitor's view of the breach. Written ONLY via uid-pinned
+    status-subresource patches (a recreated same-name alert can never
+    absorb a stale monitor's transition — the UID001 discipline)."""
+
+    state: str = AlertState.FIRING
+    # which burn-rate window pair tripped ("fast" pages on sudden total
+    # breaches, "slow" on sustained budget bleed — SRE-workbook shape)
+    window: str = ""
+    # worst burn rate observed while firing (budget-multiples/s spend)
+    burn: float = 0.0
+    since: Optional[float] = None
+    resolved_at: Optional[float] = None
+    message: str = ""
+    # total number of firings this objective has had (a resolve+refire
+    # increments; the flap/recurrence signal `ctl alerts` sorts by)
+    fired_count: int = 0
+    # the flight-recorder bundle dumped when this firing began — the
+    # path `ctl trace --last-incident` links
+    incident: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AlertStatus":
+        return AlertStatus(
+            state=d.get("state", AlertState.FIRING),
+            window=d.get("window", ""),
+            burn=d.get("burn", 0.0),
+            since=d.get("since"),
+            resolved_at=d.get("resolved_at"),
+            message=d.get("message", ""),
+            fired_count=d.get("fired_count", 0),
+            incident=d.get("incident", ""),
+        )
+
+
+@dataclass
+class Alert(_Dictable):
+    """A firing/resolved SLO breach, as a first-class watchable store
+    object: informers cache it, `ctl alerts` lists it, the watch stream
+    carries its transitions, and the firing write is trace-stamped so
+    `ctl trace --last-incident` reconstructs what the monitor saw."""
+
+    api_version: str = API_VERSION
+    kind: str = KIND_ALERT
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: AlertSpec = field(default_factory=AlertSpec)
+    status: AlertStatus = field(default_factory=AlertStatus)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Alert":
+        return Alert(
+            api_version=d.get("api_version", d.get("apiVersion", API_VERSION)),
+            kind=d.get("kind", KIND_ALERT),
+            metadata=ObjectMeta.from_dict(d.get("metadata", {})),
+            spec=AlertSpec.from_dict(d.get("spec", {})),
+            status=AlertStatus.from_dict(d.get("status", {})),
+        )
+
+    def is_firing(self) -> bool:
+        return self.status.state == AlertState.FIRING

@@ -1,0 +1,1188 @@
+"""The SLO plane: declarative objectives, multi-window burn-rate alerting,
+and an incident flight recorder (ISSUE 13).
+
+Before this round every p99 SLO in the repo lived only as an offline
+tripwire inside ``bench_controlplane.py``, evaluated once at bench exit: a
+live cluster whose reconcile p99 blew past 1 s told nobody until a human
+ran ``ctl trace``. This module promotes those objectives to a runtime
+alerting plane:
+
+- **One source of SLO truth.** :func:`load_slo_config` reads the same
+  declarative config file (``slo_defaults.json``) the bench tripwires
+  load, and **fails closed**: an objective naming a metric family absent
+  from the registry catalog, a non-histogram family under a latency
+  objective, a threshold <= 0, a malformed window pair, or an unknown key
+  is a load-time :class:`SLOConfigError` — never a silently-ignored
+  objective. The bench's historical env override knobs
+  (``BENCH_CP_SLO_*``) are preserved via each entry's ``env`` field.
+- **SRE-workbook multi-window burn rates.** Each objective reduces to an
+  error fraction per window (latency histograms: observations above the
+  good-event bucket; gauges: scrapes above the bound); burn rate = error
+  fraction / error budget. The alert fires when BOTH windows of a pair
+  breach — fast (5m & 1h at 14.4x) pages on sudden total breaches, slow
+  (30m & 6h at 6x) on sustained budget bleed — and clears only after
+  every window WITH data burns below its pair's fire threshold
+  continuously for the clean hold (hysteresis: a boundary-oscillating
+  series cannot flap the alert). The decision core (:func:`step`) is a
+  PURE function, property-swept by the test suite.
+- **Alerts are store objects.** A firing writes a watchable ``Alert``
+  (kind registered in serialize/cache) in the ``monitoring`` namespace;
+  transitions are uid-pinned status-subresource patches and each firing
+  is trace-stamped (``slo.alert`` span), so informers, ``ctl alerts``,
+  and ``ctl trace --last-incident`` all see the same state.
+- **Flight recorder.** Each firing dumps an incident bundle — recent
+  trace spans, replica status, fair-queue/tenant counters, the last N
+  watch events the monitor observed, and a scrape snapshot — under the
+  incident dir; ``ctl trace --last-incident`` links it.
+
+Runs leader-only inside the operator (``tpu-operator``), or standalone:
+
+  python -m mpi_operator_tpu.controller.slo_monitor \\
+      --store http://store:8475 \\
+      --scrape-targets op=http://op:8080/metrics,s0=http://s0:9090/metrics
+
+``--smoke`` is the <30s verify-gate check: a live 3-process wire replica
+set is scraped for real while a synthetic breach is driven through the
+local registry; the breach must fire (alert visible in the replicated
+store) and clear.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from mpi_operator_tpu.api.types import (
+    ALERT_NAMESPACE,
+    Alert,
+    AlertSpec,
+    AlertState,
+    AlertStatus,
+    ObjectMeta,
+)
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.store import AlreadyExists
+from mpi_operator_tpu.machinery.telemetry import (
+    INSTANCE_LABEL,
+    MetricsScraper,
+    ScrapeTarget,
+    SeriesRing,
+    parse_scrape_targets,
+)
+from mpi_operator_tpu.opshell import metrics as _metrics
+
+log = logging.getLogger("tpujob.slo")
+
+DEFAULT_CONFIG_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "slo_defaults.json"
+)
+ENV_SLO_CONFIG = "TPUJOB_SLO_CONFIG"
+ENV_INCIDENT_DIR = "TPUJOB_INCIDENT_DIR"
+
+# the four burn windows, in evaluation order (fast pair checked first, so
+# a breach that trips both pairs is attributed to the FASTER detector)
+WINDOW_KEYS = ("fast_short", "fast_long", "slow_short", "slow_long")
+
+
+class SLOConfigError(ValueError):
+    """A malformed SLO config — the loader's one failure mode. Fails the
+    process at startup: a typo'd objective silently watching nothing
+    would make every 'SLOs green' claim a lie."""
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative SLO. ``kind`` is 'latency' (histogram family +
+    good-event bound + good-fraction target) or 'gauge_max' (gauge family
+    + hard bound + in-bounds-fraction target)."""
+
+    name: str
+    metric: str
+    kind: str                      # "latency" | "gauge_max"
+    objective: float               # good-event fraction target (0, 1)
+    threshold_s: float = 0.0       # latency: the good-event bound
+    bound: float = 0.0             # gauge_max: the in-bounds ceiling
+    quantile: float = 0.99         # the bench tripwire's percentile
+    severity: str = "page"
+    env: str = ""                  # the bench's historical override knob
+    description: str = ""
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    @property
+    def threshold_ms(self) -> float:
+        return self.threshold_s * 1e3
+
+
+@dataclass(frozen=True)
+class BurnPolicy:
+    """The multi-window pairs + thresholds (SRE workbook ch.5 defaults)
+    and the clear hysteresis. ``scaled`` compresses every window for
+    benches/smokes whose whole life is seconds."""
+
+    fast: Tuple[float, float] = (300.0, 3600.0)
+    slow: Tuple[float, float] = (1800.0, 21600.0)
+    burn_fast: float = 14.4
+    burn_slow: float = 6.0
+    clear_hold_s: float = 300.0
+
+    def windows(self) -> Dict[str, float]:
+        return {
+            "fast_short": self.fast[0], "fast_long": self.fast[1],
+            "slow_short": self.slow[0], "slow_long": self.slow[1],
+        }
+
+    def scaled(self, scale: float) -> "BurnPolicy":
+        if scale <= 0:
+            raise SLOConfigError(f"window scale must be > 0, got {scale}")
+        return replace(
+            self,
+            fast=(self.fast[0] * scale, self.fast[1] * scale),
+            slow=(self.slow[0] * scale, self.slow[1] * scale),
+            clear_hold_s=self.clear_hold_s * scale,
+        )
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    objectives: Tuple[Objective, ...]
+    policy: BurnPolicy
+    path: str = ""
+
+    def objective(self, name: str) -> Objective:
+        for o in self.objectives:
+            if o.name == name:
+                return o
+        raise KeyError(f"no SLO objective named {name!r}")
+
+    def threshold_ms(self, name: str, *, scale: float = 1.0,
+                     env: Optional[Mapping[str, str]] = None) -> float:
+        """The bench-tripwire read: objective's latency bound in ms with
+        the env override applied LAST (so a deployment knob beats both
+        the file and any bench scaling) — the single-source-of-truth
+        contract between bench and monitor."""
+        o = self.objective(name)
+        base = (o.threshold_ms if o.kind == "latency" else o.bound) * scale
+        env = os.environ if env is None else env
+        if o.env and env.get(o.env):
+            return float(env[o.env])
+        return base
+
+    def scaled(self, scale: float) -> "SLOConfig":
+        return replace(self, policy=self.policy.scaled(scale))
+
+
+_OBJECTIVE_KEYS = {
+    "name", "metric", "kind", "objective", "threshold_ms", "bound",
+    "quantile", "severity", "env", "description",
+}
+_TOP_KEYS = {"_comment", "windows", "burn", "clear_hold_s", "objectives"}
+
+
+def _window_pair(raw: Any, which: str) -> Tuple[float, float]:
+    if (not isinstance(raw, (list, tuple)) or len(raw) != 2
+            or not all(isinstance(v, (int, float)) for v in raw)):
+        raise SLOConfigError(
+            f"windows.{which} must be [short_s, long_s], got {raw!r}")
+    short, long_ = float(raw[0]), float(raw[1])
+    if short <= 0 or long_ <= 0 or short >= long_:
+        raise SLOConfigError(
+            f"windows.{which}: need 0 < short < long, got {raw!r}")
+    return (short, long_)
+
+
+def load_slo_config(
+    path: Optional[str] = None, *,
+    registry: "_metrics.Registry" = _metrics.REGISTRY,
+    env: Optional[Mapping[str, str]] = None,
+    window_scale: float = 1.0,
+) -> SLOConfig:
+    """Load + validate the SLO config, FAIL CLOSED on anything off:
+    unknown top-level/objective keys, objectives naming metric families
+    absent from the registry catalog, kind/instrument mismatches, bad
+    thresholds or targets, malformed/inverted window pairs, duplicate
+    names. Env overrides (each entry's ``env`` knob) apply to thresholds
+    at load, so monitor and bench read identical numbers."""
+    env = os.environ if env is None else env
+    path = path or env.get(ENV_SLO_CONFIG) or DEFAULT_CONFIG_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise SLOConfigError(f"cannot read SLO config {path}: {e}") from None
+    except ValueError as e:
+        raise SLOConfigError(f"SLO config {path} is not JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise SLOConfigError(f"SLO config {path}: top level must be an object")
+    unknown = set(doc) - _TOP_KEYS
+    if unknown:
+        raise SLOConfigError(
+            f"SLO config {path}: unknown top-level keys {sorted(unknown)}")
+
+    windows = doc.get("windows", {})
+    if not isinstance(windows, dict) or set(windows) - {"fast", "slow"}:
+        raise SLOConfigError(
+            f"SLO config {path}: 'windows' must be "
+            f"{{'fast': [s,l], 'slow': [s,l]}}")
+    burn = doc.get("burn", {})
+    if not isinstance(burn, dict) or set(burn) - {"fast", "slow"}:
+        raise SLOConfigError(f"SLO config {path}: 'burn' must be "
+                             f"{{'fast': x, 'slow': y}}")
+    policy = BurnPolicy()
+    if "fast" in windows:
+        policy = replace(policy, fast=_window_pair(windows["fast"], "fast"))
+    if "slow" in windows:
+        policy = replace(policy, slow=_window_pair(windows["slow"], "slow"))
+    for which in ("fast", "slow"):
+        if which in burn:
+            v = burn[which]
+            if not isinstance(v, (int, float)) or v <= 0:
+                raise SLOConfigError(
+                    f"SLO config {path}: burn.{which} must be > 0, got {v!r}")
+            policy = replace(policy, **{f"burn_{which}": float(v)})
+    hold = doc.get("clear_hold_s", policy.clear_hold_s)
+    if not isinstance(hold, (int, float)) or hold < 0:
+        raise SLOConfigError(
+            f"SLO config {path}: clear_hold_s must be >= 0, got {hold!r}")
+    policy = replace(policy, clear_hold_s=float(hold))
+
+    raw_objs = doc.get("objectives")
+    if not isinstance(raw_objs, list) or not raw_objs:
+        raise SLOConfigError(
+            f"SLO config {path}: 'objectives' must be a non-empty list")
+    catalog = set(registry.names())
+    objectives: List[Objective] = []
+    seen = set()
+    for i, o in enumerate(raw_objs):
+        where = f"SLO config {path}: objectives[{i}]"
+        if not isinstance(o, dict):
+            raise SLOConfigError(f"{where}: must be an object")
+        unknown = set(o) - _OBJECTIVE_KEYS
+        if unknown:
+            raise SLOConfigError(f"{where}: unknown keys {sorted(unknown)}")
+        name = o.get("name")
+        metric = o.get("metric")
+        kind = o.get("kind")
+        if not name or not isinstance(name, str):
+            raise SLOConfigError(f"{where}: 'name' is required")
+        if name in seen:
+            raise SLOConfigError(f"{where}: duplicate objective {name!r}")
+        seen.add(name)
+        if not metric or not isinstance(metric, str):
+            raise SLOConfigError(f"{where} ({name}): 'metric' is required")
+        if metric not in catalog:
+            raise SLOConfigError(
+                f"{where} ({name}): metric {metric!r} is not in the "
+                f"registry catalog — an objective on an unregistered "
+                f"family would silently watch nothing (oplint OBS003 "
+                f"catches this at diff time)")
+        inst_kind = registry.kind_of(metric)
+        if kind == "latency":
+            if inst_kind != "histogram":
+                raise SLOConfigError(
+                    f"{where} ({name}): latency objectives need a "
+                    f"histogram family; {metric} is a {inst_kind}")
+            thr = o.get("threshold_ms")
+            if not isinstance(thr, (int, float)) or thr <= 0:
+                raise SLOConfigError(
+                    f"{where} ({name}): threshold_ms must be > 0, "
+                    f"got {thr!r}")
+        elif kind == "gauge_max":
+            if inst_kind != "gauge":
+                raise SLOConfigError(
+                    f"{where} ({name}): gauge_max objectives need a "
+                    f"gauge family; {metric} is a {inst_kind}")
+            bnd = o.get("bound")
+            if not isinstance(bnd, (int, float)) or bnd <= 0:
+                raise SLOConfigError(
+                    f"{where} ({name}): bound must be > 0, got {bnd!r}")
+        else:
+            raise SLOConfigError(
+                f"{where} ({name}): unknown kind {kind!r} "
+                f"(latency | gauge_max)")
+        target = o.get("objective")
+        if not isinstance(target, (int, float)) or not 0.0 < target < 1.0:
+            raise SLOConfigError(
+                f"{where} ({name}): 'objective' must be in (0, 1), "
+                f"got {target!r}")
+        q = o.get("quantile", 0.99)
+        if not isinstance(q, (int, float)) or not 0.0 < q < 1.0:
+            raise SLOConfigError(
+                f"{where} ({name}): 'quantile' must be in (0, 1)")
+        sev = o.get("severity", "page")
+        if sev not in ("page", "ticket"):
+            raise SLOConfigError(
+                f"{where} ({name}): severity must be page|ticket, got {sev!r}")
+        thr_ms = float(o.get("threshold_ms") or 0.0)
+        bound = float(o.get("bound") or 0.0)
+        env_key = o.get("env") or ""
+        # env override: a deployment's exported knob beats the file value
+        # for BOTH the monitor and the bench tripwire (same loader)
+        if env_key and env.get(env_key):
+            try:
+                v = float(env[env_key])
+            except ValueError:
+                raise SLOConfigError(
+                    f"{where} ({name}): env override {env_key}="
+                    f"{env[env_key]!r} is not a number") from None
+            if v <= 0:
+                raise SLOConfigError(
+                    f"{where} ({name}): env override {env_key} must be > 0")
+            if kind == "latency":
+                thr_ms = v
+            else:
+                bound = v
+        objectives.append(Objective(
+            name=name, metric=metric, kind=kind, objective=float(target),
+            threshold_s=thr_ms / 1e3, bound=bound, quantile=float(q),
+            severity=sev, env=env_key, description=o.get("description", ""),
+        ))
+    cfg = SLOConfig(tuple(objectives), policy, path=path)
+    return cfg.scaled(window_scale) if window_scale != 1.0 else cfg
+
+
+# ---------------------------------------------------------------------------
+# the pure burn-rate core (property-swept; no clocks, no I/O)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Probe:
+    """One objective's alert state between ticks — immutable, so the
+    decision core stays a pure (state, inputs) -> (state, event) map."""
+
+    firing: bool = False
+    window: str = ""               # "fast" | "slow" while firing
+    since: float = 0.0
+    worst_burn: float = 0.0
+    clean_since: Optional[float] = None
+    fired_count: int = 0
+
+
+FIRE = "fire"
+RESOLVE = "resolve"
+
+
+def burn_rates(error_fractions: Mapping[str, Optional[float]],
+               budget: float) -> Dict[str, Optional[float]]:
+    """error fraction per window -> budget-burn multiple per window
+    (None = no data in that window, which never breaches)."""
+    b = max(1e-9, budget)
+    return {
+        k: (None if v is None else v / b)
+        for k, v in error_fractions.items()
+    }
+
+
+def step(state: Probe, burns: Mapping[str, Optional[float]],
+         policy: BurnPolicy, now: float) -> Tuple[Probe, Optional[str]]:
+    """One evaluation tick of the multi-window burn-rate machine.
+
+    Fire: BOTH windows of a pair exceed the pair's burn threshold (fast
+    checked first — a breach tripping both is attributed to the faster
+    detector). A single-sample blip cannot fire: the long window of the
+    pair must agree, which is the multi-window design's whole point.
+
+    Clear: while firing, every window that HAS data must burn below its
+    pair's fire threshold, continuously for ``clear_hold_s`` — the clean
+    hold is the hysteresis: a series oscillating across the fire
+    threshold re-arms the hold on every suspect tick, so the alert stays
+    FIRING through the flap instead of paging on every crossing; and
+    since clearing itself consumed a clean window, a cleared alert can
+    only re-fire after one. Data gaps are judged asymmetrically: a
+    window with NO data never *fires* (a dead workload emits nothing),
+    but while firing, an all-silent tick HOLDS state rather than
+    progressing the clean hold — zero completions mid-incident usually
+    means things are stalled, not healed (the bench's injected-latency
+    fault makes short windows gap exactly this way)."""
+
+    def pair_breach(short: str, long_: str, thr: float) -> bool:
+        s, l = burns.get(short), burns.get(long_)
+        return s is not None and l is not None and s > thr and l > thr
+
+    def any_hot(short: str, long_: str, thr: float) -> bool:
+        return any(
+            b is not None and b > thr
+            for b in (burns.get(short), burns.get(long_))
+        )
+
+    breach_fast = pair_breach("fast_short", "fast_long", policy.burn_fast)
+    breach_slow = pair_breach("slow_short", "slow_long", policy.burn_slow)
+    observed = [b for b in burns.values() if b is not None]
+    worst = max(observed) if observed else 0.0
+
+    if not state.firing:
+        if breach_fast or breach_slow:
+            return Probe(
+                firing=True,
+                window="fast" if breach_fast else "slow",
+                since=now,
+                worst_burn=worst,
+                clean_since=None,
+                fired_count=state.fired_count + 1,
+            ), FIRE
+        return replace(state, worst_burn=worst, clean_since=None), None
+
+    # firing: track the worst burn, wait for the clean hold
+    worst = max(worst, state.worst_burn)
+    suspect = (any_hot("fast_short", "fast_long", policy.burn_fast)
+               or any_hot("slow_short", "slow_long", policy.burn_slow))
+    if suspect:
+        return replace(state, worst_burn=worst, clean_since=None), None
+    if not observed:
+        # all windows silent: indeterminate — neither clean progress nor
+        # a reset (the clean hold resumes where it was once data returns)
+        return replace(state, worst_burn=worst), None
+    clean_since = state.clean_since if state.clean_since is not None else now
+    if now - clean_since >= policy.clear_hold_s:
+        return replace(
+            state, firing=False, clean_since=None, worst_burn=worst,
+        ), RESOLVE
+    return replace(state, worst_burn=worst, clean_since=clean_since), None
+
+
+def error_fractions(ring: SeriesRing, obj: Objective, policy: BurnPolicy,
+                    now: float, **labels: str) -> Dict[str, Optional[float]]:
+    """Per-window error fractions for one objective out of the scraped
+    ring — the impure half the pure core consumes. Latency: fraction of
+    window observations above the good-event bucket. Gauge: the WORST
+    matching series' fraction of in-window scrapes above the bound."""
+    out: Dict[str, Optional[float]] = {}
+    for key, window in policy.windows().items():
+        if obj.kind == "latency":
+            out[key] = ring.error_fraction(
+                obj.metric, obj.threshold_s, window, now, **labels)
+        else:
+            worst: Optional[float] = None
+            for _, vals in ring.window_values(obj.metric, window, now,
+                                              **labels):
+                frac = sum(1 for v in vals if v > obj.bound) / len(vals)
+                worst = frac if worst is None else max(worst, frac)
+            out[key] = worst
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Dumps the incident bundle a firing alert triggers: enough context
+    to start triage without a live cluster — recent trace spans, replica
+    status, fair-queue/tenant counters, the last N watch events the
+    monitor observed, and the scrape-health snapshot. One JSON file per
+    firing under ``dir``; ``ctl trace --last-incident`` links the newest."""
+
+    SPAN_TAIL = 200
+    EVENT_TAIL = 50
+
+    def __init__(self, dir: str):
+        self.dir = dir
+
+    @staticmethod
+    def newest_bundle(dir: str) -> Optional[str]:
+        try:
+            names = [n for n in os.listdir(dir)
+                     if n.startswith("incident-") and n.endswith(".json")]
+        except OSError:
+            return None
+        if not names:
+            return None
+        return os.path.join(dir, max(names))
+
+    def dump(self, *, alert: Alert, burns: Mapping[str, Optional[float]],
+             scraper: Optional[MetricsScraper], store: Any,
+             watch_tail: Optional[List[Dict[str, Any]]] = None,
+             now: Optional[float] = None) -> Optional[str]:
+        now = time.time() if now is None else now
+        bundle: Dict[str, Any] = {
+            "version": 1,
+            "at": now,
+            "objective": alert.spec.objective,
+            "alert": alert.to_dict(),
+            "burns": {k: v for k, v in burns.items() if v is not None},
+        }
+        if scraper is not None:
+            bundle["scrape"] = {
+                "targets": [{"instance": t.instance, "url": t.url}
+                            for t in scraper.targets],
+                "errors": {k: v for k, v in scraper.last_error.items() if v},
+                "series": scraper.ring.series_count(),
+                "tenant_queued": [
+                    {"labels": lbl, "value": v}
+                    for lbl, _, v in scraper.ring.latest(
+                        "tpu_operator_store_tenant_queued_total")
+                ],
+                "tenant_rejected": [
+                    {"labels": lbl, "value": v}
+                    for lbl, _, v in scraper.ring.latest(
+                        "tpu_operator_store_tenant_rejected_total")
+                ],
+            }
+        if watch_tail:
+            bundle["watch_events"] = watch_tail[-self.EVENT_TAIL:]
+        # recent spans: the in-process ring plus (when exporting) the
+        # merged on-disk tail — the causal neighborhood of the breach
+        spans = trace.TRACER.ring()
+        if trace.TRACER._dir:
+            try:
+                spans = trace.load_spans(trace.TRACER._dir)
+            except OSError:
+                log.debug("span merge for bundle failed", exc_info=True)
+        bundle["spans"] = spans[-self.SPAN_TAIL:]
+        if store is not None:
+            status_fn = getattr(store, "replica_status", None)
+            if callable(status_fn):
+                try:
+                    bundle["replica_status"] = status_fn()
+                except Exception as e:
+                    log.debug("bundle replica status failed", exc_info=True)
+                    bundle["replica_status_error"] = str(e)
+            try:
+                evs = store.list("Event")
+                evs.sort(key=lambda e: e.timestamp)
+                bundle["events"] = [
+                    {"age_s": round(now - e.timestamp, 1), "type": e.type,
+                     "reason": e.reason,
+                     "involved": f"{e.involved.kind}/"
+                                 f"{e.involved.namespace}/{e.involved.name}",
+                     "message": e.message}
+                    for e in evs[-self.EVENT_TAIL:]
+                ]
+            except Exception as e:
+                log.debug("bundle event tail failed", exc_info=True)
+                bundle["events_error"] = str(e)
+        name = (f"incident-{time.strftime('%Y%m%d-%H%M%S', time.gmtime(now))}"
+                f"-{alert.spec.objective}.json")
+        path = os.path.join(self.dir, name)
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=1, default=str)
+            os.replace(tmp, path)  # readers never see a torn bundle
+        except OSError:
+            # a full disk must not take the alerting plane down with it
+            log.warning("flight recorder dump failed", exc_info=True)
+            return None
+        return path
+
+
+# ---------------------------------------------------------------------------
+# the monitor shell
+# ---------------------------------------------------------------------------
+
+
+class SLOMonitor:
+    """Scrape → evaluate → alert, one pass per ``interval``. Writes Alert
+    objects into the store (leader-only when embedded in the operator:
+    two monitors racing would flap each other's uid-pinned patches)."""
+
+    def __init__(self, store: Any, targets: List[ScrapeTarget],
+                 config: SLOConfig, *, interval: float = 15.0,
+                 scrape_timeout: float = 5.0,
+                 incident_dir: Optional[str] = None,
+                 watch_tail: int = 64,
+                 ring: Optional[SeriesRing] = None):
+        self.store = store
+        self.config = config
+        self.interval = interval
+        if ring is None:
+            # the ring must hold the LONGEST burn window's worth of
+            # scrapes or the slow pair silently evaluates a truncated
+            # window (at the 15s default the 6h slow_long needs ~1440
+            # samples — the 512 default would quietly judge ~2.1h)
+            need = int(max(config.policy.slow[1], config.policy.fast[1])
+                       / max(1e-6, interval)) + 8
+            ring = SeriesRing(capacity=max(512, need))
+        self.scraper = MetricsScraper(
+            targets, ring=ring, interval=interval, timeout=scrape_timeout)
+        d = incident_dir or os.environ.get(ENV_INCIDENT_DIR)
+        if not d and os.environ.get(trace.ENV_TRACE_DIR):
+            d = os.path.join(os.environ[trace.ENV_TRACE_DIR], "incidents")
+        self.recorder = FlightRecorder(d) if d else None
+        self.states: Dict[str, Probe] = {
+            o.name: Probe() for o in config.objectives
+        }
+        # objective → alert state last successfully WRITTEN to the store
+        # ("Firing"/"Resolved"); a write that failed (store failing over
+        # — exactly when alerts matter most) leaves this stale and the
+        # next tick retries until store and monitor agree
+        self._written: Dict[str, str] = {}
+        # objective → (firing since, trace id, bundle path): one slo.alert
+        # span + ONE flight-recorder dump per firing — write RETRIES reuse
+        # them instead of minting a fresh trace and bundle every tick a
+        # downed store refuses the write
+        self._firing_ctx: Dict[str, Tuple[float, str, str]] = {}
+        # objectives whose durable store state has not been adopted yet
+        # (leader-restart continuity); an unreadable alert stays pending
+        # and is retried next tick — a store mid-failover at the new
+        # leader's FIRST tick must not permanently skip adoption
+        self._adopt_pending = {o.name for o in config.objectives}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch_tail: deque = deque(maxlen=watch_tail)
+        self._watch_q = None
+        self._watch_thread: Optional[threading.Thread] = None
+
+    # -- the watch tail (flight-recorder context) ----------------------------
+
+    def _drain_watch(self) -> None:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                ev = self._watch_q.get(timeout=0.25)
+            except _queue.Empty:
+                continue
+            if ev is None:
+                break
+            try:
+                m = ev.obj.metadata
+                self._watch_tail.append({
+                    "t": round(time.time(), 3), "type": ev.type,
+                    "kind": ev.obj.kind, "key": f"{m.namespace}/{m.name}",
+                    "rv": m.resource_version,
+                })
+            # oplint: disable=EXC001 — a malformed event must not kill
+            # the tail thread; the tail is best-effort triage context
+            except Exception:
+                pass
+
+    # -- one pass ------------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Probe]:
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+        with trace.start_span("monitor.sync"):
+            if self._adopt_pending:
+                self._adopt_store_state(now)
+            self.scraper.scrape_once(now)
+            for obj in self.config.objectives:
+                fracs = error_fractions(
+                    self.scraper.ring, obj, self.config.policy, now)
+                burns = burn_rates(fracs, obj.budget)
+                state, event = step(
+                    self.states[obj.name], burns, self.config.policy, now)
+                self.states[obj.name] = state
+                # write-reconciliation, not edge-triggering: a transition
+                # whose store write failed (421 mid-failover, 503) is
+                # retried every tick until the store agrees
+                desired = (AlertState.FIRING if state.firing
+                           else AlertState.RESOLVED if state.fired_count
+                           else None)
+                if event == FIRE:
+                    _metrics.slo_alerts_fired.inc(objective=obj.name)
+                    if self.store is None:
+                        # storeless mode (tpu-monitor --once/no --store):
+                        # evaluate+log only, nothing to reconcile against
+                        log.warning(
+                            "SLO alert FIRING (no store configured): "
+                            "%s burning %.1fx (%s windows)", obj.name,
+                            state.worst_burn, state.window)
+                elif event == RESOLVE and self.store is None:
+                    log.warning("SLO alert resolved (no store "
+                                "configured): %s", obj.name)
+                if desired is not None and self.store is not None \
+                        and self._written.get(obj.name) != desired:
+                    if desired == AlertState.FIRING:
+                        self._fire(obj, state, burns, now)
+                    else:
+                        self._resolve(obj, state, now)
+        _metrics.monitor_tick_latency.observe(time.perf_counter() - t0)
+        return dict(self.states)
+
+    # -- alert writes (uid-pinned status patches) ----------------------------
+
+    def _adopt_store_state(self, now: float) -> None:
+        """Leader failover restarts the monitor with fresh in-memory
+        state; adopt the store's durable Alert objects so (a) an alert
+        the previous leader left Firing resolves when its breach heals
+        instead of sticking forever, and (b) a refire CONTINUES the
+        durable fired_count recurrence record instead of restarting at
+        1. An objective whose alert is UNREADABLE (store mid-failover —
+        precisely when leaders change) stays pending and is retried
+        next tick; once the local probe has evolved on its own, the
+        local state wins (adoption must never clobber live decisions)."""
+        if self.store is None:
+            self._adopt_pending.clear()
+            return
+        for name in sorted(self._adopt_pending):
+            obj = self.config.objective(name)
+            if self.states[name] != Probe():
+                # local evaluation already moved this objective: too
+                # late to adopt without clobbering a live decision
+                self._adopt_pending.discard(name)
+                continue
+            ok, alert = self._get_alert(obj.name)
+            if not ok:
+                log.warning("alert-state adoption: %s unreadable; "
+                            "retrying next tick", obj.name)
+                continue
+            self._adopt_pending.discard(name)
+            if alert is None:
+                continue
+            st = alert.status
+            if alert.is_firing():
+                self.states[obj.name] = Probe(
+                    firing=True, window=st.window or "fast",
+                    since=st.since or now, worst_burn=st.burn,
+                    fired_count=max(1, st.fired_count),
+                )
+                self._written[obj.name] = AlertState.FIRING
+                # retries must not re-dump the previous leader's incident
+                self._firing_ctx[obj.name] = (
+                    st.since or now,
+                    alert.metadata.annotations.get(
+                        trace.ANNOTATION_TRACE_ID, ""),
+                    st.incident,
+                )
+                _metrics.slo_alerts_firing.set(1, objective=obj.name)
+                log.warning("adopted FIRING alert %s from the store "
+                            "(fired_count=%d)", obj.name,
+                            st.fired_count)
+            else:
+                self.states[obj.name] = Probe(
+                    fired_count=max(1, st.fired_count))
+                self._written[obj.name] = AlertState.RESOLVED
+
+    def _fire(self, obj: Objective, state: Probe,
+              burns: Mapping[str, Optional[float]], now: float) -> None:
+        """Write the FIRING state into the store. Retried by tick()'s
+        write-reconciliation until it lands — a store mid-failover (very
+        plausibly the incident itself) must not lose the page. The
+        slo.alert span and the flight-recorder bundle are minted ONCE
+        per firing (keyed by the probe's fire time); retries reuse them."""
+        msg = (f"{obj.metric} burning {state.worst_burn:.1f}x its "
+               f"{obj.budget:.2%} error budget ({state.window} windows)")
+        ctx = self._firing_ctx.get(obj.name)
+        if ctx is None or ctx[0] != state.since:
+            log.warning("SLO alert FIRING: %s — %s", obj.name, msg)
+            preview = self._new_alert(obj)
+            preview.status = AlertStatus(
+                state=AlertState.FIRING, window=state.window,
+                burn=round(state.worst_burn, 3), since=state.since,
+                message=msg, fired_count=state.fired_count,
+            )
+            with trace.start_span(
+                "slo.alert", parent=trace.ROOT,
+                attrs={"objective": obj.name, "window": state.window,
+                       "burn": round(state.worst_burn, 2),
+                       "severity": obj.severity},
+            ) as sp:
+                bundle = ""
+                if self.recorder is not None:
+                    bundle = self.recorder.dump(
+                        alert=preview, burns=burns, scraper=self.scraper,
+                        store=self.store,
+                        watch_tail=list(self._watch_tail), now=now,
+                    ) or ""
+                    sp.set_attr("bundle", bundle)
+                ctx = (state.since, sp.trace_id or "", bundle)
+            self._firing_ctx[obj.name] = ctx
+        _, tid, bundle = ctx
+        status = AlertStatus(
+            state=AlertState.FIRING, window=state.window,
+            burn=round(state.worst_burn, 3), since=state.since,
+            message=msg, fired_count=state.fired_count, incident=bundle,
+        )
+        ok, alert = self._get_alert(obj.name)
+        if not ok:
+            return  # store unreadable: next tick retries
+        if alert is None:
+            obj_new = self._new_alert(obj)
+            obj_new.status = status
+            obj_new.metadata.annotations[trace.ANNOTATION_TRACE_ID] = tid
+            try:
+                self.store.create(obj_new)
+                self._written[obj.name] = AlertState.FIRING
+                _metrics.slo_alerts_firing.set(1, objective=obj.name)
+                return
+            except AlreadyExists:
+                ok, alert = self._get_alert(obj.name)  # raced another fire
+                if not ok or alert is None:
+                    return
+            except Exception as e:
+                # a failing store (possibly the very incident being
+                # alerted) — _written stays stale, next tick retries
+                log.warning("alert create failed (will retry): %s", e)
+                return
+        try:
+            # each firing is its own trace: re-stamp the annotation
+            # (plain patch; identity frozen), then the uid-pinned
+            # status transition
+            self.store.patch(
+                "Alert", ALERT_NAMESPACE, obj.name,
+                {"metadata": {
+                    "uid": alert.metadata.uid,
+                    "annotations": {trace.ANNOTATION_TRACE_ID: tid},
+                }},
+            )
+            status_patch = status.to_dict()
+            # merge-patch null: a refire must CLEAR the previous
+            # resolution stamp (to_dict prunes Nones, so set it
+            # explicitly — json-merge-patch deletes on null)
+            status_patch["resolved_at"] = None
+            self.store.patch(
+                "Alert", ALERT_NAMESPACE, obj.name,
+                {"metadata": {"uid": alert.metadata.uid},
+                 "status": status_patch},
+                subresource="status",
+            )
+            self._written[obj.name] = AlertState.FIRING
+            _metrics.slo_alerts_firing.set(1, objective=obj.name)
+        except Exception as e:
+            log.warning("alert fire patch failed (will retry): %s", e)
+
+    def _resolve(self, obj: Objective, state: Probe, now: float) -> None:
+        ok, alert = self._get_alert(obj.name)
+        if not ok:
+            return  # read failed ≠ alert gone: next tick retries
+        if alert is None:
+            # deleted out from under us: nothing left to resolve, but
+            # the monitor's OWN exports must still drop the firing
+            # (a phantom 1 on the gauge would page forever)
+            self._written[obj.name] = AlertState.RESOLVED
+            self._firing_ctx.pop(obj.name, None)
+            _metrics.slo_alerts_firing.set(0, objective=obj.name)
+            return
+        log.warning("SLO alert resolved: %s (worst burn %.1fx)",
+                    obj.name, state.worst_burn)
+        try:
+            self.store.patch(
+                "Alert", ALERT_NAMESPACE, obj.name,
+                {"metadata": {"uid": alert.metadata.uid},
+                 "status": {"state": AlertState.RESOLVED,
+                            "resolved_at": now,
+                            "message": f"clean for "
+                                       f"{self.config.policy.clear_hold_s:g}s"
+                                       f" after burning "
+                                       f"{state.worst_burn:.1f}x"}},
+                subresource="status",
+            )
+            self._written[obj.name] = AlertState.RESOLVED
+            self._firing_ctx.pop(obj.name, None)
+            _metrics.slo_alerts_firing.set(0, objective=obj.name)
+        except Exception as e:
+            log.warning("alert resolve patch failed (will retry): %s", e)
+
+    def _get_alert(self, name: str) -> Tuple[bool, Optional[Alert]]:
+        """(read_ok, alert). A read FAILURE is not the same claim as
+        'no alert': callers must retry on (False, None), never conclude
+        the alert was deleted (that conclusion once marked a resolve as
+        written and left the store's page stuck Firing forever)."""
+        try:
+            return True, self.store.try_get("Alert", ALERT_NAMESPACE, name)
+        except Exception as e:
+            log.warning("alert read failed: %s", e)
+            return False, None
+
+    def _new_alert(self, obj: Objective) -> Alert:
+        return Alert(
+            metadata=ObjectMeta(name=obj.name, namespace=ALERT_NAMESPACE),
+            spec=AlertSpec(
+                objective=obj.name, metric=obj.metric,
+                severity=obj.severity, description=obj.description,
+            ),
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "SLOMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        if self.store is not None and self._watch_q is None:
+            try:
+                self._watch_q = self.store.watch(None)
+                self._watch_thread = threading.Thread(
+                    target=self._drain_watch, name="slo-watch-tail",
+                    daemon=True)
+                self._watch_thread.start()
+            except Exception as e:
+                log.warning("watch tail unavailable: %s", e)
+                self._watch_q = None
+        self._thread = threading.Thread(
+            target=self._run, name="slo-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            # oplint: disable=EXC001 — one bad pass (store blip mid-
+            # failover) must not kill the alerting plane; errors are
+            # logged and the next tick retries
+            except Exception:
+                log.exception("SLO monitor tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_q is not None:
+            try:
+                self.store.stop_watch(self._watch_q)
+            except Exception as e:
+                log.debug("stop_watch failed: %s", e)
+            self._watch_q.put(None)
+            self._watch_q = None
+        for t in (self._thread, self._watch_thread):
+            if t is not None:
+                t.join(timeout=2.0)
+        self._thread = self._watch_thread = None
+
+
+# ---------------------------------------------------------------------------
+# standalone entry point (tpu-monitor) + the verify-gate smoke
+# ---------------------------------------------------------------------------
+
+
+def build_monitor(store: Any, *, scrape_targets: str = "",
+                  slo_config: Optional[str] = None,
+                  interval: float = 15.0, window_scale: float = 1.0,
+                  incident_dir: Optional[str] = None,
+                  extra_targets: Optional[List[ScrapeTarget]] = None,
+                  ) -> SLOMonitor:
+    """The one construction path operator main, tpu-monitor, and the
+    bench share (flag parsing → validated config → monitor)."""
+    targets = list(extra_targets or [])
+    targets.extend(parse_scrape_targets(scrape_targets))
+    if not targets:
+        targets = [ScrapeTarget("self", "self")]
+    config = load_slo_config(slo_config, window_scale=window_scale)
+    return SLOMonitor(store, targets, config, interval=interval,
+                      incident_dir=incident_dir)
+
+
+def smoke() -> int:
+    """The <30s verify-gate monitor smoke: spin a live 3-process wire
+    replica set (each exporting /metrics), scrape all three PLUS this
+    process for real, drive a synthetic breach through the local
+    registry (slow observations into the reconcile histogram), and
+    assert the matching alert FIRES into the replicated store, carries a
+    flight-recorder bundle, and CLEARS once the breach stops. Prints one
+    JSON line; exit 0 iff every bar held."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+    from mpi_operator_tpu.machinery.replica_wire import (
+        free_ports,
+        wait_for_wire_leader,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="slo-smoke-")
+    ids = ["n0", "n1", "n2"]
+    ports = free_ports(6)
+    store_ports = dict(zip(ids, ports[:3]))
+    mon_ports = dict(zip(ids, ports[3:]))
+    urls = {nid: f"http://127.0.0.1:{store_ports[nid]}" for nid in ids}
+    tok = os.path.join(tmp, "peer.token")
+    with open(tok, "w") as f:
+        f.write("smoke-peer\n")
+    peers = ",".join(f"{nid}={urls[nid]}" for nid in ids)
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    procs = {}
+    out: Dict[str, Any] = {"metric": "slo_monitor_smoke", "ok": False}
+    client = None
+    monitor = None
+    t_start = time.time()
+    # the firing must be trace-stamped (the smoke's trace_stamped bar):
+    # export spans like a real deployment would
+    trace.TRACER.configure("monitor-smoke",
+                           dir=os.path.join(tmp, "traces"))
+    try:
+        for nid in ids:
+            procs[nid] = subprocess.Popen(
+                [sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+                 "--store", f"sqlite:{os.path.join(tmp, nid + '.db')}",
+                 "--listen", f"127.0.0.1:{store_ports[nid]}",
+                 "--replica-id", nid, "--peers", peers,
+                 "--peer-token-file", tok,
+                 "--monitoring-port", str(mon_ports[nid]),
+                 "--replica-lease-duration", "1.0",
+                 "--replica-retry-period", "0.1"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        leader = wait_for_wire_leader(urls, 20.0)
+        if leader is None:
+            out["error"] = "no wire leader"
+            return _smoke_emit(out)
+        client = HttpStoreClient(list(urls.values()), timeout=10.0,
+                                 conn_refused_retries=10)
+        targets = [ScrapeTarget("smoke", "self")] + [
+            ScrapeTarget(nid,
+                         f"http://127.0.0.1:{mon_ports[nid]}/metrics")
+            for nid in ids
+        ]
+        config = load_slo_config().scaled(1.0 / 300.0)  # fast (1s, 12s)
+        monitor = SLOMonitor(client, targets, config, interval=0.25,
+                             incident_dir=os.path.join(tmp, "incidents"))
+        # the synthetic breach: every reconcile "takes" 3s (> the 1s
+        # good-event bound) — written into the LOCAL registry the 'smoke'
+        # target scrapes, exactly how a real regression would look
+        def observe(bad: bool, n: int = 10) -> None:
+            for _ in range(n):
+                _metrics.reconcile_latency.observe(3.0 if bad else 0.002)
+
+        fired_at = resolved_at = None
+        deadline = time.time() + 12.0
+        while time.time() < deadline and fired_at is None:
+            observe(bad=True)
+            monitor.tick()
+            a = client.try_get("Alert", ALERT_NAMESPACE, "reconcile-latency")
+            if a is not None and a.is_firing():
+                fired_at = time.time()
+            time.sleep(0.25)
+        out["fired"] = fired_at is not None
+        if fired_at is None:
+            out["error"] = "breach never fired"
+            return _smoke_emit(out)
+        alert = client.get("Alert", ALERT_NAMESPACE, "reconcile-latency")
+        out["window"] = alert.status.window
+        out["bundle"] = bool(alert.status.incident
+                             and os.path.exists(alert.status.incident))
+        out["trace_stamped"] = bool(
+            alert.metadata.annotations.get(trace.ANNOTATION_TRACE_ID))
+        out["replicas_scraped"] = sorted(
+            lbl[INSTANCE_LABEL]
+            for lbl, _, v in monitor.scraper.ring.latest("up") if v == 1.0
+        )
+        # heal: fast, clean observations until every window drains
+        deadline = time.time() + 16.0
+        while time.time() < deadline and resolved_at is None:
+            observe(bad=False)
+            monitor.tick()
+            a = client.get("Alert", ALERT_NAMESPACE, "reconcile-latency")
+            if a.status.state == AlertState.RESOLVED:
+                resolved_at = time.time()
+            time.sleep(0.25)
+        out["resolved"] = resolved_at is not None
+        out["elapsed_s"] = round(time.time() - t_start, 1)
+        out["ok"] = bool(
+            out["fired"] and out["resolved"] and out["bundle"]
+            and out["trace_stamped"]
+            and len(out["replicas_scraped"]) == 4  # 3 replicas + self
+        )
+        return _smoke_emit(out)
+    finally:
+        trace.TRACER.disable()
+        if monitor is not None:
+            monitor.stop()
+        if client is not None:
+            client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _smoke_emit(out: Dict[str, Any]) -> int:
+    print(json.dumps(out), flush=True)
+    return 0 if out.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="tpu-monitor",
+        description="Standalone SLO monitor: scrape the fleet's /metrics, "
+                    "evaluate burn-rate objectives, write Alert objects "
+                    "into the store, dump incident bundles.",
+    )
+    ap.add_argument("--store", default=None,
+                    help="the shared store alerts are written into "
+                         "('sqlite:PATH' or 'http://HOST:PORT'); omit to "
+                         "evaluate+log without writing alerts")
+    ap.add_argument("--token-file", default=None)
+    ap.add_argument("--scrape-targets", default="",
+                    help="comma list of name=http://host:port/metrics "
+                         "(use 'name=self' for this process's registry)")
+    ap.add_argument("--slo-config", default=None,
+                    help=f"SLO objectives file (default: "
+                         f"${ENV_SLO_CONFIG} or the packaged defaults)")
+    ap.add_argument("--interval", type=float, default=15.0,
+                    help="seconds between scrape+evaluate passes")
+    ap.add_argument("--window-scale", type=float, default=1.0,
+                    help="multiply every burn window (test/bench "
+                         "compression; production stays 1.0)")
+    ap.add_argument("--incident-dir", default=None,
+                    help=f"flight-recorder bundle dir (default: "
+                         f"${ENV_INCIDENT_DIR} or <trace-dir>/incidents)")
+    ap.add_argument("--once", action="store_true",
+                    help="one scrape+evaluate pass, print probe states, "
+                         "exit 1 if anything is firing")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the <30s verify-gate smoke: live 3-process wire "
+                         "set scraped, synthetic breach must fire + clear")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if args.smoke:
+        return smoke()
+    trace.configure_from_env("monitor")
+    store = None
+    if args.store:
+        from mpi_operator_tpu.machinery.http_store import read_token_file
+        from mpi_operator_tpu.opshell.__main__ import build_store
+
+        store = build_store(args.store,
+                            token=read_token_file(args.token_file))
+    try:
+        monitor = build_monitor(
+            store, scrape_targets=args.scrape_targets,
+            slo_config=args.slo_config, interval=args.interval,
+            window_scale=args.window_scale, incident_dir=args.incident_dir,
+        )
+    except (SLOConfigError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.once:
+        states = monitor.tick()
+        for name, st in sorted(states.items()):
+            print(f"{name}: {'FIRING' if st.firing else 'ok'}"
+                  + (f" ({st.window}, burn {st.worst_burn:.1f}x)"
+                     if st.firing else ""))
+        return 1 if any(s.firing for s in states.values()) else 0
+    monitor.start()
+    print(f"slo monitor running: {len(monitor.scraper.targets)} targets, "
+          f"{len(monitor.config.objectives)} objectives, "
+          f"every {args.interval:g}s", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    monitor.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

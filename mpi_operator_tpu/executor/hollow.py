@@ -801,6 +801,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-items", type=int, default=128,
                     help="max patches per batch request flush")
     ap.add_argument("--token-file", default=None)
+    ap.add_argument("--monitoring-port", type=int, default=None,
+                    help="serve /metrics + /healthz on this port (agent "
+                         "tick latency etc. — the SLO monitor scrapes the "
+                         "fleet process like any other); default: off")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from mpi_operator_tpu.machinery.http_store import (
@@ -820,11 +824,20 @@ def main(argv=None) -> int:
         capacity_chips=args.chips, heartbeat_interval=args.heartbeat,
         batch_items=args.batch_items,
     ).start()
+    ops = None
+    if args.monitoring_port is not None:
+        from mpi_operator_tpu.opshell.server import OpsServer
+
+        ops = OpsServer(args.monitoring_port)
+        ops.start()
+        logging.info("metrics on :%d/metrics", ops.port)
     print(f"hollow fleet of {args.nodes} nodes running", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
+    if ops is not None:
+        ops.stop()
     fleet.stop()
     return 0
 

@@ -64,9 +64,11 @@ LABEL_SERVE_NAME = "tpujob.dev/serve-name"
 
 # default kind set mirrors machinery.objects.KINDS minus Event: events are
 # an append-only audit stream nobody ever gets/lists on the hot path, and
-# caching them would grow the cache without bound
-DEFAULT_KINDS = ("TPUJob", "TPUServe", "Pod", "Service", "ConfigMap",
-                 "PodGroup", "Node")
+# caching them would grow the cache without bound. Alerts (the SLO plane's
+# firing state, one object per objective) ARE cached: consumers watch for
+# transitions and `ctl top` reads them as a lister would
+DEFAULT_KINDS = ("TPUJob", "TPUServe", "Alert", "Pod", "Service",
+                 "ConfigMap", "PodGroup", "Node")
 
 
 class _Relist:

@@ -2286,6 +2286,11 @@ def main(argv=None) -> int:
     ap.add_argument("--replica-seed", type=int, default=0,
                     help="seed for the ticker's campaign jitter (chaos "
                          "harness determinism)")
+    ap.add_argument("--monitoring-port", type=int, default=None,
+                    help="serve /metrics + /healthz on this port (the "
+                         "scrape endpoint the SLO monitor pulls: store "
+                         "request latency by verb, replication lag, "
+                         "tenant fair-queue counters); default: off")
     args = ap.parse_args(argv)
     if args.tls_key and not args.tls_cert:
         raise SystemExit("error: --tls-key requires --tls-cert")
@@ -2375,6 +2380,13 @@ def main(argv=None) -> int:
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         fairness=fairness, quota=quota, peer_token=peer_token,
     ).start()
+    ops = None
+    if args.monitoring_port is not None:
+        from mpi_operator_tpu.opshell.server import OpsServer
+
+        ops = OpsServer(args.monitoring_port)
+        ops.start()
+        logging.info("metrics on :%d/metrics", ops.port)
     if ticker is not None:
         # the server must be listening BEFORE the ticker campaigns: a
         # won election heartbeats every peer immediately
@@ -2389,6 +2401,8 @@ def main(argv=None) -> int:
         pass
     if ticker is not None:
         ticker.stop()
+    if ops is not None:
+        ops.stop()
     server.stop()
     return 0
 

@@ -304,5 +304,5 @@ def evict_pod(store, pod: "Pod", message: str, *,
     ) is not None
 
 
-KINDS = ("TPUJob", "TPUServe", "Pod", "Service", "ConfigMap", "PodGroup",
-         "Event", "Node")
+KINDS = ("TPUJob", "TPUServe", "Alert", "Pod", "Service", "ConfigMap",
+         "PodGroup", "Event", "Node")

@@ -18,7 +18,7 @@ import dataclasses
 import typing
 from typing import Any, Dict, Type
 
-from mpi_operator_tpu.api.types import TPUJob, TPUServe
+from mpi_operator_tpu.api.types import Alert, TPUJob, TPUServe
 from mpi_operator_tpu.machinery import objects as mo
 
 
@@ -58,6 +58,7 @@ def decode_dataclass(cls: Type, d: Dict[str, Any]) -> Any:
 KIND_CLASSES: Dict[str, Type] = {
     "TPUJob": TPUJob,
     "TPUServe": TPUServe,
+    "Alert": Alert,
     "Pod": mo.Pod,
     "Service": mo.Service,
     "ConfigMap": mo.ConfigMap,

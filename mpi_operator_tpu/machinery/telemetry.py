@@ -1,0 +1,434 @@
+"""Fleet metrics scraping + a bounded in-memory timeseries — the SLO
+plane's data layer (ISSUE 13).
+
+The reference operator exports promauto counters and delegates all
+*consumption* — dashboards, burn-rate alerts, incident triage — to an
+external Prometheus (PAPER.md §1 layers 5-6). This reproduction is
+dependency-free, so the consumer lives here: a :class:`MetricsScraper`
+periodically pulls ``/metrics`` from every configured process (store
+replicas, operator, hollow fleet), parses it with the STRICT exposition
+parser PR 9 shipped (a malformed endpoint is a scrape error, never a
+silently-wrong number), stamps an ``instance`` label, and feeds a
+:class:`SeriesRing` — per-series bounded deques over which the two reads
+the SLO monitor needs are defined:
+
+- :meth:`SeriesRing.rate` / :meth:`SeriesRing.increase` — counter
+  increase over a window, **counter-reset aware**: a scraped process that
+  restarts re-registers its counters at zero, so a value DECREASE marks a
+  new epoch and contributes the post-restart value (the counter restarted
+  from 0), never a negative rate. Prometheus ``rate()`` semantics, pinned
+  by a test that SIGKILLs and restarts a scraped StoreServer mid-window.
+- :meth:`SeriesRing.quantile` — windowed ``histogram_quantile`` over the
+  cumulative ``_bucket`` series: per-``le`` increases over the window
+  (reset-aware per bucket) rebuilt into cumulative pairs, so the monitor
+  evaluates "p99 over the last N seconds", not since-process-start.
+
+Memory is bounded twice: ``capacity`` samples per series (a ring) and
+``max_series`` distinct series (past it, NEW series are dropped and
+counted — a label-cardinality explosion in a scraped target degrades
+coverage, never the monitor's memory).
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from mpi_operator_tpu.opshell import metrics as _metrics
+from mpi_operator_tpu.opshell.metrics import (
+    ExpositionError,
+    histogram_quantile,
+    parse_exposition,
+)
+
+log = logging.getLogger("tpujob.telemetry")
+
+# the label the scraper stamps on every ingested sample — which process
+# the number came from (≙ Prometheus's instance label)
+INSTANCE_LABEL = "instance"
+
+# the synthetic target URL meaning "read this process's own registry
+# directly" (no HTTP round-trip; the operator's in-process scrape)
+SELF_TARGET = "self"
+
+
+@dataclass(frozen=True)
+class ScrapeTarget:
+    """One scrape endpoint: ``instance`` names it (the stamped label),
+    ``url`` is its /metrics endpoint — or :data:`SELF_TARGET` for the
+    local registry."""
+
+    instance: str
+    url: str
+
+
+def parse_scrape_targets(spec: Optional[str]) -> List[ScrapeTarget]:
+    """Parse ``name=http://host:port/metrics,...`` (the --scrape-targets
+    flag). Fails closed on malformed entries — a typo'd target silently
+    scraping nothing would make every SLO on it a lie."""
+    if not spec:
+        return []
+    out: List[ScrapeTarget] = []
+    seen = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, url = part.partition("=")
+        name = name.strip()
+        url = url.strip()
+        if not sep or not name or not url:
+            raise ValueError(
+                f"scrape target {part!r}: expected 'name=url'"
+            )
+        if url != SELF_TARGET and not url.startswith(("http://", "https://")):
+            raise ValueError(
+                f"scrape target {name!r}: url must be http(s):// or "
+                f"'{SELF_TARGET}', got {url!r}"
+            )
+        if name in seen:
+            raise ValueError(f"scrape target {name!r} configured twice")
+        seen.add(name)
+        out.append(ScrapeTarget(name, url))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the bounded timeseries ring
+# ---------------------------------------------------------------------------
+
+_SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class SeriesRing:
+    """Bounded in-memory timeseries: ``(sample_name, labels) →
+    deque[(t, value)]``. Sample names are the RAW exposition names
+    (``family_bucket``/``_sum``/``_count`` for histograms), so the ring
+    holds exactly what a scrape delivered."""
+
+    def __init__(self, capacity: int = 512, max_series: int = 8192):
+        if capacity < 2:
+            raise ValueError("SeriesRing needs capacity >= 2 (rate() "
+                             "requires two samples)")
+        self.capacity = capacity
+        self.max_series = max_series
+        self._series: Dict[_SeriesKey, collections.deque] = {}
+        self._lock = threading.Lock()
+        self.dropped_series = 0
+        # DISTINCT refused series (the gauge's advertised semantics —
+        # counting per-sample drop attempts would climb forever on every
+        # scrape and misstate the explosion's size). Hashes, bounded:
+        # past 8× max_series the count saturates rather than letting the
+        # dedup set become its own cardinality leak.
+        self._dropped_keys: set = set()
+
+    @staticmethod
+    def _key(name: str, labels: Dict[str, str]) -> _SeriesKey:
+        return (name, tuple(sorted(labels.items())))
+
+    def record(self, name: str, labels: Dict[str, str], value: float,
+               t: float) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            dq = self._series.get(key)
+            if dq is None:
+                if len(self._series) >= self.max_series:
+                    # bound the monitor's memory, not the fleet's labels:
+                    # drop NEW series and count the loss (surfaced via
+                    # monitor_series_dropped so "monitor silent" triages)
+                    h = hash(key)
+                    if h not in self._dropped_keys \
+                            and len(self._dropped_keys) \
+                            < 8 * self.max_series:
+                        self._dropped_keys.add(h)
+                        self.dropped_series += 1
+                    return
+                dq = self._series[key] = collections.deque(
+                    maxlen=self.capacity)
+            dq.append((t, value))
+
+    def series(self, name: str,
+               **labels: str) -> List[Tuple[Dict[str, str],
+                                            List[Tuple[float, float]]]]:
+        """Every series of ``name`` whose labels are a SUPERSET of the
+        given ones (subset match, like a PromQL selector), as
+        ``(labels, [(t, v), ...])`` snapshots."""
+        want = labels.items()
+        out = []
+        with self._lock:
+            for (n, lbl), dq in self._series.items():
+                if n != name:
+                    continue
+                d = dict(lbl)
+                if all(d.get(k) == v for k, v in want):
+                    out.append((d, list(dq)))
+        return out
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    # -- counter reads -------------------------------------------------------
+
+    @staticmethod
+    def _increase(samples: Sequence[Tuple[float, float]], start: float,
+                  end: float) -> Optional[float]:
+        """Counter increase over ``[start, end]``, reset-aware: a value
+        decrease means the scraped process restarted and its counter
+        re-began at zero — the new value IS the post-restart increase
+        (never a negative delta). Returns None when the window holds no
+        baseline-able samples (no data ≠ zero traffic). The last sample
+        BEFORE the window anchors the first in-window delta, so window
+        edges effectively snap to scrape boundaries — window resolution
+        is one scrape interval, never a lost first delta (short burn
+        windows stay responsive at coarse scrape cadences)."""
+        prev: Optional[float] = None
+        total: Optional[float] = None
+        for t, v in samples:
+            if t < start:
+                prev = v  # the last pre-window sample anchors the delta
+                continue
+            if t > end:
+                break
+            if prev is None:
+                prev = v  # first in-window sample is the baseline
+                total = 0.0 if total is None else total
+                continue
+            total = (total or 0.0) + (v if v < prev else v - prev)
+            prev = v
+        return total
+
+    def increase(self, name: str, window: float, now: Optional[float] = None,
+                 **labels: str) -> Optional[float]:
+        """Summed reset-aware increase of every matching series over the
+        trailing ``window`` seconds. None when NO matching series has
+        data in the window."""
+        now = time.time() if now is None else now
+        start = now - window
+        total: Optional[float] = None
+        for _, samples in self.series(name, **labels):
+            inc = self._increase(samples, start, now)
+            if inc is not None:
+                total = (total or 0.0) + inc
+        return total
+
+    def rate(self, name: str, window: float, now: Optional[float] = None,
+             **labels: str) -> Optional[float]:
+        """Per-second rate over the trailing window (increase / window)."""
+        inc = self.increase(name, window, now, **labels)
+        return None if inc is None else inc / max(1e-9, window)
+
+    # -- gauge reads ---------------------------------------------------------
+
+    def latest(self, name: str,
+               **labels: str) -> List[Tuple[Dict[str, str], float, float]]:
+        """The newest (labels, t, value) of every matching series."""
+        out = []
+        for lbl, samples in self.series(name, **labels):
+            if samples:
+                t, v = samples[-1]
+                out.append((lbl, t, v))
+        return out
+
+    def window_values(self, name: str, window: float,
+                      now: Optional[float] = None,
+                      **labels: str) -> List[Tuple[Dict[str, str],
+                                                   List[float]]]:
+        """Per-series values inside the trailing window (gauge SLOs:
+        'fraction of scrapes above the bound')."""
+        now = time.time() if now is None else now
+        start = now - window
+        out = []
+        for lbl, samples in self.series(name, **labels):
+            vals = [v for t, v in samples if start <= t <= now]
+            if vals:
+                out.append((lbl, vals))
+        return out
+
+    # -- histogram reads -----------------------------------------------------
+
+    def quantile(self, name: str, q: float, window: float,
+                 now: Optional[float] = None,
+                 **labels: str) -> Optional[float]:
+        """Windowed ``histogram_quantile`` over ``name``'s cumulative
+        ``_bucket`` series: per-le reset-aware increases over the window,
+        summed across matching series (instances), rebuilt into
+        cumulative pairs. None when the window saw no observations."""
+        now = time.time() if now is None else now
+        start = now - window
+        by_le: Dict[float, float] = {}
+        for lbl, samples in self.series(f"{name}_bucket", **labels):
+            le_s = lbl.get("le", "")
+            try:
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+            except ValueError:
+                continue
+            inc = self._increase(samples, start, now)
+            if inc is not None:
+                by_le[le] = by_le.get(le, 0.0) + inc
+        if not by_le:
+            return None
+        pairs = sorted((le, int(round(c))) for le, c in by_le.items())
+        if not pairs or pairs[-1][1] <= 0:
+            return None
+        return histogram_quantile(q, pairs)
+
+    def error_fraction(self, name: str, threshold: float, window: float,
+                       now: Optional[float] = None,
+                       **labels: str) -> Optional[float]:
+        """Fraction of a histogram's window observations ABOVE the
+        largest bucket bound <= ``threshold`` — the bad-event fraction a
+        latency SLO burns budget on. Bucket resolution applies: the
+        effective bound is the bucket edge at/below the threshold."""
+        now = time.time() if now is None else now
+        start = now - window
+        good: Optional[float] = None
+        total: Optional[float] = None
+        best_le = None
+        by_le: Dict[float, float] = {}
+        for lbl, samples in self.series(f"{name}_bucket", **labels):
+            le_s = lbl.get("le", "")
+            try:
+                le = float("inf") if le_s == "+Inf" else float(le_s)
+            except ValueError:
+                continue
+            inc = self._increase(samples, start, now)
+            if inc is None:
+                continue
+            by_le[le] = by_le.get(le, 0.0) + inc
+        if not by_le:
+            return None
+        finite = [le for le in by_le if le <= threshold]
+        if finite:
+            best_le = max(finite)
+            good = by_le[best_le]
+        else:
+            good = 0.0
+        total = by_le.get(float("inf"))
+        if total is None:
+            total = max(by_le.values())
+        if total <= 0:
+            return None
+        return max(0.0, min(1.0, (total - good) / total))
+
+
+# ---------------------------------------------------------------------------
+# the scraper
+# ---------------------------------------------------------------------------
+
+
+class MetricsScraper:
+    """Periodically pull every target's /metrics, strict-parse, stamp the
+    instance label, feed the ring. One thread; a dead target costs one
+    bounded-timeout request per pass and is surfaced as ``up == 0`` —
+    never an exception out of the loop."""
+
+    def __init__(self, targets: Iterable[ScrapeTarget], *,
+                 ring: Optional[SeriesRing] = None,
+                 interval: float = 15.0, timeout: float = 5.0,
+                 registry: "_metrics.Registry" = _metrics.REGISTRY):
+        self.targets = list(targets)
+        if not self.targets:
+            raise ValueError("MetricsScraper needs at least one target")
+        names = [t.instance for t in self.targets]
+        dup = sorted({n for n in names if names.count(n) > 1})
+        if dup:
+            # two processes sharing one instance label interleave into
+            # the SAME series: every crossing where the lower counter
+            # follows the higher reads as a counter reset and inflates
+            # every rate — fail closed like the rest of the SLO plane
+            # (catches --scrape-targets colliding with the operator's
+            # built-in 'operator=self' target too)
+            raise ValueError(
+                f"duplicate scrape instance name(s) {dup}: each target "
+                f"needs a unique instance label")
+        self.ring = ring if ring is not None else SeriesRing()
+        self.interval = interval
+        self.timeout = timeout
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # instance → last error string ('' = last scrape ok)
+        self.last_error: Dict[str, str] = {}
+        self.scrapes = 0
+
+    # -- one pass ------------------------------------------------------------
+
+    def _fetch(self, target: ScrapeTarget) -> str:
+        if target.url == SELF_TARGET:
+            return self._registry.render()
+        req = urllib.request.Request(
+            target.url, headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode("utf-8", "replace")
+
+    def scrape_once(self, now: Optional[float] = None) -> Dict[str, bool]:
+        """Scrape every target once. Returns instance → reachable-and-
+        parsed. Each pass also records the synthetic ``up`` series per
+        instance (the Prometheus liveness convention), so 'monitor
+        silent: check scrape targets' triages from the ring itself."""
+        now = time.time() if now is None else now
+        out: Dict[str, bool] = {}
+        for target in self.targets:
+            t0 = time.perf_counter()
+            try:
+                text = self._fetch(target)
+                families = parse_exposition(text)
+            # HTTPException covers a target dying MID-RESPONSE
+            # (IncompleteRead is not an OSError) — it must be that
+            # target's scrape error, never abort the whole pass
+            except (OSError, http.client.HTTPException,
+                    ExpositionError, ValueError) as e:
+                self.last_error[target.instance] = str(e)
+                self.ring.record("up", {INSTANCE_LABEL: target.instance},
+                                 0.0, now)
+                _metrics.monitor_scrape_errors.inc(instance=target.instance)
+                out[target.instance] = False
+                continue
+            for fam in families.values():
+                for name, labels, value in fam["samples"]:
+                    lbl = dict(labels)
+                    lbl[INSTANCE_LABEL] = target.instance
+                    self.ring.record(name, lbl, value, now)
+            self.ring.record("up", {INSTANCE_LABEL: target.instance},
+                             1.0, now)
+            self.last_error[target.instance] = ""
+            out[target.instance] = True
+            _metrics.monitor_scrape_latency.observe(
+                time.perf_counter() - t0, instance=target.instance)
+        self.scrapes += 1
+        _metrics.monitor_series_dropped.set(self.ring.dropped_series)
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MetricsScraper":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="metrics-scraper", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scrape_once()
+            # oplint: disable=EXC001 — the scrape loop must outlive any
+            # single target's weirdness; per-target errors are already
+            # recorded, this guards the pass itself
+            except Exception:
+                log.exception("scrape pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -697,9 +697,11 @@ def render_timeline(all_spans: List[Dict[str, Any]], trace_id: str,
     return "\n".join(lines)
 
 
-# incident span names `ctl trace --last-incident` anchors on
+# incident span names `ctl trace --last-incident` anchors on. slo.alert
+# is the SLO monitor's firing span (ISSUE 13): an alert IS an incident,
+# and its span carries the flight-recorder bundle path as an attribute
 _INCIDENT_NAMES = ("controller.gang_restart", "replica.election",
-                   "monitor.node_lost")
+                   "monitor.node_lost", "slo.alert")
 
 
 def last_incident(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:

@@ -147,6 +147,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between serve-autoscaler decision "
                          "passes (sample pod serve_stats → recommend → "
                          "write spec.replicas)")
+    ap.add_argument("--no-slo-monitor", action="store_true",
+                    help="disable the SLO burn-rate monitor (the alerting "
+                         "plane runs leader-only by default, scraping this "
+                         "process's own registry plus --scrape-targets)")
+    ap.add_argument("--slo-config", default=None, metavar="PATH",
+                    help="SLO objectives file (default: $TPUJOB_SLO_CONFIG "
+                         "or the packaged slo_defaults.json); the loader "
+                         "FAILS CLOSED on unknown metrics/bad thresholds/"
+                         "malformed windows — a typo'd objective refuses "
+                         "to start rather than silently watching nothing")
+    ap.add_argument("--scrape-targets", default="", metavar="MAP",
+                    help="extra /metrics endpoints the SLO monitor pulls, "
+                         "'name=http://host:port/metrics' comma list "
+                         "(store replicas, hollow fleets — anything with "
+                         "--monitoring-port); this process is always "
+                         "scraped as instance 'operator'")
+    ap.add_argument("--scrape-interval", type=float, default=15.0,
+                    help="seconds between SLO monitor scrape+evaluate "
+                         "passes")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     ap.add_argument("--version", action="store_true",
                     help="print version/build info and exit")
@@ -364,6 +383,30 @@ def main(argv=None) -> int:
             interval=args.autoscale_interval,
         )
 
+    # the SLO plane (leader-only, like every reconciler): scrape the
+    # fleet's /metrics, evaluate burn-rate objectives, write Alert
+    # objects + incident bundles. Built BEFORE the election so a bad
+    # config fails the process at startup, not at leadership.
+    slo_monitor = None
+    if not args.no_slo_monitor:
+        from mpi_operator_tpu.controller.slo_monitor import (
+            SLOConfigError,
+            build_monitor,
+        )
+        from mpi_operator_tpu.machinery.telemetry import ScrapeTarget
+
+        try:
+            slo_monitor = build_monitor(
+                store, scrape_targets=args.scrape_targets,
+                slo_config=args.slo_config,
+                interval=args.scrape_interval,
+                extra_targets=[ScrapeTarget("operator", "self")],
+            )
+        except (SLOConfigError, ValueError) as e:
+            print(f"error: --slo-config/--scrape-targets: {e}",
+                  file=sys.stderr)
+            return 2
+
     chaos_script = None
     if args.chaos_script:
         from mpi_operator_tpu.machinery.chaos import (
@@ -412,6 +455,8 @@ def main(argv=None) -> int:
         if executor:
             executor.start()
         monitor.start()
+        if slo_monitor is not None:
+            slo_monitor.start()
         if chaos_script is not None:
             # armed at leadership, not at process start: "kill the leader
             # N seconds into its reign" is then a deterministic, scripted
@@ -430,6 +475,8 @@ def main(argv=None) -> int:
         # ≙ OnStoppedLeading → fatal (server.go:246-249): losing the lease
         # stops reconciling immediately
         controller.stop()
+        if slo_monitor is not None:
+            slo_monitor.stop()
         if autoscaler is not None:
             autoscaler.stop()
         if serve_controller is not None:

@@ -801,6 +801,186 @@ def cmd_serve(client: TPUJobClient, args) -> int:
     return 0
 
 
+def cmd_alerts(client: TPUJobClient, args) -> int:
+    """`ctl alerts`: the SLO plane's firing state — every Alert object
+    the burn-rate monitor has written, firing first. Exit 1 while
+    anything is FIRING (the runbook's 'alert firing' row starts here:
+    scripts and humans probe alert health with this one verb, like
+    `ctl store status` probes HA health)."""
+    from mpi_operator_tpu.api.types import ALERT_NAMESPACE
+
+    alerts = client.store.list("Alert", ALERT_NAMESPACE)
+    firing = [a for a in alerts if a.is_firing()]
+    if args.output == "json":
+        print(json.dumps([a.to_dict() for a in alerts], indent=2))
+        return 1 if firing else 0
+    if not alerts:
+        print("No alerts recorded (the SLO monitor writes one per "
+              "objective on its first firing).")
+        return 0
+    rows = []
+    for a in sorted(alerts, key=lambda a: (not a.is_firing(),
+                                           a.metadata.name)):
+        st = a.status
+        rows.append([
+            a.metadata.name,
+            a.spec.severity,
+            st.state.upper() if a.is_firing() else st.state,
+            _age(st.since if a.is_firing() else st.resolved_at),
+            st.window or "-",
+            f"{st.burn:g}x" if st.burn else "-",
+            st.fired_count,
+            st.message,
+        ])
+    print(_table(rows, ["OBJECTIVE", "SEV", "STATE", "AGE", "WINDOW",
+                        "BURN", "FIRED", "MESSAGE"]))
+    for a in firing:
+        if a.status.incident:
+            print(f"incident bundle: {a.status.incident}")
+    return 1 if firing else 0
+
+
+def cmd_top(client: TPUJobClient, args) -> int:
+    """`ctl top`: the one-scrape cluster overview — jobs by phase, chips
+    held vs capacity, node/pod health, firing alerts from the store; and
+    with --metrics URL(s), store p99 by verb, reconcile/watch-lag
+    percentiles, and tenant shed counts read straight out of live
+    /metrics expositions (since-process-start quantiles: the trend view
+    is the monitor's windowed job, this is the snapshot)."""
+    import urllib.request
+
+    import math
+
+    from mpi_operator_tpu.api.types import ALERT_NAMESPACE
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+    from mpi_operator_tpu.opshell.metrics import (
+        histogram_quantile,
+        parse_exposition,
+    )
+    from mpi_operator_tpu.scheduler.gang import pod_cost
+
+    def _quantile(fams, family, q, **labels):
+        """histogram_quantile straight off the ALREADY-PARSED families
+        (exposition_quantile would re-parse the whole text per call —
+        O(combos × text) across the verb table)."""
+        pairs = []
+        for name, lbls, value in fams[family]["samples"]:
+            if not name.endswith("_bucket"):
+                continue
+            rest = {k: v for k, v in lbls.items() if k != "le"}
+            if rest != labels:
+                continue
+            le = lbls.get("le", "")
+            pairs.append((math.inf if le == "+Inf" else float(le),
+                          int(value)))
+        pairs.sort()
+        return histogram_quantile(q, pairs)
+
+    lines = []
+    jobs = client.store.list("TPUJob")
+    by_state: dict = {}
+    for j in jobs:
+        by_state[job_state(j)] = by_state.get(job_state(j), 0) + 1
+    lines.append(f"JOBS        {len(jobs)} total"
+                 + ("".join(f"  {k}={v}" for k, v in sorted(by_state.items()))
+                    if by_state else ""))
+    serves = client.store.list("TPUServe")
+    if serves:
+        ready = sum(s.status.ready_replicas for s in serves)
+        desired = sum(s.spec.replicas or 0 for s in serves)
+        lines.append(f"SERVES      {len(serves)} total  ready={ready}/"
+                     f"{desired}")
+    nodes = client.store.list("Node", NODE_NAMESPACE)
+    if nodes:
+        ready_n = sum(1 for n in nodes if n.status.ready)
+        cordoned = sum(1 for n in nodes if n.status.unschedulable)
+        capacity = sum(n.status.capacity_chips or 0 for n in nodes)
+        lines.append(f"NODES       {len(nodes)} total  ready={ready_n}"
+                     + (f"  cordoned={cordoned}" if cordoned else ""))
+    else:
+        capacity = 0
+    pods = client.store.list("Pod")
+    by_phase: dict = {}
+    held = 0
+    for p in pods:
+        by_phase[p.status.phase] = by_phase.get(p.status.phase, 0) + 1
+        if p.spec.node_name and not p.is_finished():
+            held += pod_cost(p)
+    lines.append(f"PODS        {len(pods)} total"
+                 + "".join(f"  {k}={v}" for k, v in sorted(by_phase.items())))
+    lines.append(f"CHIPS       held={held}"
+                 + (f" / capacity={capacity}" if capacity else ""))
+    alerts = client.store.list("Alert", ALERT_NAMESPACE)
+    firing = sorted(a.metadata.name for a in alerts if a.is_firing())
+    lines.append("ALERTS      "
+                 + (f"{len(firing)} FIRING: {', '.join(firing)} "
+                    f"(see `ctl alerts`)" if firing else
+                    f"none firing ({len(alerts)} recorded)"))
+    print("\n".join(lines))
+
+    for spec in (args.metrics or "").split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        name, sep, url = spec.partition("=")
+        if not sep:
+            name, url = "", spec
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                text = r.read().decode("utf-8", "replace")
+            fams = parse_exposition(text)
+        except Exception as e:
+            print(f"\n{name or url}: scrape failed: {e}", file=sys.stderr)
+            continue
+        print(f"\n== {name or url} ==")
+        fam = "tpu_operator_store_request_latency_seconds"
+        if fam in fams:
+            combos = sorted({
+                (lbl.get("verb", ""), lbl.get("backend", ""))
+                for n, lbl, _ in fams[fam]["samples"]
+                if n.endswith("_count")
+            })
+            rows = []
+            for verb, backend in combos:
+                count = sum(
+                    v for n, lbl, v in fams[fam]["samples"]
+                    if n.endswith("_count") and lbl.get("verb") == verb
+                    and lbl.get("backend") == backend
+                )
+                rows.append([
+                    verb, backend, int(count),
+                    f"{_quantile(fams, fam, 0.5, verb=verb, backend=backend) * 1e3:.1f}",
+                    f"{_quantile(fams, fam, 0.99, verb=verb, backend=backend) * 1e3:.1f}",
+                ])
+            if rows:
+                print(_table(rows, ["VERB", "BACKEND", "COUNT",
+                                    "P50MS", "P99MS"]))
+        for label, family in (
+            ("reconcile", "tpu_operator_reconcile_latency_seconds"),
+            ("watch-lag", "tpu_operator_watch_delivery_lag_seconds"),
+            ("bind", "tpu_operator_scheduler_bind_latency_seconds"),
+        ):
+            if family in fams and any(
+                n.endswith("_count") and v > 0
+                for n, _, v in fams[family]["samples"]
+            ):
+                p50 = _quantile(fams, family, 0.5) * 1e3
+                p99 = _quantile(fams, family, 0.99) * 1e3
+                print(f"{label}: p50 {p50:.1f}ms  p99 {p99:.1f}ms")
+        shed = [
+            (lbl.get("tenant", "?"), lbl.get("reason", ""), v)
+            for n, lbl, v in fams.get(
+                "tpu_operator_store_tenant_rejected_total",
+                {"samples": []})["samples"]
+            if v > 0
+        ]
+        if shed:
+            print("tenant shed (429s): " + ", ".join(
+                f"{t}={v:g}" + (f" ({r})" if r else "")
+                for t, r, v in sorted(shed)))
+    return 0
+
+
 def cmd_trace(client: TPUJobClient, args) -> int:
     """`ctl trace <job>` / `ctl trace --last-incident`: the causal
     timeline of a job's lifecycle (submit → scheduled → launched →
@@ -824,9 +1004,35 @@ def cmd_trace(client: TPUJobClient, args) -> int:
         incident = tr.last_incident(spans)
         if incident is None:
             print("no incident spans (gang restart / failover / node "
-                  "loss) recorded")
+                  "loss / SLO alert) recorded")
             return 0
         print(tr.render_incident(spans, incident))
+        # the flight-recorder link: an slo.alert incident carries its
+        # bundle path as a span attribute; otherwise link the newest
+        # bundle in the incident dir (same triage either way)
+        from mpi_operator_tpu.controller.slo_monitor import FlightRecorder
+
+        bundle = (incident.get("attrs") or {}).get("bundle")
+        if not bundle:
+            inc_dir = os.environ.get("TPUJOB_INCIDENT_DIR") or os.path.join(
+                trace_dir, "incidents")
+            bundle = FlightRecorder.newest_bundle(inc_dir)
+        if bundle and os.path.exists(bundle):
+            try:
+                with open(bundle, encoding="utf-8") as f:
+                    b = json.load(f)
+                print(f"\nincident bundle: {bundle}")
+                print(f"  objective: {b.get('objective', '?')}  "
+                      f"spans: {len(b.get('spans', []))}  "
+                      f"events: {len(b.get('events', []))}  "
+                      f"watch tail: {len(b.get('watch_events', []))}")
+                burns = b.get("burns") or {}
+                if burns:
+                    print("  burns: " + "  ".join(
+                        f"{k}={v:.1f}x" for k, v in sorted(burns.items())))
+            except (OSError, ValueError) as e:
+                print(f"\nincident bundle: {bundle} (unreadable: {e})",
+                      file=sys.stderr)
         return 0
     if not args.name:
         print("error: a job name (or --last-incident) is required",
@@ -1038,6 +1244,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="target replica count (scale)")
     p.add_argument("-o", "--output", choices=["table", "json"],
                    default="table")
+    p = sub.add_parser("alerts", help="the SLO plane's firing state "
+                                      "(Alert objects the burn-rate "
+                                      "monitor wrote); exit 1 while "
+                                      "anything is FIRING")
+    p.add_argument("-o", "--output", choices=["table", "json"],
+                   default="table")
+    p = sub.add_parser("top", help="one-scrape cluster overview: jobs by "
+                                   "phase, chips held, nodes, alerts; "
+                                   "--metrics adds store p99 by verb, "
+                                   "reconcile/watch-lag percentiles and "
+                                   "tenant shed counts from live /metrics")
+    p.add_argument("--metrics", default=None, metavar="MAP",
+                   help="comma list of [name=]http://host:port/metrics "
+                        "endpoints to scrape once (operator "
+                        "--monitoring-port, tpu-store --monitoring-port)")
     p = sub.add_parser("trace", help="render a job's causal span timeline "
                                      "(submit → scheduled → launched → "
                                      "restarts → terminal) from the "
@@ -1104,6 +1325,8 @@ def main(argv=None) -> int:
             "store": cmd_store,
             "serve": cmd_serve,
             "trace": cmd_trace,
+            "alerts": cmd_alerts,
+            "top": cmd_top,
         }[args.verb](client, args)
     except Forbidden as e:
         # read-tier token on a mutating verb: authenticated but not

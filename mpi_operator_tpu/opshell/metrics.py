@@ -247,6 +247,22 @@ class Registry:
                 self._metrics[name] = _Metric(name, help_, kind)
             return self._metrics[name]
 
+    def names(self) -> List[str]:
+        """Every registered family name — the catalog SLO configs are
+        validated against (an objective naming an unknown family fails
+        closed at load; oplint OBS003 catches it at diff time)."""
+        with self._lock:
+            return sorted(self._metrics)
+
+    def kind_of(self, name: str) -> Optional[str]:
+        """'counter' | 'gauge' | 'histogram' for a registered family,
+        None for unknown — SLO config validation matches objective kinds
+        against instrument kinds (a latency objective on a counter is a
+        config bug, not a runtime surprise)."""
+        with self._lock:
+            m = self._metrics.get(name)
+        return getattr(m, "kind", None)
+
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
@@ -531,6 +547,32 @@ serve_replicas_ready = REGISTRY.gauge(
     "ready) — the supply side of the autoscaler's loop",
 )
 
+# --- the SLO plane (ISSUE 13): the monitor's own health + alert state ------
+
+slo_alerts_firing = REGISTRY.gauge(
+    "tpu_operator_slo_alerts_firing",
+    "1 per FIRING SLO alert (labeled objective=) — the pager's source of "
+    "truth; `ctl alerts` renders the same Alert objects this gauge mirrors",
+)
+slo_alerts_fired = REGISTRY.counter(
+    "tpu_operator_slo_alerts_fired_total",
+    "SLO alert firings by objective (a resolve+refire counts again) — a "
+    "climbing rate on one objective is a recurring regression, not noise",
+)
+monitor_scrape_errors = REGISTRY.counter(
+    "tpu_operator_monitor_scrape_errors_total",
+    "Failed scrape attempts by instance (unreachable target, malformed "
+    "exposition) — the 'monitor silent' runbook row starts here: a dead "
+    "target also shows as up{instance=}==0 in the monitor's ring",
+)
+monitor_series_dropped = REGISTRY.gauge(
+    "tpu_operator_monitor_series_dropped",
+    "Distinct timeseries the scraper refused past its max_series bound "
+    "(a label-cardinality explosion in a scraped target degrades SLO "
+    "coverage instead of growing monitor memory without limit; the "
+    "count saturates at 8x max_series)",
+)
+
 # --- the histogram catalog (ISSUE 9): latencies at the span-close sites ----
 
 reconcile_latency = REGISTRY.histogram(
@@ -601,4 +643,16 @@ autoscaler_sync_latency = REGISTRY.histogram(
     "Autoscaler decision-pass wall time (sample every serve, run the "
     "pure recommendation, write changed scales); observed where the "
     "autoscaler.sync span closes",
+)
+monitor_scrape_latency = REGISTRY.histogram(
+    "tpu_operator_monitor_scrape_latency_seconds",
+    "Per-target /metrics fetch+parse+ingest time (labeled instance=) — "
+    "the monitor's own cost; the slo bench holds its reconcile-p50 tax "
+    "to <=2%",
+)
+monitor_tick_latency = REGISTRY.histogram(
+    "tpu_operator_monitor_tick_latency_seconds",
+    "One full SLO-monitor pass (scrape every target, evaluate every "
+    "objective's burn windows, write alert transitions); observed where "
+    "the monitor.sync span closes",
 )

@@ -1,0 +1,26 @@
+# oplint fixture: OBS003 must fire on a counter/gauge/histogram
+# registered without non-empty HELP text, and on an SLO Objective(...)
+# naming a metric family the registry catalog never registers.
+from mpi_operator_tpu.controller.slo_monitor import Objective
+from mpi_operator_tpu.opshell.metrics import REGISTRY
+
+no_help = REGISTRY.counter("tpu_operator_mystery_total")  # expect: OBS003
+empty_help = REGISTRY.gauge("tpu_operator_mystery_gauge", "")  # expect: OBS003
+blank_help = REGISTRY.histogram(  # expect: OBS003
+    "tpu_operator_mystery_seconds", "   ",
+)
+
+
+def registry_attribute_receiver(metrics):
+    # metrics.REGISTRY resolves like a bare REGISTRY receiver
+    return metrics.REGISTRY.counter("tpu_operator_other_total")  # expect: OBS003
+
+
+phantom = Objective(  # expect: OBS003
+    name="phantom", metric="tpu_operator_nonexistent_seconds",
+    kind="latency", objective=0.99,
+)
+
+positional_metric = Objective(  # expect: OBS003
+    "phantom2", "tpu_operator_also_nonexistent_total", "latency", 0.99,
+)

@@ -1,0 +1,47 @@
+# oplint fixture: blessed OBS003 shapes — registrations carry HELP, an
+# Objective may reference any cataloged family (the canonical registry's
+# or one THIS file registers), non-constant metrics are unprovable, and
+# the reasoned suppression works.
+from mpi_operator_tpu.controller.slo_monitor import Objective
+from mpi_operator_tpu.opshell.metrics import REGISTRY
+
+helped = REGISTRY.counter(
+    "tpu_operator_local_total",
+    "a locally registered family, with the HELP triage reads",
+)
+helped_kw = REGISTRY.gauge(
+    "tpu_operator_local_gauge", help_="keyword form carries HELP too",
+)
+
+# references the CANONICAL catalog (opshell/metrics.py registrations)
+canonical = Objective(
+    name="reconcile", metric="tpu_operator_reconcile_latency_seconds",
+    kind="latency", objective=0.99,
+)
+
+# references the family registered ABOVE in this very file
+local = Objective(
+    name="local", metric="tpu_operator_local_total",
+    kind="latency", objective=0.99,
+)
+
+
+def dynamic_metric(family):
+    # non-constant metric name: unprovable statically; the config
+    # loader's fail-closed check owns this case at runtime
+    return Objective(name="dyn", metric=family, kind="latency",
+                     objective=0.99)
+
+
+def non_registry_receiver(hist_cls):
+    # a direct _Histogram(...) construction is not a registry
+    # registration (bench-local scratch instruments)
+    return hist_cls("bench_scratch_seconds")
+
+
+# oplint: disable=OBS003 — fixture-only: proving the reasoned
+# suppression silences the rule
+suppressed = Objective(
+    name="sup", metric="tpu_operator_suppressed_seconds",
+    kind="latency", objective=0.99,
+)

@@ -1,0 +1,1023 @@
+"""The SLO plane (ISSUE 13): scraper + timeseries ring, the fail-closed
+config loader, the pure multi-window burn-rate core (property-swept like
+the autoscaler's recommend() suite), the Alert kind's store lifecycle,
+the flight recorder, and the ctl surfaces.
+
+The counter-reset test is the satellite pin: ``rate()`` over a scraped
+counter must treat a process-restart value DECREASE as a new epoch (the
+post-restart value is the increase), proven against a real StoreServer
+subprocess SIGKILLed and restarted mid-window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpi_operator_tpu.api.types import ALERT_NAMESPACE, Alert, AlertState
+from mpi_operator_tpu.controller.slo_monitor import (
+    FIRE,
+    RESOLVE,
+    BurnPolicy,
+    FlightRecorder,
+    Objective,
+    Probe,
+    SLOConfigError,
+    SLOMonitor,
+    burn_rates,
+    error_fractions,
+    load_slo_config,
+    step,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.machinery.telemetry import (
+    MetricsScraper,
+    ScrapeTarget,
+    SeriesRing,
+    parse_scrape_targets,
+)
+from mpi_operator_tpu.opshell import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# SeriesRing: counter-reset-aware increase/rate + windowed reads
+# ---------------------------------------------------------------------------
+
+
+def _feed(ring, name, samples, **labels):
+    for t, v in samples:
+        ring.record(name, dict(labels), v, t)
+
+
+def test_increase_is_counter_reset_aware():
+    ring = SeriesRing()
+    # 10 → 25 → (restart) 3 → 8: increase = 15 + 3 + 5 = 23, never negative
+    _feed(ring, "c_total", [(0, 10), (10, 25), (20, 3), (30, 8)])
+    assert ring.increase("c_total", 100, now=30) == 23
+    assert ring.rate("c_total", 100, now=30) == pytest.approx(0.23)
+    # window whose baseline sample is the restarted epoch's first scrape
+    assert ring.increase("c_total", 8, now=30) == pytest.approx(5)
+    # window whose baseline predates the reset: the restart's full value
+    # counts (the counter re-began at 0 inside the window)
+    assert ring.increase("c_total", 12, now=30) == pytest.approx(8)
+
+
+def test_increase_uses_pre_window_baseline_and_none_without_data():
+    ring = SeriesRing()
+    _feed(ring, "c_total", [(0, 10), (10, 20)])
+    # baseline = the last pre-window sample: delta 10, not 20
+    assert ring.increase("c_total", 15, now=10) == 10
+    # a window past every sample has no data — None, not 0 (no data is
+    # not the same claim as zero traffic)
+    assert ring.increase("c_total", 5, now=100) is None
+    assert ring.increase("absent_total", 10, now=10) is None
+
+
+def test_series_subset_label_match_sums_instances():
+    ring = SeriesRing()
+    _feed(ring, "c_total", [(0, 0), (10, 5)], verb="create", instance="a")
+    _feed(ring, "c_total", [(0, 0), (10, 7)], verb="create", instance="b")
+    _feed(ring, "c_total", [(0, 0), (10, 100)], verb="delete", instance="a")
+    assert ring.increase("c_total", 20, now=10, verb="create") == 12
+    assert ring.increase("c_total", 20, now=10, verb="create",
+                         instance="a") == 5
+
+
+def test_ring_bounds_series_count_and_counts_drops():
+    ring = SeriesRing(max_series=3)
+    for i in range(6):
+        ring.record("m", {"i": str(i)}, 1.0, 0.0)
+    assert ring.series_count() == 3
+    assert ring.dropped_series == 3
+
+
+def test_windowed_quantile_and_error_fraction():
+    ring = SeriesRing()
+    h = metrics._Histogram("h_seconds", "test")
+
+    def scrape(t):
+        snap = h.snapshot() or [(le, 0)
+                                for le in (*h.buckets, float("inf"))]
+        for le, cum in snap:
+            ring.record("h_seconds_bucket",
+                        {"le": "+Inf" if le == float("inf") else f"{le:g}"},
+                        cum, t)
+
+    scrape(0.0)  # empty baseline: the pre-history anchor
+    for v in [0.002] * 50:
+        h.observe(v)
+    scrape(10.0)
+    for v in [3.0] * 50:
+        h.observe(v)
+    scrape(20.0)
+    # whole-history window (baseline at 0): p99 lands in the slow phase
+    assert ring.quantile("h_seconds", 0.99, 100, now=20.0) > 1.0
+    # a window covering only the slow phase (edge between scrapes: a
+    # scrape-boundary edge would pull the earlier delta in via the
+    # pre-window baseline — window resolution IS the scrape interval)
+    assert ring.quantile("h_seconds", 0.5, 9, now=20.0) > 1.0
+    # error fraction vs a 1s good-event bound: all of phase 2 is bad
+    assert ring.error_fraction("h_seconds", 1.0, 9, now=20.0) == 1.0
+    # whole history: half bad
+    assert ring.error_fraction("h_seconds", 1.0, 100,
+                               now=20.0) == pytest.approx(0.5)
+    # no observations in window → None
+    assert ring.error_fraction("h_seconds", 1.0, 5, now=100.0) is None
+
+
+def test_parse_scrape_targets_fails_closed():
+    assert parse_scrape_targets("") == []
+    got = parse_scrape_targets("op=self,s0=http://h:1/metrics")
+    assert got == [ScrapeTarget("op", "self"),
+                   ScrapeTarget("s0", "http://h:1/metrics")]
+    for bad in ("noequals", "a=", "=url", "a=ftp://x", "a=self,a=self"):
+        with pytest.raises(ValueError):
+            parse_scrape_targets(bad)
+
+
+# ---------------------------------------------------------------------------
+# the scraper: self + real HTTP + dead targets
+# ---------------------------------------------------------------------------
+
+
+def test_scraper_stamps_instance_and_records_up():
+    reg = metrics.Registry()
+    reg.counter("t_total", "help").inc(3)
+    s = MetricsScraper([ScrapeTarget("me", "self")], registry=reg)
+    ok = s.scrape_once(now=10.0)
+    assert ok == {"me": True}
+    lat = s.ring.latest("t_total")
+    assert lat and lat[0][0]["instance"] == "me" and lat[0][2] == 3.0
+    assert s.ring.latest("up")[0][2] == 1.0
+
+
+def test_scraper_surfaces_dead_target_as_up_zero():
+    s = MetricsScraper(
+        [ScrapeTarget("dead", "http://127.0.0.1:1/metrics")], timeout=0.5)
+    ok = s.scrape_once(now=1.0)
+    assert ok == {"dead": False}
+    assert s.last_error["dead"]
+    assert s.ring.latest("up")[0][2] == 0.0
+
+
+def _wait_http(url, timeout=20.0):
+    import urllib.request
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return r.read().decode()
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError(f"{url} never came up")
+
+
+def _spawn_store(tmp, port, mport):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+         "--store", f"sqlite:{os.path.join(tmp, 's.db')}",
+         "--listen", f"127.0.0.1:{port}",
+         "--monitoring-port", str(mport)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def test_rate_survives_scraped_store_server_restart(tmp_path):
+    """THE satellite pin: a scraped StoreServer is SIGKILLed and
+    restarted mid-window — its counters restart at zero, and rate()
+    must read the decrease as a new epoch (post-restart value counts
+    from 0), never a negative rate."""
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.http_store import HttpStoreClient
+    from mpi_operator_tpu.machinery.objects import ConfigMap
+    from mpi_operator_tpu.machinery.replica_wire import free_ports
+
+    port, mport = free_ports(2)
+    proc = _spawn_store(str(tmp_path), port, mport)
+    client = None
+    try:
+        _wait_http(f"http://127.0.0.1:{mport}/metrics")
+        client = HttpStoreClient(f"http://127.0.0.1:{port}", timeout=10.0,
+                                 conn_refused_retries=20)
+        scraper = MetricsScraper(
+            [ScrapeTarget("store", f"http://127.0.0.1:{mport}/metrics")])
+
+        def write(n, tag):
+            for i in range(n):
+                client.create(ConfigMap(metadata=ObjectMeta(
+                    name=f"{tag}-{i}", namespace="t")))
+
+        fam = "tpu_operator_store_write_requests_total"
+        write(4, "a")
+        scraper.scrape_once()           # baseline
+        write(5, "b")
+        scraper.scrape_once()           # +5 in the first epoch
+        proc.kill()
+        proc.wait(timeout=10)
+        assert scraper.scrape_once() == {"store": False}  # down: up==0
+        proc = _spawn_store(str(tmp_path), port, mport)
+        _wait_http(f"http://127.0.0.1:{mport}/metrics")
+        write(3, "c")                   # fresh process: counter restarts
+        scraper.scrape_once()
+        inc = scraper.ring.increase(fam, 300, verb="create")
+        assert inc == 8, f"reset-aware increase: want 5+3, got {inc}"
+        assert scraper.ring.rate(fam, 300, verb="create") > 0
+    finally:
+        if client is not None:
+            client.close()
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# the fail-closed config loader (single source of SLO truth)
+# ---------------------------------------------------------------------------
+
+
+def _write_cfg(tmp_path, doc):
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _good_doc(**over):
+    doc = {
+        "windows": {"fast": [5, 60], "slow": [30, 360]},
+        "burn": {"fast": 14.4, "slow": 6.0},
+        "clear_hold_s": 5,
+        "objectives": [{
+            "name": "reconcile", "kind": "latency",
+            "metric": "tpu_operator_reconcile_latency_seconds",
+            "threshold_ms": 1000, "objective": 0.99,
+        }],
+    }
+    doc.update(over)
+    return doc
+
+
+def test_default_config_loads_and_scales():
+    cfg = load_slo_config()
+    names = {o.name for o in cfg.objectives}
+    assert {"reconcile-latency", "scheduler-bind", "watch-lag",
+            "serve-ready", "replication-lag"} <= names
+    scaled = cfg.scaled(0.01)
+    assert scaled.policy.fast == (3.0, 36.0)
+    assert scaled.policy.clear_hold_s == 3.0
+
+
+def test_bench_and_monitor_share_one_threshold(tmp_path):
+    cfg = load_slo_config()
+    assert cfg.threshold_ms("reconcile-latency", env={}) == 1000.0
+    # env override wins, ABSOLUTE (beats any bench scale factor)
+    env = {"BENCH_CP_SLO_RECONCILE_P99_MS": "2500"}
+    assert cfg.threshold_ms("reconcile-latency", scale=2.0, env=env) == 2500.0
+    assert cfg.threshold_ms("reconcile-latency", scale=2.0, env={}) == 2000.0
+    # and the loader itself applies the same override to the objective
+    cfg2 = load_slo_config(env=env)
+    assert cfg2.objective("reconcile-latency").threshold_ms == 2500.0
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda d: d.update(objectives=[dict(d["objectives"][0],
+                                         metric="tpu_operator_nope")]),
+     "not in the registry catalog"),
+    (lambda d: d.update(objectives=[dict(d["objectives"][0],
+                                         threshold_ms=0)]),
+     "threshold_ms"),
+    (lambda d: d.update(objectives=[dict(d["objectives"][0],
+                                         objective=1.5)]),
+     "objective"),
+    (lambda d: d.update(objectives=[dict(d["objectives"][0],
+                                         kind="p99")]),
+     "unknown kind"),
+    (lambda d: d.update(objectives=[dict(d["objectives"][0],
+                                         surprise=1)]),
+     "unknown keys"),
+    (lambda d: d.update(objectives=[d["objectives"][0]] * 2),
+     "duplicate"),
+    (lambda d: d.update(windows={"fast": [60, 5], "slow": [30, 360]}),
+     "short < long"),
+    (lambda d: d.update(windows={"fast": [0, 5], "slow": [30, 360]}),
+     "short < long"),
+    (lambda d: d.update(burn={"fast": -1}),
+     "burn.fast"),
+    (lambda d: d.update(objectives=[]),
+     "non-empty"),
+    (lambda d: d.update(extra_top=True),
+     "unknown top-level"),
+    (lambda d: d.update(objectives=[{
+        "name": "lag", "kind": "gauge_max",
+        "metric": "tpu_operator_store_replication_lag_entries",
+        "objective": 0.99}]),
+     "bound"),
+    (lambda d: d.update(objectives=[{
+        "name": "x", "kind": "latency",
+        "metric": "tpu_operator_jobs_created_total",
+        "threshold_ms": 10, "objective": 0.9}]),
+     "histogram"),
+])
+def test_loader_fails_closed(tmp_path, mutate, needle):
+    doc = _good_doc()
+    mutate(doc)
+    with pytest.raises(SLOConfigError) as ei:
+        load_slo_config(_write_cfg(tmp_path, doc))
+    assert needle in str(ei.value)
+
+
+def test_loader_rejects_garbage_files(tmp_path):
+    with pytest.raises(SLOConfigError):
+        load_slo_config(str(tmp_path / "missing.json"))
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    with pytest.raises(SLOConfigError):
+        load_slo_config(str(p))
+
+
+def test_loader_rejects_bad_env_override(tmp_path):
+    path = _write_cfg(tmp_path, _good_doc(objectives=[{
+        "name": "reconcile", "kind": "latency",
+        "metric": "tpu_operator_reconcile_latency_seconds",
+        "threshold_ms": 1000, "objective": 0.99, "env": "X_SLO_MS"}]))
+    with pytest.raises(SLOConfigError):
+        load_slo_config(path, env={"X_SLO_MS": "fast"})
+    with pytest.raises(SLOConfigError):
+        load_slo_config(path, env={"X_SLO_MS": "-3"})
+
+
+# ---------------------------------------------------------------------------
+# the pure burn-rate core (mirrors the recommend() property suite)
+# ---------------------------------------------------------------------------
+
+P = BurnPolicy(fast=(5, 60), slow=(30, 360), burn_fast=14.4, burn_slow=6.0,
+               clear_hold_s=20.0)
+
+
+def _burns(fs=None, fl=None, ss=None, sl=None):
+    return {"fast_short": fs, "fast_long": fl,
+            "slow_short": ss, "slow_long": sl}
+
+
+def test_fire_needs_both_windows_of_a_pair():
+    st = Probe()
+    # short-window blip alone: no fire
+    st, ev = step(st, _burns(fs=100, fl=2, ss=1, sl=1), P, 0)
+    assert ev is None and not st.firing
+    # long window alone: no fire
+    st, ev = step(st, _burns(fs=2, fl=100), P, 1)
+    assert ev is None and not st.firing
+    # both: fire, attributed fast
+    st, ev = step(st, _burns(fs=100, fl=100), P, 2)
+    assert ev == FIRE and st.window == "fast" and st.fired_count == 1
+
+
+def test_no_data_never_fires():
+    st, ev = step(Probe(), _burns(), P, 0)
+    assert ev is None and not st.firing
+
+
+def test_fast_window_fires_before_slow_on_step_outage():
+    """A sudden total outage: the fast pair's windows fill first, so the
+    first firing must be attributed 'fast' — simulated as a uniform
+    event stream whose error fraction flips 0→1 at t=100."""
+    st = Probe()
+    first = None
+    for t in range(100, 200):
+        fracs = {}
+        for key, w in P.windows().items():
+            bad = min(t - 100, w)
+            fracs[key] = bad / w
+        st, ev = step(st, burn_rates(fracs, 0.01), P, float(t))
+        if ev == FIRE and first is None:
+            first = (t, st.window)
+    assert first is not None and first[1] == "fast"
+    # sanity: the slow pair WOULD have fired eventually on its own
+    slow_only = {k: (v if k.startswith("slow") else None)
+                 for k, v in burn_rates(
+                     {k: 1.0 for k in P.windows()}, 0.01).items()}
+    _, ev = step(Probe(), slow_only, P, 0)
+    assert ev == FIRE
+
+
+def test_hysteresis_no_flap_on_boundary_oscillating_series():
+    """A burn oscillating across the fire threshold every tick: one
+    FIRE, then the alert must STAY firing through the oscillation (each
+    hot tick re-arms the clean hold), resolving only after the series
+    goes durably clean."""
+    st = Probe()
+    events = []
+    t = 0.0
+    for i in range(60):
+        hot = i % 2 == 0
+        b = 20.0 if hot else 2.0
+        st, ev = step(st, _burns(fs=b, fl=b, ss=b / 3, sl=b / 3), P, t)
+        if ev:
+            events.append((t, ev))
+        t += 1.0
+    assert events == [(0.0, FIRE)], f"flapped: {events}"
+    assert st.firing
+    # durably clean → exactly one resolve after the hold (the last
+    # oscillation tick at t=59 was already clean, so the hold anchors
+    # there: resolve at 59 + clear_hold)
+    for i in range(30):
+        st, ev = step(st, _burns(fs=0.1, fl=0.1, ss=0.1, sl=0.1), P, t)
+        if ev:
+            events.append((t, ev))
+        t += 1.0
+    assert events == [(0.0, FIRE), (59.0 + 20.0, RESOLVE)]
+
+
+def test_cleared_alert_refires_only_after_clean_window():
+    st = Probe()
+    st, ev = step(st, _burns(fs=50, fl=50), P, 0)
+    assert ev == FIRE
+    # clean hold runs its course → resolve
+    t = 1.0
+    resolved_at = None
+    while resolved_at is None:
+        st, ev = step(st, _burns(fs=0.2, fl=0.2, ss=0.2, sl=0.2), P, t)
+        if ev == RESOLVE:
+            resolved_at = t
+        t += 1.0
+    assert resolved_at - 1.0 >= P.clear_hold_s - 1.0
+    # a fresh breach after the clean window fires AGAIN, count bumped
+    st, ev = step(st, _burns(fs=50, fl=50), P, t)
+    assert ev == FIRE and st.fired_count == 2
+
+
+def test_all_silent_while_firing_holds_state():
+    """Zero completions mid-incident is stall, not recovery: an
+    all-None tick must neither progress nor reset the clean hold."""
+    st, _ = step(Probe(), _burns(fs=50, fl=50), P, 0)
+    st, ev = step(st, _burns(fs=0.1, fl=0.1), P, 1)      # hold starts
+    assert st.clean_since == 1
+    st, ev = step(st, _burns(), P, 10)                    # silence: holds
+    assert ev is None and st.firing and st.clean_since == 1
+    st, ev = step(st, _burns(fs=0.1, fl=0.1), P, 25)      # hold completes
+    assert ev == RESOLVE
+
+
+def test_sweep_invariants_hold_over_seeded_burn_traces():
+    """30 seeded random error-fraction traces through the full pipeline
+    (windowed fractions → burns → step):
+
+    - FIRE only when both windows of a pair exceeded the threshold (no
+      alert without a sustained breach — a sub-window blip cannot);
+    - while firing, no RESOLVE unless the preceding clear_hold_s of
+      ticks were all non-hot;
+    - fired_count is monotonic; events alternate FIRE/RESOLVE."""
+    for seed in range(30):
+        rng = random.Random(seed)
+        policy = BurnPolicy(
+            fast=(rng.choice([3, 5]), rng.choice([30, 60])),
+            slow=(rng.choice([15, 30]), rng.choice([180, 360])),
+            clear_hold_s=rng.choice([5.0, 20.0]),
+        )
+        st = Probe()
+        series = []          # (t, frac)
+        frac = 0.0
+        last_event = None
+        last_hot_t = None
+        for tick in range(250):
+            t = float(tick)
+            r = rng.random()
+            if r < 0.05:
+                frac = 1.0
+            elif r < 0.2:
+                frac = 0.0
+            else:
+                frac = min(1.0, max(0.0, frac + rng.uniform(-0.3, 0.3)))
+            series.append((t, frac))
+
+            def wfrac(w):
+                vals = [f for (ts, f) in series if ts > t - w]
+                return sum(vals) / len(vals) if vals else None
+
+            fracs = {k: wfrac(w) for k, w in policy.windows().items()}
+            burns = burn_rates(fracs, 0.01)
+            hot = any(
+                b is not None and b > thr
+                for keys, thr in ((("fast_short", "fast_long"),
+                                   policy.burn_fast),
+                                  (("slow_short", "slow_long"),
+                                   policy.burn_slow))
+                for b in (burns[keys[0]], burns[keys[1]])
+            )
+            if hot:
+                last_hot_t = t
+            prev = st
+            st, ev = step(st, burns, policy, t)
+            if ev == FIRE:
+                assert not prev.firing
+                assert last_event in (None, RESOLVE)
+                breach_fast = all(
+                    burns[k] is not None and burns[k] > policy.burn_fast
+                    for k in ("fast_short", "fast_long"))
+                breach_slow = all(
+                    burns[k] is not None and burns[k] > policy.burn_slow
+                    for k in ("slow_short", "slow_long"))
+                assert breach_fast or breach_slow, (seed, tick)
+                assert st.fired_count == prev.fired_count + 1
+                last_event = FIRE
+            elif ev == RESOLVE:
+                assert prev.firing and last_event == FIRE
+                assert (last_hot_t is None
+                        or t - last_hot_t >= policy.clear_hold_s), (
+                    seed, tick, "resolved inside the dirty window")
+                last_event = RESOLVE
+            else:
+                assert st.firing == prev.firing
+            assert st.fired_count >= prev.fired_count
+
+
+# ---------------------------------------------------------------------------
+# monitor end-to-end (in-process store, synthetic clock)
+# ---------------------------------------------------------------------------
+
+
+def _mini_config(tmp_path):
+    path = _write_cfg(tmp_path, {
+        "windows": {"fast": [2, 8], "slow": [4, 16]},
+        "burn": {"fast": 10.0, "slow": 5.0},
+        "clear_hold_s": 2,
+        "objectives": [{
+            "name": "reconcile", "kind": "latency",
+            "metric": "tpu_operator_reconcile_latency_seconds",
+            "threshold_ms": 1000, "objective": 0.99, "severity": "page",
+        }],
+    })
+    return load_slo_config(path)
+
+
+def _drive(monitor, now, bad, n=40):
+    for _ in range(n):
+        metrics.reconcile_latency.observe(3.0 if bad else 0.001)
+    return monitor.tick(now=now)
+
+
+def test_monitor_writes_firing_alert_with_uid_pinned_lifecycle(tmp_path):
+    store = ObjectStore()
+    monitor = SLOMonitor(
+        store, [ScrapeTarget("op", "self")], _mini_config(tmp_path),
+        incident_dir=str(tmp_path / "incidents"),
+    )
+    now = 1000.0
+    for i in range(12):
+        states = _drive(monitor, now + i, bad=True)
+        if states["reconcile"].firing:
+            break
+    assert monitor.states["reconcile"].firing
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.is_firing()
+    assert alert.status.window == "fast"
+    assert alert.status.fired_count == 1
+    assert alert.spec.metric == "tpu_operator_reconcile_latency_seconds"
+    assert alert.status.incident and os.path.exists(alert.status.incident)
+    first_uid = alert.metadata.uid
+    assert metrics.slo_alerts_firing.get(objective="reconcile") == 1.0
+
+    # heal → resolved via status patch on the SAME object
+    now += 40
+    for i in range(30):
+        states = _drive(monitor, now + i, bad=False)
+        if not states["reconcile"].firing:
+            break
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.status.state == AlertState.RESOLVED
+    assert alert.metadata.uid == first_uid
+    assert alert.status.resolved_at is not None
+    assert metrics.slo_alerts_firing.get(objective="reconcile") == 0.0
+
+    # re-breach → SAME object refires, count bumps, resolution cleared
+    now += 40
+    for i in range(12):
+        states = _drive(monitor, now + i, bad=True)
+        if states["reconcile"].firing:
+            break
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.is_firing() and alert.status.fired_count == 2
+    assert alert.metadata.uid == first_uid
+    assert alert.status.resolved_at is None
+
+
+def test_monitor_never_fires_on_healthy_traffic(tmp_path):
+    store = ObjectStore()
+    monitor = SLOMonitor(store, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path))
+    for i in range(20):
+        _drive(monitor, 2000.0 + i, bad=False)
+    assert not monitor.states["reconcile"].firing
+    assert store.list("Alert", ALERT_NAMESPACE) == []
+
+
+def test_alert_transitions_ride_the_watch(tmp_path):
+    """Alerts are watchable like any kind: an informer-style watch sees
+    the ADDED (firing) and MODIFIED (resolved) transitions."""
+    store = ObjectStore()
+    q = store.watch("Alert")
+    monitor = SLOMonitor(store, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path))
+    now = 3000.0
+    for i in range(12):
+        if _drive(monitor, now + i, bad=True)["reconcile"].firing:
+            break
+    now += 40
+    for i in range(30):
+        if not _drive(monitor, now + i, bad=False)["reconcile"].firing:
+            break
+    seen = []
+    while not q.empty():
+        ev = q.get_nowait()
+        if ev.obj.kind == "Alert":
+            seen.append((ev.type, ev.obj.status.state))
+    assert ("ADDED", AlertState.FIRING) == seen[0]
+    assert seen[-1] == ("MODIFIED", AlertState.RESOLVED)
+    store.stop_watch(q)
+
+
+def test_flight_recorder_bundle_contents(tmp_path):
+    store = ObjectStore()
+    rec = FlightRecorder(str(tmp_path / "inc"))
+    alert = Alert.from_dict({
+        "metadata": {"name": "reconcile", "namespace": ALERT_NAMESPACE},
+        "spec": {"objective": "reconcile"},
+    })
+    scraper = MetricsScraper([ScrapeTarget("op", "self")])
+    scraper.scrape_once(now=1.0)
+    path = rec.dump(alert=alert, burns={"fast_short": 20.0},
+                    scraper=scraper, store=store,
+                    watch_tail=[{"t": 1, "type": "ADDED", "kind": "Pod",
+                                 "key": "d/p", "rv": 3}])
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        b = json.load(f)
+    assert b["objective"] == "reconcile"
+    assert b["burns"] == {"fast_short": 20.0}
+    assert b["watch_events"][0]["kind"] == "Pod"
+    assert "spans" in b and "scrape" in b and "events" in b
+    assert FlightRecorder.newest_bundle(str(tmp_path / "inc")) == path
+    assert FlightRecorder.newest_bundle(str(tmp_path / "empty")) is None
+
+
+# ---------------------------------------------------------------------------
+# Alert kind plumbing + ctl surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_alert_round_trips_through_every_backend(tmp_path):
+    from mpi_operator_tpu.machinery.serialize import decode, encode
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    a = Alert.from_dict({
+        "metadata": {"name": "reconcile", "namespace": ALERT_NAMESPACE},
+        "spec": {"objective": "reconcile", "metric": "m", "severity": "page"},
+        "status": {"state": "Firing", "window": "fast", "burn": 14.5,
+                   "since": 12.0, "fired_count": 2, "incident": "/x.json"},
+    })
+    assert decode("Alert", encode(a)).to_dict() == a.to_dict()
+    s = SqliteStore(str(tmp_path / "a.db"))
+    try:
+        s.create(a)
+        got = s.get("Alert", ALERT_NAMESPACE, "reconcile")
+        assert got.is_firing() and got.status.burn == 14.5
+    finally:
+        s.close()
+
+
+def _ctl(args, capsys):
+    from mpi_operator_tpu.opshell import ctl
+
+    rc = ctl.main(args)
+    return rc, capsys.readouterr().out
+
+
+def _seed_alert_store(tmp_path, firing=True):
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    path = str(tmp_path / "ctl.db")
+    s = SqliteStore(path)
+    s.create(Alert.from_dict({
+        "metadata": {"name": "reconcile-latency",
+                     "namespace": ALERT_NAMESPACE},
+        "spec": {"objective": "reconcile-latency", "severity": "page",
+                 "metric": "tpu_operator_reconcile_latency_seconds"},
+        "status": {"state": "Firing" if firing else "Resolved",
+                   "window": "fast", "burn": 22.0, "since": time.time(),
+                   "fired_count": 1,
+                   "message": "burning 22x"},
+    }))
+    s.close()
+    return path
+
+
+def test_ctl_alerts_exit_code_tracks_firing(tmp_path, capsys):
+    path = _seed_alert_store(tmp_path, firing=True)
+    rc, out = _ctl(["--store", f"sqlite:{path}", "alerts"], capsys)
+    assert rc == 1
+    assert "reconcile-latency" in out and "FIRING" in out.upper()
+    rc, out = _ctl(["--store", f"sqlite:{path}", "alerts", "-o", "json"],
+                   capsys)
+    assert rc == 1 and json.loads(out)[0]["status"]["state"] == "Firing"
+
+    (tmp_path / "sub").mkdir()
+    path2 = _seed_alert_store(tmp_path / "sub", firing=False)
+    rc, out = _ctl(["--store", f"sqlite:{path2}", "alerts"], capsys)
+    assert rc == 0 and "Resolved" in out
+
+
+def test_ctl_top_renders_overview_and_firing_alerts(tmp_path, capsys):
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+    path = _seed_alert_store(tmp_path, firing=True)
+    s = SqliteStore(path)
+    TPUJobClient(s).create({
+        "kind": "TPUJob", "metadata": {"name": "j1"},
+        "spec": {"worker": {"replicas": 2,
+                            "template": {"container": {"image": "x"}}}},
+    })
+    s.close()
+    rc, out = _ctl(["--store", f"sqlite:{path}", "top"], capsys)
+    assert rc == 0
+    assert "JOBS" in out and "1 total" in out
+    assert "ALERTS" in out and "1 FIRING" in out
+    assert "reconcile-latency" in out
+
+
+def test_ctl_top_scrapes_live_metrics_endpoint(tmp_path, capsys):
+    from mpi_operator_tpu.opshell.server import OpsServer
+
+    metrics.reconcile_latency.observe(0.005)
+    metrics.store_request_latency.observe(0.002, verb="patch", backend="X")
+    ops = OpsServer(0)
+    ops.start()
+    try:
+        path = _seed_alert_store(tmp_path, firing=False)
+        rc, out = _ctl(
+            ["--store", f"sqlite:{path}", "top", "--metrics",
+             f"op=http://127.0.0.1:{ops.port}/metrics"], capsys)
+        assert rc == 0
+        assert "== op ==" in out
+        assert "patch" in out       # the store-verb latency table
+        assert "reconcile: p50" in out
+    finally:
+        ops.stop()
+
+
+def test_operator_main_rejects_bad_slo_config(tmp_path, capsys):
+    from mpi_operator_tpu.opshell.__main__ import main as op_main
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_good_doc(objectives=[{
+        "name": "x", "kind": "latency", "metric": "tpu_operator_nope",
+        "threshold_ms": 5, "objective": 0.9}])))
+    rc = op_main(["--store", "memory", "--slo-config", str(bad),
+                  "--monitoring-port", "0"])
+    assert rc == 2
+    assert "not in the registry catalog" in capsys.readouterr().err
+
+
+class _FlakyStore(ObjectStore):
+    """A store whose reads/writes can be toggled to fail — the
+    mid-failover window the monitor's write-reconciliation exists for."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+
+    def _check(self):
+        if self.fail:
+            raise ConnectionError("store unreachable (injected)")
+
+    def try_get(self, *a, **kw):
+        self._check()
+        return super().try_get(*a, **kw)
+
+    def create(self, *a, **kw):
+        self._check()
+        return super().create(*a, **kw)
+
+    def patch(self, *a, **kw):
+        self._check()
+        return super().patch(*a, **kw)
+
+
+def test_resolve_retries_after_store_read_failure(tmp_path):
+    """A failed alert READ during resolve must not be mistaken for 'alert
+    deleted' — that once marked the resolve as written and left the
+    store's page stuck Firing forever."""
+    store = _FlakyStore()
+    monitor = SLOMonitor(store, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path))
+    now = 5000.0
+    for i in range(12):
+        if _drive(monitor, now + i, bad=True)["reconcile"].firing:
+            break
+    assert store.get("Alert", ALERT_NAMESPACE, "reconcile").is_firing()
+    # heal while the store is unreachable: the resolve write CANNOT land
+    store.fail = True
+    now += 40
+    for i in range(30):
+        if not _drive(monitor, now + i, bad=False)["reconcile"].firing:
+            break
+    assert not monitor.states["reconcile"].firing
+    assert store.get("Alert", ALERT_NAMESPACE, "reconcile").is_firing()
+    # store heals → the very next tick reconciles the resolve
+    store.fail = False
+    _drive(monitor, now + 40, bad=False)
+    assert store.get("Alert", ALERT_NAMESPACE,
+                     "reconcile").status.state == AlertState.RESOLVED
+
+
+def test_fire_write_retries_reuse_one_bundle_and_fire_time(tmp_path):
+    """Write retries while the store is down must not dump a fresh
+    flight-recorder bundle per tick, and the eventually-landed alert
+    must carry the TRUE fire time, not the retry time."""
+    store = _FlakyStore()
+    inc_dir = tmp_path / "incidents"
+    monitor = SLOMonitor(store, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path),
+                         incident_dir=str(inc_dir))
+    store.fail = True
+    now = 6000.0
+    fired_tick = None
+    for i in range(20):
+        if _drive(monitor, now + i, bad=True)["reconcile"].firing:
+            fired_tick = now + i
+            break
+    assert fired_tick is not None
+    for i in range(20, 26):  # six more retry ticks against the dead store
+        _drive(monitor, now + i, bad=True)
+    bundles = os.listdir(inc_dir)
+    assert len(bundles) == 1, f"one bundle per firing, got {bundles}"
+    store.fail = False
+    _drive(monitor, now + 26, bad=True)
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.is_firing()
+    assert alert.status.since == monitor.states["reconcile"].since
+    assert alert.status.since <= fired_tick  # fire time, not landing time
+    assert len(os.listdir(inc_dir)) == 1
+
+
+def test_restart_adopts_store_alert_state(tmp_path):
+    """Leader failover: a fresh monitor must adopt a FIRING alert the
+    previous leader left behind — resolving it when the breach heals —
+    and a later refire must CONTINUE the durable fired_count."""
+    store = ObjectStore()
+    cfg = _mini_config(tmp_path)
+    m1 = SLOMonitor(store, [ScrapeTarget("op", "self")], cfg)
+    now = 7000.0
+    for i in range(12):
+        if _drive(m1, now + i, bad=True)["reconcile"].firing:
+            break
+    assert store.get("Alert", ALERT_NAMESPACE, "reconcile").is_firing()
+
+    # the "new leader": fresh in-memory state, same store
+    m2 = SLOMonitor(store, [ScrapeTarget("op", "self")], cfg)
+    now += 40
+    for i in range(30):
+        if not _drive(m2, now + i, bad=False)["reconcile"].firing:
+            break
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.status.state == AlertState.RESOLVED, (
+        "the adopted firing alert must clear once its breach heals")
+    # refire under the new leader continues the recurrence record
+    now += 40
+    for i in range(12):
+        if _drive(m2, now + i, bad=True)["reconcile"].firing:
+            break
+    alert = store.get("Alert", ALERT_NAMESPACE, "reconcile")
+    assert alert.is_firing() and alert.status.fired_count == 2
+
+
+def test_monitor_ring_holds_the_longest_burn_window():
+    """At the production defaults (15s scrape, 6h slow_long) the ring
+    must retain ~1440 samples per series — the 512 default would make
+    the slow pair silently judge a ~2.1h window."""
+    monitor = SLOMonitor(None, [ScrapeTarget("op", "self")],
+                         load_slo_config(), interval=15.0)
+    assert monitor.scraper.ring.capacity >= 21600 / 15
+    # an explicit ring is the caller's choice and stays untouched
+    ring = SeriesRing(capacity=64)
+    monitor = SLOMonitor(None, [ScrapeTarget("op", "self")],
+                         load_slo_config(), interval=15.0, ring=ring)
+    assert monitor.scraper.ring.capacity == 64
+
+
+def test_adoption_retries_while_store_unreadable(tmp_path):
+    """A store unreachable at the new leader's FIRST tick (precisely
+    when leaders change) must not permanently skip adoption — the
+    previous leader's Firing alert would stick forever."""
+    store = _FlakyStore()
+    cfg = _mini_config(tmp_path)
+    m1 = SLOMonitor(store, [ScrapeTarget("op", "self")], cfg)
+    now = 9000.0
+    for i in range(12):
+        if _drive(m1, now + i, bad=True)["reconcile"].firing:
+            break
+    assert store.get("Alert", ALERT_NAMESPACE, "reconcile").is_firing()
+    # new leader; store down for its first ticks
+    m2 = SLOMonitor(store, [ScrapeTarget("op", "self")], cfg)
+    store.fail = True
+    now += 40
+    _drive(m2, now, bad=False)
+    assert "reconcile" in m2._adopt_pending
+    store.fail = False
+    for i in range(1, 30):
+        if not _drive(m2, now + i, bad=False)["reconcile"].firing:
+            break
+    assert not m2._adopt_pending
+    assert store.get("Alert", ALERT_NAMESPACE,
+                     "reconcile").status.state == AlertState.RESOLVED
+
+
+def test_deleted_alert_resolve_drops_the_firing_gauge(tmp_path):
+    """An admin deleting a Firing Alert object must not leave the
+    monitor's slo_alerts_firing gauge stuck at 1 (a phantom page)."""
+    store = ObjectStore()
+    monitor = SLOMonitor(store, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path))
+    now = 11000.0
+    for i in range(12):
+        if _drive(monitor, now + i, bad=True)["reconcile"].firing:
+            break
+    assert metrics.slo_alerts_firing.get(objective="reconcile") == 1.0
+    store.delete("Alert", ALERT_NAMESPACE, "reconcile")
+    now += 40
+    for i in range(30):
+        if not _drive(monitor, now + i, bad=False)["reconcile"].firing:
+            break
+    assert metrics.slo_alerts_firing.get(objective="reconcile") == 0.0
+
+
+def test_storeless_monitor_evaluates_without_store_writes(tmp_path):
+    """tpu-monitor without --store is the documented evaluate+log mode:
+    a breach must fire the in-memory probe without attempting store
+    writes (no AttributeError warnings against a None store)."""
+    monitor = SLOMonitor(None, [ScrapeTarget("op", "self")],
+                         _mini_config(tmp_path))
+    now = 13000.0
+    for i in range(12):
+        states = _drive(monitor, now + i, bad=True)
+        if states["reconcile"].firing:
+            break
+    assert monitor.states["reconcile"].firing
+    now += 40
+    for i in range(30):
+        if not _drive(monitor, now + i, bad=False)["reconcile"].firing:
+            break
+    assert not monitor.states["reconcile"].firing
+
+
+def test_scraper_rejects_duplicate_instance_names():
+    """Two targets sharing one instance label would interleave two
+    processes into the SAME series — every crossing reads as a counter
+    reset. Fail closed at construction (catches --scrape-targets
+    colliding with the operator's built-in 'operator=self')."""
+    with pytest.raises(ValueError, match="duplicate scrape instance"):
+        MetricsScraper([ScrapeTarget("op", "self"),
+                        ScrapeTarget("op", "http://h:1/metrics")])
+    from mpi_operator_tpu.controller.slo_monitor import build_monitor
+
+    with pytest.raises(ValueError, match="duplicate scrape instance"):
+        build_monitor(None,
+                      scrape_targets="operator=http://h:1/metrics",
+                      extra_targets=[ScrapeTarget("operator", "self")])
+
+
+def test_dropped_series_counts_distinct_not_attempts():
+    ring = SeriesRing(max_series=2)
+    for _ in range(5):  # repeated scrapes of the same refused series
+        for i in range(4):
+            ring.record("m", {"i": str(i)}, 1.0, 0.0)
+    assert ring.series_count() == 2
+    assert ring.dropped_series == 2  # i=2, i=3 — distinct, not 10
+
+
+def test_error_fractions_gauge_max_uses_worst_series():
+    ring = SeriesRing()
+    _feed(ring, "g", [(1, 0), (2, 0), (3, 0)], follower="a")
+    _feed(ring, "g", [(1, 0), (2, 2000), (3, 2000)], follower="b")
+    # oplint: disable=OBS003 — 'g' is this test's synthetic ring family,
+    # deliberately outside the registry catalog
+    obj = Objective(name="lag", metric="g", kind="gauge_max",
+                    objective=0.99, bound=1024)
+    policy = BurnPolicy(fast=(2, 3), slow=(3, 4), clear_hold_s=1)
+    fracs = error_fractions(ring, obj, policy, now=3.0)
+    # follower b breaches 2 of the 3 scrapes the fast_short window holds
+    # — the WORST series judges the objective, not the average
+    assert fracs["fast_short"] == pytest.approx(2 / 3)
+    # pinned to the healthy follower alone: nothing breaches
+    healthy = error_fractions(ring, obj, policy, now=3.0, follower="a")
+    assert healthy["fast_short"] == 0.0
+    # no samples in window → None, not zero
+    assert error_fractions(ring, obj, policy, now=50.0)["fast_short"] is None

@@ -1505,6 +1505,327 @@ def run_serve_mode() -> dict:
         trace.TRACER.disable()
 
 
+def run_drain_mode(seed: int) -> dict:
+    """The disruption plane under rolling maintenance (BENCH_CP_MODES=
+    drain, ISSUE 14): a hollow fleet hosts a DisruptionBudget-protected
+    TPUServe plus a live batch backlog while a seeded maintenance wave
+    rolls over 20% of the nodes (notice → cordon → checkpoint-then-migrate
+    → deadline), with ONE extra notice deliberately too short to drain in
+    time (the escalation bar).
+
+    Asserted (the slo block):
+    - every batch job reaches Succeeded DESPITE the wave, with
+      restart_count UNCHANGED (0) — planned moves never burn the
+      backoffLimit budget — while >=1 gang shows restart_generation > 0
+      (the migrations actually happened);
+    - ZERO windows with serve ready below the DisruptionBudget;
+    - every noticed node drains EMPTY and the deliberate overrun is
+      hard-evicted (drains_total{outcome=escalated} >= 1);
+    - SLOs green: reconcile/bind p99 within the slo_defaults.json bars,
+      drain-migration p99 within its objective threshold;
+    - the trace renders the story: ONE connected component holds the
+      notice (drain.node) → migration (drain.migrate_gang) → restart
+      (controller.gang_restart) chain, the escalated node's component
+      holds drain.escalate → drain.hard_evict → restart (the
+      maintenance-fire chain), and `ctl trace <job>` exits 0.
+    """
+    import io
+    import contextlib
+    import threading
+
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.api.client import TPUServeClient
+    from mpi_operator_tpu.controller.disruption import DrainController
+    from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+    from mpi_operator_tpu.controller.serve import TPUServeController
+    from mpi_operator_tpu.executor.hollow import (
+        HollowFleet,
+        HollowTimeline,
+        MaintenanceSchedule,
+    )
+    from mpi_operator_tpu.machinery import trace
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_MAINTENANCE_AT,
+        NODE_NAMESPACE,
+    )
+    from mpi_operator_tpu.opshell import ctl, metrics
+
+    nodes = int(os.environ.get("BENCH_CP_DRAIN_NODES", "100"))
+    fraction = float(os.environ.get("BENCH_CP_DRAIN_FRACTION", "0.2"))
+    batch_jobs = int(os.environ.get("BENCH_CP_DRAIN_BATCH_JOBS", "40"))
+    batch_pods = int(os.environ.get("BENCH_CP_DRAIN_BATCH_PODS", "2"))
+    batch_run_s = float(os.environ.get("BENCH_CP_DRAIN_BATCH_RUN_S", "6.0"))
+    notice_s = float(os.environ.get("BENCH_CP_DRAIN_NOTICE_S", "10.0"))
+    serve_replicas = 6
+    budget = 5
+    slo_reconcile = _slo_ms("reconcile-latency")
+    slo_bind = _slo_ms("scheduler-bind")
+    slo_drain = _slo_ms("drain-migration")
+
+    tmp = tempfile.mkdtemp(prefix="bench-cp-drain-")
+    trace_dir = os.path.join(tmp, "traces")
+    trace.TRACER.configure("bench-drain", dir=trace_dir)
+    backing = SqliteStore(os.path.join(tmp, "store.db"))
+    server = StoreServer(backing, "127.0.0.1", 0,
+                         log_capacity=65536).start()
+    client = HttpStoreClient(server.url, timeout=30.0,
+                             watch_poll_timeout=2.0)
+    fleet_client = HttpStoreClient(server.url, timeout=30.0,
+                                   watch_poll_timeout=2.0)
+    timeline = HollowTimeline(pending_s=0.05, run_s=batch_run_s,
+                              run_jitter_s=2.0, seed=seed,
+                              serve_warmup_s=0.3)
+    snaps = {
+        "reconcile": metrics.reconcile_latency.snapshot(),
+        "bind": metrics.scheduler_bind_latency.snapshot(),
+        "drain": metrics.drain_migration_latency.snapshot(),
+    }
+    escalated0 = metrics.drains_total.get(outcome="escalated")
+    cache = InformerCache(client).start()
+    recorder = EventRecorder(client)
+    controller = TPUJobController(
+        client, recorder, ControllerOptions(threadiness=4), cache=cache)
+    serve_controller = TPUServeController(client, recorder, cache=cache)
+    scheduler = GangScheduler(client, recorder, cache=cache)
+    monitor = NodeMonitor(client, recorder, cache=cache)
+    drain = DrainController(client, recorder, interval=0.2, cache=cache)
+    fleet = None
+    samples = []
+    min_ready = [serve_replicas]
+    try:
+        if not cache.wait_for_sync(30.0):
+            raise RuntimeError("informer cache never synced")
+        fleet = HollowFleet(fleet_client, nodes, timeline=timeline,
+                            capacity_chips=4,
+                            heartbeat_interval=2.0).start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(cache.list("Node")) >= nodes:
+                break
+            time.sleep(0.1)
+        controller.run()
+        serve_controller.run()
+        scheduler.start()
+        monitor.start()
+        drain.start()
+
+        TPUServeClient(client, namespace="bench").create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "bench"},
+            "spec": {
+                "replicas": serve_replicas, "workers_per_replica": 1,
+                "slice": {"accelerator": "cpu", "chips_per_host": 2},
+                "disruption_budget": budget, "max_surge": 2,
+                "max_unavailable": 1,
+            },
+        })
+        for i in range(batch_jobs):
+            job = _make_job(i, batch_pods, clean="None")
+            job.spec.worker.restart_policy = "OnFailure"
+            client.create(job)
+
+        def serve_ready() -> int:
+            s = client.try_get("TPUServe", "bench", "svc")
+            return s.status.ready_replicas if s else 0
+
+        def succeeded() -> int:
+            return sum(1 for j in client.list("TPUJob", "bench")
+                       if cond.is_succeeded(j.status))
+
+        t0 = time.time()
+        deadline = time.time() + 60
+        while time.time() < deadline and serve_ready() < serve_replicas:
+            time.sleep(0.2)
+        if serve_ready() < serve_replicas:
+            raise RuntimeError("serve never reached full readiness")
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            p.status.phase == "Running"
+            for p in cache.list("Pod", "bench")
+        ):
+            time.sleep(0.2)
+
+        # --- the rolling wave: 20% of the fleet, seeded, staggered -----
+        sched_m = MaintenanceSchedule(fraction=fraction, notice_s=notice_s,
+                                      start_s=0.5, stagger_s=0.4,
+                                      seed=seed)
+        victims = sched_m.victims(fleet.node_names)
+        fleet.arm_maintenance(sched_m)
+        # ... plus ONE deliberate overrun: a node with live pods and a
+        # notice far too short to drain gracefully → must hard-evict
+        overrun = None
+        deadline = time.time() + 30
+        while overrun is None and time.time() < deadline:
+            for p in cache.list("Pod", "bench"):
+                n = p.spec.node_name
+                if (n and n not in victims and not p.is_finished()
+                        and p.status.phase == "Running"):
+                    overrun = n
+                    break
+            time.sleep(0.1)
+        if overrun is None:
+            raise RuntimeError("no node eligible for the overrun probe")
+        # zero-warning reclaim: the deadline is already PAST when the
+        # notice lands, so the first drain tick must hard-evict (a
+        # graceful migration is store-instant and would beat any
+        # realistically short window)
+        fleet.announce_maintenance(overrun, time.time() - 0.1)
+
+        # --- drive to completion, sampling the budget every 100ms ------
+        sample_stop = threading.Event()
+
+        def sampler():
+            while not sample_stop.is_set():
+                r = serve_ready()
+                min_ready[0] = min(min_ready[0], r)
+                samples.append({"t": round(time.time() - t0, 1),
+                                "ready": r})
+                sample_stop.wait(0.1)
+
+        st = threading.Thread(target=sampler, daemon=True)
+        st.start()
+        run_deadline = time.time() + float(os.environ.get(
+            "BENCH_CP_DRAIN_DEADLINE_S", "180"))
+        done = 0
+        while time.time() < run_deadline:
+            done = succeeded()
+            if done >= batch_jobs:
+                break
+            time.sleep(0.5)
+        # every noticed node must drain EMPTY (cordoned, nothing live)
+        all_noticed = victims + [overrun]
+        drained_deadline = time.time() + 60
+        remaining = all_noticed
+        while time.time() < drained_deadline:
+            live = {p.spec.node_name for p in cache.list("Pod")
+                    if p.spec.node_name and not p.is_finished()}
+            remaining = [n for n in all_noticed if n in live]
+            if not remaining:
+                break
+            time.sleep(0.5)
+        # serve settles back to full strength off the drained nodes
+        settle_deadline = time.time() + 60
+        while time.time() < settle_deadline \
+                and serve_ready() < serve_replicas:
+            time.sleep(0.2)
+        sample_stop.set()
+        st.join(timeout=2)
+        elapsed = time.time() - t0
+
+        jobs_all = client.list("TPUJob", "bench")
+        migrated = [j for j in jobs_all
+                    if j.status.restart_generation > 0]
+        burned = [j.metadata.name for j in jobs_all
+                  if j.status.restart_count > 0]
+        escalated = metrics.drains_total.get(
+            outcome="escalated") - escalated0
+
+        # --- the trace story -------------------------------------------
+        trace.TRACER.flush()
+        spans = trace.load_spans(trace_dir)
+        comps = trace.connected_components(spans, link_traces=True)
+        by_id = {s["span_id"]: s for s in spans if "span_id" in s}
+
+        def component_names(comp):
+            return {by_id[sid]["name"] for sid in comp if sid in by_id}
+
+        migrate_chain = any(
+            {"drain.node", "drain.migrate_gang",
+             "controller.gang_restart"} <= component_names(c)
+            for c in comps
+        )
+        fire_chain = any(
+            {"drain.node", "drain.escalate", "drain.hard_evict",
+             "controller.gang_restart"} <= component_names(c)
+            for c in comps
+        )
+        ctl_trace_rc = None
+        if migrated:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                ctl_trace_rc = ctl.main([
+                    "--store", server.url, "-n", "bench",
+                    "trace", migrated[0].metadata.name,
+                    "--trace-dir", trace_dir,
+                ])
+
+        out = {
+            "metric": "controlplane_drain",
+            "seed": seed,
+            "nodes": nodes,
+            "noticed_nodes": len(all_noticed),
+            "batch_jobs": batch_jobs,
+            "batch_succeeded": done,
+            "gangs_migrated": len(migrated),
+            "jobs_with_burned_backoff": burned,
+            "serve_replicas": serve_replicas,
+            "disruption_budget": budget,
+            "min_ready_during_wave": min_ready[0],
+            "budget_violation_windows": sum(
+                1 for s in samples if s["ready"] < budget),
+            "drains_escalated": int(escalated),
+            "nodes_never_drained": remaining,
+            "trace_migrate_chain_connected": migrate_chain,
+            "trace_fire_chain_connected": fire_chain,
+            "ctl_trace_rc": ctl_trace_rc,
+            "elapsed_s": round(elapsed, 1),
+            "timeline_tail": samples[-40:],
+        }
+        for q, tag in ((0.50, "p50"), (0.99, "p99")):
+            out[f"reconcile_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.reconcile_latency, q, snaps["reconcile"],
+                metrics.reconcile_latency.snapshot()) * 1e3, 2)
+            out[f"bind_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.scheduler_bind_latency, q, snaps["bind"],
+                metrics.scheduler_bind_latency.snapshot()) * 1e3, 2)
+            out[f"drain_migration_{tag}_ms"] = round(_hist_quantile_delta(
+                metrics.drain_migration_latency, q, snaps["drain"],
+                metrics.drain_migration_latency.snapshot()) * 1e3, 2)
+        out["slo"] = {
+            "reconcile_p99_ms": slo_reconcile,
+            "bind_p99_ms": slo_bind,
+            "drain_migration_p99_ms": slo_drain,
+            "budget_violation_windows": 0,
+        }
+        out["ok"] = bool(
+            done >= batch_jobs
+            and not burned
+            and migrated
+            and min_ready[0] >= budget
+            and out["budget_violation_windows"] == 0
+            and escalated >= 1
+            and not remaining
+            and migrate_chain
+            and fire_chain
+            and ctl_trace_rc == 0
+            and out["reconcile_p99_ms"] <= slo_reconcile
+            and out["bind_p99_ms"] <= slo_bind
+            and out["drain_migration_p99_ms"] <= slo_drain
+        )
+        return out
+    finally:
+        try:
+            sample_stop.set()
+        except NameError:
+            pass
+        drain.stop()
+        monitor.stop()
+        for comp in (serve_controller, controller):
+            try:
+                comp.stop()
+            except Exception:
+                pass
+        scheduler.stop()
+        if fleet is not None:
+            fleet.stop()
+        cache.stop()
+        client.close()
+        fleet_client.close()
+        server.stop()
+        backing.close()
+        trace.TRACER.disable()
+
+
 def run_slo_overhead(jobs: int, pods: int, rounds: int) -> dict:
     """The monitor-tax bound (half of BENCH_CP_MODES=slo): interleaved
     off/on informer reconcile storms — 'on' runs a live SLOMonitor at a
@@ -1996,6 +2317,22 @@ def main() -> None:
             }
         elif mode == "serve":
             r = run_serve_mode()
+        elif mode == "drain":
+            # TWO runs on ONE seed (the chaos determinism contract): the
+            # rolling-maintenance bar must hold both times, not once by
+            # luck (ISSUE 14 acceptance → BENCH_CP_r14.json)
+            seed = int(os.environ.get("BENCH_CP_DRAIN_SEED", "1407"))
+            runs = [
+                run_drain_mode(seed)
+                for _ in range(int(os.environ.get("BENCH_CP_DRAIN_RUNS",
+                                                  "2")))
+            ]
+            r = {
+                "metric": "controlplane_drain",
+                "seed": seed,
+                "runs": runs,
+                "ok": all(x.get("ok") for x in runs),
+            }
         elif mode == "slo":
             # TWO detection runs on ONE seed (chaos determinism) + the
             # monitor-overhead A/B, one verdict (ISSUE 13 acceptance)

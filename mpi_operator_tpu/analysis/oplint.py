@@ -55,6 +55,14 @@ Rule catalog (rationale → the PR that motivated each):
   request timeout), and the gang scheduler listed pods under the
   scheduler lock. A lock held across a round-trip turns one slow backend
   response into a control-plane-wide stall.
+- **DIS001** a teardown verb (``evict_pod``, a direct Pod delete) inside a
+  drain/maintenance/migration-named code path outside the DrainController's
+  sanctioned seam. ISSUE 14 made planned disruption budgeted (serve
+  DisruptionBudget floors, maintenance evictions that never burn
+  backoffLimit, one-eviction dedupe against the node monitor); an ad-hoc
+  eviction on a drain path silently forfeits all three. The seam:
+  ``_migrate_batch_gangs``/``_escalate`` (controller/disruption.py) and
+  the serve controller's ``_drain_replica`` retire primitive.
 - **REP001** a mutation verb invoked directly on a follower/standby
   handle (``follower.update(...)``, ``self.standby.store.delete(...)``).
   ISSUE 8's replicated store routes every write through the leased
@@ -214,6 +222,20 @@ RULES: Dict[str, Rule] = {
             "nothing (the config loader fails closed at runtime; this "
             "catches it at diff time)",
             scope="all",
+        ),
+        Rule(
+            "DIS001", "error",
+            "direct eviction/teardown on a drain/maintenance path outside "
+            "the DrainController's sanctioned seam",
+            "ISSUE 14: planned disruption is budgeted and accounted — the "
+            "DrainController evicts with reason=Maintenance (free restart, "
+            "budget-floored serve migration, one eviction per gang even "
+            "when the node also dies). An ad-hoc evict_pod or Pod delete "
+            "on a drain path bypasses the DisruptionBudget, burns the "
+            "job's backoffLimit, and can double-tear the gang the "
+            "controller is already migrating; route through the "
+            "DrainController (or the serve controller's _drain_replica "
+            "retire seam)",
         ),
         Rule(
             "REP001", "error",
@@ -600,6 +622,60 @@ def _check_rep001(ctx: _FileCtx, call: ast.Call,
         )
 
 
+# DIS001: teardown verbs reached from a drain/maintenance-flavored code
+# path. Matching is by enclosing-function name (the same approximation
+# REP001 uses for the replication seam): a function named for draining /
+# evacuation / maintenance / migration that calls `evict_pod(...)` or
+# deletes Pods directly is re-implementing the DrainController's job
+# without its budget, dedupe, or free-restart accounting.
+_DISRUPTION_FN_RE = re.compile(r"(^|_)(drain|evacuat|maintenan|migrat)", re.I)
+# the sanctioned seam: the DrainController's own executors and the serve
+# controller's gang-retire primitive (rollout + migration share it)
+_DISRUPTION_SEAM_FNS = {
+    "_migrate_batch_gangs", "_escalate", "_drain_replica",
+}
+_POD_DELETE_VERBS = {"delete", "try_delete"}
+
+
+def _on_disruption_path(fn_stack: List[str]) -> bool:
+    return any(_DISRUPTION_FN_RE.search(name) for name in fn_stack)
+
+
+def _in_disruption_seam(fn_stack: List[str]) -> bool:
+    return any(name in _DISRUPTION_SEAM_FNS for name in fn_stack)
+
+
+def _check_dis001(ctx: _FileCtx, call: ast.Call,
+                  fn_stack: List[str]) -> None:
+    if not _on_disruption_path(fn_stack) or _in_disruption_seam(fn_stack):
+        return
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name == "evict_pod":
+        ctx.report(
+            "DIS001", call,
+            f"evict_pod(...) on the drain path {fn_stack[-1]!r} bypasses "
+            f"the DrainController seam (budget floor, maintenance "
+            f"free-restart accounting, one-eviction dedupe); stamp the "
+            f"maintenance notice and let the controller evacuate",
+        )
+        return
+    if (
+        name in _POD_DELETE_VERBS
+        and call.args
+        and _const(call.args[0]) == "Pod"
+    ):
+        ctx.report(
+            "DIS001", call,
+            f"direct Pod {name}(...) on the drain path {fn_stack[-1]!r} "
+            f"tears workload down outside the DrainController's "
+            f"sanctioned seam; route through the drain plane (or the "
+            f"serve controller's _drain_replica retire seam)",
+        )
+
+
 def _check_obs001(ctx: _FileCtx, call: ast.Call,
                   with_context_calls: Set[int]) -> None:
     """A ``start_span(...)`` call (any receiver — the module function,
@@ -971,6 +1047,7 @@ def lint_source(
             _check_blk001(ctx, node, fn_stack)
             _check_dur001(ctx, node, fn_stack)
             _check_rep001(ctx, node, fn_stack)
+            _check_dis001(ctx, node, fn_stack)
             _check_obs001(ctx, node, with_context_calls)
             _check_obs003(ctx, node, file_catalog)
             if lock_depth > 0:

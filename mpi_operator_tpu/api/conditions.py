@@ -26,6 +26,7 @@ from mpi_operator_tpu.api.types import Condition, ConditionType, JobStatus
 REASON_CREATED = "TPUJobCreated"
 REASON_RUNNING = "TPUJobRunning"
 REASON_RESTARTING = "TPUJobRestarting"
+REASON_MIGRATING = "TPUJobMigrating"
 REASON_SUSPENDED = "TPUJobSuspended"
 REASON_RESUMED = "TPUJobResumed"
 REASON_SUCCEEDED = "TPUJobSucceeded"
@@ -45,14 +46,26 @@ def get_condition(status: JobStatus, ctype: str) -> Optional[Condition]:
 def _filter_out(conditions: List[Condition], ctype: str) -> List[Condition]:
     """≙ filterOutCondition (status.go:131-153)."""
     out: List[Condition] = []
+    # Migrating is the planned-disruption flavor of Restarting: the two
+    # restart-ish states and Running are mutually exclusive, exactly the
+    # Running↔Restarting rule the reference pins (status.go:131-153)
+    _restartish = (ConditionType.RESTARTING, ConditionType.MIGRATING)
     for c in conditions:
         if c.type == ctype:
             continue
-        if ctype == ConditionType.RESTARTING and c.type == ConditionType.RUNNING:
+        if ctype in _restartish and c.type == ConditionType.RUNNING:
             continue
-        if ctype == ConditionType.RUNNING and c.type == ConditionType.RESTARTING:
+        if ctype == ConditionType.RUNNING and c.type in _restartish:
             continue
-        if ctype in (ConditionType.RESTARTING, ConditionType.RUNNING) and c.type in (
+        if ctype == ConditionType.RESTARTING and c.type == ConditionType.MIGRATING:
+            continue
+        if ctype == ConditionType.MIGRATING and c.type == ConditionType.RESTARTING:
+            continue
+        if ctype in (
+            ConditionType.RESTARTING,
+            ConditionType.MIGRATING,
+            ConditionType.RUNNING,
+        ) and c.type in (
             ConditionType.FAILED,
             ConditionType.SUCCEEDED,
         ):
@@ -62,12 +75,16 @@ def _filter_out(conditions: List[Condition], ctype: str) -> List[Condition]:
             c.status = False
         if ctype in (ConditionType.SUCCEEDED, ConditionType.FAILED) and c.type in (
             ConditionType.RUNNING,
+            ConditionType.RESTARTING,
+            ConditionType.MIGRATING,
             ConditionType.SUCCEEDED,
             ConditionType.FAILED,
         ):
-            # terminal condition supersedes Running and any *prior* opposite
-            # terminal state (a restarted-then-succeeded job must not keep
-            # reporting Failed=True), ≙ status.go:146
+            # terminal condition supersedes Running, the restart-ish states
+            # and any *prior* opposite terminal state (a restarted-then-
+            # succeeded job must not keep reporting Failed=True — nor keep
+            # an active Restarting/Migrating when the relaunched gang went
+            # straight to terminal), ≙ status.go:146
             c.status = False
         out.append(c)
     return out

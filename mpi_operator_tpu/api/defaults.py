@@ -129,3 +129,17 @@ def set_serve_defaults(serve: TPUServe) -> TPUServe:
             else DEFAULT_SERVE_REPLICAS
         )
     return serve
+
+
+def effective_disruption_budget(serve: TPUServe) -> int:
+    """THE DisruptionBudget rule (ISSUE 14), shared by the serve
+    controller's retire gate and the DrainController's blocked-drain
+    reporting so the two can never disagree: an unset budget defaults to
+    ``replicas - max_unavailable`` (planned disruption is never allowed
+    to be worse than a rollout). Callers max() this with the rollout
+    floor — an explicit low value relaxes toward that floor, never below
+    it. Call on a DEFAULTED serve (after :func:`set_serve_defaults`)."""
+    spec = serve.spec
+    if spec.disruption_budget is not None:
+        return max(0, spec.disruption_budget)
+    return max(0, (spec.replicas or 0) - (spec.max_unavailable or 0))

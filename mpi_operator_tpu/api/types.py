@@ -166,11 +166,17 @@ class ConditionType:
     CREATED = "Created"
     RUNNING = "Running"
     RESTARTING = "Restarting"
+    # the planned-disruption flavor of Restarting: the gang is being
+    # checkpoint-migrated off a draining node (reason names the node). A
+    # Migrating restart is FREE — restart_generation advances, the
+    # backoffLimit budget does not (disruption plane, ISSUE 14).
+    MIGRATING = "Migrating"
     SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
 
-    ALL_VALUES = (CREATED, RUNNING, RESTARTING, SUSPENDED, SUCCEEDED, FAILED)
+    ALL_VALUES = (CREATED, RUNNING, RESTARTING, MIGRATING, SUSPENDED,
+                  SUCCEEDED, FAILED)
 
 
 # ---------------------------------------------------------------------------
@@ -705,6 +711,15 @@ class TPUServeSpec(_Dictable):
     # window rollout the serve bench asserts
     max_surge: Optional[int] = None
     max_unavailable: Optional[int] = None
+    # DisruptionBudget (a PDB riding the rollout machinery, ISSUE 14):
+    # the minimum READY replica count that must survive any PLANNED
+    # disruption — a maintenance drain may retire a ready replica only
+    # when a surged replacement keeps ready_total above this floor.
+    # None defaults to replicas - max_unavailable at reconcile time
+    # (planned disruption is never allowed to be worse than a rollout);
+    # an explicit low value can only RELAX toward that rollout floor,
+    # never below it — the zero-unready rollout guarantee always holds.
+    disruption_budget: Optional[int] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "TPUServeSpec":
@@ -718,6 +733,7 @@ class TPUServeSpec(_Dictable):
             priority_class=d.get("priority_class"),
             max_surge=d.get("max_surge"),
             max_unavailable=d.get("max_unavailable"),
+            disruption_budget=d.get("disruption_budget"),
         )
 
 

@@ -286,6 +286,9 @@ def validate_tpuserve(serve: TPUServe) -> List[str]:
         errs.append("spec.max_surge: must be >= 1")
     if spec.max_unavailable is not None and spec.max_unavailable < 0:
         errs.append("spec.max_unavailable: must be >= 0")
+    if spec.disruption_budget is not None and spec.disruption_budget < 0:
+        errs.append("spec.disruption_budget: must be >= 0 (minimum ready "
+                    "replicas a planned drain must leave serving)")
 
     if spec.priority_class:
         from mpi_operator_tpu.scheduler.gang import (

@@ -57,6 +57,7 @@ from mpi_operator_tpu.controller.placement import (
 from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
+    REASON_MAINTENANCE,
     ConfigMap,
     Pod,
     PodGroup,
@@ -921,7 +922,26 @@ class TPUJobController:
         if failed:
             retryable = any(self._pod_retryable(job, p) for p in failed)
             all_pods = self._list_workers(job)  # incl. over-index stragglers
-            if retryable and cond.update_job_conditions(
+            # a maintenance-evicted member marks the whole generation as a
+            # MIGRATION (the planned-disruption flavor of Restarting): the
+            # condition machine treats the two restart-ish states as one
+            # slot, so `ctl describe` shows Migrating while the
+            # checkpoint-then-migrate drains and relaunches
+            migrating = retryable and any(
+                p.status.reason == REASON_MAINTENANCE for p in failed
+            )
+            if migrating and cond.update_job_conditions(
+                job.status,
+                ConditionType.MIGRATING,
+                cond.REASON_MIGRATING,
+                f"gang is migrating off a draining node "
+                f"({failed[0].status.message or 'maintenance'})",
+            ):
+                self.recorder.event(
+                    job, NORMAL, cond.REASON_MIGRATING,
+                    "gang migrating off a draining node",
+                )
+            elif not migrating and retryable and cond.update_job_conditions(
                 job.status,
                 ConditionType.RESTARTING,
                 cond.REASON_RESTARTING,
@@ -951,13 +971,17 @@ class TPUJobController:
                 # A busy cluster preempting a low-priority job 3 times must
                 # not permanently FAIL it with backoffLimit=2. The free pass
                 # requires every RETRYABLE failure in the generation to be a
-                # preemption — non-retryable companions (rc=1 collective
+                # PLANNED disruption (preemption or a drain's maintenance
+                # migration) — non-retryable companions (rc=1 collective
                 # errors) are collateral of the eviction, but a pod that
                 # failed retryably on its own (exit 137, EXIT_RESTART)
                 # means the workload was crashing anyway and the generation
                 # must still count toward backoffLimit.
-                preempted = any(p.is_preempted() for p in failed) and all(
-                    p.is_preempted() or not self._pod_retryable(job, p)
+                preempted = any(
+                    p.is_planned_disruption() for p in failed
+                ) and all(
+                    p.is_planned_disruption()
+                    or not self._pod_retryable(job, p)
                     for p in failed
                 )
                 backoff = job.spec.run_policy.backoff_limit

@@ -1,0 +1,672 @@
+"""DrainController: the disruption plane's per-node evacuation orchestrator.
+
+Real TPU fleets are dominated by *planned* disruption — Cloud TPU
+maintenance events and spot reclaims arrive with advance notice — yet until
+this module the operator only had the unplanned path (node_monitor sees a
+dead heartbeat and fires a lossy gang restart). This controller turns
+"this node will die at T" into a budgeted, observable workflow:
+
+- **Notice contract**: a Node carrying the ``tpujob.dev/maintenance-at``
+  annotation (absolute unix ts — stamped by ``ctl drain <node>
+  [--deadline S]`` or a hollow fleet's seeded maintenance schedule) is
+  adopted: cordoned (no new bindings) and marked with an active
+  ``Draining`` condition.
+- **Batch gangs get checkpoint-then-migrate**: every TPUJob gang with a
+  member bound to the draining node is evicted WHOLE (reason
+  ``Maintenance``) — the agent's ``--eviction-grace`` path SIGTERMs each
+  worker, which force-checkpoints at a gang-uniform step (ops/elastic.py)
+  before exiting; the controller then relaunches the full gang, which the
+  scheduler places off the cordoned node. The move is FREE:
+  ``restart_generation`` advances, ``restart_count`` (the backoffLimit
+  budget) does not.
+- **Serve replicas migrate surge-first**: the TPUServe controller (made
+  drain-aware in controller/serve.py) surges a replacement gang elsewhere,
+  waits for it to pass the readiness gate, and only then retires the
+  doomed replica — ``ready_total`` never drops below the serve's
+  ``DisruptionBudget``. This controller only *observes* serve progress: a
+  drain that cannot proceed without violating a budget (cluster too full
+  to surge) parks as ``drain_budget_blocked`` with an Event explaining
+  why, and unblocks the moment capacity frees (everything here is
+  level-triggered — no internal state a failover could lose).
+- **Deadline escalation**: when ``maintenance-at`` arrives (or the node is
+  already dead — a draining node that also stops heartbeating resolves to
+  ONE eviction, here, never a second one in node_monitor) anything still
+  bound is hard-evicted: the budget yields to physics, because the
+  hardware is going away either way.
+- **Failover-safe by construction**: the notice, the cordon, the Draining
+  condition and every eviction live in the store; the per-tick sync
+  re-derives everything else, so a new leader resumes a half-finished
+  drain exactly where the old one died.
+
+Observability: ``drain.node`` (one per adopted notice) → per-gang
+``drain.migrate_gang`` spans in each affected job's trace (the cross-trace
+edge ``ctl trace`` renders), ``drain.escalate`` on deadline overruns;
+``tpu_operator_drains_total`` by outcome, the ``drain_budget_blocked``
+gauge, and the ``drain_migration_latency`` histogram — sampled every tick
+for still-draining nodes past the SLO threshold, so a STUCK drain keeps
+scoring bad events and the burn-rate monitor pages (the
+``drain-migration`` objective in controller/slo_defaults.json).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    NODE_NAMESPACE,
+    REASON_MAINTENANCE,
+    NodeConditionType,
+    evict_pod,
+    maintenance_at,
+    node_draining,
+)
+from mpi_operator_tpu.machinery.store import NotFound
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.drain")
+
+# duplicated label constants (this controller must not import the batch or
+# serve controller modules just for strings; tests pin they stay identical)
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
+
+EVENT_DRAIN_STARTED = "DrainStarted"
+EVENT_DRAIN_COMPLETED = "DrainCompleted"
+EVENT_DRAIN_ESCALATED = "DrainEscalated"
+EVENT_DRAIN_BLOCKED = "DrainBudgetBlocked"
+EVENT_MAINTENANCE_INVALID = "MaintenanceAnnotationInvalid"
+EVENT_GANG_MIGRATING = "GangMigrating"
+
+# how a migration-latency "bad event" is scored while a drain is still in
+# flight: once the node has been draining longer than this, every tick
+# observes the elapsed age into the histogram — a stuck drain therefore
+# keeps burning SLO budget until someone acts (see module docstring).
+STUCK_SAMPLE_AFTER_S = 60.0
+
+
+class DrainController:
+    """Leader-only, level-triggered per-node evacuation. Same operational
+    shape as the NodeMonitor (periodic scan over informer reads, writes
+    through the store); every decision is recomputed from observed state,
+    which is what makes a half-finished drain survive leader failover."""
+
+    def __init__(
+        self,
+        store,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        interval: float = 1.0,
+        node_grace: float = 6.0,
+        cache=None,
+    ):
+        self.store = store
+        self.cache = cache
+        self.read = cache if cache is not None else store
+        self.recorder = recorder or EventRecorder(
+            store, component="tpujob-drain-controller"
+        )
+        self.interval = interval
+        # a draining node whose heartbeat is older than this is DEAD: the
+        # grace window cannot checkpoint anything, so escalation fires
+        # immediately (matches the NodeMonitor's liveness bar)
+        self.node_grace = node_grace
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # node name → (maintenance_at value, drain.node span context,
+        # first-seen ts): the trace anchor of the current drain. Rebuilt
+        # lazily after failover — a fresh leader opens a fresh drain.node
+        # span; causality still connects through the migrate spans in each
+        # job's trace.
+        self._active: Dict[str, Tuple[float, object, float]] = {}
+        # (job uid, restart_generation) pairs already migrated — the
+        # once-per-generation guard on migrate spans/events (evict_pod
+        # itself is idempotent; this only dedupes observability)
+        self._migrated: Set[Tuple[str, int]] = set()
+        # node → last blocked-explanation message (Event dedupe)
+        self._blocked_msg: Dict[str, str] = {}
+        # node → deadline of the drain already recorded COMPLETE: the
+        # Drained patch goes through self.store but the next tick re-reads
+        # through the informer, which may not have echoed it yet — without
+        # this memo that one stale read double-counts drains_total
+        # {completed}, double-observes the latency histogram and re-emits
+        # the DrainCompleted event
+        self._completed: Dict[str, float] = {}
+        # nodes whose malformed annotation was already warned about
+        self._warned_invalid: Set[str] = set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="drain-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync()
+            except Exception:
+                log.exception("drain sync failed")  # next tick retries
+
+    # -- the per-tick evacuation pass ---------------------------------------
+
+    def sync(self) -> None:
+        if self.cache is not None and not self.cache.has_synced():
+            return  # cold cache = empty world; next tick retries
+        now = time.time()
+        nodes = self.read.list("Node", NODE_NAMESPACE)
+        noticed = {}
+        for node in nodes:
+            if ANNOTATION_MAINTENANCE_AT not in node.metadata.annotations:
+                # a completed drain's bookkeeping is dropped when the
+                # annotation clears (ctl uncordon after maintenance)
+                self._forget(node.metadata.name)
+                continue
+            deadline = maintenance_at(node)
+            if deadline is None:
+                if node.metadata.name not in self._warned_invalid:
+                    self._warned_invalid.add(node.metadata.name)
+                    self.recorder.event(
+                        node, WARNING, EVENT_MAINTENANCE_INVALID,
+                        f"unparseable {ANNOTATION_MAINTENANCE_AT} value "
+                        f"{node.metadata.annotations.get(ANNOTATION_MAINTENANCE_AT)!r}"
+                        f" — expected a unix timestamp; ignoring the notice",
+                    )
+                continue
+            noticed[node.metadata.name] = (node, deadline)
+        for stale in set(self._active) - set(noticed):
+            self._forget(stale)
+        if not noticed:
+            metrics.drain_budget_blocked.set(0)
+            return
+
+        # ONE pod list for the whole tick regardless of draining-node count
+        pods = self.read.list("Pod")
+        blocked_total = 0
+        for name, (node, deadline) in sorted(noticed.items()):
+            try:
+                blocked_total += self._sync_node(node, deadline, pods, now)
+            except NotFound:
+                continue  # node deleted under us; next tick re-derives
+        metrics.drain_budget_blocked.set(blocked_total)
+
+    def _forget(self, node_name: str) -> None:
+        self._active.pop(node_name, None)
+        self._blocked_msg.pop(node_name, None)
+        self._completed.pop(node_name, None)
+        self._warned_invalid.discard(node_name)
+
+    def _sync_node(self, node, deadline: float, pods: List, now: float) -> int:
+        """Evacuate one noticed node. Returns the number of budget-blocked
+        serves currently parking this drain (the gauge contribution)."""
+        name = node.metadata.name
+        live = [
+            p for p in pods
+            if p.spec.node_name == name and not p.is_finished()
+        ]
+        anchor = self._adopt(node, deadline, now, idle=not live)
+        if not live:
+            self._complete(node, anchor, now)
+            return 0
+        age = now - anchor[2]
+        if age > STUCK_SAMPLE_AFTER_S:
+            # a stuck drain must PAGE: keep scoring its age as a bad
+            # latency event so the burn-rate monitor sees a breach (a
+            # completed drain scores its true latency exactly once)
+            metrics.drain_migration_latency.observe(age)
+        hb = node.status.last_heartbeat
+        dead = bool(hb) and now - hb > self.node_grace or not node.status.ready
+        if now >= deadline or dead:
+            self._escalate(node, anchor, live, dead=dead, now=now)
+            return 0
+        batch = [p for p in live if LABEL_SERVE_NAME not in p.metadata.labels]
+        self._migrate_batch_gangs(node, anchor, batch)
+        return self._observe_serve_progress(node, live)
+
+    # -- adoption / completion ----------------------------------------------
+
+    def _adopt(self, node, deadline: float, now: float, *,
+               idle: bool = False):
+        """Idempotently take ownership of a maintenance notice: cordon,
+        flip the Draining condition active, open the drain.node anchor
+        span. Store state is only written when it differs (a resumed
+        leader re-adopts for free); the in-memory anchor re-arms whenever
+        the maintenance-at value changes (a re-scheduled window is a new
+        drain). ``idle`` (no live pod bound) adoption never touches the
+        Draining condition: re-activating it on a node whose drain a
+        PREVIOUS leader already completed — or that was empty all along —
+        would re-announce a drain with nothing to do and strand the
+        condition active."""
+        name = node.metadata.name
+        cur = self._active.get(name)
+        if cur is not None and cur[0] == deadline:
+            return cur
+        with trace.start_span(
+            "drain.node",
+            attrs={
+                "node": name,
+                "maintenance_at": deadline,
+                "notice_s": round(max(0.0, deadline - now), 1),
+            },
+        ) as sp:
+            anchor = (deadline, sp.context(), now)
+            self._active[name] = anchor
+            self._completed.pop(name, None)  # a new window drains anew
+            changes = {}
+            if not node.status.unschedulable:
+                changes["unschedulable"] = True
+            if not idle and not node_draining(node):
+                changes["conditions"] = self._conditions_patch(
+                    node, True, "MaintenanceNotice",
+                    f"maintenance at {deadline:.0f}; evacuating",
+                )
+            if changes:
+                try:
+                    self.store.patch(
+                        "Node", NODE_NAMESPACE, name,
+                        {"status": changes}, subresource="status",
+                    )
+                except NotFound:
+                    raise
+                self.recorder.event(
+                    node, NORMAL, EVENT_DRAIN_STARTED,
+                    f"maintenance notice adopted: node dies at "
+                    f"{deadline:.0f} ({max(0.0, deadline - now):.0f}s); "
+                    f"cordoned, evacuating",
+                )
+                metrics.drains_total.inc(outcome="started")
+        return anchor
+
+    @staticmethod
+    def _conditions_patch(node, active: bool, reason: str,
+                          message: str) -> List[dict]:
+        """The full conditions list with Draining set as asked — Node
+        conditions ride a merge patch, and lists replace whole."""
+        from mpi_operator_tpu.api.types import Condition
+
+        out = [
+            c.to_dict() for c in node.status.conditions
+            if c.type != NodeConditionType.DRAINING
+        ]
+        out.append(Condition.new(
+            NodeConditionType.DRAINING, active, reason, message
+        ).to_dict())
+        return out
+
+    def _complete(self, node, anchor, now: float) -> None:
+        """Nothing live remains bound: the drain is done. The node stays
+        cordoned and keeps its notice (the hardware still dies at T);
+        `ctl uncordon` clears both when it returns from maintenance."""
+        if self._completed.get(node.metadata.name) == anchor[0]:
+            return  # recorded; an informer read lagging our own Drained
+            # patch must not double-count the completion
+        if not node_draining(node):
+            # already inactive in the store (e.g. a resumed leader finds
+            # the predecessor's bookkeeping finished): memo and move on
+            self._completed[node.metadata.name] = anchor[0]
+            return
+        latency = now - anchor[2]
+        with trace.start_span(
+            "drain.node_complete", parent=anchor[1],
+            attrs={"node": node.metadata.name,
+                   "drain_latency_s": round(latency, 3)},
+        ):
+            try:
+                self.store.patch(
+                    "Node", NODE_NAMESPACE, node.metadata.name,
+                    {"status": {"conditions": self._conditions_patch(
+                        node, False, "Drained",
+                        f"node empty after {latency:.1f}s",
+                    )}},
+                    subresource="status",
+                )
+            except NotFound:
+                return
+        self._completed[node.metadata.name] = anchor[0]
+        metrics.drain_migration_latency.observe(latency)
+        metrics.drains_total.inc(outcome="completed")
+        self.recorder.event(
+            node, NORMAL, EVENT_DRAIN_COMPLETED,
+            f"drain complete in {latency:.1f}s; node empty and cordoned "
+            f"until `ctl uncordon`",
+        )
+        self._blocked_msg.pop(node.metadata.name, None)
+
+    # -- batch: checkpoint-then-migrate -------------------------------------
+
+    def _migrate_batch_gangs(self, node, anchor, batch: List) -> None:
+        """Evict every affected batch gang WHOLE (reason=Maintenance): the
+        agent SIGTERMs each member (--eviction-grace force-checkpoint), the
+        controller advances restart_generation (NOT restart_count — a
+        planned move is free) and the scheduler re-places the relaunched
+        gang off the cordoned node."""
+        by_gang: Dict[Tuple[str, str], List] = {}
+        for p in batch:
+            gang = p.metadata.labels.get(LABEL_JOB_NAME)
+            if gang:
+                by_gang.setdefault((p.metadata.namespace, gang), []).append(p)
+        if not by_gang:
+            return
+        # gang members NOT on the draining node are collateral: the whole
+        # gang moves (an XLA gang cannot lose one member and live), so the
+        # eviction covers every live member wherever it is bound
+        all_pods = None
+        for (ns, gang), members in sorted(by_gang.items()):
+            uid_gen = self._gang_identity(members[0])
+            if uid_gen is not None and uid_gen in self._migrated:
+                continue  # this generation's move is already in flight
+            if all_pods is None:
+                all_pods = self.read.list("Pod")
+            whole = [
+                p for p in all_pods
+                if p.metadata.namespace == ns
+                and p.metadata.labels.get(LABEL_JOB_NAME) == gang
+                and not p.is_finished()
+            ]
+            with trace.start_span(
+                "drain.migrate_gang",
+                parent=anchor[1],
+                trace_id=members[0].metadata.annotations.get(
+                    trace.ANNOTATION_TRACE_ID
+                ),
+                attrs={"node": node.metadata.name, "gang": f"{ns}/{gang}",
+                       "members": len(whole)},
+            ):
+                n = 0
+                for p in whole:
+                    if evict_pod(
+                        self.store, p,
+                        f"node {node.metadata.name} draining for "
+                        f"maintenance (checkpoint-then-migrate)",
+                        reason=REASON_MAINTENANCE,
+                    ):
+                        n += 1
+                if n and uid_gen is not None:
+                    self._migrated.add(uid_gen)
+                    if len(self._migrated) > 8192:
+                        self._migrated.clear()  # bounded; re-evict no-ops
+                if n:
+                    self.recorder.event(
+                        members[0], NORMAL, EVENT_GANG_MIGRATING,
+                        f"gang {gang}: {n} pod(s) evicted for maintenance "
+                        f"on {node.metadata.name}; checkpoint-then-migrate "
+                        f"(free restart)",
+                    )
+                    metrics.drains_total.inc(outcome="gang_migrated")
+
+    def _gang_identity(self, pod) -> Optional[Tuple[str, str]]:
+        """(owner uid, generation label) — the once-per-generation key."""
+        owner = next(
+            (r for r in pod.metadata.owner_references if r.controller), None
+        )
+        gen = pod.metadata.labels.get("tpujob.dev/generation", "0")
+        if owner is None:
+            return None
+        return (owner.uid, gen)
+
+    # -- serve: observe surge-first migration / budget parking --------------
+
+    def _observe_serve_progress(self, node, live: List) -> int:
+        """The serve controller owns serve migration (surge-first, budget-
+        floored); this controller reports blocked budgets. Returns the
+        count of serves currently parking this node's drain."""
+        serve_names = {
+            (p.metadata.namespace,
+             p.metadata.labels.get(LABEL_SERVE_NAME))
+            for p in live
+            if LABEL_SERVE_NAME in p.metadata.labels
+        }
+        blocked = 0
+        msgs = []
+        for ns, sname in sorted(serve_names):
+            serve = self.read.try_get("TPUServe", ns, sname)
+            if serve is None:
+                continue
+            reason = self._serve_blocked_reason(serve)
+            if reason:
+                blocked += 1
+                msgs.append(f"{ns}/{sname}: {reason}")
+        msg = "; ".join(msgs)
+        if msg and self._blocked_msg.get(node.metadata.name) != msg:
+            self._blocked_msg[node.metadata.name] = msg
+            self.recorder.event(
+                node, WARNING, EVENT_DRAIN_BLOCKED,
+                f"drain parked by disruption budget — {msg}; will resume "
+                f"the moment a surged replacement passes readiness (or "
+                f"escalate at the maintenance deadline)",
+            )
+        elif not msg:
+            self._blocked_msg.pop(node.metadata.name, None)
+        return blocked
+
+    @staticmethod
+    def _serve_blocked_reason(serve) -> Optional[str]:
+        """Why this serve cannot give up a ready replica right now, or None
+        when the migration can proceed (the SAME effective-budget rule the
+        serve controller's retire gate applies — one shared helper, so the
+        gauge and the gate can never disagree)."""
+        from mpi_operator_tpu.api.defaults import (
+            effective_disruption_budget,
+            set_serve_defaults,
+        )
+
+        set_serve_defaults(serve)
+        desired = serve.spec.replicas or 0
+        # the retire gate's exact floor: the rollout guarantee
+        # (desired - max_unavailable) never relaxes, the budget can only
+        # tighten it — mirrored from the serve controller's drain loop
+        floor = max(desired - (serve.spec.max_unavailable or 0),
+                    effective_disruption_budget(serve))
+        ready = serve.status.ready_replicas
+        if ready - 1 >= floor:
+            return None
+        return (
+            f"ready {ready} - 1 < disruption budget {floor} "
+            f"(waiting for a surged replacement to become ready)"
+        )
+
+    # -- deadline escalation -------------------------------------------------
+
+    def _escalate(self, node, anchor, live: List, *, dead: bool,
+                  now: float) -> None:
+        """The maintenance window arrived (or the node already died):
+        hard-evict everything still bound. Budgets yield — the hardware is
+        going away either way; serve self-healing replaces the gangs after
+        the fact. Still reason=Maintenance: the workload being moved did
+        nothing wrong, so the restart stays free."""
+        why = ("node died while draining" if dead
+               else "maintenance deadline reached")
+        with trace.start_span(
+            "drain.escalate", parent=anchor[1],
+            attrs={"node": node.metadata.name, "pods": len(live),
+                   "dead": dead,
+                   "overrun_s": round(max(0.0, now - anchor[0]), 1)},
+        ):
+            n = 0
+            for p in live:
+                with trace.start_span(
+                    "drain.hard_evict",
+                    trace_id=p.metadata.annotations.get(
+                        trace.ANNOTATION_TRACE_ID
+                    ),
+                    attrs={"pod": p.metadata.key(),
+                           "node": node.metadata.name},
+                ):
+                    if evict_pod(
+                        self.store, p,
+                        f"hard-evicted: {why} on {node.metadata.name}",
+                        reason=REASON_MAINTENANCE,
+                    ):
+                        n += 1
+            if n:
+                metrics.drains_total.inc(outcome="escalated")
+                self.recorder.event(
+                    node, WARNING, EVENT_DRAIN_ESCALATED,
+                    f"{why}: {n} pod(s) still bound were hard-evicted "
+                    f"(budget yields to the deadline)",
+                )
+
+
+def smoke() -> int:
+    """The <30s drain smoke (verify SKILL.md static gate): one hollow node
+    drained out from under a 2-replica serve with DisruptionBudget 1 AND a
+    running batch gang. Bars: the batch job Succeeds with restart_count 0
+    (restart_generation 1 — the move was free), serve ready never dips
+    below the budget, the node drains empty (Draining → Drained), and the
+    migrated pods land off-node. Prints one JSON line; exit 0 iff all hold.
+    """
+    import json
+
+    from mpi_operator_tpu.api.client import TPUJobClient, TPUServeClient
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.controller.controller import TPUJobController
+    from mpi_operator_tpu.controller.serve import TPUServeController
+    from mpi_operator_tpu.executor.hollow import HollowFleet, HollowTimeline
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+    t0 = time.time()
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    ctrl = TPUJobController(store, recorder)
+    serve_ctrl = TPUServeController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, interval=0.1)
+    # TWO nodes, sized so the drain necessarily hits BOTH workload
+    # classes: serve replicas spread one per node, batch members too —
+    # whichever node hosts batch worker-0 also hosts a serve replica
+    fleet = HollowFleet(
+        store, 2, timeline=HollowTimeline(run_s=1.5, serve_warmup_s=0.3),
+        capacity_chips=6, heartbeat_interval=0.5,
+    )
+    ctrl.run()
+    serve_ctrl.run()
+    sched.start()
+    fleet.start()
+    drain.start()
+    out = {"metric": "drain_smoke", "ok": False}
+    min_ready = [2]
+    try:
+        TPUServeClient(store).create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "default"},
+            "spec": {"replicas": 2, "workers_per_replica": 1,
+                     "slice": {"accelerator": "cpu", "chips_per_host": 2},
+                     "disruption_budget": 1, "max_surge": 1},
+        })
+        TPUJobClient(store).create({
+            "kind": "TPUJob", "metadata": {"name": "batch"},
+            "spec": {"slice": {"accelerator": "cpu", "chips_per_host": 1},
+                     "worker": {"replicas": 2, "template": {"containers": [
+                         {"image": "x", "command": ["true"]}]}},
+                     "run_policy": {"clean_pod_policy": "None"}}})
+
+        def ready_replicas() -> int:
+            s = store.try_get("TPUServe", "default", "svc")
+            return s.status.ready_replicas if s else 0
+
+        def wait(fn, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                min_ready[0] = min(min_ready[0], ready_replicas())
+                if fn():
+                    return True
+                time.sleep(0.05)
+            raise RuntimeError(f"smoke: {what} not reached")
+
+        wait(lambda: ready_replicas() >= 2, 15, "serve ready")
+        wait(lambda: any(
+            p.spec.node_name and p.status.phase == "Running"
+            for p in store.list("Pod", "default")
+            if LABEL_SERVE_NAME not in p.metadata.labels
+        ), 15, "batch running")
+        victim = next(
+            p.spec.node_name for p in store.list("Pod", "default")
+            if LABEL_SERVE_NAME not in p.metadata.labels
+            and p.spec.node_name and not p.is_finished()
+        )
+        assert any(
+            p.spec.node_name == victim
+            for p in store.list("Pod", "default")
+            if LABEL_SERVE_NAME in p.metadata.labels
+        ), "smoke geometry: the victim must host a serve replica too"
+        min_ready[0] = 2
+        fleet.announce_maintenance(victim, time.time() + 25.0)
+        wait(lambda: not any(
+            p.spec.node_name == victim and not p.is_finished()
+            for p in store.list("Pod")
+        ), 20, "node empty")
+        wait(lambda: not node_draining(
+            store.get("Node", NODE_NAMESPACE, victim)), 10, "drain complete")
+        wait(lambda: cond.is_succeeded(
+            store.get("TPUJob", "default", "batch").status), 20,
+            "batch succeeded")
+        wait(lambda: ready_replicas() >= 2, 15, "serve re-ready")
+        job = store.get("TPUJob", "default", "batch")
+        off_node = all(
+            p.spec.node_name != victim
+            for p in store.list("Pod") if not p.is_finished()
+        )
+        out.update({
+            "victim": victim,
+            "batch_succeeded": bool(cond.is_succeeded(job.status)),
+            "restart_count": job.status.restart_count,
+            "restart_generation": job.status.restart_generation,
+            "min_ready_during_drain": min_ready[0],
+            "budget": 1,
+            "migrated_off_node": off_node,
+            "elapsed_s": round(time.time() - t0, 1),
+        })
+        out["ok"] = bool(
+            out["batch_succeeded"]
+            and job.status.restart_count == 0
+            and job.status.restart_generation >= 1
+            and min_ready[0] >= 1
+            and off_node
+        )
+    except Exception as e:
+        log.exception("drain smoke failed")
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        drain.stop()
+        fleet.stop()
+        sched.stop()
+        serve_ctrl.stop()
+        ctrl.stop()
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpu-drain",
+        description="Disruption-plane utilities (the DrainController "
+                    "itself runs leader-only inside tpu-operator).",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the <30s in-process drain smoke: one hollow "
+                         "node drained under a 2-replica serve (budget 1) "
+                         "+ a batch gang; exit 0 iff every bar holds")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

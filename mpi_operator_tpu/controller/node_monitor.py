@@ -27,7 +27,11 @@ from typing import Optional
 
 from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
-from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, evict_pod
+from mpi_operator_tpu.machinery.objects import (
+    NODE_NAMESPACE,
+    evict_pod,
+    maintenance_at,
+)
 from mpi_operator_tpu.machinery.store import NotFound
 from mpi_operator_tpu.opshell import metrics
 
@@ -45,6 +49,7 @@ class NodeMonitor:
         grace: float = 6.0,
         interval: float = 1.0,
         cache=None,
+        defer_to_drain: bool = True,
     ):
         self.store = store
         # informer read path: the per-tick Node scan (and the Pod scan when
@@ -58,6 +63,10 @@ class NodeMonitor:
         )
         self.grace = grace
         self.interval = interval
+        # whether a DrainController owns maintenance-noticed nodes (set
+        # False when the operator runs --no-drain-controller: a notice
+        # nobody will adopt must not disable node-loss eviction)
+        self.defer_to_drain = defer_to_drain
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -95,6 +104,29 @@ class NodeMonitor:
             if not hb:
                 continue  # static node: no heartbeat contract
             if now - hb <= self.grace:
+                continue
+            if self.defer_to_drain and maintenance_at(node) is not None:
+                # a node with a VALID maintenance notice belongs to the
+                # DrainController: it escalates a dead draining node to ONE
+                # hard eviction itself. Evicting here too would tear the
+                # same gang down twice (double restart_generation advance —
+                # the double-eviction bug ISSUE 14 pins with a test), and
+                # gating on the notice rather than the adopted Draining
+                # condition closes the stamp-to-adopt window the same way.
+                # Two escape hatches keep unplanned-loss eviction owned:
+                # a MALFORMED notice (maintenance_at None) never defers,
+                # and an operator running --no-drain-controller constructs
+                # this monitor with defer_to_drain=False — a notice nobody
+                # will ever adopt must not disable the monitor. The
+                # NotReady mark below still applies: liveness is this
+                # monitor's truth either way.
+                if node.status.ready:
+                    self._mark_not_ready(node.metadata.name)
+                    log.warning(
+                        "node %s lost while draining; leaving its pods to "
+                        "the drain controller's escalation",
+                        node.metadata.name,
+                    )
                 continue
             stale.append(node.metadata.name)
             if node.status.ready:

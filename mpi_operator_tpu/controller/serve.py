@@ -42,7 +42,10 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from mpi_operator_tpu.api import conditions as cond
-from mpi_operator_tpu.api.defaults import set_serve_defaults
+from mpi_operator_tpu.api.defaults import (
+    effective_disruption_budget,
+    set_serve_defaults,
+)
 from mpi_operator_tpu.api.types import (
     Container,
     ObjectMeta,
@@ -71,6 +74,8 @@ from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.cache import InformerCache
 from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    NODE_NAMESPACE,
     Pod,
     PodGroup,
     PodGroupSpec,
@@ -216,6 +221,11 @@ class TPUServeController:
         self._ready_noted: set = set()
         # serve uid → last effective desired (stamps last_scale_*_time)
         self._last_desired: Dict[str, int] = {}
+        # node → last maintenance-at value observed through the pump: a
+        # CHANGE (notice stamped / rescheduled / cleared) re-enqueues every
+        # serve so drain-aware migration starts without waiting for a pod
+        # event — heartbeat-only Node updates stay cheap (no enqueue)
+        self._node_maint_seen: Dict[str, Optional[str]] = {}
 
     # ------------------------------------------------------------------
     # run loop
@@ -285,6 +295,9 @@ class TPUServeController:
 
     def _pump_obj(self, obj) -> None:
         ns = obj.metadata.namespace
+        if obj.kind == "Node":
+            self._pump_node(obj)
+            return
         if self.options.namespace is not None and ns != self.options.namespace:
             return
         if obj.kind == "TPUServe":
@@ -295,6 +308,24 @@ class TPUServeController:
         if owner is not None:
             self._note_trigger(f"{ns}/{owner.name}")
             self.enqueue(f"{ns}/{owner.name}")
+
+    def _pump_node(self, node) -> None:
+        """Maintenance-notice wakeups: a node whose ``maintenance-at``
+        annotation appears, changes, or clears re-enqueues every serve in
+        scope (serves are few; per-heartbeat Node events cost one dict
+        probe). Without this a drain would wait for the next unrelated
+        pod event before surge-first migration began."""
+        name = node.metadata.name
+        val = node.metadata.annotations.get(ANNOTATION_MAINTENANCE_AT)
+        with self._lock:
+            seen = self._node_maint_seen.get(name)
+            if seen == val:
+                return
+            self._node_maint_seen[name] = val
+            if len(self._node_maint_seen) > 65536:
+                self._node_maint_seen.clear()  # bounded; re-wake is benign
+        for serve in self.read.list("TPUServe", self.options.namespace):
+            self.enqueue(serve.metadata.key())
 
     def _note_trigger(self, key: str) -> None:
         link = trace.get_delivery()
@@ -434,6 +465,19 @@ class TPUServeController:
             if replica_generation(members) == gen
         }
 
+        # --- drain-awareness (the disruption plane, ISSUE 14) ----------
+        # replicas with a member on a maintenance-noticed node are DOOMED:
+        # they migrate surge-first — a replacement gang is created (and
+        # placed elsewhere; the scheduler excludes cordoned nodes and
+        # penalizes imminent-maintenance ones), waits for readiness, and
+        # only then is the doomed replica retired, never letting
+        # ready_total dip below the serve's DisruptionBudget
+        draining_nodes = self._draining_nodes()
+        doomed = {
+            rid for rid, members in live.items()
+            if any(p.spec.node_name in draining_nodes for p in members)
+        }
+
         # --- heal partial gangs (crash mid-create) --------------------
         for rid, members in live.items():
             if len(members) < workers:
@@ -447,7 +491,10 @@ class TPUServeController:
                         self._create_pod(serve, rid, j, rgen, placement)
 
         # --- surge new-generation gangs up to desired ------------------
-        need = desired - len(new_gen)
+        # doomed replicas don't count toward coverage: a gang on a
+        # draining node needs a replacement REGARDLESS of its generation
+        # (the surge-first half of checkpoint-free serve migration)
+        need = desired - len(new_gen - doomed)
         budget = desired + serve.spec.max_surge - len(live)
         for _ in range(max(0, min(need, budget))):
             rid = serve.status.next_replica_id
@@ -456,27 +503,62 @@ class TPUServeController:
             live[rid] = []  # counts against desired/surge this pass
             new_gen.add(rid)
 
-        # --- drain: old generations and scale-down excess --------------
-        # One rule serves both rollout and scale-down: while more gangs
-        # are live than desired, retire the best victim whose removal
-        # keeps ready_total >= desired - max_unavailable. Old-generation
-        # gangs go first (unready before ready), then the newest
-        # new-generation ids. A ready victim is only retired when the
-        # readiness floor survives it — that is the zero-unready-window
-        # guarantee.
+        # --- drain: doomed replicas, old generations, scale-down -------
+        # One rule serves rollout, scale-down AND maintenance migration:
+        # while more gangs are live than needed, retire the best victim
+        # whose removal keeps ready_total above the floor. Doomed gangs go
+        # first (their node is dying), then old generations, then the
+        # newest new-generation ids. A ready victim is only retired when
+        # the floor survives it — rollouts floor at
+        # desired - max_unavailable (the zero-unready-window guarantee),
+        # doomed victims additionally at the DisruptionBudget.
         floor = desired - serve.spec.max_unavailable
+        # ONE budget rule, shared with the DrainController's blocked-drain
+        # reporting (api/defaults.py) so gate and gauge can never disagree
+        dbudget = effective_disruption_budget(serve)
         ready_total = len(ready_ids)
-        while len(live) > desired:
-            victim = self._pick_victim(live, new_gen, ready_ids)
+        # a surplus exists while gangs exceed desired, OR while a doomed
+        # gang still has a ready surged replacement able to stand in
+        while len(live) > desired or (doomed & set(live)):
+            victim = self._pick_victim(live, new_gen, ready_ids,
+                                       doomed=doomed)
             if victim is None:
                 break
-            if victim in ready_ids and ready_total - 1 < floor:
-                break  # draining now would open an unready window
+            if victim not in doomed and len(live) <= desired:
+                break  # only doomed gangs may retire below the surplus
+            vfloor = max(floor, dbudget) if victim in doomed else floor
+            if victim in ready_ids and ready_total - 1 < vfloor:
+                break  # retiring now would violate the budget/floor
+            if (victim in doomed and victim not in ready_ids
+                    and len(live) <= desired and ready_total < vfloor):
+                # an unready doomed gang with no ready replacement yet:
+                # keep it (it may still be serving warmup traffic) until
+                # the surge covers the floor — the DrainController
+                # reports this state as drain_budget_blocked
+                break
             members = live.pop(victim)
             if victim in ready_ids:
                 ready_ids.discard(victim)
                 ready_total -= 1
             new_gen.discard(victim)
+            if victim in doomed:
+                doomed.discard(victim)
+                with trace.start_span(
+                    "drain.migrate_replica",
+                    trace_id=self._trace_id(serve),
+                    attrs={
+                        "serve": key, "replica": victim,
+                        "nodes": sorted({
+                            p.spec.node_name for p in members
+                            if p.spec.node_name in draining_nodes
+                        }),
+                        "ready_total_after": ready_total,
+                        "budget": dbudget,
+                    },
+                ):
+                    self._drain_replica(serve, victim, members,
+                                        reason="maintenance")
+                continue
             self._drain_replica(
                 serve, victim, members,
                 reason=("rollout" if members
@@ -526,6 +608,16 @@ class TPUServeController:
             kind="TPUServe", name=serve.name, uid=serve.metadata.uid,
             controller=True,
         )
+
+    def _draining_nodes(self) -> set:
+        """Nodes with a maintenance notice: replicas bound there are doomed
+        and migrate surge-first. Informer-cached — one list per reconcile,
+        zero store traffic."""
+        return {
+            n.metadata.name
+            for n in self.read.list("Node", NODE_NAMESPACE)
+            if ANNOTATION_MAINTENANCE_AT in n.metadata.annotations
+        }
 
     def _reap_orphans(self, namespace: str, name: str) -> None:
         """Cascade delete for a deleted serve (kube GC semantics), guarded
@@ -697,12 +789,20 @@ class TPUServeController:
 
     @staticmethod
     def _pick_victim(live: Dict[int, List[Pod]], new_gen: set,
-                     ready_ids: set) -> Optional[int]:
-        """Preference order: unready old-gen, ready old-gen, unready
-        newest new-gen, ready newest new-gen."""
-        old = [rid for rid in live if rid not in new_gen]
-        for pool, prefer_new in ((old, False), (list(new_gen & set(live)),
-                                                True)):
+                     ready_ids: set, doomed: Optional[set] = None
+                     ) -> Optional[int]:
+        """Preference order: doomed (their node is dying — unready first),
+        then unready old-gen, ready old-gen, unready newest new-gen,
+        ready newest new-gen."""
+        doomed = doomed or set()
+        old = [rid for rid in live if rid not in new_gen and rid not in doomed]
+        fresh = [rid for rid in new_gen & set(live) if rid not in doomed]
+        pools = (
+            (sorted(doomed & set(live)), False),
+            (old, False),
+            (fresh, True),
+        )
+        for pool, prefer_new in pools:
             if not pool:
                 continue
             unready = [r for r in pool if r not in ready_ids]

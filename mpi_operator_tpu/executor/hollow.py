@@ -43,6 +43,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
     NODE_NAMESPACE,
     Node,
     Pod,
@@ -168,6 +169,28 @@ class ServeLoadModel:
             "queue_depth": round(queue, 3),
             "p99_ms": round(p99, 3),
         }
+
+
+@dataclass
+class MaintenanceSchedule:
+    """Seeded rolling-maintenance notices for a hollow fleet (ISSUE 14):
+    the rehearsal harness for the disruption plane. ``fraction`` of the
+    fleet (chosen by a seeded rng — two runs of one seed pick the same
+    victims in the same order) receives a ``tpujob.dev/maintenance-at``
+    notice: the first at ``start_s`` after fleet start, one more every
+    ``stagger_s`` (the rolling wave), each with ``notice_s`` of warning
+    before its deadline. The DrainController takes it from there."""
+
+    fraction: float = 0.2
+    notice_s: float = 10.0
+    start_s: float = 2.0
+    stagger_s: float = 0.5
+    seed: int = 0
+
+    def victims(self, node_names: List[str]) -> List[str]:
+        k = max(1, round(self.fraction * len(node_names)))
+        rng = random.Random(f"maintenance:{self.seed}")
+        return rng.sample(sorted(node_names), min(k, len(node_names)))
 
 
 class _TimerWheel:
@@ -579,11 +602,13 @@ class HollowFleet:
                  capacity_chips: int = 32,
                  advertise: str = "127.0.0.1",
                  heartbeat_interval: float = 10.0,
-                 batch_items: int = 256):
+                 batch_items: int = 256,
+                 maintenance: Optional[MaintenanceSchedule] = None):
         from mpi_operator_tpu.executor.agent import StatusBatcher
 
         self.store = store
         self.timeline = timeline or HollowTimeline()
+        self.maintenance = maintenance
         self.capacity_chips = capacity_chips
         self.advertise = advertise
         self.heartbeat_interval = heartbeat_interval
@@ -634,9 +659,40 @@ class HollowFleet:
             ex = self.executors.get(pod.spec.node_name or "")
             if ex is not None:
                 ex.observe(pod)
+        if self.maintenance is not None:
+            self.arm_maintenance(self.maintenance)
         log.info("hollow fleet up: %d nodes, %d chips each",
                  len(self.node_names), self.capacity_chips)
         return self
+
+    def arm_maintenance(self, sched: MaintenanceSchedule) -> None:
+        """Schedule the rolling notice wave on the shared timer wheel
+        (``start_s`` counts from THIS call — benches arm it once the
+        workload is live instead of at fleet start)."""
+        for i, name in enumerate(sched.victims(self.node_names)):
+            delay = sched.start_s + i * sched.stagger_s
+
+            def fire(node=name, notice=sched.notice_s):
+                try:
+                    self.announce_maintenance(node,
+                                              time.time() + notice)
+                except Exception:
+                    log.warning("maintenance notice for %s failed", node,
+                                exc_info=True)
+
+            self.wheel.schedule(delay, fire)
+
+    def announce_maintenance(self, node: str, at_ts: float) -> None:
+        """Stamp the maintenance-notice annotation (the cloud provider's
+        'this host dies at T' event, as the disruption plane consumes it).
+        Metadata patch → needs an admin-tier store handle."""
+        self.store.patch(
+            "Node", NODE_NAMESPACE, node,
+            {"metadata": {"annotations": {
+                ANNOTATION_MAINTENANCE_AT: str(at_ts),
+            }}},
+        )
+        log.info("maintenance notice: node %s dies at %.0f", node, at_ts)
 
     def stop(self) -> None:
         self._stop.set()
@@ -800,6 +856,16 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch-items", type=int, default=128,
                     help="max patches per batch request flush")
+    ap.add_argument("--maintenance-fraction", type=float, default=0.0,
+                    help="fraction of the fleet that receives a seeded "
+                         "rolling maintenance notice (0 = none)")
+    ap.add_argument("--maintenance-notice", type=float, default=10.0,
+                    help="seconds of warning each notice carries before "
+                         "its deadline")
+    ap.add_argument("--maintenance-start", type=float, default=5.0,
+                    help="seconds after fleet start the first notice fires")
+    ap.add_argument("--maintenance-stagger", type=float, default=0.5,
+                    help="seconds between successive notices (the wave)")
     ap.add_argument("--token-file", default=None)
     ap.add_argument("--monitoring-port", type=int, default=None,
                     help="serve /metrics + /healthz on this port (agent "
@@ -823,6 +889,16 @@ def main(argv=None) -> int:
                                 seed=args.seed),
         capacity_chips=args.chips, heartbeat_interval=args.heartbeat,
         batch_items=args.batch_items,
+        maintenance=(
+            MaintenanceSchedule(
+                fraction=args.maintenance_fraction,
+                notice_s=args.maintenance_notice,
+                start_s=args.maintenance_start,
+                stagger_s=args.maintenance_stagger,
+                seed=args.seed,
+            )
+            if args.maintenance_fraction > 0 else None
+        ),
     ).start()
     ops = None
     if args.monitoring_port is not None:

@@ -78,6 +78,13 @@ PROXY_FAULTS = ("sever", "blackhole", "restore", "drop", "delay",
 # symmetric pairwise cuts: replicated_store.PeerHub in-process, or any
 # object with partition(a, b)/heal(a, b)) — the Jepsen partition verb
 FABRIC_FAULTS = ("partition", "heal")
+# faults acting through a store handle (ChaosController store=): the
+# planned-disruption verbs. `maintenance` stamps the tpujob.dev/
+# maintenance-at notice on Node `target` with `duration` seconds of
+# warning, then expands into a `maintenance-fire` edge at the deadline —
+# which SIGKILLs the same-named process target IF anything is still
+# bound to the node (the cloud provider does not wait for your drain)
+STORE_FAULTS = ("maintenance", "maintenance-fire")
 MATCHES = ("any", "watch", "mutation", "read")
 
 
@@ -106,6 +113,11 @@ _FAULT_KNOBS: Dict[str, frozenset] = {
     # the executed log shows both edges (same treatment as blackhole)
     "partition": frozenset({"a", "b", "duration"}),
     "heal": frozenset({"a", "b"}),
+    # maintenance: target names BOTH the Node object to stamp and the
+    # process registry entry to SIGKILL at the deadline; duration is the
+    # notice window (required: a notice with no deadline is not a fault)
+    "maintenance": frozenset({"target", "duration"}),
+    "maintenance-fire": frozenset({"target"}),
 }
 
 
@@ -164,7 +176,8 @@ class ChaosScript:
                 ) from None
             if at < 0:
                 raise ChaosScriptError(f"actions[{i}]: at must be >= 0")
-            known = PROCESS_FAULTS + PROXY_FAULTS + FABRIC_FAULTS
+            known = (PROCESS_FAULTS + PROXY_FAULTS + FABRIC_FAULTS
+                     + STORE_FAULTS)
             if fault not in known:
                 raise ChaosScriptError(
                     f"actions[{i}]: unknown fault {fault!r} (known: "
@@ -178,7 +191,7 @@ class ChaosScript:
                     f"valid knobs: {sorted(_FAULT_KNOBS[fault]) or 'none'})"
                 )
             target = str(a.get("target", ""))
-            if fault in PROCESS_FAULTS and not target:
+            if fault in PROCESS_FAULTS + STORE_FAULTS and not target:
                 raise ChaosScriptError(
                     f"actions[{i}]: fault {fault!r} needs a 'target'"
                 )
@@ -213,6 +226,24 @@ class ChaosScript:
                                            a=end_a, b=end_b))
                 actions.append(ChaosAction(at=until, fault="heal",
                                            a=end_a, b=end_b))
+                continue
+            if fault == "maintenance":
+                if duration <= 0:
+                    raise ChaosScriptError(
+                        f"actions[{i}]: fault 'maintenance' needs a "
+                        f"positive 'duration' (the notice window before "
+                        f"the deadline SIGKILL)"
+                    )
+                # notice now, fire at the deadline: both edges land in the
+                # executed log (the blackhole/partition treatment). The
+                # notice action carries the window in `seconds` so it can
+                # stamp deadline = apply-time + window.
+                actions.append(ChaosAction(at=at, fault="maintenance",
+                                           target=target,
+                                           seconds=duration))
+                actions.append(ChaosAction(at=at + duration,
+                                           fault="maintenance-fire",
+                                           target=target))
                 continue
             actions.append(ChaosAction(
                 at=at, fault=fault, target=target, match=match, prob=prob,
@@ -671,13 +702,17 @@ class ChaosController:
     def __init__(self, script: ChaosScript, *,
                  proxy: Optional[ChaosProxy] = None,
                  targets: Optional[Dict[str, Any]] = None,
-                 fabric: Any = None):
+                 fabric: Any = None,
+                 store: Any = None):
         self.script = script
         self.proxy = proxy
         # the partition/heal surface: anything with partition(a, b) and
         # heal(a, b) — replicated_store.PeerHub, or a NamedProxyFabric
         # over per-directed-pair ChaosProxy instances
         self.fabric = fabric
+        # the store handle maintenance faults stamp notices through (an
+        # admin-tier client: the annotation is a metadata write)
+        self.store = store
         self.targets = dict(targets or {})
         self.executed: List[Tuple[float, ChaosAction, Optional[str]]] = []
         self._stop = threading.Event()
@@ -722,6 +757,9 @@ class ChaosController:
                      f" target={action.target}" if action.target else "")
 
     def _apply(self, a: ChaosAction) -> None:
+        if a.fault in STORE_FAULTS:
+            self._apply_maintenance(a)
+            return
         if a.fault in PROCESS_FAULTS:
             target = self.targets.get(a.target)
             if target is None:
@@ -752,3 +790,53 @@ class ChaosController:
                 a.fault, match=a.match, prob=a.prob, seconds=a.seconds,
                 until=until,
             )
+
+    def _apply_maintenance(self, a: ChaosAction) -> None:
+        """The planned-disruption verbs. `maintenance` stamps the notice
+        annotation on Node `target` (deadline = now + window); at the
+        deadline `maintenance-fire` checks the store — if ANY live pod is
+        still bound, the same-named process target is SIGKILLed (the
+        provider reclaims the host whether or not the drain finished). A
+        clean fire (node already empty) is the drain plane doing its job."""
+        if self.store is None:
+            raise RuntimeError(
+                f"fault {a.fault!r} needs a store= handle on the "
+                f"ChaosController"
+            )
+        # the shared notice contract — imported, not retyped, so a rename
+        # breaks loudly instead of stamping a key nobody watches
+        from mpi_operator_tpu.machinery.objects import (
+            ANNOTATION_MAINTENANCE_AT,
+            NODE_NAMESPACE,
+        )
+
+        if a.fault == "maintenance":
+            deadline = time.time() + a.seconds
+            self.store.patch(
+                "Node", NODE_NAMESPACE, a.target,
+                {"metadata": {"annotations": {
+                    ANNOTATION_MAINTENANCE_AT: str(deadline),
+                }}},
+            )
+            log.warning("chaos: maintenance notice on node %s "
+                        "(deadline in %.1fs)", a.target, a.seconds)
+            return
+        # maintenance-fire
+        still_bound = [
+            p for p in self.store.list("Pod")
+            if p.spec.node_name == a.target and not p.is_finished()
+        ]
+        if not still_bound:
+            log.info("chaos: maintenance fired on empty node %s "
+                     "(drain completed in time)", a.target)
+            return
+        target = self.targets.get(a.target)
+        if target is None:
+            raise KeyError(
+                f"maintenance deadline on {a.target!r} found "
+                f"{len(still_bound)} pod(s) still bound but no process "
+                f"target of that name is registered to SIGKILL"
+            )
+        log.warning("chaos: maintenance deadline on %s with %d pod(s) "
+                    "still bound — SIGKILL", a.target, len(still_bound))
+        target.kill()

@@ -1116,6 +1116,15 @@ class StoreServer:
                     return (403,
                             f"agent {node!r} may not change its own "
                             f"cordon flag (status.unschedulable)")
+                stored_conds = [c.to_dict() for c in stored.status.conditions]
+                if (status.get("conditions") or []) != stored_conds:
+                    # Node conditions (the Draining state machine) are
+                    # operator-owned, same argument as the cordon flag —
+                    # a full-object PUT at matching rv must carry them
+                    # through unchanged
+                    return (403,
+                            f"agent {node!r} may not change its own "
+                            f"status.conditions (operator-owned)")
                 return None  # its own heartbeat
             if kind == "Pod":
                 spec = obj.get("spec")
@@ -1192,6 +1201,14 @@ class StoreServer:
                 return (403,
                         f"agent {node!r} may not touch "
                         f"status.unschedulable (cordon is operator-owned)")
+            if "conditions" in status:
+                # same posture for Node conditions: the Draining state
+                # machine is the DrainController's — a compromised node
+                # clearing its own Draining condition could lure the
+                # drain plane into declaring a half-evacuated node done
+                return (403,
+                        f"agent {node!r} may not touch status.conditions "
+                        f"(the Draining state machine is operator-owned)")
             return None  # its own heartbeat
         if kind == "Pod":
             try:

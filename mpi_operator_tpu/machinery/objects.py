@@ -13,9 +13,9 @@ type reuses the api ObjectMeta so ownership/adoption logic is uniform.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
-from mpi_operator_tpu.api.types import Container, ObjectMeta, _Dictable
+from mpi_operator_tpu.api.types import Condition, Container, ObjectMeta, _Dictable
 from mpi_operator_tpu.machinery.store import Conflict, NotFound
 
 
@@ -29,6 +29,13 @@ class PodPhase:
     FAILED = "Failed"
 
     ALL_VALUES = (PENDING, RUNNING, SUCCEEDED, FAILED)
+
+
+# eviction reason for planned maintenance moves (the disruption plane's
+# checkpoint-then-migrate verb): retryable like "Evicted", free like
+# "Preempted" — the move is the infrastructure's doing, so it advances
+# restart_generation but never restart_count
+REASON_MAINTENANCE = "Maintenance"
 
 
 @dataclass
@@ -77,11 +84,11 @@ class Pod(_Dictable):
 
     def is_evicted(self) -> bool:
         """≙ isEvicted check on launcher pods (status.go:99-106 + controller
-        :935-950): Failed with an eviction-flavored reason. Covers both
-        infrastructure eviction (node loss, drain) and priority preemption —
-        both are always-retryable."""
+        :935-950): Failed with an eviction-flavored reason. Covers
+        infrastructure eviction (node loss, drain), priority preemption,
+        and planned maintenance moves — all always-retryable."""
         return self.status.phase == PodPhase.FAILED and self.status.reason in (
-            "Evicted", "Preempted",
+            "Evicted", "Preempted", REASON_MAINTENANCE,
         )
 
     def is_preempted(self) -> bool:
@@ -92,6 +99,16 @@ class Pod(_Dictable):
         return (
             self.status.phase == PodPhase.FAILED
             and self.status.reason == "Preempted"
+        )
+
+    def is_planned_disruption(self) -> bool:
+        """The free-restart class: preemption AND maintenance migration.
+        Both are the control plane's doing — a job moved off a node with a
+        maintenance window must not burn its backoffLimit budget any more
+        than a preempted one (the DrainController's checkpoint-then-migrate
+        contract: restart_generation advances, restart_count does not)."""
+        return self.status.phase == PodPhase.FAILED and self.status.reason in (
+            "Preempted", REASON_MAINTENANCE,
         )
 
 
@@ -138,6 +155,54 @@ class PodGroup(_Dictable):
 # live under one well-known pseudo-namespace
 NODE_NAMESPACE = "nodes"
 
+# The planned-disruption notice contract (the disruption plane, ISSUE 14):
+# a node carrying this annotation has a maintenance window — the value is
+# the ABSOLUTE unix timestamp the hardware goes away. Stamped by
+# `ctl drain <node> [--deadline S]` or a hollow fleet's seeded maintenance
+# schedule; consumed by the DrainController (cordon → migrate → escalate
+# at the deadline), the scheduler (imminent-maintenance placement penalty)
+# and the node monitor (drain-owned nodes are not double-evicted).
+# Cleared by `ctl uncordon` when the node returns from maintenance.
+ANNOTATION_MAINTENANCE_AT = "tpujob.dev/maintenance-at"
+
+
+class NodeConditionType:
+    """Node conditions (operator-owned, like the cordon flag):
+
+    Draining — an active maintenance drain is evacuating this node. Set by
+    the DrainController when it adopts a maintenance notice; flipped
+    inactive (reason=Drained) once no live pod remains bound.
+    """
+
+    DRAINING = "Draining"
+
+    ALL_VALUES = (DRAINING,)
+
+
+def maintenance_at(node: "Node"):
+    """The node's maintenance deadline as a float, or None when absent or
+    unparseable (a malformed stamp is surfaced by the DrainController as a
+    warning Event, never silently treated as a real window)."""
+    raw = node.metadata.annotations.get(ANNOTATION_MAINTENANCE_AT)
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def node_has_maintenance(node: "Node") -> bool:
+    return ANNOTATION_MAINTENANCE_AT in node.metadata.annotations
+
+
+def node_draining(node: "Node") -> bool:
+    """True while the Draining condition is active (an in-flight drain)."""
+    for c in node.status.conditions:
+        if c.type == NodeConditionType.DRAINING:
+            return bool(c.status)
+    return False
+
 # The single-process binding sentinel: the scheduler binds to it when no
 # Node objects exist (dev/standalone shape), the LocalExecutor claims it,
 # and agents must REJECT it as an identity. A cross-plane contract, so it
@@ -165,6 +230,10 @@ class NodeStatus(_Dictable):
     # chips this node can host (None = unbounded); the scalar-mode gang
     # scheduler admits against the sum over live nodes
     capacity_chips: Optional[int] = None
+    # operator-owned conditions (the Draining state machine); like the
+    # cordon flag, the NODE token tier may not touch these — agents
+    # heartbeat via merge-patches that never mention the key
+    conditions: List[Condition] = field(default_factory=list)
 
 
 @dataclass

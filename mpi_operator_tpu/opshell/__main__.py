@@ -139,6 +139,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "'kill'/'term' actions on target 'self' crash this "
                          "process at a deterministic offset into its reign — "
                          "the scripted half of the crash-recovery e2e suite")
+    ap.add_argument("--no-drain-controller", action="store_true",
+                    help="disable the disruption plane's DrainController "
+                         "(maintenance-notice drains run leader-only by "
+                         "default; with it off, `ctl drain` notices are "
+                         "inert and only --now drains work)")
     ap.add_argument("--no-serving", action="store_true",
                     help="disable the TPUServe controller + autoscaler "
                          "(batch-only operator; the serving workload "
@@ -359,7 +364,20 @@ def main(argv=None) -> int:
     )
     # the node-controller role (leader-only): evicts pods off nodes whose
     # agents stop heartbeating, so gang restarts land on live nodes
-    monitor = NodeMonitor(store, recorder, grace=args.node_grace, cache=cache)
+    monitor = NodeMonitor(store, recorder, grace=args.node_grace, cache=cache,
+                          defer_to_drain=not args.no_drain_controller)
+
+    # the disruption plane (leader-only): adopts maintenance notices and
+    # orchestrates budgeted per-node evacuation — batch gangs checkpoint-
+    # then-migrate free, serve replicas migrate surge-first, deadline
+    # overruns hard-evict (controller/disruption.py)
+    drain_controller = None
+    if not args.no_drain_controller:
+        from mpi_operator_tpu.controller.disruption import DrainController
+
+        drain_controller = DrainController(
+            store, recorder, node_grace=args.node_grace, cache=cache,
+        )
 
     # the serving workload class (leader-only, like every reconciler):
     # the TPUServe controller drives replica gangs + rollouts, the
@@ -455,6 +473,8 @@ def main(argv=None) -> int:
         if executor:
             executor.start()
         monitor.start()
+        if drain_controller is not None:
+            drain_controller.start()
         if slo_monitor is not None:
             slo_monitor.start()
         if chaos_script is not None:
@@ -486,6 +506,8 @@ def main(argv=None) -> int:
         if executor:
             executor.stop()
         monitor.stop()
+        if drain_controller is not None:
+            drain_controller.stop()
         if cache is not None:
             cache.stop()
         stop.set()

@@ -447,31 +447,152 @@ def cmd_cordon(client: TPUJobClient, args) -> int:
 
 
 def cmd_uncordon(client: TPUJobClient, args) -> int:
+    """Clear the cordon flag AND any maintenance notice: the node returned
+    from maintenance and is a binding target again (the DrainController
+    level-triggers the Draining condition inactive once the notice is
+    gone)."""
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_MAINTENANCE_AT,
+        NODE_NAMESPACE,
+    )
+
     if not _set_cordon(client, args.name, False):
         return 1
+    try:
+        client.store.patch(
+            "Node", NODE_NAMESPACE, args.name,
+            {"metadata": {"annotations": {ANNOTATION_MAINTENANCE_AT: None}}},
+        )
+    except NotFound:
+        pass  # deleted between the two patches; nothing left to clear
     print(f"node/{args.name} uncordoned")
     return 0
 
 
 def cmd_drain(client: TPUJobClient, args) -> int:
-    """≙ kubectl drain: cordon, then evict every live pod on the node.
-    Evictions are retryable (reason=Evicted), so affected gangs restart on
-    the remaining schedulable nodes; the drained agent keeps heartbeating
-    and can be uncordoned later."""
-    from mpi_operator_tpu.machinery.objects import evict_pod
+    """≙ kubectl drain, rebuilt on the disruption plane (ISSUE 14): stamp
+    the ``tpujob.dev/maintenance-at`` notice (now + --deadline) and cordon;
+    the leader's DrainController then evacuates the node end to end —
+    batch gangs checkpoint-then-migrate (free restart), serve replicas
+    migrate surge-first under their DisruptionBudget, and anything still
+    bound at the deadline is hard-evicted. ``--status`` renders drain
+    progress (exit 0 only when every draining node is empty). ``--now`` is
+    the break-glass client-side path: evict immediately, no operator
+    needed, no budget honored."""
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_MAINTENANCE_AT,
+        NODE_NAMESPACE,
+        evict_pod,
+    )
 
+    if getattr(args, "status", False):
+        return _drain_status(client, getattr(args, "name", None))
+    if not getattr(args, "name", None):
+        print("error: drain needs a node name (or --status)",
+              file=sys.stderr)
+        return 2
+    if getattr(args, "now", False):
+        if cmd_cordon(client, args) != 0:
+            return 1
+        evicted = []
+        for pod in client.store.list("Pod"):
+            if pod.spec.node_name != args.name or pod.is_finished():
+                continue
+            # break-glass immediate eviction is the sanctioned CLIENT-side
+            # drain seam: no DrainController in the loop by design (the
+            # operator may be down — that is what --now is for)
+            if evict_pod(client.store, pod,  # oplint: disable=DIS001
+                         f"node {args.name} drained (--now)"):
+                evicted.append(
+                    f"{pod.metadata.namespace}/{pod.metadata.name}"
+                )
+        for name in evicted:
+            print(f"evicted pod {name}")
+        print(f"node/{args.name} drained ({len(evicted)} pod(s) evicted)")
+        return 0
+    deadline_s = getattr(args, "deadline", None)
+    if deadline_s is None:
+        deadline_s = 3600.0
+    if deadline_s <= 0:
+        print("error: --deadline must be positive seconds", file=sys.stderr)
+        return 2
     if cmd_cordon(client, args) != 0:
         return 1
-    evicted = []
-    for pod in client.store.list("Pod"):
-        if pod.spec.node_name != args.name or pod.is_finished():
-            continue
-        if evict_pod(client.store, pod, f"node {args.name} drained"):
-            evicted.append(f"{pod.metadata.namespace}/{pod.metadata.name}")
-    for name in evicted:
-        print(f"evicted pod {name}")
-    print(f"node/{args.name} drained ({len(evicted)} pod(s) evicted)")
+    at = time.time() + deadline_s
+    try:
+        client.store.patch(
+            "Node", NODE_NAMESPACE, args.name,
+            {"metadata": {"annotations": {
+                ANNOTATION_MAINTENANCE_AT: str(at),
+            }}},
+        )
+    except NotFound:
+        print(f"error: no node named {args.name!r}", file=sys.stderr)
+        return 1
+    print(f"node/{args.name} drain requested: maintenance deadline in "
+          f"{deadline_s:.0f}s; the operator's drain controller is "
+          f"evacuating (watch with `ctl drain --status`)")
     return 0
+
+
+def _drain_status(client: TPUJobClient, only: Optional[str]) -> int:
+    """The drain progress table (the ISSUE 14 runbook probe): one row per
+    node with a maintenance notice — pods remaining, budget-blocked serve
+    count, deadline countdown, Draining state. Exit 0 only when every
+    shown node is EMPTY; exit 1 while anything is still evacuating or
+    blocked (cron/CI can poll it like `ctl alerts`)."""
+    from mpi_operator_tpu.controller.disruption import (
+        DrainController,
+        LABEL_SERVE_NAME,
+    )
+    from mpi_operator_tpu.machinery.objects import (
+        NODE_NAMESPACE,
+        maintenance_at,
+        node_draining,
+        node_has_maintenance,
+    )
+
+    nodes = [
+        n for n in client.store.list("Node", NODE_NAMESPACE)
+        if node_has_maintenance(n)
+        and (only is None or n.metadata.name == only)
+    ]
+    if only is not None and not nodes:
+        print(f"node/{only}: no maintenance notice (nothing draining)")
+        return 0
+    if not nodes:
+        print("no nodes draining")
+        return 0
+    pods = client.store.list("Pod")
+    now = time.time()
+    rows = []
+    busy = False
+    for n in sorted(nodes, key=lambda n: n.metadata.name):
+        live = [
+            p for p in pods
+            if p.spec.node_name == n.metadata.name and not p.is_finished()
+        ]
+        blocked = 0
+        for ns, sname in sorted({
+            (p.metadata.namespace, p.metadata.labels.get(LABEL_SERVE_NAME))
+            for p in live if LABEL_SERVE_NAME in p.metadata.labels
+        }):
+            serve = client.store.try_get("TPUServe", ns, sname)
+            if serve is not None and \
+                    DrainController._serve_blocked_reason(serve):
+                blocked += 1
+        deadline = maintenance_at(n)
+        left = "?" if deadline is None else f"{deadline - now:.0f}s"
+        state = ("Draining" if node_draining(n)
+                 else ("Drained" if not live else "Noticed"))
+        if live:
+            busy = True
+        rows.append([
+            n.metadata.name, state, len(live), blocked, left,
+        ])
+    print(_table(rows, ["NODE", "STATE", "PODS-REMAINING",
+                        "BUDGET-BLOCKED", "DEADLINE-IN"]))
+    return 1 if busy else 0
 
 
 def _read_log_from(path: str, offset: int, token: Optional[str] = None) -> bytes:
@@ -1222,9 +1343,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("name")
     p = sub.add_parser("uncordon", help="clear a node's cordon flag")
     p.add_argument("name")
-    p = sub.add_parser("drain", help="cordon a node and evict its pods "
-                                     "(gangs restart on schedulable nodes)")
-    p.add_argument("name")
+    p = sub.add_parser("drain", help="stamp a maintenance notice on a node "
+                                     "(the operator's drain controller "
+                                     "then migrates its gangs off, budget-"
+                                     "aware); --status shows progress, "
+                                     "--now evicts immediately client-side")
+    p.add_argument("name", nargs="?",
+                   help="node name (optional with --status: all draining)")
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="seconds until the maintenance window fires "
+                        "(default 3600); pods still bound then are "
+                        "hard-evicted")
+    p.add_argument("--status", action="store_true",
+                   help="render drain progress; exit 0 only when every "
+                        "draining node is empty, 1 while evacuating")
+    p.add_argument("--now", action="store_true",
+                   help="break-glass: cordon + evict immediately from this "
+                        "client (no operator, no budget)")
     p = sub.add_parser("store", help="store backend introspection "
                                      "(replica roles, lease, lag)")
     p.add_argument("action", choices=["status"])

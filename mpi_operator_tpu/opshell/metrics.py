@@ -457,6 +457,21 @@ gangs_preempted = REGISTRY.counter(
     "Counts running gangs evicted whole to make room for a "
     "higher-priority pending gang (--preemption-grace)",
 )
+drains_total = REGISTRY.counter(
+    "tpu_operator_drains_total",
+    "Disruption-plane drain lifecycle events by outcome= label: started "
+    "(maintenance notice adopted), gang_migrated (a batch gang "
+    "checkpoint-then-migrated off the node), completed (node empty), "
+    "escalated (deadline/dead-node hard eviction fired)",
+)
+drain_budget_blocked = REGISTRY.gauge(
+    "tpu_operator_drain_budget_blocked",
+    "Serves currently PARKING a node drain because retiring their doomed "
+    "replica would drop ready_total below the DisruptionBudget (cluster "
+    "too full to surge a replacement); 0 when every drain can proceed — "
+    "a sustained nonzero means capacity must free or the maintenance "
+    "deadline will hard-evict",
+)
 informer_synced = REGISTRY.gauge(
     "tpu_operator_informer_synced",
     "1 once the informer cache holds its initial snapshot (reconcilers "
@@ -637,6 +652,19 @@ serve_ready_latency = REGISTRY.histogram(
     # hollow gangs through multi-minute real compile+load
     buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
              300.0),
+)
+drain_migration_latency = REGISTRY.histogram(
+    "tpu_operator_drain_migration_latency_seconds",
+    "Maintenance-drain evacuation time per node (notice adoption → no "
+    "live pod bound). A completed drain observes its true latency once; "
+    "a drain still in flight past the stuck threshold observes its AGE "
+    "every tick — so a stuck drain keeps scoring bad events and the "
+    "drain-migration burn-rate objective (controller/slo_defaults.json) "
+    "pages instead of staying silent",
+    # drains span quick hollow moves through multi-minute checkpoint+
+    # reschedule cycles; 60s is the SLO threshold's bucket edge
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 60.0, 120.0, 300.0,
+             600.0),
 )
 autoscaler_sync_latency = REGISTRY.histogram(
     "tpu_operator_autoscaler_sync_latency_seconds",

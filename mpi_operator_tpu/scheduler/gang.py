@@ -47,6 +47,7 @@ from mpi_operator_tpu.controller.placement import (
 from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
     LOCAL_NODE,
     NODE_NAMESPACE,
     Pod,
@@ -904,17 +905,25 @@ class GangScheduler:
 
     @staticmethod
     def _pick_node(nodes: List, used: Dict[str, int], cost: int) -> Optional[str]:
-        """Least-loaded live node with room (spread; name order breaks ties)."""
-        best = None
-        best_load = None
+        """Least-loaded live node with room (spread; name order breaks
+        ties). Nodes with a pending maintenance notice are LAST-RESORT:
+        placing a migration onto the next victim would just move it twice
+        (the disruption plane's anti-hop penalty) — they only host when no
+        clean node has room."""
+        best = best_load = None
+        doomed_best = doomed_load = None
         for n in nodes:
             cap = n.status.capacity_chips
             u = used.get(n.metadata.name, 0)
             if cap is not None and u + cost > cap:
                 continue
+            if ANNOTATION_MAINTENANCE_AT in n.metadata.annotations:
+                if doomed_best is None or u < doomed_load:
+                    doomed_best, doomed_load = n.metadata.name, u
+                continue
             if best is None or u < best_load:
                 best, best_load = n.metadata.name, u
-        return best
+        return best if best is not None else doomed_best
 
     def _assign_gang(
         self, nodes: List, used: Dict[str, int], unbound: List[Pod]

@@ -1,0 +1,24 @@
+"""DIS001 fixture: teardown verbs on drain/maintenance paths outside the
+DrainController's sanctioned seam must fire."""
+
+
+def drain_node(store, pods, node):
+    for p in pods:
+        if p.spec.node_name != node:
+            continue
+        evict_pod(store, p, "draining")  # expect: DIS001
+
+
+def _evacuate_for_maintenance(store, pod):
+    return evict_pod(store, pod, "maintenance window")  # expect: DIS001
+
+
+def migrate_gang_off(store, members):
+    for p in members:
+        store.try_delete("Pod", p.metadata.namespace, p.metadata.name)  # expect: DIS001
+
+
+class Mover:
+    def _maintenance_sweep(self, live):
+        for p in live:
+            self.store.delete("Pod", p.metadata.namespace, p.metadata.name)  # expect: DIS001

@@ -1,0 +1,52 @@
+"""DIS001 fixture: the blessed forms stay silent.
+
+- the sanctioned seam functions (_migrate_batch_gangs / _escalate /
+  _drain_replica) ARE the drain plane — direct teardown is their job;
+- teardown outside any drain-flavored path (the node monitor's eviction,
+  a reaper's delete) is a different rule's business;
+- non-Pod deletes on a drain path are fine (a drain completing cleans its
+  own bookkeeping objects);
+- a reasoned suppression works.
+"""
+
+
+class DrainController:
+    def _migrate_batch_gangs(self, node, gangs):
+        for p in gangs:
+            evict_pod(self.store, p, "checkpoint-then-migrate",
+                      reason="Maintenance")
+
+    def _escalate(self, node, live):
+        for p in live:
+            evict_pod(self.store, p, "deadline reached",
+                      reason="Maintenance")
+
+
+class ServeController:
+    def _drain_replica(self, serve, rid, members):
+        for p in members:
+            self.store.try_delete("Pod", p.metadata.namespace,
+                                  p.metadata.name)
+
+
+def _evict_pods(store, stale, pods):
+    # the node monitor's unplanned-loss eviction: not a drain path
+    for p in pods:
+        if p.spec.node_name in stale:
+            evict_pod(store, p, "node lost")
+
+
+def drain_bookkeeping(store, node):
+    # non-Pod teardown on a drain path: the drain cleaning up after itself
+    store.try_delete("ConfigMap", "default", f"{node}-drain-note")
+
+
+def cmd_drain_now(store, pods, node):
+    for p in pods:
+        if p.spec.node_name != node:
+            continue
+        # break-glass client-side drain: the operator may be DOWN — that
+        # is exactly what this path exists for, so it cannot route
+        # through the DrainController
+        if evict_pod(store, p, "drained (--now)"):  # oplint: disable=DIS001
+            print("evicted", p.metadata.name)

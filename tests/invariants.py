@@ -41,6 +41,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
 LABEL_GENERATION = "tpujob.dev/generation"
 
 _TERMINAL = ("Succeeded", "Failed")
@@ -105,13 +106,26 @@ def _job_key(obj) -> str:
 
 
 def no_orphaned_dependents(trail: Trail) -> List[str]:
-    """Every live dependent's owning job exists in the final snapshot."""
+    """Every live dependent's owning workload exists in the final
+    snapshot. Serve dependents (they carry the gang name in
+    ``tpujob.dev/job-name`` — no TPUJob of that name ever exists) resolve
+    against their ``tpujob.dev/serve-name`` TPUServe instead."""
     out: List[str] = []
     if not trail.final:
         return out
     jobs = {_job_key(j) for j in trail.final.get("TPUJob", [])}
+    serves = {_job_key(s) for s in trail.final.get("TPUServe", [])}
     for kind in ("Pod", "ConfigMap", "Service", "PodGroup"):
         for obj in trail.final.get(kind, []):
+            serve_owner = obj.metadata.labels.get(LABEL_SERVE_NAME)
+            if serve_owner:
+                if f"{obj.metadata.namespace}/{serve_owner}" not in serves:
+                    out.append(
+                        f"orphaned {kind} {_job_key(obj)}: its TPUServe "
+                        f"{obj.metadata.namespace}/{serve_owner} no longer "
+                        f"exists"
+                    )
+                continue
             owner = obj.metadata.labels.get(LABEL_JOB_NAME)
             if not owner:
                 continue  # not controller-owned (test fixtures, nodes)
@@ -207,6 +221,10 @@ def conditions_obey_state_machine(trail: Trail) -> List[str]:
         where = f"job {_job_key(job)}"
         if "Running" in active and "Restarting" in active:
             out.append(f"{where}: Running and Restarting both active")
+        if "Running" in active and "Migrating" in active:
+            out.append(f"{where}: Running and Migrating both active")
+        if "Restarting" in active and "Migrating" in active:
+            out.append(f"{where}: Restarting and Migrating both active")
         if "Succeeded" in active and "Failed" in active:
             out.append(f"{where}: Succeeded and Failed both active")
         if ("Running" in active or active & set(_TERMINAL)) \

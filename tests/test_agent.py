@@ -657,7 +657,10 @@ def test_drain_evicts_pods_and_gang_lands_on_other_node():
         p.status.phase = PodPhase.RUNNING
         store.update(p, force=True)
     client = TPUJobClient(store)
-    assert cmd_drain(client, _Args(name="node-b")) == 0
+    # --now: the break-glass client-side path (no operator in this test);
+    # the default graceful path only stamps the maintenance notice and
+    # leaves evacuation to the DrainController (tests/test_disruption.py)
+    assert cmd_drain(client, _Args(name="node-b", now=True)) == 0
     drained = store.get("Pod", "default", "j-worker-1")
     assert drained.is_evicted()  # → the controller's gang restart path
     # after the controller recreates the gang, rebinding avoids node-b:

@@ -517,3 +517,213 @@ def test_watch_severed_past_ring_relists_with_no_stale_reads(chaos_run):
         client.close()
         proxy.stop()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: the leader is SIGKILLed MID-DRAIN — the standby resumes it
+# ---------------------------------------------------------------------------
+
+
+@TWO_RUNS
+def test_leader_sigkilled_mid_drain_standby_resumes_it(tmp_path, chaos_run):
+    """ISSUE 14's failover bar: drain state lives in the store (the
+    maintenance-at notice, the cordon, the Draining condition, the evicted
+    pods' Maintenance reasons, the budget-parked serve), so a leader dying
+    mid-drain loses NOTHING. The mid-drain state is made DURABLE by
+    construction: a batch gang AND a one-replica serve (DisruptionBudget 1)
+    both live on agent-a, and the only other node is one chip too small to
+    host the surged replacement — so leader A adopts the drain, migrates
+    the batch gang (free restart; it parks Pending), surges a serve
+    replacement that cannot bind, and PARKS the drain budget-blocked. THAT
+    stable state is when A is SIGKILLed via the chaos harness. Standby B
+    plus a freshly registered big node must finish everything A started:
+    the replacement binds and turns ready, the doomed replica retires
+    (never dipping ready below the budget), the batch gang lands off-node
+    and Succeeds with restart_count 0 and restart_generation exactly 1
+    (never a second teardown), and B records the Drained bookkeeping."""
+    from mpi_operator_tpu.api.client import TPUServeClient
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_MAINTENANCE_AT,
+        REASON_MAINTENANCE,
+        node_draining,
+    )
+
+    port = free_port()
+    procs = []
+    election = ["--lease-duration", "3", "--renew-deadline", "2",
+                "--retry-period", "0.5"]
+    tags = ["store", "op-a", "op-b", "agent-a", "agent-b", "agent-c"]
+    store = None
+    try:
+        procs.append(_spawn(tmp_path, "store", [
+            sys.executable, "-m", "mpi_operator_tpu.machinery.http_store",
+            "--store", f"sqlite:{tmp_path / 'store.db'}",
+            "--listen", f"127.0.0.1:{port}",
+        ]))
+        _wait_http(f"http://127.0.0.1:{port}/healthz")
+        op_a = _spawn(tmp_path, "op-a", [
+            sys.executable, "-m", "mpi_operator_tpu.opshell",
+            "--store", f"http://127.0.0.1:{port}",
+            "--monitoring-port", "0", *election,
+        ])
+        procs.append(op_a)
+        # A must hold the lease before B exists so WHICH replica drains
+        # (and dies) is deterministic across both runs — the lease
+        # ConfigMap existing proves A (the only replica yet) acquired it
+        lease_probe = HttpStoreClient(f"http://127.0.0.1:{port}")
+        deadline = time.time() + 30
+        while lease_probe.try_get(
+                "ConfigMap", "kube-system", "tpu-operator-leader-lock"
+        ) is None:
+            assert time.time() < deadline, _proc_logs(tmp_path, ["op-a"])
+            time.sleep(0.2)
+        lease_probe.close()
+        procs.append(_spawn(tmp_path, "op-b", [
+            sys.executable, "-m", "mpi_operator_tpu.opshell",
+            "--store", f"http://127.0.0.1:{port}",
+            "--monitoring-port", "0", *election,
+        ]))
+        # agent-a first and ALONE: both workloads must land on it
+        _spawn_agent(tmp_path, procs, port, "agent-a", "agent-a", chips=8)
+        store = HttpStoreClient(f"http://127.0.0.1:{port}")
+        _wait_nodes_registered(store, ["agent-a"])
+        trail = Trail(store)
+        TPUServeClient(store).create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "default"},
+            "spec": {
+                "replicas": 1, "workers_per_replica": 1,
+                "slice": {"accelerator": "cpu", "chips_per_host": 2},
+                "disruption_budget": 1, "max_surge": 1,
+                "template": {"containers": [{
+                    "image": "local",
+                    "command": ["python", "-c",
+                                "import time; time.sleep(600)"],
+                }]},
+            },
+        })
+        TPUJobClient(store).create(_job_manifest(
+            "drained", replicas=2, env={"HOLD_SECONDS": "8"},
+            command=["python", "tests/data/coupled_worker.py"],
+        ))
+        pods = _wait_pods_running(store, "drained", 2, 90, tmp_path, tags)
+        assert {p.spec.node_name for p in pods} == {"agent-a"}
+
+        def serve_pods():
+            return [p for p in store.list(
+                "Pod", "default",
+                selector={"tpujob.dev/serve-name": "svc"})
+                if not p.is_finished()]
+
+        deadline = time.time() + 60
+        while not any(p.status.phase == "Running" and p.status.ready
+                      for p in serve_pods()):
+            assert time.time() < deadline, (
+                "serve replica never ready\n" + _proc_logs(tmp_path, tags))
+            time.sleep(0.2)
+        # the too-small node: one chip — neither the 2-chip serve
+        # replacement nor the 2x1-chip batch gang can fit
+        _spawn_agent(tmp_path, procs, port, "agent-b", "agent-b", chips=1)
+        _wait_nodes_registered(store, ["agent-a", "agent-b"])
+
+        # the ctl-drain write pair: cordon + maintenance notice (far
+        # deadline: escalation must NOT rescue this drain)
+        store.patch("Node", NODE_NAMESPACE, "agent-a",
+                    {"status": {"unschedulable": True}},
+                    subresource="status")
+        store.patch("Node", NODE_NAMESPACE, "agent-a",
+                    {"metadata": {"annotations": {
+                        ANNOTATION_MAINTENANCE_AT: str(time.time() + 600),
+                    }}})
+        # wait for the DURABLE half-finished state: Draining active, the
+        # batch gang Maintenance-migrated (generation 1), and the drain
+        # PARKED budget-blocked behind the unplaceable serve replacement
+        deadline = time.time() + 90
+        while True:
+            assert time.time() < deadline, (
+                "leader never reached the parked mid-drain state\n"
+                + _proc_logs(tmp_path, tags))
+            node = store.get("Node", NODE_NAMESPACE, "agent-a")
+            job = store.get("TPUJob", "default", "drained")
+            blocked = [e for e in store.list("Event")
+                       if e.reason == "DrainBudgetBlocked"]
+            if (node_draining(node) and blocked
+                    and job.status.restart_generation == 1):
+                break
+            time.sleep(0.3)
+        assert job.status.restart_count == 0
+        doomed = [p for p in serve_pods() if p.spec.node_name == "agent-a"]
+        assert doomed, "the budget must keep the doomed replica serving"
+
+        # MID-DRAIN, durably parked: kill the leader via the chaos harness
+        script = ChaosScript.parse({"seed": SEED, "actions": [
+            {"at": 0.0, "fault": "kill", "target": "op-a"},
+        ]})
+        chaos = ChaosController(
+            script, targets={"op-a": ProcessTarget(lambda: None, op_a[0])},
+        ).arm()
+        chaos.join(30)
+        assert [e for (_, a, e) in chaos.executed if e] == [], chaos.executed
+        op_a[0].wait(timeout=10)
+        assert op_a[0].returncode == -9, _proc_logs(tmp_path, ["op-a"])
+
+        # capacity arrives AFTER the failover: everything that happens
+        # next is the STANDBY resuming A's half-finished drain
+        _spawn_agent(tmp_path, procs, port, "agent-c", "agent-c", chips=8)
+
+        final = _wait_job(store, "drained", 240, tmp_path, tags)
+        assert final.status.restart_count == 0, (
+            "a maintenance migration must stay FREE through failover: "
+            f"{final.status.conditions}")
+        assert final.status.restart_generation == 1, (
+            "the resumed drain tore the gang down a second time")
+        # the migrated generation ran entirely off the draining node
+        # (agent-b can legally host one 1-chip member once agent-c's
+        # capacity lets the gang place at all)
+        for p in store.list("Pod", "default",
+                            selector={LABEL_JOB_NAME: "drained"}):
+            if p.metadata.labels.get("tpujob.dev/generation") == "1":
+                assert p.spec.node_name in ("agent-b", "agent-c"), (
+                    p.metadata.name, p.spec.node_name)
+        # the serve migrated surge-first: replacement ready on agent-c,
+        # doomed replica retired, never below the budget
+        deadline = time.time() + 90
+        while True:
+            sp = serve_pods()
+            assert sp, "serve must never drop to zero live replicas"
+            if (all(p.spec.node_name == "agent-c" for p in sp)
+                    and any(p.status.ready for p in sp)):
+                break
+            assert time.time() < deadline, (
+                "standby never finished the serve migration\n"
+                + _proc_logs(tmp_path, tags))
+            time.sleep(0.3)
+        # standby B completed the drain bookkeeping it inherited
+        deadline = time.time() + 60
+        while True:
+            node = store.get("Node", NODE_NAMESPACE, "agent-a")
+            if not node_draining(node):
+                break
+            assert time.time() < deadline, (
+                "standby never completed the inherited drain\n"
+                + _proc_logs(tmp_path, tags))
+            time.sleep(0.5)
+        d = next(c for c in node.status.conditions if c.type == "Draining")
+        assert d.reason == "Drained"
+        assert node.status.unschedulable
+        # the one gang teardown was the Maintenance migration, not a
+        # monitor eviction racing it
+        gen0 = [p for p in trail.snapshot_events()
+                if p.kind == "Pod"
+                and p.obj.metadata.labels.get(LABEL_JOB_NAME) == "drained"
+                and p.obj.metadata.labels.get("tpujob.dev/generation") == "0"
+                and p.obj.status.phase == "Failed"]
+        assert gen0 and all(
+            p.obj.status.reason == REASON_MAINTENANCE for p in gen0
+        ), [(p.obj.metadata.name, p.obj.status.reason) for p in gen0]
+        trail.stop()
+        check_invariants(trail, detail=_proc_logs(tmp_path, tags))
+    finally:
+        if store is not None:
+            store.close()
+        _reap(procs)

@@ -1,0 +1,556 @@
+"""The disruption plane (ISSUE 14): maintenance-aware drains, disruption
+budgets, checkpoint-then-migrate gang evictions.
+
+Pins the tentpole contracts:
+
+- a maintenance notice on a node drives the full batch migration loop
+  (cordon → whole-gang Maintenance eviction → free gang restart placed off
+  the node → drain completion) with ``restart_count`` UNTOUCHED;
+- a node that dies *while draining* resolves to exactly ONE eviction (the
+  DrainController's escalation) — the node monitor defers, so the gang's
+  restart_generation advances once, not twice;
+- drain state lives in the store (annotation + Node conditions + evicted
+  pod reasons), so a NEW controller instance resumes a half-finished
+  drain instead of restarting or abandoning it;
+- serve replicas migrate SURGE-FIRST under the DisruptionBudget: a drain
+  that cannot surge parks as drain_budget_blocked=1 with an explaining
+  Event and unblocks the moment capacity frees — zero budget violations;
+- the scheduler treats maintenance-noticed nodes as last-resort targets;
+- `ctl drain` stamps the notice / renders progress with the documented
+  exit codes; the chaos `maintenance` fault stamps-then-SIGKILLs.
+"""
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.client import TPUJobClient, TPUServeClient
+from mpi_operator_tpu.api.types import ConditionType
+from mpi_operator_tpu.controller.controller import (
+    LABEL_JOB_NAME as CTRL_LABEL_JOB_NAME,
+    TPUJobController,
+)
+from mpi_operator_tpu.controller.disruption import (
+    DrainController,
+    LABEL_JOB_NAME,
+    LABEL_SERVE_NAME,
+)
+from mpi_operator_tpu.controller.node_monitor import NodeMonitor
+from mpi_operator_tpu.controller.serve import (
+    LABEL_SERVE_NAME as SERVE_LABEL_SERVE_NAME,
+    TPUServeController,
+)
+from mpi_operator_tpu.machinery.chaos import (
+    ChaosController,
+    ChaosScript,
+    ChaosScriptError,
+)
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    NODE_NAMESPACE,
+    REASON_MAINTENANCE,
+    NodeConditionType,
+    PodPhase,
+    node_draining,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.opshell import metrics
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+from test_agent import make_node
+from test_hollow import make_job
+
+
+def stamp_maintenance(store, node, in_s=60.0):
+    store.patch(
+        "Node", NODE_NAMESPACE, node,
+        {"metadata": {"annotations": {
+            ANNOTATION_MAINTENANCE_AT: str(time.time() + in_s),
+        }}},
+    )
+
+
+def mark_running(store, pods):
+    for p in pods:
+        store.patch(
+            "Pod", p.metadata.namespace, p.metadata.name,
+            {"status": {"phase": PodPhase.RUNNING, "ready": True}},
+            subresource="status",
+        )
+
+
+def live_on(store, node):
+    return [
+        p for p in store.list("Pod")
+        if p.spec.node_name == node and not p.is_finished()
+    ]
+
+
+def wait_until(fn, timeout=10.0, every=0.03, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(every)
+    raise AssertionError(f"{what} not reached within {timeout}s")
+
+
+def events(store, reason=None):
+    out = store.list("Event")
+    if reason is not None:
+        out = [e for e in out if e.reason == reason]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batch: checkpoint-then-migrate, free restart, off-node placement
+# ---------------------------------------------------------------------------
+
+
+def _manual_plane(workers=2, node_chips=8):
+    """store + UNSTARTED controller/scheduler/drain — every step driven by
+    explicit sync calls, so ordering is deterministic."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    ctrl = TPUJobController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, node_grace=5.0)
+    make_node(store, "node-a", chips=node_chips)
+    store.create(make_job("mig", ns="default", replicas=workers))
+    key = "default/mig"
+    ctrl.sync_handler(key)  # service/config/podgroup/pods
+    sched.sync()            # bind the gang onto node-a
+    mark_running(store, store.list("Pod"))
+    ctrl.sync_handler(key)  # Running condition
+    return store, ctrl, sched, drain, key
+
+
+def test_maintenance_notice_migrates_batch_gang_for_free():
+    store, ctrl, sched, drain, key = _manual_plane()
+    job0 = store.get("TPUJob", "default", "mig")
+    assert cond.is_running(job0.status)
+    stamp_maintenance(store, "node-a", in_s=120.0)
+    drain.sync()  # adopt: cordon + Draining + whole-gang eviction
+
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert node.status.unschedulable, "drain must cordon"
+    assert node_draining(node)
+    evicted = [p for p in store.list("Pod") if p.is_finished()]
+    assert len(evicted) == 2, "whole gang evicted, not just one member"
+    assert all(p.status.reason == REASON_MAINTENANCE for p in evicted)
+    assert all(p.is_evicted() and p.is_planned_disruption()
+               for p in evicted)
+
+    ctrl.sync_handler(key)  # verdict: Migrating, free restart executes
+    job = store.get("TPUJob", "default", "mig")
+    assert job.status.restart_generation == 1
+    assert job.status.restart_count == 0, \
+        "a maintenance move must never burn the backoffLimit budget"
+    assert cond.has_condition(job.status, ConditionType.MIGRATING)
+    assert events(store, "GangMigrating")
+
+    ctrl.sync_handler(key)  # recreate the gang at generation 1
+    make_node(store, "node-b", chips=8)
+    sched.sync()
+    rebound = [p for p in store.list("Pod") if p.spec.node_name]
+    assert rebound and all(
+        p.spec.node_name == "node-b" for p in rebound
+    ), "migrated gang must land OFF the draining node"
+
+    drain.sync()  # node now empty → drain completes
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    d = next(c for c in node.status.conditions
+             if c.type == NodeConditionType.DRAINING)
+    assert d.status is False and d.reason == "Drained"
+    assert node.status.unschedulable, "stays cordoned until uncordon"
+    assert ANNOTATION_MAINTENANCE_AT in node.metadata.annotations
+    assert events(store, "DrainCompleted")
+
+    # the relaunched gang runs to completion untouched by the drain
+    mark_running(store, rebound)
+    for p in rebound:
+        store.patch("Pod", p.metadata.namespace, p.metadata.name,
+                    {"status": {"phase": PodPhase.SUCCEEDED,
+                                "ready": False, "exit_code": 0}},
+                    subresource="status")
+    ctrl.sync_handler(key)
+    job = store.get("TPUJob", "default", "mig")
+    assert cond.is_succeeded(job.status)
+    assert job.status.restart_count == 0
+    assert not cond.has_condition(job.status, ConditionType.MIGRATING)
+
+
+def test_deadline_overrun_hard_evicts_whats_left():
+    store, ctrl, sched, drain, key = _manual_plane()
+    # the window is already over when the notice is adopted
+    stamp_maintenance(store, "node-a", in_s=-1.0)
+    drain.sync()
+    evicted = [p for p in store.list("Pod") if p.is_finished()]
+    assert len(evicted) == 2
+    assert all(p.status.reason == REASON_MAINTENANCE for p in evicted)
+    assert events(store, "DrainEscalated")
+    ctrl.sync_handler(key)
+    job = store.get("TPUJob", "default", "mig")
+    # even the hard path is a planned move: the restart stays free
+    assert job.status.restart_generation == 1
+    assert job.status.restart_count == 0
+
+
+# ---------------------------------------------------------------------------
+# dedupe: a node that dies WHILE draining = exactly one eviction
+# ---------------------------------------------------------------------------
+
+
+def test_dead_draining_node_resolves_to_one_eviction():
+    store, ctrl, sched, drain, key = _manual_plane()
+    monitor = NodeMonitor(store, grace=5.0)
+    stamp_maintenance(store, "node-a", in_s=120.0)
+    # the node dies mid-drain: heartbeat goes stale
+    store.patch("Node", NODE_NAMESPACE, "node-a",
+                {"status": {"last_heartbeat": time.time() - 60}},
+                subresource="status")
+    evicted0 = metrics.pods_evicted.get()
+    make_node(store, "node-b", chips=8)
+    # interleave both controllers repeatedly — the bug this pins is each
+    # of them tearing the same gang down once
+    for _ in range(4):
+        monitor.sync()
+        drain.sync()
+        ctrl.sync_handler(key)
+        sched.sync()
+    job = store.get("TPUJob", "default", "mig")
+    assert job.status.restart_generation == 1, \
+        "the drain + node loss must resolve to ONE gang teardown"
+    assert job.status.restart_count == 0
+    # the one eviction was the DrainController's, not the monitor's
+    assert metrics.pods_evicted.get() == evicted0
+    assert not events(store, "NodeLost") or all(
+        e.involved.kind != "Pod" for e in events(store, "NodeLost")
+    ), "node monitor must not evict pods off a draining node"
+    # the relaunched generation is alive and bound elsewhere
+    fresh = [p for p in store.list("Pod") if not p.is_finished()]
+    assert fresh and all(p.spec.node_name in ("", "node-b") for p in fresh)
+
+
+# ---------------------------------------------------------------------------
+# failover: a new controller resumes a half-finished drain
+# ---------------------------------------------------------------------------
+
+
+def test_drain_state_survives_controller_failover():
+    store, ctrl, sched, drain1, key = _manual_plane()
+    stamp_maintenance(store, "node-a", in_s=120.0)
+    drain1.sync()  # adopt + evict, then the leader "dies"
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert node_draining(node) and node.status.unschedulable
+    assert all(p.status.reason == REASON_MAINTENANCE
+               for p in store.list("Pod") if p.is_finished())
+    drain1.stop()
+
+    # fresh leader: new controller instances, empty in-memory state —
+    # everything it needs is in the store
+    recorder = EventRecorder(store)
+    ctrl2 = TPUJobController(store, recorder)
+    drain2 = DrainController(store, recorder, node_grace=5.0)
+    ctrl2.sync_handler(key)  # restart verdict (once)
+    ctrl2.sync_handler(key)  # recreate generation-1 pods
+    make_node(store, "node-b", chips=8)
+    sched.sync()
+    drain2.sync()
+
+    job = store.get("TPUJob", "default", "mig")
+    assert job.status.restart_generation == 1, \
+        "the resumed drain must not re-tear the gang"
+    assert job.status.restart_count == 0
+    fresh = [p for p in store.list("Pod") if not p.is_finished()]
+    assert fresh and all(p.spec.node_name == "node-b" for p in fresh), \
+        "resumed drain must leave the migrated generation alone"
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    d = next(c for c in node.status.conditions
+             if c.type == NodeConditionType.DRAINING)
+    assert d.status is False and d.reason == "Drained", \
+        "the NEW leader must complete the drain it inherited"
+
+
+# ---------------------------------------------------------------------------
+# serve: surge-first migration under the DisruptionBudget
+# ---------------------------------------------------------------------------
+
+
+def _serve_plane():
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    serve_ctrl = TPUServeController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder)
+    serve_ctrl.run()
+    sched.start()
+    return store, serve_ctrl, sched, drain
+
+
+def test_budget_blocked_drain_parks_then_unblocks():
+    store, serve_ctrl, sched, drain = _serve_plane()
+    try:
+        make_node(store, "node-a", chips=2)
+        make_node(store, "node-b", chips=2)
+        TPUServeClient(store).create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "default"},
+            "spec": {
+                "replicas": 2, "workers_per_replica": 1,
+                "slice": {"accelerator": "cpu", "chips_per_host": 2},
+                "disruption_budget": 2, "max_surge": 1,
+            },
+        })
+
+        def ready_count():
+            s = store.try_get("TPUServe", "default", "svc")
+            return s.status.ready_replicas if s else 0
+
+        def serve_pods():
+            return [p for p in store.list(
+                "Pod", "default", selector={LABEL_SERVE_NAME: "svc"})
+                if not p.is_finished()]
+
+        wait_until(lambda: len([p for p in serve_pods()
+                                if p.spec.node_name]) == 2,
+                   what="both replicas bound")
+        mark_running(store, serve_pods())
+        wait_until(lambda: ready_count() == 2, what="both replicas ready")
+
+        victim = serve_pods()[0].spec.node_name
+        assert victim in ("node-a", "node-b")
+        min_ready = [2]
+
+        def sample_ready(v):
+            min_ready[0] = min(min_ready[0], ready_count())
+            return v
+
+        stamp_maintenance(store, victim, in_s=300.0)
+        # the serve controller surges a replacement (node event wakes it)
+        wait_until(lambda: sample_ready(len(serve_pods()) == 3),
+                   what="surged replacement created")
+        # ... which cannot place: both nodes are full → drain parks
+        for _ in range(3):
+            drain.sync()
+            sample_ready(True)
+        assert metrics.drain_budget_blocked.get() == 1
+        blocked = events(store, "DrainBudgetBlocked")
+        assert blocked and "disruption budget 2" in blocked[0].message
+        assert live_on(store, victim), \
+            "the doomed replica must NOT be retired while blocked"
+
+        # capacity frees → the replacement binds, warms, passes readiness
+        make_node(store, "node-c", chips=2)
+        replacement = wait_until(
+            lambda: sample_ready(next((
+                p for p in serve_pods() if p.spec.node_name == "node-c"
+            ), None)),
+            what="replacement bound to the new node")
+        mark_running(store, [replacement])
+        # only now is the doomed replica retired — surge-first
+        wait_until(lambda: sample_ready(not live_on(store, victim)),
+                   what="doomed replica retired")
+        wait_until(lambda: drain.sync() or
+                   metrics.drain_budget_blocked.get() == 0,
+                   what="drain unblocks")
+        node = store.get("Node", NODE_NAMESPACE, victim)
+        assert not node_draining(node)
+        assert min_ready[0] >= 2, \
+            f"ready dipped to {min_ready[0]} — budget violated"
+    finally:
+        serve_ctrl.stop()
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: anti-hop placement penalty
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_treats_noticed_nodes_as_last_resort():
+    from test_scheduler import bound_pods, make_gang, make_pod
+
+    store = ObjectStore()
+    sched = GangScheduler(store)
+    make_node(store, "node-m", chips=8)
+    make_node(store, "node-c", chips=2)
+    stamp_maintenance(store, "node-m", in_s=600.0)
+    make_gang(store, "j", min_member=1)
+    make_pod(store, "j", 0, chips=2)
+    sched.sync()
+    # node-m is emptier, but its maintenance window makes it last-resort
+    assert [p.spec.node_name for p in bound_pods(store, "j")] == ["node-c"]
+    # clean capacity exhausted → the noticed node still hosts (capacity
+    # beats purity; the drain will move it again if the window fires)
+    make_gang(store, "k", min_member=1)
+    make_pod(store, "k", 0, chips=2)
+    sched.sync()
+    assert [p.spec.node_name for p in bound_pods(store, "k")] == ["node-m"]
+
+
+# ---------------------------------------------------------------------------
+# ctl: drain UX
+# ---------------------------------------------------------------------------
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_ctl_drain_stamps_notice_and_status_tracks_progress(capsys):
+    from mpi_operator_tpu.opshell.ctl import cmd_drain, cmd_uncordon
+
+    store = ObjectStore()
+    client = TPUJobClient(store)
+    make_node(store, "node-a")
+    assert cmd_drain(client, _Args(name="node-a", deadline=120.0)) == 0
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert node.status.unschedulable
+    at = float(node.metadata.annotations[ANNOTATION_MAINTENANCE_AT])
+    assert 100 < at - time.time() <= 120
+
+    # a live pod on the node → --status reports busy (exit 1)
+    from test_scheduler import make_gang, make_pod
+    make_gang(store, "j", min_member=1)
+    pod = make_pod(store, "j", 0)
+    store.patch("Pod", "default", pod.metadata.name,
+                {"spec": {"node_name": "node-a"}})
+    mark_running(store, [store.get("Pod", "default", pod.metadata.name)])
+    assert cmd_drain(client, _Args(status=True, name=None)) == 1
+    out = capsys.readouterr().out
+    assert "node-a" in out and "PODS-REMAINING" in out
+
+    # node empties → exit 0
+    store.patch("Pod", "default", pod.metadata.name,
+                {"status": {"phase": PodPhase.SUCCEEDED, "ready": False}},
+                subresource="status")
+    assert cmd_drain(client, _Args(status=True, name=None)) == 0
+
+    # uncordon = back from maintenance: clears the flag AND the notice
+    assert cmd_uncordon(client, _Args(name="node-a")) == 0
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert not node.status.unschedulable
+    assert ANNOTATION_MAINTENANCE_AT not in node.metadata.annotations
+
+
+def test_ctl_drain_rejects_bad_invocations(capsys):
+    from mpi_operator_tpu.opshell.ctl import cmd_drain
+
+    store = ObjectStore()
+    client = TPUJobClient(store)
+    assert cmd_drain(client, _Args(name=None, status=False)) == 2
+    make_node(store, "node-a")
+    assert cmd_drain(client, _Args(name="node-a", deadline=-5.0)) == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos: the maintenance fault
+# ---------------------------------------------------------------------------
+
+
+class _KillSpy:
+    def __init__(self):
+        self.killed = 0
+
+    def kill(self):
+        self.killed += 1
+
+
+def test_chaos_maintenance_fault_stamps_then_fires_on_busy_node():
+    from test_scheduler import make_gang, make_pod
+
+    store = ObjectStore()
+    make_node(store, "node-x")
+    make_gang(store, "j", min_member=1)
+    pod = make_pod(store, "j", 0)
+    store.patch("Pod", "default", pod.metadata.name,
+                {"spec": {"node_name": "node-x"}})
+    mark_running(store, [store.get("Pod", "default", pod.metadata.name)])
+    spy = _KillSpy()
+    script = ChaosScript.parse({"seed": 7, "actions": [
+        {"at": 0.0, "fault": "maintenance", "target": "node-x",
+         "duration": 0.3},
+    ]})
+    chaos = ChaosController(script, targets={"node-x": spy},
+                            store=store).arm()
+    chaos.join(10)
+    assert [e for (_, a, e) in chaos.executed if e] == [], chaos.executed
+    node = store.get("Node", NODE_NAMESPACE, "node-x")
+    at = float(node.metadata.annotations[ANNOTATION_MAINTENANCE_AT])
+    assert abs(at - time.time()) < 5.0
+    assert spy.killed == 1, "pods still bound at the deadline → SIGKILL"
+
+
+def test_chaos_maintenance_fault_spares_an_empty_node():
+    store = ObjectStore()
+    make_node(store, "node-x")
+    spy = _KillSpy()
+    script = ChaosScript.parse({"seed": 7, "actions": [
+        {"at": 0.0, "fault": "maintenance", "target": "node-x",
+         "duration": 0.2},
+    ]})
+    chaos = ChaosController(script, targets={"node-x": spy},
+                            store=store).arm()
+    chaos.join(10)
+    assert [e for (_, a, e) in chaos.executed if e] == [], chaos.executed
+    assert spy.killed == 0, "a drained node rides the window out unharmed"
+
+
+def test_chaos_maintenance_fault_validates_knobs():
+    with pytest.raises(ChaosScriptError):  # no duration: not a fault
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 0.0, "fault": "maintenance", "target": "n"}]})
+    with pytest.raises(ChaosScriptError):  # no target
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 0.0, "fault": "maintenance", "duration": 1.0}]})
+    with pytest.raises(ChaosScriptError):  # inapplicable knob rejected
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 0.0, "fault": "maintenance", "target": "n",
+             "duration": 1.0, "prob": 0.5}]})
+
+
+# ---------------------------------------------------------------------------
+# contracts: constants parity, API admission, malformed notices
+# ---------------------------------------------------------------------------
+
+
+def test_disruption_label_constants_match_controllers():
+    assert LABEL_JOB_NAME == CTRL_LABEL_JOB_NAME
+    assert LABEL_SERVE_NAME == SERVE_LABEL_SERVE_NAME
+
+
+def test_disruption_budget_rides_the_manifest_schema():
+    from mpi_operator_tpu.api.schema import parse_tpuserve
+    from mpi_operator_tpu.api.validation import validate_tpuserve
+    from mpi_operator_tpu.api.defaults import set_serve_defaults
+
+    s = parse_tpuserve({
+        "kind": "TPUServe", "metadata": {"name": "svc"},
+        "spec": {"replicas": 3, "disruptionBudget": 2},
+    })
+    assert s.spec.disruption_budget == 2
+    set_serve_defaults(s)
+    assert validate_tpuserve(s) == []
+    s.spec.disruption_budget = -1
+    assert any("disruption_budget" in e for e in validate_tpuserve(s))
+
+
+def test_malformed_maintenance_annotation_is_surfaced_not_obeyed():
+    store = ObjectStore()
+    make_node(store, "node-a")
+    store.patch("Node", NODE_NAMESPACE, "node-a",
+                {"metadata": {"annotations": {
+                    ANNOTATION_MAINTENANCE_AT: "tomorrow-ish",
+                }}})
+    drain = DrainController(store)
+    drain.sync()
+    drain.sync()
+    node = store.get("Node", NODE_NAMESPACE, "node-a")
+    assert not node.status.unschedulable, "garbage must not cordon"
+    warnings = events(store, "MaintenanceAnnotationInvalid")
+    assert len(warnings) == 1, "warn once, not per tick"

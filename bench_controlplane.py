@@ -1826,6 +1826,377 @@ def run_drain_mode(seed: int) -> dict:
         trace.TRACER.disable()
 
 
+def run_goodput_mode(seed: int) -> dict:
+    """The workload telemetry plane under seeded pathology
+    (BENCH_CP_MODES=goodput, ISSUE 15): a hollow fleet runs batch + serve
+    while one seeded job suffers an input-pipeline stall and one gang
+    hosts a seeded straggler worker; a node drain checkpoint-migrates a
+    third gang. Asserted:
+
+    - the stall job's dominant bucket reads ``input`` in its telemetry;
+    - the ``goodput-collapse`` burn-rate alert FIRES within its
+      documented bound (fast_long + 2 evaluation periods, at the bench's
+      compressed window scale) of the gauge first crossing the floor,
+      and CLEARS after the stall heals;
+    - the Straggler Event names the exact pod and node;
+    - ``restart_to_first_step_seconds`` records at least one planned
+      MIGRATION outage span (the ROADMAP item 5 baseline).
+    """
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.api.client import TPUJobClient, TPUServeClient
+    from mpi_operator_tpu.api.types import ALERT_NAMESPACE
+    from mpi_operator_tpu.controller.disruption import DrainController
+    from mpi_operator_tpu.controller.goodput import GoodputAggregator
+    from mpi_operator_tpu.controller.serve import TPUServeController
+    from mpi_operator_tpu.controller.slo_monitor import (
+        SLOMonitor,
+        load_slo_config,
+    )
+    from mpi_operator_tpu.executor.hollow import (
+        HollowFleet,
+        HollowTimeline,
+        ServeLoadModel,
+        TrainLoadModel,
+    )
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.machinery.telemetry import ScrapeTarget
+    from mpi_operator_tpu.opshell import metrics
+
+    window_scale = 1.0 / 300.0  # fast (1s, 12s), slow (6s, 72s), hold 1s
+    slo_cfg = load_slo_config().scaled(window_scale)
+    floor = slo_cfg.objective("goodput-collapse").bound
+    monitor_interval = 0.25
+    # the DOCUMENTED detection bound (slo_defaults.json): fast_long + two
+    # evaluation periods, measured from the gauge first crossing the floor
+    detect_bound_s = slo_cfg.policy.fast[1] + 2 * monitor_interval
+
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    train = TrainLoadModel(step_ms=40.0, compile_s=0.4, seed=seed)
+    train.set_straggler("bench/skew-worker-1", 2.5)
+    load = ServeLoadModel(capacity_qps=100.0)
+    load.set_offered("bench/svc", 40.0)
+    fleet = HollowFleet(
+        store, 6,
+        timeline=HollowTimeline(
+            run_s=600.0, seed=seed, train=train,
+            train_stats_interval_s=0.2,
+            serve_warmup_s=0.3, serve_stats_interval_s=0.5, load=load,
+        ),
+        capacity_chips=8, heartbeat_interval=0.5,
+    )
+    controller = TPUJobController(store, recorder,
+                                  ControllerOptions(threadiness=2))
+    serve_ctrl = TPUServeController(store, recorder)
+    scheduler = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, interval=0.2)
+    agg = GoodputAggregator(store, recorder, interval=0.25)
+    monitor = SLOMonitor(store, [ScrapeTarget("bench", "self")], slo_cfg,
+                         interval=monitor_interval)
+    job_keys = [f"bench/{n}" for n in ("stall", "skew", "mig")]
+    mig_before = metrics.restart_to_first_step.count(kind="migration")
+    out: Dict[str, Any] = {"metric": "controlplane_goodput", "seed": seed,
+                           "ok": False}
+    t0 = time.time()
+    try:
+        controller.run()
+        serve_ctrl.run()
+        scheduler.start()
+        fleet.start()
+        drain.start()
+        agg.start()
+        jc = TPUJobClient(store, namespace="bench")
+        for name, workers in (("stall", 2), ("skew", 3), ("mig", 2)):
+            jc.create({
+                "kind": "TPUJob", "metadata": {"name": name,
+                                               "namespace": "bench"},
+                "spec": {
+                    "slice": {"accelerator": "cpu", "chips_per_host": 1},
+                    "worker": {"replicas": workers, "template": {
+                        "containers": [{"image": "x",
+                                        "command": ["train"]}]}},
+                },
+            })
+        TPUServeClient(store, namespace="bench").create({
+            "kind": "TPUServe",
+            "metadata": {"name": "svc", "namespace": "bench"},
+            "spec": {"replicas": 1, "workers_per_replica": 1,
+                     "slice": {"accelerator": "cpu", "chips_per_host": 2}},
+        })
+
+        def telemetry(name):
+            job = store.try_get("TPUJob", "bench", name)
+            return (job.status.train_telemetry or {}) if job else {}
+
+        def wait_for(pred, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.1)
+            raise RuntimeError(f"timed out waiting for {what}")
+
+        # --- phase 1: everything healthy and reporting. The monitor
+        # starts only once the fleet is past warmup: at the bench's
+        # 300x-compressed windows a job's first seconds (compile, no
+        # steps yet) dominate fast_long the way they never could at the
+        # production 1h window — starting the scrape on a live healthy
+        # fleet is also the deployment-normal shape ---
+        wait_for(lambda: all(telemetry(n).get("steps", 0) > 0
+                             and (telemetry(n).get("goodput") or 0) > floor
+                             for n in ("stall", "skew", "mig")),
+                 30.0, "all jobs reporting healthy telemetry")
+        monitor.start()
+        alert_obj = lambda: store.try_get(  # noqa: E731
+            "Alert", ALERT_NAMESPACE, "goodput-collapse")
+        time.sleep(2.0)  # healthy baseline: no false positive
+        a = alert_obj()
+        out["false_positive"] = bool(a is not None and a.is_firing())
+
+        # --- phase 2: the seeded input-pipeline stall ---
+        train.set_stall("bench/stall", "input", 0.9)
+        wait_for(lambda: metrics.job_goodput_ratio.get(
+            job="bench/stall") < floor, 30.0, "goodput below the floor")
+        breach_at = time.time()
+        wait_for(lambda: (a := alert_obj()) is not None and a.is_firing(),
+                 detect_bound_s + 5.0, "goodput-collapse firing")
+        fired_at = time.time()
+        out["detect_s"] = round(fired_at - breach_at, 2)
+        out["detect_bound_s"] = round(detect_bound_s, 2)
+        out["dominant_stall"] = telemetry("stall").get("dominant_stall")
+        out["stall_goodput"] = telemetry("stall").get("goodput")
+
+        # --- phase 3: heal; the alert must clear ---
+        train.clear_stall("bench/stall")
+        wait_for(lambda: (a := alert_obj()) is not None
+                 and not a.is_firing(), 60.0, "goodput-collapse clearing")
+        out["clear_s"] = round(time.time() - fired_at, 2)
+
+        # --- the straggler (seeded from t=0) ---
+        strag = telemetry("skew").get("straggler", "")
+        pod = store.try_get("Pod", "bench", "skew-worker-1")
+        node = pod.spec.node_name if pod else ""
+        evs = [e for e in store.list("Event")
+               if e.reason == "Straggler" and "skew-worker-1" in e.message
+               and node and node in e.message]
+        out["straggler"] = strag
+        out["straggler_event"] = bool(evs)
+
+        # --- phase 4: drain the node hosting mig's coordinator ---
+        mig_pod = store.get("Pod", "bench", "mig-worker-0")
+        victim = mig_pod.spec.node_name
+        out["drained_node"] = victim
+        fleet.announce_maintenance(victim, time.time() + 20.0)
+        wait_for(
+            lambda: metrics.restart_to_first_step.count(
+                kind="migration") > mig_before,
+            40.0, "restart_to_first_step recorded for the migration",
+        )
+        snap = metrics.restart_to_first_step.snapshot(kind="migration")
+        # mean outage span of this run's migrations (sum/count delta is
+        # overkill for one seeded migration; count delta asserted above)
+        out["restart_to_first_step_count"] = int(
+            metrics.restart_to_first_step.count(kind="migration")
+            - mig_before)
+        out["restart_to_first_step_p50_s"] = round(
+            metrics.histogram_quantile(0.5, snap), 2)
+        wait_for(lambda: not cond.is_finished(
+            store.get("TPUJob", "bench", "mig").status)
+            and telemetry("mig").get("steps", 0) > 0,
+            20.0, "migrated gang stepping again")
+        out["mig_generation"] = store.get(
+            "TPUJob", "bench", "mig").status.restart_generation
+        out["mig_restart_count"] = store.get(
+            "TPUJob", "bench", "mig").status.restart_count
+
+        out["elapsed_s"] = round(time.time() - t0, 1)
+        out["ok"] = bool(
+            not out["false_positive"]
+            and out["dominant_stall"] == "input"
+            and out["detect_s"] <= detect_bound_s
+            and strag.startswith("bench/skew-worker-1@")
+            and out["straggler_event"]
+            and out["restart_to_first_step_count"] >= 1
+            and out["mig_generation"] >= 1
+            and out["mig_restart_count"] == 0  # the migration was FREE
+        )
+        return out
+    finally:
+        monitor.stop()
+        agg.stop()
+        drain.stop()
+        scheduler.stop()
+        serve_ctrl.stop()
+        controller.stop()
+        fleet.stop()
+        # the registry is process-global and this mode runs TWICE: run 1's
+        # per-job gauges must not leak a stale collapsed value into run
+        # 2's scrape (a counter-reset false alert)
+        for key in job_keys:
+            metrics.job_goodput_ratio.remove(job=key)
+            metrics.job_stragglers.remove(job=key)
+
+
+def run_goodput_llama() -> dict:
+    """The REAL (non-hollow) half of the goodput acceptance: a short
+    llama gang on the local executor with stepstats enabled end to end —
+    train_stats mirrored into pod status, measured stepstats overhead
+    <= 2% of step p50, and the `ctl profile` round trip (stamp → workers
+    capture a jax.profiler trace → --status → --fetch rc=0)."""
+    import io
+    import contextlib
+    import shutil
+
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.api import conditions as cond
+    from mpi_operator_tpu.controller.goodput import GoodputAggregator
+    from mpi_operator_tpu.executor.local import LocalExecutor
+    from mpi_operator_tpu.opshell import ctl
+    from mpi_operator_tpu.runtime.stepstats import StepStatsRecorder
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench-goodput-llama-")
+    ckpt = os.path.join(tmp, "ckpt")
+    db = os.path.join(tmp, "store.db")
+    store = SqliteStore(db, poll_interval=0.02)
+    spec = f"sqlite:{db}"
+    recorder = EventRecorder(store)
+    controller = TPUJobController(store, recorder,
+                                  ControllerOptions(threadiness=2))
+    scheduler = GangScheduler(store, recorder)
+    executor = LocalExecutor(store, workdir=repo, require_binding=True,
+                             stepstats_poll=0.5)
+    agg = GoodputAggregator(store, recorder, interval=0.5)
+    out: Dict[str, Any] = {"metric": "goodput_llama", "ok": False}
+    t0 = time.time()
+    steps_total = int(os.environ.get("BENCH_CP_GOODPUT_LLAMA_STEPS", "80"))
+    try:
+        controller.run()
+        scheduler.start()
+        executor.start()
+        agg.start()
+        jc = TPUJobClient(store)
+        jc.create({
+            "kind": "TPUJob", "metadata": {"name": "llama"},
+            "spec": {
+                "slice": {"accelerator": "cpu", "chips_per_host": 1},
+                "run_policy": {"backoff_limit": 2},
+                "worker": {
+                    "replicas": 2, "restart_policy": "ExitCode",
+                    "template": {"containers": [{
+                        "image": "local",
+                        "command": ["python", "examples/llama_worker.py"],
+                        "env": [
+                            {"name": "LLAMA_CONFIG", "value": "tiny"},
+                            {"name": "LLAMA_BATCH", "value": "2"},
+                            {"name": "LLAMA_SEQ", "value": "32"},
+                            {"name": "LLAMA_STEPS",
+                             "value": str(steps_total)},
+                            {"name": "LLAMA_CKPT", "value": ckpt},
+                            {"name": "LLAMA_SAVE_EVERY", "value": "40"},
+                            {"name": "LLAMA_CHECK_EVERY", "value": "5"},
+                            {"name": "LLAMA_STEP_SLEEP", "value": "0.05"},
+                        ],
+                    }]},
+                },
+            },
+        })
+
+        def coord_stats():
+            p = store.try_get("Pod", "default", "llama-worker-0")
+            return (p.status.train_stats or {}) if p else {}
+
+        def wait_for(pred, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.25)
+            raise RuntimeError(f"timed out waiting for {what}")
+
+        # real training is stepping AND its stats are mirrored
+        wait_for(lambda: coord_stats().get("steps", 0) >= 5, 180.0,
+                 "llama train_stats in pod status")
+
+        # --- the profile round trip, through the REAL ctl verbs ---
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = ctl.main(["--store", spec, "profile", "llama",
+                           "--steps", "3"])
+        out["profile_request_rc"] = rc
+
+        def profile_done():
+            with contextlib.redirect_stdout(io.StringIO()):
+                return ctl.main(["--store", spec, "profile", "llama",
+                                 "--status"]) == 0
+        wait_for(profile_done, 120.0, "profile capture acked done")
+        prof = coord_stats().get("profile") or {}
+        trace_files = []
+        if prof.get("dir") and os.path.isdir(prof["dir"]):
+            for root, _dirs, files in os.walk(prof["dir"]):
+                trace_files += [os.path.join(root, f) for f in files]
+        out["trace_files"] = len(trace_files)
+        dest = os.path.join(tmp, "fetched")
+        with contextlib.redirect_stdout(io.StringIO()):
+            out["profile_fetch_rc"] = ctl.main([
+                "--store", spec, "profile", "llama", "--fetch",
+                "--dest", dest])
+        out["fetched_files"] = sum(
+            len(fs) for _r, _d, fs in os.walk(dest))
+
+        # the gang must still FINISH (profiling never perturbs outcome)
+        wait_for(lambda: cond.is_finished(
+            store.get("TPUJob", "default", "llama").status), 240.0,
+            "llama job finishing")
+        job = store.get("TPUJob", "default", "llama")
+        out["succeeded"] = cond.is_succeeded(job.status)
+        tel = job.status.train_telemetry or {}
+        out["goodput"] = tel.get("goodput")
+        out["buckets"] = tel.get("buckets")
+        step_p50_ms = float(coord_stats().get("step_p50_ms", 0.0) or 0.0)
+        out["step_p50_ms"] = step_p50_ms
+
+        # --- stepstats overhead: the measured per-step recorder cost
+        # (the exact call sequence the elastic loop pays: three phases +
+        # step_done, flush cadence included) against the REAL step p50 ---
+        rec = StepStatsRecorder(os.path.join(tmp, "bench.stats.json"),
+                                interval=1.0)
+        n = 4000
+        t_bench = time.perf_counter()
+        for i in range(n):
+            with rec.phase("input"):
+                pass
+            with rec.phase("compute"):
+                pass
+            with rec.phase("sync"):
+                pass
+            rec.step_done(i)
+        per_step_us = (time.perf_counter() - t_bench) / n * 1e6
+        out["stepstats_cost_us_per_step"] = round(per_step_us, 1)
+        out["stepstats_overhead_pct"] = round(
+            per_step_us / 1e3 / max(1e-9, step_p50_ms) * 100.0, 3)
+
+        out["elapsed_s"] = round(time.time() - t0, 1)
+        out["ok"] = bool(
+            out["succeeded"]
+            and out["profile_request_rc"] == 0
+            and out["trace_files"] > 0
+            and out["profile_fetch_rc"] == 0
+            and out["fetched_files"] > 0
+            and step_p50_ms > 0
+            and out["stepstats_overhead_pct"] <= 2.0
+            and (out["goodput"] or 0) > 0
+        )
+        return out
+    finally:
+        agg.stop()
+        executor.stop()
+        scheduler.stop()
+        controller.stop()
+        store.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_slo_overhead(jobs: int, pods: int, rounds: int) -> dict:
     """The monitor-tax bound (half of BENCH_CP_MODES=slo): interleaved
     off/on informer reconcile storms — 'on' runs a live SLOMonitor at a
@@ -2352,6 +2723,25 @@ def main() -> None:
                 "runs": runs,
                 "ok": bool(overhead["overhead_ok"]
                            and all(x.get("ok") for x in runs)),
+            }
+        elif mode == "goodput":
+            # TWO seeded hollow runs (the chaos determinism contract) +
+            # ONE real llama run (overhead + profile round trip), one
+            # verdict (ISSUE 15 acceptance → BENCH_CP_r15.json)
+            seed = int(os.environ.get("BENCH_CP_GOODPUT_SEED", "1507"))
+            runs = [
+                run_goodput_mode(seed)
+                for _ in range(int(os.environ.get(
+                    "BENCH_CP_GOODPUT_RUNS", "2")))
+            ]
+            llama = run_goodput_llama()
+            r = {
+                "metric": "controlplane_goodput",
+                "seed": seed,
+                "runs": runs,
+                "llama": llama,
+                "ok": bool(all(x.get("ok") for x in runs)
+                           and llama.get("ok")),
             }
         elif mode == "fanout":
             r = run_fanout_mode()

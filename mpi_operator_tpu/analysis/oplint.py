@@ -72,6 +72,13 @@ Rule catalog (rationale → the PR that motivated each):
   is the replication apply seam (``apply_replicated``/``install_snapshot``
   /``append_entries``/``load_snapshot``), which the checker exempts by
   enclosing-function name.
+- **OBS004** a ``train_stats``/``serve_stats`` status blob constructed
+  outside the bounded-blob helpers (``bounded_train_stats``/
+  ``bounded_serve_stats``, machinery/objects.py). ISSUE 15: status blobs
+  ride every watch event delivering the pod — an unbounded dict there is
+  a watch-fan-out size multiplier. Blessed shapes: a direct helper call,
+  a name assigned from one in the same/enclosing scope, or ``None``
+  (clearing).
 
 Suppression: ``# oplint: disable=RULE[,RULE...]`` on the flagged line or the
 line directly above it silences that rule there. Policy: every suppression
@@ -222,6 +229,18 @@ RULES: Dict[str, Rule] = {
             "nothing (the config loader fails closed at runtime; this "
             "catches it at diff time)",
             scope="all",
+        ),
+        Rule(
+            "OBS004", "error",
+            "train_stats/serve_stats status blob built outside the "
+            "bounded-blob helper",
+            "ISSUE 15: pod status blobs ride EVERY watch event delivering "
+            "the pod, so their size is a fan-out multiplier — an "
+            "unbounded dict mirrored into status.train_stats/serve_stats "
+            "bloats the whole control plane's watch traffic; construct "
+            "the blob with bounded_train_stats/bounded_serve_stats "
+            "(machinery/objects.py), which clamp keys and round values "
+            "at the source",
         ),
         Rule(
             "DIS001", "error",
@@ -787,6 +806,88 @@ def _check_obs003(ctx: _FileCtx, call: ast.Call,
             )
 
 
+# OBS004: a status-stats blob (the train_stats / serve_stats keys the
+# executors mirror into pod status) must come out of the bounded-blob
+# helpers. Recognized blessed shapes: the value is a DIRECT call to a
+# helper, a name assigned from one in the same (or an enclosing)
+# function scope, or None (clearing). Everything else — a raw dict, an
+# unvetted parameter, a model's own sample() — fires: the lint cannot
+# prove it bounded, and status blobs multiply across the watch fan-out.
+_STATS_BLOB_KEYS = {"train_stats", "serve_stats"}
+_BOUNDED_BLOB_FNS = {"bounded_train_stats", "bounded_serve_stats"}
+
+
+def _check_obs004(ctx: _FileCtx, tree: ast.Module) -> None:
+    def is_helper_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        return name in _BOUNDED_BLOB_FNS
+
+    def blessed(node: ast.AST, names: Set[str]) -> bool:
+        if is_helper_call(node):
+            return True
+        if isinstance(node, ast.Constant) and node.value is None:
+            return True  # clearing the blob is always legal
+        return isinstance(node, ast.Name) and node.id in names
+
+    def scan(body, inherited: Set[str]) -> None:
+        names = set(inherited)
+        nested: List[ast.AST] = []
+        nodes: List[ast.AST] = []
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(n)  # own scope; checked with inheritance
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for n in nodes:  # pass 1: names assigned from a helper call
+            if isinstance(n, ast.Assign) and is_helper_call(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        for n in nodes:  # pass 2: every blob construction site
+            if isinstance(n, ast.Dict):
+                for k, v in zip(n.keys, n.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value in _STATS_BLOB_KEYS
+                        and not blessed(v, names)
+                    ):
+                        ctx.report(
+                            "OBS004", v,
+                            f"status blob {k.value!r} built outside the "
+                            f"bounded-blob helper — an unbounded dict "
+                            f"here bloats every watch event carrying the "
+                            f"pod; wrap it in bounded_"
+                            f"{k.value.split('_')[0]}_stats(...)",
+                        )
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value in _STATS_BLOB_KEYS
+                        and not blessed(n.value, names)
+                    ):
+                        ctx.report(
+                            "OBS004", n.value,
+                            f"status blob {t.slice.value!r} assigned "
+                            f"outside the bounded-blob helper; wrap it "
+                            f"in bounded_"
+                            f"{t.slice.value.split('_')[0]}_stats(...)",
+                        )
+        for fn in nested:
+            scan(fn.body, names)
+
+    scan(tree.body, set())
+
+
 # span names that mark a CONTROLLER LOOP (the per-pass work of a
 # level-triggered reconciler): these are the latencies PERF tracks and the
 # SLO tripwires read, so their span-close function must observe a histogram
@@ -1017,6 +1118,7 @@ def lint_source(
         _check_rmw001(ctx, fn)
         _check_term001(ctx, fn)
     _check_obs002(ctx, tree)
+    _check_obs004(ctx, tree)
 
     # pre-pass for OBS003: families this file registers itself count
     # toward the catalog (a module may register and reference its own)

@@ -34,6 +34,9 @@ REASON_FAILED = "TPUJobFailed"
 REASON_EVICTED = "TPUJobEvicted"
 REASON_BACKOFF = "TPUJobBackoffLimitExceeded"
 REASON_DEADLINE = "TPUJobDeadlineExceeded"
+# the workload telemetry plane's auxiliary Straggler condition (ISSUE 15)
+REASON_STRAGGLER = "StragglerDetected"
+REASON_STRAGGLER_CLEARED = "StragglerCleared"
 
 
 def get_condition(status: JobStatus, ctype: str) -> Optional[Condition]:

@@ -174,9 +174,16 @@ class ConditionType:
     SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # workload-telemetry condition (ISSUE 15), AUXILIARY — it coexists
+    # with Running rather than riding the exclusive restart-ish slot: a
+    # gang member whose step p50 exceeds the gang median by the skew
+    # threshold (controller/goodput.py) flips this active with the pod
+    # and node in the reason/message; it flips inactive when the skew
+    # clears or the member is replaced.
+    STRAGGLER = "Straggler"
 
     ALL_VALUES = (CREATED, RUNNING, RESTARTING, MIGRATING, SUSPENDED,
-                  SUCCEEDED, FAILED)
+                  SUCCEEDED, FAILED, STRAGGLER)
 
 
 # ---------------------------------------------------------------------------
@@ -557,6 +564,14 @@ class JobStatus(_Dictable):
     # concurrent gangs under one executor never collide on bind; the
     # reference gets isolation for free from per-pod DNS)
     coordinator_port: Optional[int] = None
+    # the goodput aggregator's per-job rollup (the workload telemetry
+    # plane, ISSUE 15): goodput ratio, step p50, attributed stall buckets
+    # incl. controller-charged restart downtime, dominant stall, active
+    # straggler — a BOUNDED blob (controller/goodput.py builds it) that
+    # `ctl top --jobs` renders straight from the store. Written by the
+    # aggregator via uid-pinned status patches; the reconcile loop
+    # carries it through untouched.
+    train_telemetry: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "JobStatus":
@@ -571,6 +586,7 @@ class JobStatus(_Dictable):
             restart_count=d.get("restart_count", 0),
             restart_generation=d.get("restart_generation", 0),
             coordinator_port=d.get("coordinator_port"),
+            train_telemetry=d.get("train_telemetry"),
         )
 
 

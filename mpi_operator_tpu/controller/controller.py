@@ -57,6 +57,7 @@ from mpi_operator_tpu.controller.placement import (
 from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_PROFILE_REQUEST,
     REASON_MAINTENANCE,
     ConfigMap,
     Pod,
@@ -122,6 +123,11 @@ EXIT_RESTART = 75
 CONFIG_HOSTFILE = "hostfile"
 CONFIG_DISCOVER_HOSTS = "discover_hosts.sh"
 CONFIG_COORDINATOR = "coordinator"
+# the on-demand profiling channel (ISSUE 15): the tpujob.dev/profile-
+# request annotation, projected verbatim into the config dir the elastic
+# membership check already polls — stamping the annotation reaches every
+# worker through the SAME file-sync path a rescale does
+CONFIG_PROFILE = "profile"
 
 EVENT_VALIDATION_ERROR = "ValidationError"
 EVENT_PLACEMENT_ERROR = "PlacementError"
@@ -679,11 +685,15 @@ class TPUJobController:
         discover = "#!/bin/sh\n" + "".join(
             f"echo {job.worker_hostname(i)}:{slots}\n" for i in running
         )
-        return {
+        data = {
             CONFIG_HOSTFILE: hostfile,
             CONFIG_DISCOVER_HOSTS: discover,
             CONFIG_COORDINATOR: self.coordinator_address(job),
         }
+        req = job.metadata.annotations.get(ANNOTATION_PROFILE_REQUEST, "")
+        if req:
+            data[CONFIG_PROFILE] = req
+        return data
 
     def _get_or_create_configmap(self, job: TPUJob, workers: List[Pod]) -> ConfigMap:
         data = self._config_data(job, workers)
@@ -1209,6 +1219,13 @@ class TPUJobController:
             # absorbed restart_count would never self-heal
             return True
         old, new = stored.status.to_dict(), job.status.to_dict()
+        # train_telemetry is the goodput aggregator's field — this
+        # controller NEVER writes it, so it must never appear in the
+        # diff: a reconcile snapshot that predates the aggregator's
+        # rollup patch would otherwise emit train_telemetry: null (or a
+        # stale blob) and erase the other writer's work
+        old.pop("train_telemetry", None)
+        new.pop("train_telemetry", None)
         if old == new:
             metrics.store_writes_elided.inc(component="controller")
             return True

@@ -1,0 +1,488 @@
+"""The goodput aggregator: per-job rollup of the workload telemetry plane
+(ISSUE 15).
+
+Workers (and hollow timelines) mirror bounded ``train_stats`` blobs into
+pod status — cumulative per-incarnation stall buckets + step counters
+(runtime/stepstats.py). This controller-side loop rolls them up per job:
+
+- **goodput** = productive step-compute seconds ÷ wall seconds since
+  admission. Productive time is the COORDINATOR's ``compute`` bucket (the
+  gang is SPMD — summing members would multiply the same seconds), the
+  wall clock never stops, and restart downtime — which no worker process
+  can observe, being dead — is charged controller-side from the job's
+  Restarting/Migrating conditions into the ``restart`` bucket.
+- **stall attribution**: per-bucket cumulative seconds + the dominant
+  non-compute bucket, written into ``status.train_telemetry`` (a bounded
+  blob `ctl top --jobs` renders straight from the store) and observed
+  into ``step_latency_seconds{bucket=...}`` as per-step averages.
+- **straggler detection**: a gang member whose step p50 exceeds the gang
+  median by the skew threshold gets a ``Straggler`` Event + an auxiliary
+  job condition naming the exact pod and node; both clear when the skew
+  does.
+- **restart_to_first_step_seconds**: the outage span from an observed
+  gang restart (generation bump, anchored on the restart-ish condition's
+  transition time) to the relaunched coordinator's first completed step,
+  labeled ``kind=migration|restart`` — the baseline ROADMAP item 5's
+  compile-cache work must beat.
+
+Counter resets are absorbed the same way the SLO scraper absorbs process
+restarts: a worker blob whose counters DECREASED (new pod incarnation,
+relaunched trainer) contributes its post-reset value, never a negative
+delta — goodput can only ever move continuously.
+
+Runs leader-only next to the other reconcilers; ``tick()`` is public so
+tests, the smoke, and the bench drive it with their own clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.types import ConditionType, TPUJob
+from mpi_operator_tpu.controller.controller import (
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+)
+from mpi_operator_tpu.machinery import trace
+from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    BUCKET_RESTART,
+    TRAIN_BUCKETS,
+    PodPhase,
+)
+from mpi_operator_tpu.machinery.store import Conflict, NotFound, ObjectStore
+from mpi_operator_tpu.opshell import metrics
+
+log = logging.getLogger("tpujob.goodput")
+
+# a member must have run this many steps this incarnation before its p50
+# joins the skew comparison (fresh pods mid-warmup are not stragglers)
+SKEW_MIN_STEPS = 3
+
+
+@dataclass
+class _Worker:
+    """Last-seen cumulative counters for one pod incarnation — the base
+    the next tick's reset-aware deltas are taken against."""
+
+    uid: str
+    steps: int = 0
+    step: int = 0
+    p50_ms: float = 0.0
+    buckets: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class _JobState:
+    key: str
+    coord_name: str = ""  # TPUJob.worker_name(0): ONE derivation, set once
+    admitted_at: Optional[float] = None
+    last_tick: Optional[float] = None
+    was_running: bool = False
+    productive_s: float = 0.0
+    steps_total: int = 0
+    buckets: Dict[str, float] = field(default_factory=lambda: {
+        **{k: 0.0 for k in TRAIN_BUCKETS}, BUCKET_RESTART: 0.0,
+    })
+    workers: Dict[str, _Worker] = field(default_factory=dict)
+    # wall seconds excluded from the goodput denominator (deliberate
+    # suspension is an operator action, not lost goodput)
+    excluded_s: float = 0.0
+    # adoption tick: seed each live worker's delta base from its CURRENT
+    # counters instead of charging its whole cumulative again (the
+    # telemetry blob we adopted already includes it)
+    seed_bases: bool = False
+    generation: int = 0
+    restart_count_seen: int = 0
+    restart_at: Optional[float] = None
+    restart_kind: str = ""
+    restart_coord_uid: str = ""
+    straggler: str = ""          # "<ns>/<pod>@<node>" while skewed
+    straggler_uid: str = ""      # pod uid already evented
+    telemetry: Optional[Dict[str, Any]] = None  # last written blob
+
+
+class GoodputAggregator:
+    """Roll pod ``train_stats`` up into per-job goodput, stall
+    attribution, straggler detection and restart-outage spans."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        cache=None,
+        namespace: Optional[str] = None,
+        interval: float = 2.0,
+        skew_factor: float = 1.5,
+        skew_min_ms: float = 1.0,
+    ):
+        self.store = store
+        self.cache = cache
+        self.read = cache if cache is not None else store
+        self.recorder = recorder or EventRecorder(
+            store, component="tpujob-goodput"
+        )
+        self.namespace = namespace
+        self.interval = interval
+        self.skew_factor = skew_factor
+        self.skew_min_ms = skew_min_ms
+        self._states: Dict[str, _JobState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "GoodputAggregator":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tpujob-goodput", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("goodput tick failed; next tick retries")
+
+    # -- one pass ------------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        t0 = time.perf_counter()
+        with trace.start_span("goodput.sync"):
+            seen = set()
+            for job in self.read.list("TPUJob", self.namespace):
+                uid = job.metadata.uid
+                if cond.is_finished(job.status):
+                    self._drop(uid)
+                    continue
+                seen.add(uid)
+                try:
+                    self._tick_job(job, now)
+                except (Conflict, NotFound):
+                    continue  # stale read; next tick re-reads
+            for uid in [u for u in self._states if u not in seen]:
+                self._drop(uid)
+        metrics.goodput_sync_latency.observe(time.perf_counter() - t0)
+
+    def _drop(self, uid: str) -> None:
+        state = self._states.pop(uid, None)
+        if state is not None:
+            # a finished/deleted job's gauges must not export forever
+            metrics.job_goodput_ratio.remove(job=state.key)
+            metrics.job_stragglers.remove(job=state.key)
+
+    # -- per-job rollup ------------------------------------------------------
+
+    def _tick_job(self, job: TPUJob, now: float) -> None:
+        st = self._states.get(job.metadata.uid)
+        if st is None:
+            # adopt at the job's CURRENT generation: a leader failover
+            # picking up a gen-2 job must not read its history as a
+            # fresh restart and mint a bogus outage span
+            st = self._states[job.metadata.uid] = _JobState(
+                job.metadata.key(),
+                coord_name=job.worker_name(0),
+                generation=job.status.restart_generation,
+                restart_count_seen=job.status.restart_count,
+            )
+            tel = job.status.train_telemetry
+            if tel:
+                # failover continuity: resume from the PERSISTED rollup —
+                # without this, prior incarnations' productive seconds
+                # vanish while the wall denominator spans the job's full
+                # age, deflating goodput toward the page floor on every
+                # operator restart. The live incarnation's contribution
+                # is already inside this blob, so its workers' delta
+                # bases seed from their current counters (seed_bases).
+                b = tel.get("buckets") or {}
+                for k in st.buckets:
+                    try:
+                        st.buckets[k] = float(b.get(k, 0.0) or 0.0)
+                    except (TypeError, ValueError):
+                        st.buckets[k] = 0.0
+                st.productive_s = st.buckets.get("compute", 0.0)
+                try:
+                    st.steps_total = int(tel.get("steps", 0) or 0)
+                except (TypeError, ValueError):
+                    st.steps_total = 0
+                st.seed_bases = True
+        if len(self._states) > 8192:
+            self._drop(next(iter(self._states)))
+        if st.admitted_at is None:
+            st.admitted_at = job.status.start_time or now
+
+        if cond.is_suspended(job.status):
+            # deliberate suspension is an operator action, not lost
+            # goodput: exclude the window from the wall, stop exporting
+            # (a decaying gauge would page goodput-collapse on intent),
+            # and charge no downtime. Resume re-exports next tick.
+            if st.last_tick is not None:
+                st.excluded_s += now - st.last_tick
+            st.last_tick = now
+            metrics.job_goodput_ratio.remove(job=st.key)
+            return
+
+        self._note_restart(job, st, now)
+        self._charge_downtime(job, st, now)
+
+        pods = [
+            p for p in self.read.list(
+                "Pod", job.namespace, selector={LABEL_JOB_NAME: job.name}
+            )
+            if p.status.phase == PodPhase.RUNNING
+        ]
+        coord_dsteps = self._ingest_workers(st, pods)
+        st.seed_bases = False  # adoption seeding covers ONE tick only
+        self._close_restart_span(st, now)
+        self._detect_skew(job, st, pods)
+        self._write_rollup(job, st, now, coord_dsteps)
+
+    def _note_restart(self, job: TPUJob, st: _JobState, now: float) -> None:
+        gen = job.status.restart_generation
+        burned = job.status.restart_count > st.restart_count_seen
+        st.restart_count_seen = job.status.restart_count
+        if gen <= st.generation:
+            return
+        st.generation = gen
+        # kind attribution: an ACTIVE restart-ish condition names the
+        # flavor; a relaunch fast enough that Running already replaced it
+        # (the condition record is removed, not flipped) falls back to
+        # the backoff budget — a generation that burned restart_count is
+        # a crash, an unburned one is a planned move (maintenance
+        # migration / preemption: the control plane's doing either way)
+        anchor, kind = now, ("restart" if burned else "migration")
+        for ctype, k in ((ConditionType.MIGRATING, "migration"),
+                         (ConditionType.RESTARTING, "restart")):
+            c = cond.get_condition(job.status, ctype)
+            if c is not None and c.status:
+                anchor = min(now, c.last_transition_time or now)
+                kind = k
+                break
+        st.restart_at, st.restart_kind = anchor, kind
+        coord = st.workers.get(st.coord_name)
+        st.restart_coord_uid = coord.uid if coord else ""
+
+    def _charge_downtime(self, job: TPUJob, st: _JobState,
+                         now: float) -> None:
+        """Restart downtime, charged controller-side: wall time while a
+        restart-ish condition is active — or while a previously-running
+        job is not Running (teardown observed before the condition flip).
+        Counted between OUR ticks, so resolution is one interval."""
+        s = job.status
+        down = (
+            cond.has_condition(s, ConditionType.RESTARTING)
+            or cond.has_condition(s, ConditionType.MIGRATING)
+            or (st.was_running and not cond.is_running(s))
+        )
+        if st.last_tick is not None and down:
+            st.buckets[BUCKET_RESTART] += now - st.last_tick
+        st.last_tick = now
+        if cond.is_running(s):
+            st.was_running = True
+
+    def _ingest_workers(self, st: _JobState, pods) -> int:
+        """Reset-aware per-worker deltas; the coordinator's land in the
+        job buckets. Returns the coordinator's step delta this tick."""
+        coord_dsteps = 0
+        for p in pods:
+            ts = p.status.train_stats
+            if not ts:
+                continue
+            name = p.metadata.name
+            w = st.workers.get(name)
+            if w is None or w.uid != p.metadata.uid:
+                # new incarnation: fresh base — its counters restarted
+                # from zero, so deltas resume continuously (never negative)
+                w = st.workers[name] = _Worker(uid=p.metadata.uid)
+                if st.seed_bases:
+                    # adoption tick: this worker's cumulative is already
+                    # inside the telemetry blob we resumed from — base at
+                    # its CURRENT counters (zero delta), never recharge it
+                    w.steps = int(ts.get("steps", 0) or 0)
+                    w.buckets = {
+                        k: float((ts.get("buckets") or {}).get(k, 0.0)
+                                 or 0.0)
+                        for k in TRAIN_BUCKETS
+                    }
+            new_steps = int(ts.get("steps", 0) or 0)
+            new_buckets = dict(ts.get("buckets") or {})
+            dsteps = new_steps - w.steps
+            if dsteps < 0:  # in-place reset (defensive): value IS the delta
+                w.buckets = {}
+                dsteps = new_steps
+            dbuckets = {}
+            for k in TRAIN_BUCKETS:
+                nv = float(new_buckets.get(k, 0.0) or 0.0)
+                ov = float(w.buckets.get(k, 0.0))
+                dbuckets[k] = nv if nv < ov else nv - ov
+            w.steps = new_steps
+            w.step = int(ts.get("step", 0) or 0)
+            w.p50_ms = float(ts.get("step_p50_ms", 0.0) or 0.0)
+            w.buckets = {k: float(new_buckets.get(k, 0.0) or 0.0)
+                         for k in TRAIN_BUCKETS}
+            if p.metadata.labels.get(LABEL_REPLICA_INDEX) == "0":
+                for k, v in dbuckets.items():
+                    if v > 0:
+                        st.buckets[k] += v
+                st.productive_s += max(0.0, dbuckets.get("compute", 0.0))
+                st.steps_total = max(st.steps_total, w.step)
+                coord_dsteps = max(0, dsteps)
+                if coord_dsteps > 0:
+                    total = 0.0
+                    for k, v in dbuckets.items():
+                        if v > 0:
+                            metrics.step_latency.observe(
+                                v / coord_dsteps, bucket=k)
+                            total += v
+                    metrics.step_latency.observe(
+                        total / coord_dsteps, bucket="step")
+        return coord_dsteps
+
+    def _close_restart_span(self, st: _JobState, now: float) -> None:
+        if st.restart_at is None:
+            return
+        coord = st.workers.get(st.coord_name)
+        if (coord is not None and coord.steps > 0
+                and coord.uid != st.restart_coord_uid):
+            # the RELAUNCHED coordinator completed a step: the outage span
+            # closes (evict → relaunch → first completed step)
+            metrics.restart_to_first_step.observe(
+                max(0.0, now - st.restart_at),
+                kind=st.restart_kind or "restart",
+            )
+            st.restart_at = None
+
+    def _detect_skew(self, job: TPUJob, st: _JobState, pods) -> None:
+        reporting = []
+        for p in pods:
+            w = st.workers.get(p.metadata.name)
+            if (w is not None and w.uid == p.metadata.uid
+                    and w.steps >= SKEW_MIN_STEPS and w.p50_ms > 0):
+                reporting.append((w.p50_ms, p))
+        cleared = True
+        if len(reporting) >= 2:
+            med = statistics.median(p50 for p50, _ in reporting)
+            worst_p50, worst = max(reporting, key=lambda r: r[0])
+            if (med > 0 and worst_p50 > self.skew_factor * med
+                    and worst_p50 - med > self.skew_min_ms):
+                cleared = False
+                node = worst.spec.node_name or "?"
+                who = f"{worst.metadata.namespace}/{worst.metadata.name}"
+                st.straggler = f"{who}@{node}"
+                metrics.job_stragglers.set(1, job=st.key)
+                msg = (f"worker pod {worst.metadata.name} on node "
+                       f"{node} is a straggler: step p50 "
+                       f"{worst_p50:.1f}ms vs gang median {med:.1f}ms "
+                       f"(>{self.skew_factor:g}x)")
+                if st.straggler_uid != worst.metadata.uid:
+                    # the Event fires once per straggler incarnation...
+                    st.straggler_uid = worst.metadata.uid
+                    self.recorder.event(job, WARNING, "Straggler", msg)
+                # ...but the CONDITION is level-triggered every tick: a
+                # flip whose rv-pinned patch lost a write race (or was
+                # erased by the controller's own conditions write) is
+                # re-stamped next tick — the fresh-read no-op elision in
+                # update_job_conditions makes the steady state free
+                self._set_straggler_condition(job, True,
+                                              cond.REASON_STRAGGLER, msg)
+        # clear on the JOB's durable condition too, not just in-memory
+        # state: a leader failover hands the new aggregator a fresh
+        # _JobState, and a healed gang's still-active Straggler condition
+        # must flip off even though THIS aggregator never set it
+        if cleared and (st.straggler or cond.has_condition(
+                job.status, ConditionType.STRAGGLER)):
+            st.straggler = ""
+            st.straggler_uid = ""
+            metrics.job_stragglers.set(0, job=st.key)
+            self._set_straggler_condition(
+                job, False, cond.REASON_STRAGGLER_CLEARED,
+                "step-time skew back under the threshold",
+            )
+
+    def _set_straggler_condition(self, job: TPUJob, active: bool,
+                                 reason: str, message: str) -> None:
+        """Flip the auxiliary Straggler condition. A merge patch replaces
+        the WHOLE conditions array, and the reconcile loop writes the
+        same array from its own reads — so this is a fresh-read RMW with
+        an rv precondition (the sanctioned patch-with-rv shape): a
+        controller write landing in between bounces this patch as a
+        Conflict instead of this patch resurrecting a stale array (e.g.
+        erasing a just-written Failed condition). Next tick retries."""
+        try:
+            cur = self.store.get("TPUJob", job.namespace, job.name)
+        except NotFound:
+            return
+        if cur.metadata.uid != job.metadata.uid:
+            return  # recreated same-name job: not ours to stamp
+        if not cond.update_job_conditions(
+            cur.status, ConditionType.STRAGGLER, reason, message, active
+        ):
+            return
+        try:
+            self.store.patch(
+                "TPUJob", job.namespace, job.name,
+                {"metadata": {
+                    "uid": cur.metadata.uid,
+                    "resource_version": cur.metadata.resource_version,
+                 },
+                 "status": {"conditions": [
+                     c.to_dict() for c in cur.status.conditions
+                 ]}},
+                subresource="status",
+            )
+        except (Conflict, NotFound):
+            pass  # lost the write race / deleted; next tick re-evaluates
+
+    def _write_rollup(self, job: TPUJob, st: _JobState, now: float,
+                      coord_dsteps: int) -> None:
+        wall = max(1e-9, now - (st.admitted_at or now) - st.excluded_s)
+        goodput = max(0.0, min(1.0, st.productive_s / wall))
+        if st.steps_total <= 0:
+            return  # nothing reported yet: no gauge, no telemetry
+        # export only once steps exist — a brand-new job mid-compile must
+        # not page goodput-collapse before it ever could have stepped
+        metrics.job_goodput_ratio.set(round(goodput, 4), job=st.key)
+        coord = st.workers.get(st.coord_name)
+        stalls = {k: v for k, v in st.buckets.items() if k != "compute"}
+        dominant = max(stalls, key=stalls.get) if any(
+            v > 0 for v in stalls.values()) else ""
+        blob = {
+            "goodput": round(goodput, 4),
+            "step_p50_ms": round(coord.p50_ms, 3) if coord else 0.0,
+            "steps": st.steps_total,
+            "dominant_stall": dominant,
+            "buckets": {k: round(v, 3) for k, v in st.buckets.items()},
+            "straggler": st.straggler,
+            "workers_reporting": sum(
+                1 for w in st.workers.values() if w.steps > 0
+            ),
+        }
+        if blob == st.telemetry:
+            return  # no-op elision: an idle rollup costs zero writes
+        try:
+            self.store.patch(
+                "TPUJob", job.namespace, job.name,
+                {"metadata": {"uid": job.metadata.uid},
+                 "status": {"train_telemetry": blob}},
+                subresource="status",
+            )
+            st.telemetry = blob
+        except (Conflict, NotFound):
+            pass  # recreated/deleted under us; next tick re-evaluates

@@ -17,8 +17,9 @@ alerting plane:
   (``BENCH_CP_SLO_*``) are preserved via each entry's ``env`` field.
 - **SRE-workbook multi-window burn rates.** Each objective reduces to an
   error fraction per window (latency histograms: observations above the
-  good-event bucket; gauges: scrapes above the bound); burn rate = error
-  fraction / error budget. The alert fires when BOTH windows of a pair
+  good-event bucket; gauges: scrapes above the ceiling — gauge_max — or
+  below the floor — gauge_min); burn rate = error fraction / error
+  budget. The alert fires when BOTH windows of a pair
   breach — fast (5m & 1h at 14.4x) pages on sudden total breaches, slow
   (30m & 6h at 6x) on sustained budget bleed — and clears only after
   every window WITH data burns below its pair's fire threshold
@@ -104,12 +105,14 @@ class SLOConfigError(ValueError):
 @dataclass(frozen=True)
 class Objective:
     """One declarative SLO. ``kind`` is 'latency' (histogram family +
-    good-event bound + good-fraction target) or 'gauge_max' (gauge family
-    + hard bound + in-bounds-fraction target)."""
+    good-event bound + good-fraction target), 'gauge_max' (gauge family +
+    hard ceiling + in-bounds-fraction target), or 'gauge_min' (gauge
+    family + hard FLOOR: a scrape below ``bound`` is the bad event — the
+    goodput-collapse shape, where low is the pathology)."""
 
     name: str
     metric: str
-    kind: str                      # "latency" | "gauge_max"
+    kind: str                      # "latency" | "gauge_max" | "gauge_min"
     objective: float               # good-event fraction target (0, 1)
     threshold_s: float = 0.0       # latency: the good-event bound
     bound: float = 0.0             # gauge_max: the in-bounds ceiling
@@ -300,10 +303,10 @@ def load_slo_config(
                 raise SLOConfigError(
                     f"{where} ({name}): threshold_ms must be > 0, "
                     f"got {thr!r}")
-        elif kind == "gauge_max":
+        elif kind in ("gauge_max", "gauge_min"):
             if inst_kind != "gauge":
                 raise SLOConfigError(
-                    f"{where} ({name}): gauge_max objectives need a "
+                    f"{where} ({name}): {kind} objectives need a "
                     f"gauge family; {metric} is a {inst_kind}")
             bnd = o.get("bound")
             if not isinstance(bnd, (int, float)) or bnd <= 0:
@@ -312,7 +315,7 @@ def load_slo_config(
         else:
             raise SLOConfigError(
                 f"{where} ({name}): unknown kind {kind!r} "
-                f"(latency | gauge_max)")
+                f"(latency | gauge_max | gauge_min)")
         target = o.get("objective")
         if not isinstance(target, (int, float)) or not 0.0 < target < 1.0:
             raise SLOConfigError(
@@ -459,7 +462,9 @@ def error_fractions(ring: SeriesRing, obj: Objective, policy: BurnPolicy,
     """Per-window error fractions for one objective out of the scraped
     ring — the impure half the pure core consumes. Latency: fraction of
     window observations above the good-event bucket. Gauge: the WORST
-    matching series' fraction of in-window scrapes above the bound."""
+    matching series' fraction of in-window scrapes out of bounds —
+    above the ceiling for gauge_max, below the floor for gauge_min (one
+    collapsed job among a healthy fleet must still burn)."""
     out: Dict[str, Optional[float]] = {}
     for key, window in policy.windows().items():
         if obj.kind == "latency":
@@ -469,7 +474,11 @@ def error_fractions(ring: SeriesRing, obj: Objective, policy: BurnPolicy,
             worst: Optional[float] = None
             for _, vals in ring.window_values(obj.metric, window, now,
                                               **labels):
-                frac = sum(1 for v in vals if v > obj.bound) / len(vals)
+                if obj.kind == "gauge_min":
+                    bad = sum(1 for v in vals if v < obj.bound)
+                else:
+                    bad = sum(1 for v in vals if v > obj.bound)
+                frac = bad / len(vals)
                 worst = frac if worst is None else max(worst, frac)
             out[key] = worst
     return out

@@ -45,9 +45,12 @@ from typing import Any, Dict, List, Optional
 from mpi_operator_tpu.machinery.objects import (
     ANNOTATION_MAINTENANCE_AT,
     NODE_NAMESPACE,
+    TRAIN_BUCKETS,
     Node,
     Pod,
     PodPhase,
+    bounded_serve_stats,
+    bounded_train_stats,
     patch_pod_status,
 )
 from mpi_operator_tpu.machinery.store import (
@@ -90,6 +93,14 @@ class HollowTimeline:
     serve_warmup_s: float = 0.2
     serve_stats_interval_s: float = 0.5
     load: Optional["ServeLoadModel"] = None
+    # training telemetry (the workload telemetry plane, ISSUE 15): when a
+    # TrainLoadModel is attached, every batch worker pod mirrors synthetic
+    # ``status.train_stats`` blobs (stall-attributed bucket seconds + step
+    # counters) every ``train_stats_interval_s`` — the hollow twin of the
+    # real step loop's stepstats file, so goodput/straggler aggregation
+    # benches at fleet scale with zero training processes
+    train: Optional["TrainLoadModel"] = None
+    train_stats_interval_s: float = 0.5
 
     def pod_rng(self, namespace: str, name: str, uid: str) -> random.Random:
         return random.Random(f"{self.seed}:{namespace}/{name}:{uid}")
@@ -100,6 +111,7 @@ class HollowTimeline:
 # the agent; controller/serve.py's tests pin the values stay identical)
 LABEL_ROLE = "tpujob.dev/job-role"
 LABEL_SERVE_NAME = "tpujob.dev/serve-name"
+LABEL_JOB_NAME = "tpujob.dev/job-name"
 ROLE_SERVE = "serve"
 
 
@@ -169,6 +181,119 @@ class ServeLoadModel:
             "queue_depth": round(queue, 3),
             "p99_ms": round(p99, 3),
         }
+
+
+class TrainLoadModel:
+    """Synthetic per-pod training timelines for hollow fleets — the batch
+    twin of :class:`ServeLoadModel` (the workload telemetry plane,
+    ISSUE 15).
+
+    Each registered worker pod advances a seeded synthetic step clock on
+    every stats tick: wall time splits into the TRAIN_BUCKETS taxonomy by
+    a steady-state profile (mostly ``compute``), the first tick charges a
+    one-shot ``compile`` phase, and two seeded fault knobs exist so the
+    goodput aggregator has something real to attribute:
+
+    - :meth:`set_stall` shifts a fraction of a whole JOB's step wall time
+      into one named bucket (e.g. an input-pipeline stall: steps stretch
+      and the stolen time accrues to ``input``);
+    - :meth:`set_straggler` multiplies ONE pod's step time (a slow host:
+      its step p50 diverges from the gang median — the skew signal).
+
+    Cumulative counters are PER POD INCARNATION (keyed by pod uid at
+    registration), so a relaunched gang restarts its counters from zero —
+    exactly the counter-reset shape the aggregator's deltas must absorb.
+    """
+
+    # steady-state wall-time split of a healthy step
+    PROFILE = {"compute": 0.86, "input": 0.05, "sync": 0.06, "ckpt": 0.03}
+
+    def __init__(self, *, step_ms: float = 50.0, compile_s: float = 1.0,
+                 seed: int = 0):
+        self.step_ms = step_ms
+        self.compile_s = compile_s
+        self.seed = seed
+        self._lock = threading.Lock()
+        # (pod_key, uid) → {"steps": float, "buckets": {...}, "p50": ms}
+        self._pods: Dict[tuple, Dict[str, Any]] = {}
+        self._stalls: Dict[str, tuple] = {}       # job key → (bucket, frac)
+        self._stragglers: Dict[str, float] = {}   # pod key → step factor
+
+    def set_stall(self, job_key: str, bucket: str, fraction: float) -> None:
+        if bucket not in TRAIN_BUCKETS:
+            raise ValueError(f"unknown stall bucket {bucket!r} "
+                             f"(one of {TRAIN_BUCKETS})")
+        if not 0.0 < fraction < 1.0:
+            raise ValueError("stall fraction must be in (0, 1)")
+        with self._lock:
+            self._stalls[job_key] = (bucket, fraction)
+
+    def clear_stall(self, job_key: str) -> None:
+        with self._lock:
+            self._stalls.pop(job_key, None)
+
+    def set_straggler(self, pod_key: str, factor: float) -> None:
+        if factor <= 0:
+            raise ValueError("straggler factor must be > 0")
+        with self._lock:
+            self._stragglers[pod_key] = factor
+
+    def clear_straggler(self, pod_key: str) -> None:
+        with self._lock:
+            self._stragglers.pop(pod_key, None)
+
+    def forget(self, pod_key: str, uid: str) -> None:
+        with self._lock:
+            self._pods.pop((pod_key, uid), None)
+
+    def advance(self, job_key: str, pod_key: str, uid: str,
+                dt: float) -> Dict[str, Any]:
+        """Advance one pod's synthetic clock by ``dt`` wall seconds and
+        return its bounded train_stats blob. Deterministic per (seed,
+        pod identity): two runs of one seeded fleet produce identical
+        tapes."""
+        with self._lock:
+            st = self._pods.get((pod_key, uid))
+            if st is None:
+                rng = random.Random(f"{self.seed}:{pod_key}:{uid}")
+                st = self._pods[(pod_key, uid)] = {
+                    "steps": 0.0,
+                    "buckets": {k: 0.0 for k in TRAIN_BUCKETS},
+                    "jitter": 1.0 + rng.uniform(-0.03, 0.03),
+                    "compiled": False,
+                }
+            stall = self._stalls.get(job_key)
+            factor = self._stragglers.get(pod_key, 1.0)
+        remaining = dt
+        if not st["compiled"]:
+            # one-shot compile charge at the head of the incarnation
+            spent = min(self.compile_s, remaining)
+            st["buckets"]["compile"] += spent
+            remaining -= spent
+            if st["buckets"]["compile"] >= self.compile_s - 1e-9:
+                st["compiled"] = True
+        base_s = self.step_ms / 1e3 * st["jitter"] * factor
+        if stall is not None:
+            # the stall steals `frac` of every step's wall time: the
+            # effective step stretches and the stolen share accrues to
+            # the named bucket
+            bucket, frac = stall
+            step_s = base_s / max(1e-9, 1.0 - frac)
+        else:
+            bucket, frac = "", 0.0
+            step_s = base_s
+        if remaining > 0:
+            st["steps"] += remaining / step_s
+            healthy = remaining * (base_s / step_s)
+            for k, share in self.PROFILE.items():
+                st["buckets"][k] += healthy * share
+            if stall is not None:
+                st["buckets"][bucket] += remaining - healthy
+        p50 = step_s * 1e3
+        return bounded_train_stats(
+            step=int(st["steps"]), steps=int(st["steps"]),
+            step_p50_ms=p50, buckets=st["buckets"],
+        )
 
 
 @dataclass
@@ -408,6 +533,8 @@ class HollowExecutor:
                 _TimerWheel.cancel(stats)
             if serve_key is not None and self.timeline.load is not None:
                 self.timeline.load.unregister(serve_key, key)
+            if self.timeline.train is not None:
+                self.timeline.train.forget(key, uid)
             return
         if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
             return
@@ -439,6 +566,8 @@ class HollowExecutor:
             _TimerWheel.cancel(stats)
         if serve_key is not None and self.timeline.load is not None:
             self.timeline.load.unregister(serve_key, key)
+        if self.timeline.train is not None:
+            self.timeline.train.forget(key, pod.metadata.uid)
 
     # -- the scripted lifecycle ---------------------------------------------
 
@@ -459,6 +588,30 @@ class HollowExecutor:
                 "phase": PodPhase.RUNNING, "ready": True, "reason": "",
                 "pod_ip": "127.0.0.1",
             })
+
+        def train_tick():
+            # synthetic train_stats mirror (workload telemetry, ISSUE 15):
+            # rides the same recurring-handle discipline as serve stats —
+            # the recurrence dies with the incarnation, never past it
+            with self._lock:
+                if self._seen.get(key) != uid or self._stop.is_set():
+                    return
+            tl_ = self.timeline
+            job_key = f"{ns}/{pod.metadata.labels.get(LABEL_JOB_NAME, '')}"
+            # advance() already emits the bounded shape; re-bounding at
+            # the mirror edge keeps the blessed OBS004 form visible here
+            stats = bounded_train_stats(**tl_.train.advance(
+                job_key, key, uid, tl_.train_stats_interval_s))
+            # rv=0: a stats mirror may always apply to the live
+            # incarnation (same posture as the serve stats tick)
+            self._mirror(ns, name, uid, 0, {"train_stats": stats})
+            handle = self._wheel.schedule(tl_.train_stats_interval_s,
+                                          train_tick)
+            with self._lock:
+                if self._seen.get(key) == uid:
+                    self._stats_handles[key] = handle
+                else:
+                    _TimerWheel.cancel(handle)
 
         def to_terminal():
             with self._lock:
@@ -489,13 +642,25 @@ class HollowExecutor:
             # scripted clock from now (a restarted real process would
             # also start over)
             handles.append(self._wheel.schedule(run_s, to_terminal))
+        stats_handle = None
+        if tl.train is not None and pod.metadata.labels.get(LABEL_JOB_NAME):
+            # first synthetic train_stats tick once the pod is "running";
+            # the tick re-arms itself (replacing _stats_handles[key], the
+            # serve-stats recurrence discipline)
+            first_delay = (tl.train_stats_interval_s if already_running
+                           else tl.pending_s + tl.train_stats_interval_s)
+            stats_handle = self._wheel.schedule(first_delay, train_tick)
         with self._lock:
             if self._seen.get(key) == uid and key in self._handles:
                 self._handles[key].extend(handles)
+                if stats_handle is not None:
+                    self._stats_handles[key] = stats_handle
             else:
                 # evicted/deleted between scheduling and recording
                 for h in handles:
                     _TimerWheel.cancel(h)
+                if stats_handle is not None:
+                    _TimerWheel.cancel(stats_handle)
 
     def _schedule_serve_timeline(self, pod: Pod, key: str, uid: str,
                                  already_running: bool = False) -> None:
@@ -515,9 +680,8 @@ class HollowExecutor:
             with self._lock:
                 if self._seen.get(key) != uid or self._stop.is_set():
                     return  # evicted/replaced: the recurrence dies here
-            stats = (
-                tl.load.sample(serve_key) if tl.load is not None
-                else {"qps": 0.0, "queue_depth": 0.0, "p99_ms": 0.0}
+            stats = bounded_serve_stats(
+                **(tl.load.sample(serve_key) if tl.load is not None else {})
             )
             # rv=0: no precondition — a stats mirror may always apply to
             # the live incarnation (patch_pod_status still enforces the

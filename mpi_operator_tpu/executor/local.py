@@ -31,7 +31,13 @@ import uuid
 from typing import Dict, Optional
 
 from mpi_operator_tpu.machinery import trace
-from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Pod, PodPhase
+from mpi_operator_tpu.machinery.objects import (
+    NODE_NAMESPACE,
+    Pod,
+    PodPhase,
+    bounded_train_stats,
+    patch_pod_status,
+)
 from mpi_operator_tpu.machinery.store import (
     ADDED,
     DELETED,
@@ -40,6 +46,7 @@ from mpi_operator_tpu.machinery.store import (
     ObjectStore,
 )
 from mpi_operator_tpu.runtime.emulation import pin_host_device_count
+from mpi_operator_tpu.runtime.stepstats import ENV_STATS_FILE, read_stats
 
 log = logging.getLogger("tpujob.executor")
 
@@ -106,6 +113,7 @@ class LocalExecutor:
         log_url_base: Optional[str] = None,
         status_sink=None,
         eviction_grace: float = 5.0,
+        stepstats_poll: float = 1.0,
     ):
         self.store = store
         self.loopback_rewrite = loopback_rewrite
@@ -152,6 +160,13 @@ class LocalExecutor:
         # Own lock: _set_phase runs both inside and outside _lock.
         self._status_rv: Dict[str, tuple] = {}
         self._rv_lock = threading.Lock()
+        # workload telemetry (ISSUE 15): each launched pod gets a
+        # $TPUJOB_STEPSTATS_FILE pointing into the log dir; a poll thread
+        # mirrors the worker's flushed blob into pod.status.train_stats —
+        # the kubelet-reads-cAdvisor shape, so workers never need store
+        # credentials. pod key → {path, ns, name, uid, mtime}
+        self.stepstats_poll = stepstats_poll
+        self._stats_files: Dict[str, Dict] = {}
         self.logs: Dict[str, tuple] = {}  # pod key → (stdout, stderr)
         # kubelet log dir: pod stdout/stderr stream to files here while the
         # pod runs; the stdout path is stamped into pod.status.log_path so
@@ -170,6 +185,12 @@ class LocalExecutor:
         t = threading.Thread(target=self._run, name="local-executor", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.stepstats_poll > 0:
+            ts = threading.Thread(
+                target=self._stats_loop, name="stepstats-poll", daemon=True
+            )
+            ts.start()
+            self._threads.append(ts)
         # adopt objects that existed before the watch began (configs first:
         # pods read the projected dir at launch)
         for cm in self.store.list("ConfigMap"):
@@ -240,6 +261,50 @@ class LocalExecutor:
                 # if it dies, the kernel SIGKILLs all of them. A bad event
                 # must never take down the node's workload.
                 log.exception("executor event handling failed; continuing")
+
+    def _stats_loop(self) -> None:
+        """Mirror each live pod's flushed step-stats blob into
+        pod.status.train_stats (the workload telemetry plane, ISSUE 15).
+        mtime-gated: an idle worker (or one with stepstats off) costs one
+        stat() per poll, zero store writes."""
+        while not self._stop.wait(self.stepstats_poll):
+            with self._lock:
+                entries = list(self._stats_files.items())
+            for key, ent in entries:
+                try:
+                    mtime = os.stat(ent["path"]).st_mtime
+                except OSError:
+                    continue  # worker never flushed (stepstats dormant)
+                if mtime <= ent["mtime"]:
+                    continue
+                raw = read_stats(ent["path"])
+                if raw is None:
+                    continue  # torn/unreadable: next poll retries
+                ent["mtime"] = mtime
+                try:
+                    # re-bound at the mirror edge (oplint OBS004), INSIDE
+                    # the guard: the file is written by an untrusted
+                    # workload — a wrong-typed field must cost one skipped
+                    # mirror, never this thread (which serves every pod
+                    # on the node)
+                    changes = {"train_stats": bounded_train_stats(**raw)}
+                    self._mirror_train_stats(ent, changes)
+                except Exception:
+                    log.warning("train_stats mirror of %s failed", key,
+                                exc_info=True)
+
+    def _mirror_train_stats(self, ent: Dict, changes: Dict) -> None:
+        if self.status_sink is not None:
+            # agent mode: coalesced into the next tick's patch-batch
+            # beside the phase mirrors and the heartbeat
+            self.status_sink.enqueue(
+                ent["ns"], ent["name"], ent["uid"], 0, changes,
+            )
+            return
+        patch_pod_status(
+            self.store, ent["ns"], ent["name"], ent["uid"],
+            changes, what="stepstats-mirror",
+        )
 
     def _pod_key(self, pod: Pod) -> str:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -349,6 +414,7 @@ class LocalExecutor:
             proc = self._procs.pop(key, None)
             self.logs.pop(key, None)
             draining = self._terminating.pop(key, None)
+            self._stats_files.pop(key, None)
         with self._rv_lock:
             self._status_rv.pop(key, None)
         if proc is not None and proc.poll() is None:
@@ -452,6 +518,12 @@ class LocalExecutor:
                 f"-{uuid.uuid4().hex[:8]}",
             )
             log_path = base + ".log"
+            # the stepstats contract: the worker flushes its bounded blob
+            # here (runtime/stepstats.py) and _stats_loop mirrors it into
+            # pod.status.train_stats — path is per-incarnation like the
+            # log files, so a restarted pod never inherits stale stats
+            stats_path = base + ".stats.json"
+            env[ENV_STATS_FILE] = stats_path
             handles = []
             try:
                 f_out = open(log_path, "w")
@@ -477,6 +549,11 @@ class LocalExecutor:
                 for f in handles:
                     f.close()
             self._procs[key] = proc
+            self._stats_files[key] = {
+                "path": stats_path, "ns": pod.metadata.namespace,
+                "name": pod.metadata.name, "uid": pod.metadata.uid,
+                "mtime": 0.0,
+            }
         stamped = log_path
         if self.log_url_base:
             stamped = f"{self.log_url_base}/{os.path.basename(log_path)}"

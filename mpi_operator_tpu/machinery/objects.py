@@ -70,6 +70,14 @@ class PodStatus(_Dictable):
     # status so it rides the existing patch-batch machinery and watch
     # fan-out instead of needing a second metrics pipeline
     serve_stats: Optional[Dict[str, float]] = None
+    # training-pod telemetry, the batch twin of serve_stats (the workload
+    # telemetry plane, ISSUE 15): cumulative stall-attributed wall-second
+    # buckets + step counters this incarnation, mirrored by the executor
+    # from the worker's step-stats file (runtime/stepstats.py) or scripted
+    # by a hollow timeline. ALWAYS built through bounded_train_stats —
+    # an unbounded dict here would bloat every watch event carrying the
+    # pod (oplint OBS004)
+    train_stats: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -371,6 +379,97 @@ def evict_pod(store, pod: "Pod", message: str, *,
         expected_rv=pod.metadata.resource_version,
         what="evict_pod",
     ) is not None
+
+
+# The on-demand profiling contract (the workload telemetry plane, ISSUE
+# 15): `ctl profile <job> --steps N` stamps this TPUJob annotation with a
+# JSON request ({"id", "steps", "at"}); the controller projects it into
+# the job ConfigMap's "profile" key (the same membership channel the
+# elastic protocol already polls), each worker captures a jax.profiler
+# trace for N steps into the job's artifact dir and acks completion
+# through its train_stats "profile" entry. `ctl profile --status/--fetch`
+# read the acks back. Cleared by stamping a new request (one in-flight
+# request per job; the id disambiguates).
+ANNOTATION_PROFILE_REQUEST = "tpujob.dev/profile-request"
+
+
+# ---------------------------------------------------------------------------
+# bounded status-stats blobs (the workload telemetry plane, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+# the stall-attribution bucket taxonomy — every wall-second of a training
+# step classifies into exactly one of these (worker-side) or "restart"
+# (controller-side downtime, charged from conditions by the goodput
+# aggregator). Shared by the real step loop (runtime/stepstats.py), the
+# hollow timelines, and the aggregator, so the attribution can never fork.
+TRAIN_BUCKETS = ("compile", "input", "compute", "sync", "ckpt")
+# the controller-side bucket: wall time a job spent torn down between
+# generations (evict → relaunch), which no worker process can observe
+BUCKET_RESTART = "restart"
+
+_PROFILE_KEYS = ("id", "state", "dir")
+
+
+def _r3(v) -> float:
+    try:
+        return round(float(v), 3)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _i(v) -> int:
+    try:
+        return int(v or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def bounded_serve_stats(qps=0.0, queue_depth=0.0, p99_ms=0.0,
+                        **_ignored) -> Dict[str, float]:
+    """THE constructor for a pod's ``status.serve_stats`` blob (oplint
+    OBS004): exactly three rounded floats, whatever the caller passed.
+    Status blobs ride EVERY watch event delivering the pod, so their size
+    is a fan-out multiplier — bounding happens at construction, not by
+    reviewer vigilance."""
+    return {
+        "qps": _r3(qps),
+        "queue_depth": _r3(queue_depth),
+        "p99_ms": _r3(p99_ms),
+    }
+
+
+def bounded_train_stats(step=0, steps=0, step_p50_ms=0.0, buckets=None,
+                        profile=None, **_ignored) -> Dict[str, object]:
+    """THE constructor for a pod's ``status.train_stats`` blob (oplint
+    OBS004). Fixed key set, rounded floats, bucket keys clamped to the
+    :data:`TRAIN_BUCKETS` taxonomy, profile ack clamped to short strings
+    — an unbounded dict here would bloat every watch event carrying the
+    pod (the same reason serve_stats is three floats).
+
+    ``step`` is the global step (survives restarts via checkpoint
+    resume); ``steps`` counts steps run by THIS incarnation and
+    ``buckets`` are THIS incarnation's cumulative attributed seconds —
+    both reset on relaunch, which the aggregator's reset-aware deltas
+    expect (like a Prometheus counter across a process restart)."""
+    # the source may be a file written by an UNTRUSTED workload process
+    # (the executor mirrors whatever the worker flushed): wrong-typed
+    # fields degrade to zeros/absence, never an exception out of the
+    # executor's poll loop
+    if not isinstance(buckets, dict):
+        buckets = {}
+    out: Dict[str, object] = {
+        "step": _i(step),
+        "steps": _i(steps),
+        "step_p50_ms": _r3(step_p50_ms),
+        "buckets": {
+            k: _r3(buckets.get(k, 0.0)) for k in TRAIN_BUCKETS
+        },
+    }
+    if isinstance(profile, dict) and profile:
+        out["profile"] = {
+            k: str(profile.get(k, ""))[:256] for k in _PROFILE_KEYS
+        }
+    return out
 
 
 KINDS = ("TPUJob", "TPUServe", "Alert", "Pod", "Service", "ConfigMap",

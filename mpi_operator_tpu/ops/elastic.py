@@ -30,8 +30,9 @@ import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
 from mpi_operator_tpu.ops.checkpoint import CheckpointManager
-from mpi_operator_tpu.ops.profiling import StepProfiler
+from mpi_operator_tpu.ops.profiling import ProfileRequestWatcher, StepProfiler
 from mpi_operator_tpu.ops.trainer import Trainer, TrainState
+from mpi_operator_tpu.runtime.stepstats import StepStatsRecorder
 
 # EX_TEMPFAIL: the "re-run me" exit code workers use on membership change.
 # Job specs pair it with restart_policy: ExitCode (the controller treats the
@@ -172,23 +173,47 @@ def run_elastic(
     step = start_step = int(state.step)
     metrics = None
     profiler = StepProfiler()  # no-op unless TPUJOB_PROFILE_DIR is set
+    # the workload telemetry plane (ISSUE 15): every wall-second of every
+    # step classifies into an attributed bucket — input wait, compute (the
+    # first one lands in `compile`), membership sync, checkpoint save —
+    # flushed to $TPUJOB_STEPSTATS_FILE for the executor to mirror into
+    # pod.status.train_stats. Two perf_counter calls per phase: the
+    # goodput bench pins the per-step cost at <=2% of step p50.
+    stats = StepStatsRecorder.from_env()
+    # operator-triggered profiling: `ctl profile` stamps the annotation,
+    # the controller projects it into the same config dir the membership
+    # check polls; captures land under the job's artifact dir
+    prof_watch = ProfileRequestWatcher(
+        stats,
+        out_root=(os.path.join(config.checkpoint_dir, "profiles")
+                  if config.checkpoint_dir else None),
+    )
     try:
         while step < total_steps:
-            state, metrics = trainer.train_step(state, next(batches))
+            with stats.phase("input"):
+                batch = next(batches)
+            with stats.phase("compute"):
+                state, metrics = trainer.train_step(state, batch)
             step += 1
             profiler.observe(step)
+            prof_watch.observe(step)
+            stats.step_done(step)
             if step % config.save_interval_steps == 0:
-                mgr.save(step, state)
+                with stats.phase("ckpt"):
+                    mgr.save(step, state)
             if step % config.membership_check_every == 0:
-                want, preempted = agreed_gang_state()
+                with stats.phase("sync"):
+                    want, preempted = agreed_gang_state()
+                prof_watch.poll(step)
                 if preempted or want != current_world:
                     # force-checkpoint BEFORE exiting: for preemption this
                     # runs inside the executor's eviction grace window, so
                     # the next incarnation resumes from this step instead
                     # of the last periodic save
-                    if mgr.latest_step() != step:
-                        mgr.save(step, state, force=True)
-                    mgr.wait()
+                    with stats.phase("ckpt"):
+                        if mgr.latest_step() != step:
+                            mgr.save(step, state, force=True)
+                        mgr.wait()
                     return ElasticResult(
                         "restart",
                         state,
@@ -196,10 +221,13 @@ def run_elastic(
                         {k: float(v) for k, v in (metrics or {}).items()},
                         start_step=start_step,
                     )
-        if mgr.latest_step() != step:
-            mgr.save(step, state, force=True)
-        mgr.wait()
+        with stats.phase("ckpt"):
+            if mgr.latest_step() != step:
+                mgr.save(step, state, force=True)
+            mgr.wait()
     finally:
+        prof_watch.close()
+        stats.close()
         profiler.close()
         mgr.close()
     return ElasticResult(

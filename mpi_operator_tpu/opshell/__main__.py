@@ -152,6 +152,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between serve-autoscaler decision "
                          "passes (sample pod serve_stats → recommend → "
                          "write spec.replicas)")
+    ap.add_argument("--no-goodput", action="store_true",
+                    help="disable the workload telemetry plane's goodput "
+                         "aggregator (per-job goodput/stall/straggler "
+                         "rollups run leader-only by default)")
+    ap.add_argument("--goodput-interval", type=float, default=2.0,
+                    help="seconds between goodput-aggregator rollup "
+                         "passes over running jobs' train_stats")
     ap.add_argument("--no-slo-monitor", action="store_true",
                     help="disable the SLO burn-rate monitor (the alerting "
                          "plane runs leader-only by default, scraping this "
@@ -401,6 +408,19 @@ def main(argv=None) -> int:
             interval=args.autoscale_interval,
         )
 
+    # the workload telemetry plane (leader-only, ISSUE 15): roll pod
+    # train_stats up into per-job goodput / stall attribution /
+    # straggler detection — the gauges the goodput-collapse objective
+    # burns on and the telemetry `ctl top --jobs` renders
+    goodput_aggregator = None
+    if not args.no_goodput:
+        from mpi_operator_tpu.controller.goodput import GoodputAggregator
+
+        goodput_aggregator = GoodputAggregator(
+            store, recorder, cache=cache, namespace=args.namespace,
+            interval=args.goodput_interval,
+        )
+
     # the SLO plane (leader-only, like every reconciler): scrape the
     # fleet's /metrics, evaluate burn-rate objectives, write Alert
     # objects + incident bundles. Built BEFORE the election so a bad
@@ -475,6 +495,8 @@ def main(argv=None) -> int:
         monitor.start()
         if drain_controller is not None:
             drain_controller.start()
+        if goodput_aggregator is not None:
+            goodput_aggregator.start()
         if slo_monitor is not None:
             slo_monitor.start()
         if chaos_script is not None:
@@ -497,6 +519,8 @@ def main(argv=None) -> int:
         controller.stop()
         if slo_monitor is not None:
             slo_monitor.stop()
+        if goodput_aggregator is not None:
+            goodput_aggregator.stop()
         if autoscaler is not None:
             autoscaler.stop()
         if serve_controller is not None:

@@ -961,13 +961,69 @@ def cmd_alerts(client: TPUJobClient, args) -> int:
     return 1 if firing else 0
 
 
+def _top_jobs(client: TPUJobClient) -> int:
+    """`ctl top --jobs`: the workload-telemetry view — per-job GOODPUT /
+    STEP-P50 / DOMINANT-STALL / STRAGGLER straight from the goodput
+    aggregator's status.train_telemetry rollups. Exit 1 while any RUNNING
+    job sits below the goodput-collapse floor (runbook probe parity with
+    `ctl alerts`: scripts gate on the rc, humans read the table)."""
+    floor = 0.0
+    try:
+        from mpi_operator_tpu.controller.slo_monitor import load_slo_config
+
+        floor = load_slo_config().objective("goodput-collapse").bound
+    except (ImportError, KeyError, ValueError):
+        # custom SLO config without the objective (or none loadable from
+        # this client): render the table, skip the rc gate
+        floor = 0.0
+    rows = []
+    breached = []
+    for j in sorted(client.store.list("TPUJob"),
+                    key=lambda j: j.metadata.key()):
+        state = job_state(j)
+        tel = j.status.train_telemetry or {}
+        goodput = tel.get("goodput")
+        below = (
+            state == "Running" and floor > 0
+            and goodput is not None and goodput < floor
+        )
+        if below:
+            breached.append(j.metadata.key())
+        rows.append([
+            j.metadata.key(),
+            state,
+            (f"{goodput:.0%}" + ("!" if below else ""))
+            if goodput is not None else "-",
+            f"{tel.get('step_p50_ms'):g}ms"
+            if tel.get("step_p50_ms") else "-",
+            tel.get("dominant_stall") or "-",
+            tel.get("straggler") or "-",
+            tel.get("steps", "-"),
+        ])
+    if not rows:
+        print("no jobs")
+        return 0
+    print(_table(rows, ["JOB", "STATE", "GOODPUT", "STEP-P50",
+                        "DOMINANT-STALL", "STRAGGLER", "STEPS"]))
+    if breached:
+        print(f"{len(breached)} running job(s) below the "
+              f"goodput-collapse floor ({floor:g}): "
+              f"{', '.join(breached)} — read the stall buckets "
+              f"(`ctl describe`, runbook 'job slow')")
+    return 1 if breached else 0
+
+
 def cmd_top(client: TPUJobClient, args) -> int:
     """`ctl top`: the one-scrape cluster overview — jobs by phase, chips
     held vs capacity, node/pod health, firing alerts from the store; and
     with --metrics URL(s), store p99 by verb, reconcile/watch-lag
     percentiles, and tenant shed counts read straight out of live
     /metrics expositions (since-process-start quantiles: the trend view
-    is the monitor's windowed job, this is the snapshot)."""
+    is the monitor's windowed job, this is the snapshot). `--jobs`
+    switches to the per-job workload-telemetry table (goodput / stall
+    attribution / stragglers)."""
+    if getattr(args, "jobs", False):
+        return _top_jobs(client)
     import urllib.request
 
     import math
@@ -1099,6 +1155,116 @@ def cmd_top(client: TPUJobClient, args) -> int:
             print("tenant shed (429s): " + ", ".join(
                 f"{t}={v:g}" + (f" ({r})" if r else "")
                 for t, r, v in sorted(shed)))
+    return 0
+
+
+def cmd_profile(client: TPUJobClient, args) -> int:
+    """`ctl profile <job> --steps N`: attach the profiler to a live gang
+    — stamps the tpujob.dev/profile-request annotation; the controller
+    projects it into the job's config dir, every worker captures a
+    jax.profiler trace for N steps into the job's artifact dir and acks
+    through its train_stats. `--status` renders the acks, `--fetch`
+    collects the trace dirs (local/shared filesystem — the single-host
+    and shared-volume shapes; cross-node collection rides the same
+    artifact volume checkpoints already require)."""
+    import shutil
+    import uuid
+
+    from mpi_operator_tpu.machinery.objects import ANNOTATION_PROFILE_REQUEST
+
+    try:
+        job = client.get(args.name)
+    except NotFound as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    current = {}
+    raw = job.metadata.annotations.get(ANNOTATION_PROFILE_REQUEST, "")
+    if raw:
+        try:
+            current = json.loads(raw)
+        except ValueError:
+            current = {}
+
+    def profile_acks():
+        pods = client.store.list(
+            "Pod", job.metadata.namespace,
+            selector={"tpujob.dev/job-name": job.metadata.name},
+        )
+        out = []
+        for p in sorted(pods, key=lambda p: p.metadata.name):
+            if p.is_finished():
+                continue
+            prof = (p.status.train_stats or {}).get("profile") or {}
+            out.append((p.metadata.name, prof))
+        return out
+
+    if args.status or args.fetch:
+        want = str(current.get("id", ""))
+        if not want:
+            print(f"error: job {args.name} has no profile request "
+                  f"(run `ctl profile {args.name} --steps N` first)",
+                  file=sys.stderr)
+            return 1
+        acks = profile_acks()
+        matching = [(n, p) for n, p in acks if p.get("id") == want]
+        if args.status:
+            rows = [
+                [n, p.get("id") or "-", p.get("state") or "pending",
+                 p.get("dir") or "-"]
+                for n, p in acks
+            ]
+            print(_table(rows, ["POD", "REQUEST", "STATE", "DIR"])
+                  if rows else "no live worker pods")
+            # done means EVERY live worker acked THIS request done — a
+            # subset-done rc=0 would let a script --fetch half the
+            # gang's traces with no error
+            done = bool(acks) and all(
+                p.get("id") == want and p.get("state") == "done"
+                for _, p in acks
+            )
+            return 0 if done else 1
+        # --fetch: collect every completed capture's trace dir
+        dest = args.dest or f"profile-{args.name}-{want}"
+        fetched = 0
+        for n, p in matching:
+            if p.get("state") != "done":
+                continue
+            src = p.get("dir") or ""
+            if not src or not os.path.isdir(src):
+                print(f"warning: {n}: trace dir {src or '<none>'} not "
+                      f"readable from here (fetch from the artifact "
+                      f"volume)", file=sys.stderr)
+                continue
+            target = os.path.join(dest, n)
+            shutil.copytree(src, target, dirs_exist_ok=True)
+            fetched += 1
+            print(f"{n}: fetched {src} -> {target}")
+        if not fetched:
+            print("error: no completed captures to fetch (try --status)",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    # stamp a fresh request (one in flight per job; the id disambiguates)
+    req_id = uuid.uuid4().hex[:8]
+    req = json.dumps({"id": req_id, "steps": int(args.steps),
+                      "at": round(time.time(), 3)})
+    try:
+        client.store.patch(
+            "TPUJob", job.metadata.namespace, job.metadata.name,
+            # uid-pinned: a recreated same-name job must not absorb a
+            # stale profile request aimed at its predecessor
+            {"metadata": {"uid": job.metadata.uid,
+                          "annotations": {ANNOTATION_PROFILE_REQUEST: req}}},
+        )
+    except (Conflict, NotFound) as e:
+        # deleted or recreated between the read and this stamp
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"profile request {req_id} stamped: {args.steps} steps; "
+          f"workers pick it up at their next membership check — poll "
+          f"with `ctl profile {args.name} --status`, collect with "
+          f"--fetch")
     return 0
 
 
@@ -1394,6 +1560,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of [name=]http://host:port/metrics "
                         "endpoints to scrape once (operator "
                         "--monitoring-port, tpu-store --monitoring-port)")
+    p.add_argument("--jobs", action="store_true",
+                   help="per-job workload telemetry: GOODPUT / STEP-P50 / "
+                        "DOMINANT-STALL / STRAGGLER from the goodput "
+                        "aggregator's rollups; exit 1 while any running "
+                        "job is below the goodput-collapse floor")
+    p = sub.add_parser("profile", help="attach the profiler to a live "
+                                       "gang: stamp a profile request "
+                                       "(workers capture N steps of "
+                                       "jax.profiler trace); --status "
+                                       "shows acks, --fetch collects")
+    p.add_argument("name", help="job name")
+    p.add_argument("--steps", type=int, default=5,
+                   help="steps to capture per worker (default 5)")
+    p.add_argument("--status", action="store_true",
+                   help="render per-pod capture acks; exit 0 once every "
+                        "reporting worker finished the current request")
+    p.add_argument("--fetch", action="store_true",
+                   help="copy completed trace dirs here (or --dest)")
+    p.add_argument("--dest", default=None,
+                   help="fetch destination (default "
+                        "./profile-<job>-<request-id>)")
     p = sub.add_parser("trace", help="render a job's causal span timeline "
                                      "(submit → scheduled → launched → "
                                      "restarts → terminal) from the "
@@ -1462,6 +1649,7 @@ def main(argv=None) -> int:
             "trace": cmd_trace,
             "alerts": cmd_alerts,
             "top": cmd_top,
+            "profile": cmd_profile,
         }[args.verb](client, args)
     except Forbidden as e:
         # read-tier token on a mutating verb: authenticated but not

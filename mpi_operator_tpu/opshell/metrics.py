@@ -562,6 +562,45 @@ serve_replicas_ready = REGISTRY.gauge(
     "ready) — the supply side of the autoscaler's loop",
 )
 
+# --- the workload telemetry plane (ISSUE 15) -------------------------------
+
+job_goodput_ratio = REGISTRY.gauge(
+    "tpu_operator_job_goodput_ratio",
+    "Per-job goodput (labeled job=<ns>/<name>): productive step-compute "
+    "seconds / wall seconds since admission, restart downtime included in "
+    "the denominator — exported once the job has completed at least one "
+    "step, removed at terminal/delete; the goodput-collapse burn-rate "
+    "objective pages when a running job's ratio sits below its floor",
+)
+job_stragglers = REGISTRY.gauge(
+    "tpu_operator_job_stragglers",
+    "Gang members currently flagged as stragglers per job (step p50 above "
+    "the gang median by the skew threshold); the Straggler Event/condition "
+    "name the exact pod and node",
+)
+restart_to_first_step = REGISTRY.histogram(
+    "tpu_operator_restart_to_first_step_seconds",
+    "Gang-restart outage span: restart observed (evict/teardown) to the "
+    "FIRST completed step of the relaunched generation, labeled kind= "
+    "(migration for planned Maintenance moves, restart otherwise) — THE "
+    "baseline ROADMAP item 5's compile-cache work must beat",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 60.0, 120.0, 300.0,
+             600.0),
+)
+step_latency = REGISTRY.histogram(
+    "tpu_operator_step_latency_seconds",
+    "Per-step wall seconds attributed to each stall bucket (labeled "
+    "bucket=compile|input|compute|sync|ckpt, plus bucket=step for the "
+    "whole step): the aggregator observes each tick's per-step bucket "
+    "averages, so the distribution shows WHERE step time goes fleet-wide",
+)
+goodput_sync_latency = REGISTRY.histogram(
+    "tpu_operator_goodput_sync_latency_seconds",
+    "Goodput-aggregator pass wall time (read every running job's worker "
+    "train_stats, roll up goodput/skew, write telemetry + gauges); "
+    "observed where the goodput.sync span closes",
+)
+
 # --- the SLO plane (ISSUE 13): the monitor's own health + alert state ------
 
 slo_alerts_firing = REGISTRY.gauge(

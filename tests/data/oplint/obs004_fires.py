@@ -1,0 +1,24 @@
+# oplint fixture: OBS004 must fire on a train_stats/serve_stats status
+# blob constructed outside the bounded-blob helpers — raw dict literals,
+# unvetted names, and subscript assignment all count.
+from mpi_operator_tpu.machinery.objects import patch_pod_status
+
+
+def raw_dict_literal(store, ns, name, uid):
+    patch_pod_status(store, ns, name, uid, {
+        "serve_stats": {"qps": 1.0, "whatever": object()},  # expect: OBS004
+    })
+
+
+def unvetted_name(store, ns, name, uid, model):
+    stats = model.sample("svc")  # not the helper: unprovable bound
+    patch_pod_status(store, ns, name, uid, {"serve_stats": stats})  # expect: OBS004
+
+
+def unvetted_parameter(sink, ns, name, uid, blob):
+    sink.enqueue(ns, name, uid, 0, {"train_stats": blob})  # expect: OBS004
+
+
+def subscript_assignment(changes, raw):
+    changes["train_stats"] = raw  # expect: OBS004
+    return changes

@@ -1,0 +1,43 @@
+# oplint fixture: blessed OBS004 shapes — direct helper calls, names
+# assigned from a helper in the same (or an enclosing) scope, clearing
+# with None, and the reasoned suppression.
+from mpi_operator_tpu.machinery.objects import (
+    bounded_serve_stats,
+    bounded_train_stats,
+    patch_pod_status,
+)
+
+
+def direct_helper_call(store, ns, name, uid, raw):
+    patch_pod_status(store, ns, name, uid, {
+        "train_stats": bounded_train_stats(**raw),
+    })
+
+
+def helper_assigned_name(store, ns, name, uid, model):
+    stats = bounded_serve_stats(**model.sample("svc"))
+    patch_pod_status(store, ns, name, uid, {"serve_stats": stats})
+
+
+def enclosing_scope_blessing(sink, ns, name, uid, raw):
+    blob = bounded_train_stats(**raw)
+
+    def flush():
+        sink.enqueue(ns, name, uid, 0, {"train_stats": blob})
+
+    return flush
+
+
+def clearing_is_legal(store, ns, name, uid):
+    patch_pod_status(store, ns, name, uid, {"serve_stats": None})
+
+
+def unrelated_keys_are_free(changes):
+    changes["phase"] = "Running"
+    return {"other_stats": {"anything": 1}}
+
+
+def suppressed(sink, ns, name, uid, blob):
+    # oplint: disable=OBS004 — fixture-only: proving the reasoned
+    # suppression silences the rule
+    sink.enqueue(ns, name, uid, 0, {"train_stats": blob})

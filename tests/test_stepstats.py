@@ -1,0 +1,897 @@
+"""The workload telemetry plane (ISSUE 15): bounded stats blobs, the
+step-stats recorder, the goodput aggregator's math (restart downtime,
+skew detection, counter resets), hollow train timelines, the on-demand
+profile watcher, and the `ctl top --jobs` / `ctl profile` verbs.
+
+The goodput unit suite drives the aggregator with an explicit clock and
+hand-built pods, so every charge — productive seconds, restart downtime,
+a Maintenance migration vs a backoff-burning crash, a counter reset on
+trainer relaunch — is asserted against exact arithmetic, not wall-clock
+luck.
+"""
+
+import json
+import os
+
+import pytest
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.types import (
+    ConditionType,
+    ObjectMeta,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from mpi_operator_tpu.controller.goodput import GoodputAggregator
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    BUCKET_RESTART,
+    TRAIN_BUCKETS,
+    Pod,
+    PodPhase,
+    bounded_serve_stats,
+    bounded_train_stats,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.opshell import metrics
+from mpi_operator_tpu.runtime.stepstats import (
+    ENV_STATS_FILE,
+    StepStatsRecorder,
+    read_stats,
+)
+
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_REPLICA_INDEX = "tpujob.dev/replica-index"
+
+
+# ---------------------------------------------------------------------------
+# bounded blobs (the OBS004 helpers)
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_serve_stats_clamps_and_rounds():
+    blob = bounded_serve_stats(qps=1.23456, queue_depth="7", p99_ms=None,
+                               surprise={"huge": "x" * 10000})
+    assert blob == {"qps": 1.235, "queue_depth": 7.0, "p99_ms": 0.0}
+
+
+def test_bounded_train_stats_fixed_keys():
+    blob = bounded_train_stats(
+        step=7, steps=3, step_p50_ms=12.3456,
+        buckets={"compute": 1.23456, "input": 0.5, "bogus": 99.0},
+        profile={"id": "ab", "state": "done", "dir": "/x" * 500,
+                 "extra": "nope"},
+    )
+    assert set(blob) == {"step", "steps", "step_p50_ms", "buckets",
+                        "profile"}
+    assert set(blob["buckets"]) == set(TRAIN_BUCKETS)
+    assert "bogus" not in blob["buckets"]
+    assert blob["step_p50_ms"] == 12.346
+    assert set(blob["profile"]) == {"id", "state", "dir"}
+    assert len(blob["profile"]["dir"]) <= 256
+    # garbage in, zeros out — never a crash, never an unbounded value
+    # (the stats file is written by an UNTRUSTED workload process: a
+    # wrong-typed field must cost a skipped mirror, not the executor's
+    # poll thread)
+    assert bounded_train_stats(step="x", buckets=None)["step"] == 0
+    assert bounded_train_stats(buckets=[1.0])["buckets"]["compute"] == 0.0
+    assert "profile" not in bounded_train_stats(profile="not-a-dict")
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_recorder_attributes_phases_and_first_compile(tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "s.json")
+    rec = StepStatsRecorder(path, interval=0.0, clock=clock)
+    for step in range(1, 4):
+        with rec.phase("input"):
+            clock.advance(0.1)
+        with rec.phase("compute"):
+            clock.advance(2.0 if step == 1 else 0.5)
+        with rec.phase("sync"):
+            clock.advance(0.05)
+        rec.step_done(step)
+    snap = rec.snapshot()
+    b = snap["buckets"]
+    # first compute phase lands in `compile`, later ones in `compute`
+    assert b["compile"] == pytest.approx(2.0, abs=1e-6)
+    assert b["compute"] == pytest.approx(1.0, abs=1e-6)
+    assert b["input"] == pytest.approx(0.3, abs=1e-6)
+    assert b["sync"] == pytest.approx(0.15, abs=1e-6)
+    assert snap["step"] == 3 and snap["steps"] == 3
+    # step wall = everything since the previous step_done
+    assert snap["step_p50_ms"] == pytest.approx(650.0, abs=1.0)
+    # flushed blob round-trips through the executor-side reader
+    on_disk = read_stats(path)
+    assert on_disk["buckets"] == b
+    assert on_disk["pid"] == os.getpid()
+
+
+def test_recorder_profile_ack_flushes_immediately(tmp_path):
+    path = str(tmp_path / "s.json")
+    rec = StepStatsRecorder(path, interval=1000.0, clock=FakeClock())
+    rec.set_profile("ab12", "capturing", "/tmp/prof/ab12")
+    got = read_stats(path)
+    assert got["profile"] == {"id": "ab12", "state": "capturing",
+                              "dir": "/tmp/prof/ab12"}
+
+
+def test_recorder_from_env_and_disabled_noop(tmp_path):
+    rec = StepStatsRecorder.from_env(env={})
+    assert not rec.enabled
+    rec.step_done()  # no path: must not touch the filesystem
+    rec.close()
+    p = str(tmp_path / "e.json")
+    rec2 = StepStatsRecorder.from_env(
+        env={ENV_STATS_FILE: p, "TPUJOB_STEPSTATS_INTERVAL": "0.25"})
+    assert rec2.enabled and rec2.interval == 0.25
+    assert read_stats(str(tmp_path / "missing.json")) is None
+
+
+# ---------------------------------------------------------------------------
+# goodput aggregator harness
+# ---------------------------------------------------------------------------
+
+
+def make_job(store, name, workers=2, start=1000.0):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(worker=ReplicaSpec(replicas=workers)),
+    )
+    job.status.start_time = start
+    cond.update_job_conditions(
+        job.status, ConditionType.CREATED, "TPUJobCreated", "created")
+    cond.update_job_conditions(
+        job.status, ConditionType.RUNNING, "TPUJobRunning", "running")
+    return store.create(job)
+
+
+def make_pod(store, job, index, node="n0"):
+    pod = Pod(metadata=ObjectMeta(
+        name=f"{job.metadata.name}-worker-{index}", namespace="default",
+        labels={LABEL_JOB_NAME: job.metadata.name,
+                LABEL_REPLICA_INDEX: str(index)},
+    ))
+    pod.spec.node_name = node
+    pod.status.phase = PodPhase.RUNNING
+    return store.create(pod)
+
+
+def report(store, pod_name, **kw):
+    p = store.get("Pod", "default", pod_name)
+    p.status.train_stats = bounded_train_stats(**kw)
+    store.update(p)
+
+
+def set_restartish(store, name, ctype, at, generation):
+    job = store.get("TPUJob", "default", name)
+    cond.update_job_conditions(
+        job.status, ctype, "x", "restart-ish active")
+    for c in job.status.conditions:
+        if c.type == ctype:
+            c.last_transition_time = at
+    job.status.restart_generation = generation
+    store.update(job)
+
+
+@pytest.fixture
+def harness():
+    store = ObjectStore()
+    agg = GoodputAggregator(store, EventRecorder(store))
+    return store, agg
+
+
+def telemetry(store, name):
+    return store.get("TPUJob", "default", name).status.train_telemetry or {}
+
+
+def test_goodput_is_productive_over_wall(harness):
+    store, agg = harness
+    job = make_job(store, "gp-basic", workers=2, start=1000.0)
+    make_pod(store, job, 0)
+    make_pod(store, job, 1)
+    report(store, "gp-basic-worker-0", step=10, steps=10, step_p50_ms=100,
+           buckets={"compute": 5.0, "input": 1.0, "compile": 2.0})
+    agg.tick(now=1010.0)
+    tel = telemetry(store, "gp-basic")
+    assert tel["goodput"] == pytest.approx(0.5)
+    assert tel["steps"] == 10
+    assert tel["dominant_stall"] == "compile"
+    assert metrics.job_goodput_ratio.get(
+        job="default/gp-basic") == pytest.approx(0.5)
+    # wall keeps running with no new steps: goodput decays
+    agg.tick(now=1020.0)
+    assert telemetry(store, "gp-basic")["goodput"] == pytest.approx(0.25)
+
+
+def test_no_telemetry_before_first_step(harness):
+    store, agg = harness
+    job = make_job(store, "gp-fresh", start=1000.0)
+    make_pod(store, job, 0)
+    report(store, "gp-fresh-worker-0", step=0, steps=0,
+           buckets={"compile": 3.0})  # still compiling, zero steps
+    agg.tick(now=1005.0)
+    assert telemetry(store, "gp-fresh") == {}
+    assert metrics.job_goodput_ratio.get(job="default/gp-fresh") == 0.0
+
+
+@pytest.mark.parametrize("ctype,kind", [
+    (ConditionType.MIGRATING, "migration"),
+    (ConditionType.RESTARTING, "restart"),
+])
+def test_restart_downtime_charged_and_outage_span(harness, ctype, kind):
+    """A free Maintenance migration and a backoff-burning crash charge
+    IDENTICAL downtime for an identical outage — the difference is the
+    kind label on the outage histogram (and, elsewhere, restart_count)."""
+    store, agg = harness
+    name = f"gp-{kind}"
+    key = f"default/{name}"
+    job = make_job(store, name, workers=2, start=1000.0)
+    make_pod(store, job, 0)
+    make_pod(store, job, 1)
+    report(store, f"{name}-worker-0", step=10, steps=10, step_p50_ms=100,
+           buckets={"compute": 8.0})
+    agg.tick(now=1010.0)
+    before = metrics.restart_to_first_step.count(kind=kind)
+    # the gang tears down: pods deleted, restart-ish condition active at
+    # t=1010, generation bumps
+    store.delete("Pod", "default", f"{name}-worker-0")
+    store.delete("Pod", "default", f"{name}-worker-1")
+    set_restartish(store, name, ctype, at=1010.0, generation=1)
+    agg.tick(now=1012.0)
+    agg.tick(now=1014.0)
+    # relaunched gang (new uids), fresh counters — the reset shape
+    job = store.get("TPUJob", "default", name)
+    make_pod(store, job, 0)
+    make_pod(store, job, 1)
+    report(store, f"{name}-worker-0", step=12, steps=2, step_p50_ms=100,
+           buckets={"compute": 1.0})
+    agg.tick(now=1016.0)
+    tel = telemetry(store, name)
+    # downtime: (1012-1010) + (1014-1012) + (1016-1014) = 6s
+    assert tel["buckets"][BUCKET_RESTART] == pytest.approx(6.0)
+    # productive seconds accumulate CONTINUOUSLY across the reset
+    assert tel["goodput"] == pytest.approx(9.0 / 16.0)
+    # the outage span closed on the relaunched coordinator's first step:
+    # anchored at the condition transition (1010) → observed 6s
+    assert metrics.restart_to_first_step.count(kind=kind) == before + 1
+    snap = metrics.restart_to_first_step.snapshot(kind=kind)
+    assert snap[-1][1] >= 1  # landed in a finite-or-inf bucket
+    assert metrics.job_goodput_ratio.get(job=key) > 0.0
+
+
+def test_counter_reset_never_yields_negative_goodput(harness):
+    store, agg = harness
+    job = make_job(store, "gp-reset", workers=1, start=1000.0)
+    make_pod(store, job, 0)
+    report(store, "gp-reset-worker-0", step=100, steps=100,
+           buckets={"compute": 50.0})
+    agg.tick(now=1100.0)
+    g1 = telemetry(store, "gp-reset")["goodput"]
+    # in-place counter reset (same pod uid, counters rewound): the new
+    # value IS the delta — never negative
+    report(store, "gp-reset-worker-0", step=10, steps=10,
+           buckets={"compute": 5.0})
+    agg.tick(now=1110.0)
+    tel = telemetry(store, "gp-reset")
+    assert tel["goodput"] >= 0.0
+    # productive total grew by exactly the post-reset value (50 + 5)
+    assert tel["goodput"] == pytest.approx(55.0 / 110.0)
+    assert tel["goodput"] <= g1
+
+
+def test_skew_detector_fires_on_seeded_slow_worker(harness):
+    store, agg = harness
+    job = make_job(store, "gp-skew", workers=3, start=1000.0)
+    for i in range(3):
+        make_pod(store, job, i, node=f"n{i}")
+    for i, p50 in enumerate([100.0, 102.0, 320.0]):
+        report(store, f"gp-skew-worker-{i}", step=10, steps=10,
+               step_p50_ms=p50, buckets={"compute": 5.0})
+    agg.tick(now=1010.0)
+    tel = telemetry(store, "gp-skew")
+    assert tel["straggler"] == "default/gp-skew-worker-2@n2"
+    job = store.get("TPUJob", "default", "gp-skew")
+    c = cond.get_condition(job.status, ConditionType.STRAGGLER)
+    assert c is not None and c.status
+    assert "gp-skew-worker-2" in c.message and "n2" in c.message
+    evs = [e for e in store.list("Event") if e.reason == "Straggler"
+           and "gp-skew-worker-2" in e.message]
+    assert evs and "n2" in evs[0].message
+    assert metrics.job_stragglers.get(job="default/gp-skew") == 1
+    # the event fires ONCE per straggler incarnation, not per tick
+    agg.tick(now=1012.0)
+    assert len([e for e in store.list("Event")
+                if e.reason == "Straggler"]) == len(evs)
+    # heal: skew clears → condition flips inactive, telemetry clears
+    report(store, "gp-skew-worker-2", step=20, steps=20,
+           step_p50_ms=104.0, buckets={"compute": 10.0})
+    agg.tick(now=1014.0)
+    assert telemetry(store, "gp-skew")["straggler"] == ""
+    job = store.get("TPUJob", "default", "gp-skew")
+    c = cond.get_condition(job.status, ConditionType.STRAGGLER)
+    assert c is not None and not c.status
+    assert metrics.job_stragglers.get(job="default/gp-skew") == 0
+
+
+def test_straggler_condition_write_never_resurrects_stale_conditions(
+        harness):
+    """The condition flip is a fresh-read RMW with an rv precondition: a
+    controller status write landing between the aggregator's read and
+    its patch bounces the patch — a stale conditions array can never
+    erase e.g. a just-written Failed condition."""
+    store, agg = harness
+    job = make_job(store, "gp-race", workers=3, start=1000.0)
+    for i in range(3):
+        make_pod(store, job, i, node=f"n{i}")
+    for i, p50 in enumerate([100.0, 100.0, 400.0]):
+        report(store, f"gp-race-worker-{i}", step=10, steps=10,
+               step_p50_ms=p50, buckets={"compute": 5.0})
+    # the controller marks the job Failed while the aggregator holds an
+    # older snapshot (the lister-lag shape)
+    cur = store.get("TPUJob", "default", "gp-race")
+    cond.update_job_conditions(
+        cur.status, ConditionType.FAILED, "TPUJobFailed", "backoff")
+    store.update(cur)
+    agg.tick(now=1010.0)  # skew fires against the CURRENT store state
+    after = store.get("TPUJob", "default", "gp-race")
+    failed = cond.get_condition(after.status, ConditionType.FAILED)
+    # whatever happened to the Straggler flip, Failed survived
+    assert failed is not None and failed.status
+
+
+def test_straggler_condition_is_level_triggered_after_lost_write(harness):
+    """The condition flip is re-stamped every tick while the skew holds:
+    a write the controller's own conditions patch erased (or that lost
+    its rv race) comes back next tick instead of staying lost for the
+    straggler's whole lifetime."""
+    store, agg = harness
+    job = make_job(store, "gp-lost", workers=2, start=1000.0)
+    for i in range(2):
+        make_pod(store, job, i, node=f"n{i}")
+    for i, p50 in enumerate([100.0, 400.0]):
+        report(store, f"gp-lost-worker-{i}", step=10, steps=10,
+               step_p50_ms=p50, buckets={"compute": 5.0})
+    agg.tick(now=1010.0)
+    cur = store.get("TPUJob", "default", "gp-lost")
+    assert cond.has_condition(cur.status, ConditionType.STRAGGLER)
+    # a racing controller write replaces the conditions array WITHOUT
+    # the Straggler entry (its read predated the flip)
+    cur.status.conditions = [
+        c for c in cur.status.conditions
+        if c.type != ConditionType.STRAGGLER
+    ]
+    store.update(cur)
+    agg.tick(now=1012.0)
+    after = store.get("TPUJob", "default", "gp-lost")
+    c = cond.get_condition(after.status, ConditionType.STRAGGLER)
+    assert c is not None and c.status  # re-stamped, not lost forever
+    # and still only ONE Event (the per-incarnation guard is unchanged)
+    assert len([e for e in store.list("Event")
+                if e.reason == "Straggler"
+                and "gp-lost" in e.message]) == 1
+
+
+def test_straggler_clears_after_aggregator_failover(harness):
+    """A healed gang's still-active Straggler condition flips off even
+    when a FRESH aggregator (leader failover) never set it."""
+    store, agg = harness
+    job = make_job(store, "gp-fo", workers=2, start=1000.0)
+    for i in range(2):
+        make_pod(store, job, i, node=f"n{i}")
+    # the PREVIOUS leader left the condition active in the store
+    cur = store.get("TPUJob", "default", "gp-fo")
+    cond.update_job_conditions(
+        cur.status, ConditionType.STRAGGLER, cond.REASON_STRAGGLER,
+        "pod gp-fo-worker-1 on node n1")
+    store.update(cur)
+    for i in range(2):  # healthy, uniform gang
+        report(store, f"gp-fo-worker-{i}", step=10, steps=10,
+               step_p50_ms=100.0, buckets={"compute": 5.0})
+    agg.tick(now=1010.0)
+    after = store.get("TPUJob", "default", "gp-fo")
+    c = cond.get_condition(after.status, ConditionType.STRAGGLER)
+    assert c is not None and not c.status
+
+
+def test_skew_detector_silent_on_uniform_jitter(harness):
+    store, agg = harness
+    job = make_job(store, "gp-jitter", workers=3, start=1000.0)
+    for i in range(3):
+        make_pod(store, job, i, node=f"n{i}")
+    for i, p50 in enumerate([95.0, 100.0, 110.0]):  # ±10%: healthy
+        report(store, f"gp-jitter-worker-{i}", step=10, steps=10,
+               step_p50_ms=p50, buckets={"compute": 5.0})
+    agg.tick(now=1010.0)
+    assert telemetry(store, "gp-jitter")["straggler"] == ""
+    assert not [e for e in store.list("Event") if e.reason == "Straggler"
+                and "gp-jitter" in e.message]
+
+
+def test_adoption_resumes_goodput_from_persisted_telemetry(harness):
+    """Leader failover: a FRESH aggregator adopting a long-running job
+    seeds its ratio from the persisted train_telemetry rollup and does
+    NOT recharge the live incarnation's cumulative counters — goodput is
+    failover-continuous, never deflated toward the page floor nor
+    double-counted above it."""
+    store, agg = harness
+    job = make_job(store, "gp-adopt", workers=1, start=1000.0)
+    make_pod(store, job, 0)
+    report(store, "gp-adopt-worker-0", step=100, steps=100,
+           buckets={"compute": 80.0})
+    agg.tick(now=1100.0)
+    g_before = telemetry(store, "gp-adopt")["goodput"]
+    assert g_before == pytest.approx(0.8)
+    # the "new leader": a fresh aggregator with no in-memory history
+    agg2 = GoodputAggregator(store, EventRecorder(store))
+    agg2.tick(now=1101.0)
+    g_after = telemetry(store, "gp-adopt")["goodput"]
+    assert g_after == pytest.approx(g_before, abs=0.02)
+    # and deltas still flow continuously after adoption
+    report(store, "gp-adopt-worker-0", step=110, steps=110,
+           buckets={"compute": 88.0})
+    agg2.tick(now=1110.0)
+    assert telemetry(store, "gp-adopt")["goodput"] == pytest.approx(
+        88.0 / 110.0, abs=0.02)
+
+
+def test_suspended_job_pauses_charging_and_drops_gauge(harness):
+    store, agg = harness
+    job = make_job(store, "gp-susp", workers=1, start=1000.0)
+    make_pod(store, job, 0)
+    report(store, "gp-susp-worker-0", step=10, steps=10,
+           buckets={"compute": 8.0})
+    agg.tick(now=1010.0)
+    g0 = telemetry(store, "gp-susp")["goodput"]
+    # operator suspends the job: Running flips off, Suspended on
+    cur = store.get("TPUJob", "default", "gp-susp")
+    cond.update_job_conditions(
+        cur.status, ConditionType.SUSPENDED, "TPUJobSuspended",
+        "suspended")
+    store.update(cur)
+    agg.tick(now=1060.0)
+    agg.tick(now=1110.0)
+    # the gauge is withdrawn (no decaying series to page on) and NO
+    # downtime was charged for the deliberate suspension
+    assert "gp-susp" not in metrics.job_goodput_ratio.render()
+    # resume: the suspension window is EXCLUDED from the wall
+    cur = store.get("TPUJob", "default", "gp-susp")
+    cond.update_job_conditions(
+        cur.status, ConditionType.SUSPENDED, "TPUJobResumed", "resumed",
+        False)
+    cond.update_job_conditions(
+        cur.status, ConditionType.RUNNING, "TPUJobRunning", "running")
+    store.update(cur)
+    agg.tick(now=1111.0)
+    tel = telemetry(store, "gp-susp")
+    assert tel["buckets"][BUCKET_RESTART] == pytest.approx(0.0, abs=1.1)
+    assert tel["goodput"] == pytest.approx(g0, abs=0.1)
+
+
+def test_finished_job_drops_gauges(harness):
+    store, agg = harness
+    job = make_job(store, "gp-done", workers=1, start=1000.0)
+    make_pod(store, job, 0)
+    report(store, "gp-done-worker-0", step=5, steps=5,
+           buckets={"compute": 5.0})
+    agg.tick(now=1010.0)
+    assert "gp-done" in metrics.job_goodput_ratio.render()
+    job = store.get("TPUJob", "default", "gp-done")
+    cond.update_job_conditions(
+        job.status, ConditionType.SUCCEEDED, "TPUJobSucceeded", "done")
+    store.update(job)
+    agg.tick(now=1012.0)
+    assert "gp-done" not in metrics.job_goodput_ratio.render()
+
+
+# ---------------------------------------------------------------------------
+# hollow train timelines
+# ---------------------------------------------------------------------------
+
+
+def test_train_load_model_is_seeded_deterministic():
+    from mpi_operator_tpu.executor.hollow import TrainLoadModel
+
+    tapes = []
+    for _ in range(2):
+        m = TrainLoadModel(step_ms=50.0, compile_s=0.5, seed=3)
+        m.set_stall("ns/j", "input", 0.6)
+        tapes.append([m.advance("ns/j", "ns/j-worker-0", "u1", 0.5)
+                      for _ in range(6)])
+    assert tapes[0] == tapes[1]
+    last = tapes[0][-1]
+    b = last["buckets"]
+    # the stall's stolen share dominates every non-compute bucket
+    assert b["input"] > max(b["sync"], b["ckpt"], b["compile"])
+    assert last["steps"] > 0
+
+
+def test_train_load_model_straggler_stretches_p50():
+    from mpi_operator_tpu.executor.hollow import TrainLoadModel
+
+    m = TrainLoadModel(step_ms=50.0, compile_s=0.0, seed=1)
+    m.set_straggler("ns/j-worker-1", 3.0)
+    fast = m.advance("ns/j", "ns/j-worker-0", "u0", 1.0)
+    slow = m.advance("ns/j", "ns/j-worker-1", "u1", 1.0)
+    assert slow["step_p50_ms"] > 2.5 * fast["step_p50_ms"]
+    assert slow["steps"] < fast["steps"]
+    # new incarnation restarts its counters (the reset shape)
+    again = m.advance("ns/j", "ns/j-worker-1", "u2", 1.0)
+    assert again["steps"] <= slow["steps"] + 1
+    with pytest.raises(ValueError):
+        m.set_stall("ns/j", "bogus", 0.5)
+    with pytest.raises(ValueError):
+        m.set_stall("ns/j", "input", 1.5)
+
+
+# ---------------------------------------------------------------------------
+# the profile watcher (fake backend: no jax needed)
+# ---------------------------------------------------------------------------
+
+
+def _write_request(cfg_dir, req):
+    with open(os.path.join(cfg_dir, "profile"), "w") as f:
+        f.write(req if isinstance(req, str) else json.dumps(req))
+
+
+def test_profile_watcher_lifecycle(tmp_path):
+    from mpi_operator_tpu.ops.profiling import ProfileRequestWatcher
+
+    cfg = tmp_path / "cfg"
+    cfg.mkdir()
+    calls = []
+    rec = StepStatsRecorder(str(tmp_path / "s.json"), interval=0.0,
+                            clock=FakeClock())
+    w = ProfileRequestWatcher(
+        rec, config_dir=str(cfg), out_root=str(tmp_path / "prof"),
+        host_index=0,
+        start_trace=lambda d: calls.append(("start", d)),
+        stop_trace=lambda: calls.append(("stop",)),
+    )
+    w.poll(10)  # no request file yet
+    assert not calls
+    _write_request(str(cfg), {"id": "r1", "steps": 3})
+    w.poll(10)
+    assert calls == [("start", str(tmp_path / "prof" / "r1" / "host0"))]
+    assert read_stats(str(tmp_path / "s.json"))["profile"]["state"] \
+        == "capturing"
+    w.observe(11)
+    w.observe(12)
+    assert len(calls) == 1  # window not elapsed
+    w.observe(13)
+    assert calls[-1] == ("stop",)
+    prof = read_stats(str(tmp_path / "s.json"))["profile"]
+    assert prof["state"] == "done" and prof["id"] == "r1"
+    assert os.path.isdir(prof["dir"])
+    # same id never re-fires; a NEW id does
+    w.poll(20)
+    assert len(calls) == 2
+    _write_request(str(cfg), {"id": "r2", "steps": 1})
+    w.poll(20)
+    assert calls[-1] == ("start", str(tmp_path / "prof" / "r2" / "host0"))
+    w.close()  # mid-capture close stops and acks
+    assert calls[-1] == ("stop",)
+    assert read_stats(str(tmp_path / "s.json"))["profile"]["state"] == "done"
+    # a RELAUNCHED worker (fresh watcher, same shared artifact dir) must
+    # NOT re-capture an id whose host dir already holds a trace — the
+    # annotation is never cleared, so the dir is the durable marker
+    (tmp_path / "prof" / "r1" / "host0" / "trace.xplane").write_text("x")
+    calls2 = []
+    w2 = ProfileRequestWatcher(
+        rec, config_dir=str(cfg), out_root=str(tmp_path / "prof"),
+        host_index=0,
+        start_trace=lambda d: calls2.append(("start", d)),
+        stop_trace=lambda: calls2.append(("stop",)),
+    )
+    _write_request(str(cfg), {"id": "r1", "steps": 3})
+    w2.poll(100)
+    assert not calls2  # no re-capture
+    prof = read_stats(str(tmp_path / "s.json"))["profile"]
+    assert prof["id"] == "r1" and prof["state"] == "done"
+
+
+def test_profile_watcher_ignores_garbage(tmp_path):
+    from mpi_operator_tpu.ops.profiling import ProfileRequestWatcher
+
+    cfg = tmp_path / "cfg"
+    cfg.mkdir()
+    calls = []
+    w = ProfileRequestWatcher(
+        None, config_dir=str(cfg), out_root=str(tmp_path / "p"),
+        host_index=0,
+        start_trace=lambda d: calls.append(d),
+        stop_trace=lambda: None,
+    )
+    _write_request(str(cfg), "not json{")
+    w.poll(1)
+    _write_request(str(cfg), {"steps": 5})  # no id
+    w.poll(2)
+    assert not calls
+    # a NUMERIC id is normalized: it captures once, never re-fires on
+    # every later poll (the forever-new-request loop)
+    _write_request(str(cfg), {"id": 123, "steps": 1})
+    w.poll(3)
+    w.observe(4)
+    w.poll(5)
+    w.poll(6)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO: the gauge_min kind + the goodput-collapse objective
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_min_error_fraction_counts_below_floor():
+    from mpi_operator_tpu.controller.slo_monitor import (
+        BurnPolicy,
+        Objective,
+        error_fractions,
+    )
+    from mpi_operator_tpu.machinery.telemetry import SeriesRing
+
+    ring = SeriesRing()
+    now = 1000.0
+    # one healthy job, one collapsed job: the WORST series drives it
+    for i in range(10):
+        t = now - 10 + i
+        ring.record("tpu_operator_job_goodput_ratio", {"job": "a/ok"},
+                    0.9, t)
+        ring.record("tpu_operator_job_goodput_ratio", {"job": "a/bad"},
+                    0.2 if i >= 5 else 0.9, t)
+    obj = Objective(name="g", metric="tpu_operator_job_goodput_ratio",
+                    kind="gauge_min", objective=0.95, bound=0.5)
+    policy = BurnPolicy(fast=(5.0, 10.0), slow=(20.0, 40.0))
+    fracs = error_fractions(ring, obj, policy, now)
+    # fast_short window [995,1000] holds only the collapsed samples
+    assert fracs["fast_short"] == pytest.approx(1.0)
+    assert fracs["fast_long"] == pytest.approx(0.5)
+    # gauge_max on the same tape sees nothing above a 1.0 ceiling
+    obj_max = Objective(name="g2", metric="tpu_operator_job_goodput_ratio",
+                        kind="gauge_max", objective=0.95, bound=1.0)
+    assert error_fractions(ring, obj_max, policy, now)["fast_long"] == 0.0
+
+
+def test_gauge_min_loader_validation(tmp_path):
+    from mpi_operator_tpu.controller.slo_monitor import (
+        SLOConfigError,
+        load_slo_config,
+    )
+
+    def write(doc):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    base = {
+        "windows": {"fast": [5, 60], "slow": [30, 360]},
+        "objectives": [{
+            "name": "goodput", "kind": "gauge_min",
+            "metric": "tpu_operator_job_goodput_ratio",
+            "bound": 0.5, "objective": 0.95,
+        }],
+    }
+    cfg = load_slo_config(write(base))
+    assert cfg.objective("goodput").kind == "gauge_min"
+    bad = dict(base, objectives=[dict(
+        base["objectives"][0],
+        metric="tpu_operator_reconcile_latency_seconds")])
+    with pytest.raises(SLOConfigError, match="gauge family"):
+        load_slo_config(write(bad))
+
+
+def test_default_config_has_goodput_collapse():
+    from mpi_operator_tpu.controller.slo_monitor import load_slo_config
+
+    o = load_slo_config().objective("goodput-collapse")
+    assert o.kind == "gauge_min"
+    assert o.metric == "tpu_operator_job_goodput_ratio"
+    assert 0 < o.bound < 1
+    # full collapse must clear BOTH burn thresholds (fires, not ticket
+    # noise): error fraction 1.0 / budget > fast burn threshold
+    assert 1.0 / (1.0 - o.objective) > 14.4
+
+
+# ---------------------------------------------------------------------------
+# ctl: top --jobs and profile
+# ---------------------------------------------------------------------------
+
+
+def test_ctl_top_jobs_and_profile(tmp_path, capsys):
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_PROFILE_REQUEST,
+    )
+    from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+    from mpi_operator_tpu.opshell import ctl
+
+    path = str(tmp_path / "ctl.db")
+    store = SqliteStore(path)
+    spec = f"sqlite:{path}"
+    healthy = make_job(store, "fine", workers=1)
+    healthy.status.train_telemetry = {
+        "goodput": 0.8, "step_p50_ms": 12.0, "steps": 100,
+        "dominant_stall": "ckpt", "straggler": "",
+    }
+    store.update(healthy)
+    assert ctl.main(["--store", spec, "top", "--jobs"]) == 0
+    out = capsys.readouterr().out
+    assert "fine" in out and "80%" in out and "ckpt" in out
+
+    sick = make_job(store, "slow", workers=1)
+    sick.status.train_telemetry = {
+        "goodput": 0.1, "step_p50_ms": 900.0, "steps": 5,
+        "dominant_stall": "input", "straggler": "",
+    }
+    store.update(sick)
+    # a running job below the goodput-collapse floor gates the rc
+    assert ctl.main(["--store", spec, "top", "--jobs"]) == 1
+    out = capsys.readouterr().out
+    assert "input" in out and "goodput-collapse" in out
+
+    # profile: stamp → annotation lands; --status before any ack → rc 1
+    assert ctl.main(["--store", spec, "profile", "fine",
+                     "--steps", "3"]) == 0
+    req = json.loads(
+        store.get("TPUJob", "default", "fine")
+        .metadata.annotations[ANNOTATION_PROFILE_REQUEST])
+    assert req["steps"] == 3 and req["id"]
+    assert ctl.main(["--store", spec, "profile", "fine",
+                     "--status"]) == 1
+    capsys.readouterr()
+    # one of TWO pods acks done → --status must STAY 1 (a subset-done
+    # rc=0 would let a script fetch half the gang's traces silently)
+    pod = make_pod(store, healthy, 0)
+    straggler_pod = make_pod(store, healthy, 1)
+    trace_dir = tmp_path / "prof" / req["id"] / "host0"
+    trace_dir.mkdir(parents=True)
+    (trace_dir / "trace.xplane").write_text("x")
+    pod = store.get("Pod", "default", "fine-worker-0")
+    pod.status.train_stats = bounded_train_stats(
+        step=5, steps=5,
+        profile={"id": req["id"], "state": "done", "dir": str(trace_dir)},
+    )
+    store.update(pod)
+    assert ctl.main(["--store", spec, "profile", "fine", "--status"]) == 1
+    capsys.readouterr()
+    # the second worker finishes too → rc flips to 0
+    straggler_pod = store.get("Pod", "default", "fine-worker-1")
+    straggler_pod.status.train_stats = bounded_train_stats(
+        step=5, steps=5,
+        profile={"id": req["id"], "state": "done", "dir": str(trace_dir)},
+    )
+    store.update(straggler_pod)
+    assert ctl.main(["--store", spec, "profile", "fine", "--status"]) == 0
+    dest = tmp_path / "fetched"
+    assert ctl.main(["--store", spec, "profile", "fine", "--fetch",
+                     "--dest", str(dest)]) == 0
+    assert (dest / "fine-worker-0" / "trace.xplane").exists()
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# the verify-gate smoke is importable and wired (full run is the gate)
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_entrypoint_exists():
+    from mpi_operator_tpu.runtime import stepstats
+
+    assert callable(stepstats.smoke)
+    assert stepstats.main([]) == 2  # no flags: usage, not a crash
+
+
+# ---------------------------------------------------------------------------
+# review regressions: field ownership + CLI races + watcher robustness
+# ---------------------------------------------------------------------------
+
+
+def test_controller_status_write_never_erases_train_telemetry():
+    """The reconcile loop's status merge-patch must never carry
+    train_telemetry — that field is the goodput aggregator's. A
+    reconcile whose job snapshot predates the aggregator's rollup patch
+    (informer lag) would otherwise diff stored-has-blob vs
+    snapshot-lacks-blob into train_telemetry: null and erase it."""
+    import copy
+
+    from mpi_operator_tpu.controller.controller import TPUJobController
+
+    store = ObjectStore()
+    controller = TPUJobController(store, EventRecorder(store))
+    job = make_job(store, "gp-own", workers=1)
+    # the reconcile's in-memory snapshot: taken BEFORE the aggregator
+    # wrote the rollup, and with its own status change pending so the
+    # write is not elided
+    snapshot = copy.deepcopy(job)
+    snapshot.status.restart_count = 1
+    assert snapshot.status.train_telemetry is None
+    # the aggregator lands its rollup in between
+    store.patch(
+        "TPUJob", "default", "gp-own",
+        {"metadata": {"uid": job.metadata.uid},
+         "status": {"train_telemetry": {"goodput": 0.9, "steps": 10}}},
+        subresource="status",
+    )
+    assert controller._default_write_status(snapshot)
+    after = store.get("TPUJob", "default", "gp-own")
+    assert after.status.restart_count == 1  # the controller's change
+    assert after.status.train_telemetry == {
+        "goodput": 0.9, "steps": 10}  # the aggregator's survived
+    # and a snapshot differing ONLY in train_telemetry is a no-op write
+    rv = after.metadata.resource_version
+    snap2 = copy.deepcopy(after)
+    snap2.status.train_telemetry = None
+    assert controller._default_write_status(snap2)
+    assert store.get("TPUJob", "default",
+                     "gp-own").metadata.resource_version == rv
+
+
+def test_profile_watcher_survives_host_index_failure(tmp_path):
+    """_host() lazily imports jax — if that itself fails (no profiler
+    build, half-initialized jax.distributed) the poll must ack failed,
+    not propagate: the annotation is never cleared, so a propagated
+    exception would crash-loop every relaunched incarnation."""
+    from mpi_operator_tpu.ops.profiling import ProfileRequestWatcher
+
+    cfg = tmp_path / "cfg"
+    cfg.mkdir()
+    rec = StepStatsRecorder(str(tmp_path / "s.json"), interval=0.0,
+                            clock=FakeClock())
+    w = ProfileRequestWatcher(
+        rec, config_dir=str(cfg), out_root=str(tmp_path / "prof"),
+        start_trace=lambda d: None, stop_trace=lambda: None,
+    )
+
+    def boom():
+        raise RuntimeError("jax backend unavailable")
+
+    w._host = boom
+    _write_request(str(cfg), {"id": "hx", "steps": 3})
+    w.poll(1)  # must not raise
+    prof = read_stats(str(tmp_path / "s.json"))["profile"]
+    assert prof["id"] == "hx" and prof["state"] == "failed"
+
+
+def test_ctl_profile_stamp_race_is_an_error_not_a_traceback(capsys):
+    """A job deleted (NotFound) or recreated (Conflict on the uid pin)
+    between cmd_profile's read and its annotation stamp must exit 1
+    with a clean error, like every other mutating verb."""
+    import argparse
+
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.machinery.store import NotFound
+    from mpi_operator_tpu.opshell import ctl
+
+    store = ObjectStore()
+    make_job(store, "gone", workers=1)
+    client = TPUJobClient(store, namespace="default")
+
+    real_patch = store.patch
+
+    def racing_patch(*a, **kw):
+        raise NotFound("TPUJob default/gone")
+
+    store.patch = racing_patch
+    try:
+        args = argparse.Namespace(name="gone", steps=3, status=False,
+                                  fetch=False, dest=None)
+        rc = ctl.cmd_profile(client, args)
+    finally:
+        store.patch = real_patch
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err

@@ -57,25 +57,43 @@ def _device_info():
     return len(devices), kind, peak
 
 
-def _timed_steps(trainer, state, batch, steps, warmup, steps_per_call=1):
+def _timed_steps(trainer, state, batch, steps, warmup, steps_per_call=1,
+                 batches=None):
     """Time ``steps`` training steps; with steps_per_call > 1 the inner
     steps run as one lax.scan dispatch (Trainer.multi_step — ≙ the
     reference benchmark's steps-per-session-run), which removes per-step
     host dispatch overhead (~5 ms/step on ResNet-101, real throughput the
-    per-call path leaves on the table)."""
+    per-call path leaves on the table).
+
+    ``batches`` (optional iterator, e.g. ops.data.prefetch) switches to
+    streamed input: every call fetches a fresh device-resident batch, so
+    the timed region includes whatever input cost the pipeline fails to
+    hide — the honest way to measure input overlap.
+
+    Returns (dt, steps, compile_s, warmup_s): the first call is timed
+    separately as ``compile_s`` (trace + XLA compile + one step; with a
+    warm persistent compile cache this collapses toward one step) and the
+    remaining warmup calls as ``warmup_s``, so restart-latency wins show
+    up as a compile_s drop instead of hiding in one merged number."""
     import jax
 
     def run(state):
+        b = next(batches) if batches is not None else batch
         if steps_per_call == 1:
-            return trainer.train_step(state, batch)
-        return trainer.multi_step(state, batch, steps_per_call)
+            return trainer.train_step(state, b)
+        return trainer.multi_step(state, b, steps_per_call)
 
     t0 = time.perf_counter()
-    for _ in range(warmup):
+    state, metrics = run(state)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(warmup - 1):
         state, metrics = run(state)
     jax.block_until_ready(metrics["loss"])
+    warmup_s = time.perf_counter() - t0
     print(
-        f"[bench] compile+warmup {time.perf_counter() - t0:.1f}s, "
+        f"[bench] compile {compile_s:.1f}s, warmup {warmup_s:.1f}s, "
         f"loss={float(metrics['loss']):.3f}",
         file=sys.stderr,
     )
@@ -85,7 +103,7 @@ def _timed_steps(trainer, state, batch, steps, warmup, steps_per_call=1):
     for _ in range(calls):
         state, metrics = run(state)
     jax.block_until_ready(metrics["loss"])
-    return time.perf_counter() - t0, calls * steps_per_call
+    return time.perf_counter() - t0, calls * steps_per_call, compile_s, warmup_s
 
 
 def bench_resnet():
@@ -93,7 +111,12 @@ def bench_resnet():
 
     from mpi_operator_tpu.models import resnet
     from mpi_operator_tpu.ops import Trainer, TrainerConfig
-    from mpi_operator_tpu.ops.data import make_global_batch, synthetic_imagenet
+    from mpi_operator_tpu.ops.data import (
+        imagenet_normalize,
+        make_global_batch,
+        prefetch,
+        synthetic_imagenet,
+    )
     from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 
     n_chips, kind, peak = _device_info()
@@ -106,7 +129,12 @@ def bench_resnet():
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))  # ≥1: first
     # step compiles and binds `metrics` for the sync below
 
-    cfg = resnet.Config(depth="resnet101")
+    # depth/size knobs exist for CPU smoke runs; the headline stays the
+    # defaults (ResNet-101 @ 224, the reference benchmark's shape)
+    cfg = resnet.Config(
+        depth=os.environ.get("BENCH_RESNET_DEPTH", "resnet101"),
+        image_size=int(os.environ.get("BENCH_IMAGE_SIZE", "224")),
+    )
     mesh = build_mesh(MeshPlan.data_parallel(n_chips))
     params, mstate = resnet.init(cfg, jax.random.PRNGKey(0))
     paxes, saxes = resnet.logical_axes(cfg)
@@ -119,15 +147,43 @@ def bench_resnet():
         model_state_axes=saxes,
     )
     state = trainer.init_state(params, mstate)
-    batch = make_global_batch(
-        mesh,
-        next(synthetic_imagenet(global_batch=global_batch, image_size=cfg.image_size)),
-    )
+
+    # input mode (ISSUE 16 tentpole c): "stream" (default) feeds every timed
+    # call through the REAL input path — uint8 host batches double-buffered
+    # by ops.data.prefetch with the normalize cast placed on-device — so the
+    # headline includes any input cost the pipeline fails to hide. "fixed"
+    # is the old one-resident-batch mode (pure-compute ceiling, the
+    # BENCH_r01–r15 convention), kept for A/B: stream-vs-fixed is the
+    # measured input-overlap gap.
+    input_mode = os.environ.get("BENCH_INPUT", "stream")
+    batch = batches = None
+    if input_mode == "stream":
+        host_it = synthetic_imagenet(
+            global_batch=global_batch, image_size=cfg.image_size, dtype="uint8"
+        )
+        batches = prefetch(
+            host_it,
+            mesh,
+            depth=int(os.environ.get("BENCH_PREFETCH_DEPTH", "2")),
+            device_transform=imagenet_normalize(),
+        )
+    else:
+        batch = make_global_batch(
+            mesh,
+            next(synthetic_imagenet(
+                global_batch=global_batch, image_size=cfg.image_size
+            )),
+        )
 
     steps_per_call = int(os.environ.get("BENCH_STEPS_PER_CALL", "10"))
-    dt, steps = _timed_steps(
-        trainer, state, batch, steps, warmup, steps_per_call=steps_per_call
-    )
+    try:
+        dt, steps, compile_s, warmup_s = _timed_steps(
+            trainer, state, batch, steps, warmup,
+            steps_per_call=steps_per_call, batches=batches,
+        )
+    finally:
+        if batches is not None:
+            batches.close()  # release the prefetch producer + its buffers
 
     imgs_per_sec = global_batch * steps / dt
     per_chip = imgs_per_sec / n_chips
@@ -143,8 +199,11 @@ def bench_resnet():
                 "chips": n_chips,
                 "device": kind,
                 "global_batch": global_batch,
+                "input": input_mode,
                 "mfu": round(mfu, 4),
                 "step_ms": round(1000 * dt / steps, 2),
+                "compile_s": round(compile_s, 2),
+                "warmup_s": round(warmup_s, 2),
             }
         )
     )
@@ -205,6 +264,8 @@ def llama_setup(per_chip_batch: int, seq_len: int):
     from mpi_operator_tpu.ops.data import make_global_batch, synthetic_tokens
     from mpi_operator_tpu.runtime import MeshPlan, build_mesh
 
+    import dataclasses
+
     n_chips = jax.device_count()
     global_batch = per_chip_batch * n_chips
     if jax.default_backend() != "tpu":
@@ -213,6 +274,13 @@ def llama_setup(per_chip_batch: int, seq_len: int):
         cfg = llama.bench_long_context()  # smaller vocab: activations win
     else:
         cfg = llama.bench_single_chip()
+    # BENCH_QUANT=int8|fp8 (ISSUE 16): run the FFN matmuls on the MXU's
+    # narrow-dtype tier (kernels.quant_matmul). Default bf16 — the exact
+    # baseline; the output JSON carries the flag so quant MFU claims are
+    # never conflated with the bf16 series.
+    quant = os.environ.get("BENCH_QUANT", "bf16")
+    if quant != "bf16":
+        cfg = dataclasses.replace(cfg, matmul_precision=quant)
     mesh = build_mesh(MeshPlan.data_parallel(n_chips))
     params = llama.init(cfg, jax.random.PRNGKey(0))
     trainer = Trainer(
@@ -262,7 +330,9 @@ def bench_llama(*, seq_len=None, per_chip_batch=None,
         per_chip_batch, seq_len
     )
 
-    dt, steps = _timed_steps(trainer, state, batch, steps, warmup)
+    dt, steps, compile_s, warmup_s = _timed_steps(
+        trainer, state, batch, steps, warmup
+    )
 
     tokens_per_sec = global_batch * seq_len * steps / dt
     per_chip = tokens_per_sec / n_chips
@@ -279,8 +349,11 @@ def bench_llama(*, seq_len=None, per_chip_batch=None,
                 "params": llama.param_count(cfg),
                 "global_batch": global_batch,
                 "seq_len": seq_len,
+                "matmul_precision": cfg.matmul_precision,
                 "mfu": round(mfu, 4),
                 "step_ms": round(1000 * dt / steps, 2),
+                "compile_s": round(compile_s, 2),
+                "warmup_s": round(warmup_s, 2),
                 "flash_kernel_max_err": flash_err,
             }
         )

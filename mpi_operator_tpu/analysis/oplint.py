@@ -72,6 +72,16 @@ Rule catalog (rationale → the PR that motivated each):
   is the replication apply seam (``apply_replicated``/``install_snapshot``
   /``append_entries``/``load_snapshot``), which the checker exempts by
   enclosing-function name.
+- **CKP001** a blocking checkpoint-commit wait (``mgr.wait()``,
+  ``manager.wait_until_finished()``) reached from step-loop code
+  (train/elastic/step-loop-named functions) outside the sanctioned seams.
+  ISSUE 16 made periodic saves async — the disk commit overlaps the next
+  steps and the ``ckpt`` stall bucket charges only the blocking snapshot
+  slice; a wait inside the step loop re-serializes every save and
+  resurrects the periodic goodput spike the async path removed. The
+  sanctioned blocking seams: the force-checkpoint/terminal-exit helper
+  (``_final_checkpoint``, ops/elastic.py), the pre-restore fence
+  (``restore``), and teardown (``close``).
 - **OBS004** a ``train_stats``/``serve_stats`` status blob constructed
   outside the bounded-blob helpers (``bounded_train_stats``/
   ``bounded_serve_stats``, machinery/objects.py). ISSUE 15: status blobs
@@ -255,6 +265,20 @@ RULES: Dict[str, Rule] = {
             "controller is already migrating; route through the "
             "DrainController (or the serve controller's _drain_replica "
             "retire seam)",
+        ),
+        Rule(
+            "CKP001", "error",
+            "blocking checkpoint-commit wait in step-loop code outside "
+            "the sanctioned final-checkpoint/restore/teardown seams",
+            "ISSUE 16: periodic saves are async — the disk commit "
+            "overlaps the next steps and the `ckpt` bucket charges only "
+            "the blocking snapshot slice. A mgr.wait() / "
+            "wait_until_finished() reached from the step loop "
+            "re-serializes every save behind its fsync, resurrecting the "
+            "periodic goodput stall the async path removed. Block only "
+            "in the sanctioned seams: _final_checkpoint (SIGTERM "
+            "force-checkpoint / terminal exit), restore (pre-restore "
+            "fence), close (teardown)",
         ),
         Rule(
             "REP001", "error",
@@ -693,6 +717,45 @@ def _check_dis001(ctx: _FileCtx, call: ast.Call,
             f"sanctioned seam; route through the drain plane (or the "
             f"serve controller's _drain_replica retire seam)",
         )
+
+
+# CKP001: blocking checkpoint-commit waits reached from step-loop code.
+# Matching mirrors DIS001/REP001: enclosing-function-name flavor for the
+# path ("am I in train/elastic/step-loop code?"), receiver last-component
+# flavor for the handle ("does this look like a checkpoint manager?"),
+# and a seam-function exemption for the sanctioned blocking sites.
+_CKPT_WAIT_VERBS = {"wait", "wait_until_finished"}
+_CKPT_RECV_COMPONENTS = ("mgr", "manager", "ckpt", "checkpoint", "checkpointer")
+_STEP_LOOP_FN_RE = re.compile(r"(^|_)(train|elastic|step_loop|run_steps)", re.I)
+# the sanctioned blocking seams: the force-checkpoint/terminal-exit helper,
+# the pre-restore fence, and teardown (ops/elastic.py, ops/checkpoint.py)
+_CKPT_SEAM_FNS = {"_final_checkpoint", "restore", "close", "wait"}
+
+
+def _is_ckpt_manager_like(recv: Optional[str]) -> bool:
+    last = _last_component(recv)
+    return last in _CKPT_RECV_COMPONENTS or last.endswith(_CKPT_RECV_COMPONENTS)
+
+
+def _check_ckp001(ctx: _FileCtx, call: ast.Call,
+                  fn_stack: List[str]) -> None:
+    if not any(_STEP_LOOP_FN_RE.search(name) for name in fn_stack):
+        return
+    if any(name in _CKPT_SEAM_FNS for name in fn_stack):
+        return
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _CKPT_WAIT_VERBS:
+        return
+    if not _is_ckpt_manager_like(_dotted(f.value)):
+        return
+    ctx.report(
+        "CKP001", call,
+        f"blocking checkpoint wait {f.attr}(...) in the step-loop path "
+        f"{fn_stack[-1]!r} re-serializes async saves behind their disk "
+        f"commit (the periodic `ckpt` goodput stall ISSUE 16 removed); "
+        f"let the commit overlap and fence it only in the sanctioned "
+        f"seams (_final_checkpoint / restore / close)",
+    )
 
 
 def _check_obs001(ctx: _FileCtx, call: ast.Call,
@@ -1150,6 +1213,7 @@ def lint_source(
             _check_dur001(ctx, node, fn_stack)
             _check_rep001(ctx, node, fn_stack)
             _check_dis001(ctx, node, fn_stack)
+            _check_ckp001(ctx, node, fn_stack)
             _check_obs001(ctx, node, with_context_calls)
             _check_obs003(ctx, node, file_catalog)
             if lock_depth > 0:

@@ -30,6 +30,10 @@ DEFAULT_SLOTS_PER_WORKER = 1
 DEFAULT_WORKER_REPLICAS = 1
 DEFAULT_RESTART_POLICY = RestartPolicy.NEVER
 DEFAULT_ACCELERATOR = "cpu"
+# the persistent compile cache defaults ON (ISSUE 16): restart paths are
+# exactly where the operator spends its cleverness, and a warm cache is
+# what makes them cheap; the spec knob exists to opt OUT
+DEFAULT_COMPILE_CACHE = True
 
 # TPUServe defaults: serving outranks batch by default (the workload-class
 # distinction — see TPUServeSpec), one-host gangs, a Deployment-shaped
@@ -79,6 +83,8 @@ def set_defaults(job: TPUJob) -> TPUJob:
             spec.elastic.min_replicas = 1
         if spec.elastic.max_replicas is None:
             spec.elastic.max_replicas = spec.worker.replicas
+    if spec.compile_cache is None:
+        spec.compile_cache = DEFAULT_COMPILE_CACHE
     return job
 
 

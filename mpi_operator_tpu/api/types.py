@@ -482,16 +482,24 @@ class TPUJobSpec(_Dictable):
     worker: ReplicaSpec = field(default_factory=ReplicaSpec)
     slice: SliceSpec = field(default_factory=SliceSpec)
     elastic: Optional[ElasticPolicy] = None
+    # persistent XLA compile cache (ISSUE 16): defaulted ON — warm gang
+    # restarts/rescales reuse the node-local cache the executor owns
+    # instead of repaying the compile warmup. Projected to workers as
+    # $TPUJOB_COMPILE_CACHE; opt out for workloads whose programs are
+    # shape-polymorphic enough that cache churn outweighs reuse.
+    compile_cache: Optional[bool] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "TPUJobSpec":
         el = d.get("elastic")
+        cc = d.get("compile_cache")
         return TPUJobSpec(
             slots_per_worker=d.get("slots_per_worker"),
             run_policy=RunPolicy.from_dict(d.get("run_policy", {})),
             worker=ReplicaSpec.from_dict(d.get("worker", {})),
             slice=SliceSpec.from_dict(d.get("slice", {})),
             elastic=ElasticPolicy.from_dict(el) if el else None,
+            compile_cache=None if cc is None else bool(cc),
         )
 
 

@@ -111,6 +111,12 @@ ENV_HOST_MESH = "TPUJOB_HOST_MESH"
 ENV_HOST_COORD = "TPUJOB_HOST_COORD"
 ENV_SLICE_ID = "TPUJOB_SLICE_ID"
 ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
+# spec.compile_cache projection ("1"/"0", ISSUE 16): the EXECUTOR reads
+# this gate and, when on, injects its node-local persistent-cache dir as
+# $TPUJOB_COMPILE_CACHE_DIR (runtime/compile_cache.py owns that name —
+# same split as the stepstats file: controller knows policy, executor
+# knows node paths)
+ENV_COMPILE_CACHE = "TPUJOB_COMPILE_CACHE"
 
 DEFAULT_COORDINATOR_PORT = 8476
 
@@ -779,6 +785,9 @@ class TPUJobController:
                 ENV_HOST_COORD: "x".join(map(str, placement.host_coords[index])),
                 ENV_SLICE_ID: str(placement.slice_ids[index]),
                 ENV_NUM_SLICES: str(placement.num_slices),
+                ENV_COMPILE_CACHE: (
+                    "0" if job.spec.compile_cache is False else "1"
+                ),
             }
         )
         container.env = env

@@ -439,7 +439,8 @@ def bounded_serve_stats(qps=0.0, queue_depth=0.0, p99_ms=0.0,
 
 
 def bounded_train_stats(step=0, steps=0, step_p50_ms=0.0, buckets=None,
-                        profile=None, **_ignored) -> Dict[str, object]:
+                        profile=None, compile_cache=None,
+                        **_ignored) -> Dict[str, object]:
     """THE constructor for a pod's ``status.train_stats`` blob (oplint
     OBS004). Fixed key set, rounded floats, bucket keys clamped to the
     :data:`TRAIN_BUCKETS` taxonomy, profile ack clamped to short strings
@@ -468,6 +469,15 @@ def bounded_train_stats(step=0, steps=0, step_p50_ms=0.0, buckets=None,
     if isinstance(profile, dict) and profile:
         out["profile"] = {
             k: str(profile.get(k, ""))[:256] for k in _PROFILE_KEYS
+        }
+    if isinstance(compile_cache, dict) and compile_cache:
+        # persistent-compile-cache hit/miss counts (ISSUE 16): present
+        # only when the worker configured the cache, so the `compile`
+        # bucket can be read as warm (hits, near-zero seconds) vs cold
+        # (misses, the full warmup). Two bounded ints, per incarnation.
+        out["compile_cache"] = {
+            "hits": _i(compile_cache.get("hits")),
+            "misses": _i(compile_cache.get("misses")),
         }
     return out
 

@@ -57,6 +57,13 @@ class Config:
     # (tests/small cases only); "flash" forces the kernel path. A sharded
     # sequence axis always takes the ring — the only exact option there.
     attention_impl: str = "auto"
+    # FFN matmul precision (ISSUE 16): "bf16" is the exact baseline; "int8"
+    # / "fp8" route w_gate/w_up/w_down (~2/3 of model FLOPs) through
+    # kernels.quant_matmul — dynamically quantized forward on the MXU's
+    # narrow-dtype tier, full-precision straight-through backward.
+    # Attention and the lm_head stay bf16: they are numerically the
+    # touchiest matmuls and a minority of the FLOPs.
+    matmul_precision: str = "bf16"
     # checkpoint each scan layer: backward stores only the 12-layer stack of
     # [B,T,D] layer inputs instead of every intra-layer intermediate — the
     # remat that actually bounds peak HBM for deep stacks (a whole-loss
@@ -69,6 +76,11 @@ class Config:
             raise ValueError(
                 f"attention_impl={self.attention_impl!r}; "
                 "expected auto|dense|flash"
+            )
+        if self.matmul_precision not in ("bf16", "int8", "fp8"):
+            raise ValueError(
+                f"matmul_precision={self.matmul_precision!r}; "
+                "expected bf16|int8|fp8"
             )
 
     @property
@@ -287,9 +299,24 @@ def apply(
             h = h + attn @ lp["wo"]["w"].astype(dt)
         h = constrain_fwd(h, ["batch", "seq", "embed"])
         y = _rmsnorm(h, lp["mlp_norm"]["scale"], c.norm_eps)
-        gate = jax.nn.silu(y @ lp["w_gate"]["w"].astype(dt))
-        up = y @ lp["w_up"]["w"].astype(dt)
-        h = h + (gate * up) @ lp["w_down"]["w"].astype(dt)
+        if c.matmul_precision == "bf16":
+            gate = jax.nn.silu(y @ lp["w_gate"]["w"].astype(dt))
+            up = y @ lp["w_up"]["w"].astype(dt)
+            h = h + (gate * up) @ lp["w_down"]["w"].astype(dt)
+        else:
+            # quantized FFN (config-gated): forward contraction on the
+            # int8/fp8 MXU tier, backward full-precision (custom_vjp in
+            # kernels.quant_matmul — the straight-through estimator)
+            from mpi_operator_tpu.kernels.quant_matmul import quant_matmul
+
+            mp = c.matmul_precision
+            gate = jax.nn.silu(
+                quant_matmul(y, lp["w_gate"]["w"].astype(dt), precision=mp)
+            )
+            up = quant_matmul(y, lp["w_up"]["w"].astype(dt), precision=mp)
+            h = h + quant_matmul(
+                gate * up, lp["w_down"]["w"].astype(dt), precision=mp
+            )
         h = constrain_fwd(h, ["batch", "seq", "embed"])
         return h, None
 

@@ -28,6 +28,7 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         save_interval_steps: int = 1000,
+        async_save: bool = True,
     ):
         import orbax.checkpoint as ocp
 
@@ -39,12 +40,24 @@ class CheckpointManager:
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 create=True,
+                # async commit (ISSUE 16): save() returns once the device
+                # arrays are snapshotted host-side; serialization to disk
+                # overlaps the NEXT steps on orbax's background thread.
+                # The step loop then charges only that blocking snapshot
+                # slice to its `ckpt` bucket — the commit costs goodput
+                # nothing. Durability is unchanged WHERE IT MATTERS: the
+                # sanctioned seams (SIGTERM force-checkpoint, terminal
+                # exit, pre-restore) call wait() to fence the commit.
+                enable_async_checkpointing=async_save,
             ),
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Save if the step hits the interval (or force). Multi-host safe:
-        every process must call this (orbax coordinates the barrier)."""
+        every process must call this (orbax coordinates the barrier).
+        With ``async_save`` (the default) this returns after the blocking
+        device→host snapshot; the disk commit overlaps later steps and is
+        fenced by :meth:`wait`."""
         saved = self.manager.save(
             step, args=self._ocp.args.StandardSave(state), force=force
         )
@@ -57,6 +70,10 @@ class CheckpointManager:
         """Restore into the layout of ``state_template`` (an abstract or
         concrete TrainState whose shardings describe the *current* mesh —
         resharding across gang sizes happens here)."""
+        # pre-restore fence (a sanctioned wait seam, oplint CKP001): an
+        # in-flight async commit of the step being restored must finish
+        # before its files are read back
+        self.wait()
         if step is None:
             step = self.latest_step()
         if step is None:

@@ -106,6 +106,22 @@ def declared_world_size() -> int:
         return sum(1 for line in f if line.strip())
 
 
+def _final_checkpoint(mgr: CheckpointManager, stats: StepStatsRecorder,
+                      step: int, state: Any) -> None:
+    """THE sanctioned blocking-wait seam (oplint CKP001): the only places
+    the step loop may block on a checkpoint COMMIT are the SIGTERM
+    force-checkpoint (the eviction grace window is about to expire — an
+    uncommitted save is a lost step) and the terminal exit (the process
+    is about to vanish). Periodic saves stay async: their commit overlaps
+    the next steps and the `ckpt` bucket charges only the blocking
+    device→host snapshot slice, which is what keeps the goodput pager
+    silent through steady-state saves."""
+    with stats.phase("ckpt"):
+        if mgr.latest_step() != step:
+            mgr.save(step, state, force=True)
+        mgr.wait()
+
+
 def run_elastic(
     trainer: Trainer,
     batches: Iterator[Any],
@@ -199,6 +215,12 @@ def run_elastic(
             prof_watch.observe(step)
             stats.step_done(step)
             if step % config.save_interval_steps == 0:
+                # async save: returns after the blocking device→host
+                # snapshot; the disk commit overlaps the next steps, so
+                # this phase charges only the blocking slice (the old
+                # synchronous save stalled the whole gang here for the
+                # full serialize+fsync — the periodic `ckpt` spike the
+                # goodput pager used to see)
                 with stats.phase("ckpt"):
                     mgr.save(step, state)
             if step % config.membership_check_every == 0:
@@ -210,10 +232,7 @@ def run_elastic(
                     # runs inside the executor's eviction grace window, so
                     # the next incarnation resumes from this step instead
                     # of the last periodic save
-                    with stats.phase("ckpt"):
-                        if mgr.latest_step() != step:
-                            mgr.save(step, state, force=True)
-                        mgr.wait()
+                    _final_checkpoint(mgr, stats, step, state)
                     return ElasticResult(
                         "restart",
                         state,
@@ -221,10 +240,7 @@ def run_elastic(
                         {k: float(v) for k, v in (metrics or {}).items()},
                         start_step=start_step,
                     )
-        with stats.phase("ckpt"):
-            if mgr.latest_step() != step:
-                mgr.save(step, state, force=True)
-            mgr.wait()
+        _final_checkpoint(mgr, stats, step, state)
     finally:
         prof_watch.close()
         stats.close()

@@ -153,6 +153,13 @@ def initialize(
         return _initialized_ctx
     if ctx is None:
         ctx = context_from_env(environ)
+    # the persistent-compile-cache contract (ISSUE 16): when the executor
+    # injected a node-local cache dir, point jax at it BEFORE anything
+    # compiles — a relaunched gang then reads its executables off disk
+    # instead of repaying the 75–98 s warmup
+    from mpi_operator_tpu.runtime import compile_cache
+
+    compile_cache.configure_from_env(environ)
     if ctx.is_distributed:
         import jax
 

@@ -155,10 +155,17 @@ class StepStatsRecorder:
 
     def snapshot(self) -> Dict[str, Any]:
         """The bounded blob (exactly what lands in status.train_stats)."""
+        from mpi_operator_tpu.runtime import compile_cache
+
         return bounded_train_stats(
             step=self._step, steps=self._steps,
             step_p50_ms=self.step_p50_ms(), buckets=self._buckets,
             profile=self._profile,
+            # present only when the persistent compile cache is on for
+            # this process (ISSUE 16) — lets the operator side read the
+            # `compile` bucket as warm-vs-cold instead of just big-vs-small
+            compile_cache=(compile_cache.cache_stats()
+                           if compile_cache.is_configured() else None),
         )
 
     def flush(self, force: bool = False, now: Optional[float] = None) -> None:

@@ -1,0 +1,38 @@
+"""CKP001 fixture: blocking checkpoint-commit waits reached from step-loop
+code outside the sanctioned seams must fire."""
+
+
+def run_train_loop(mgr, trainer, state, batches, total_steps):
+    step = 0
+    while step < total_steps:
+        state, _ = trainer.train_step(state, next(batches))
+        step += 1
+        if step % 100 == 0:
+            mgr.save(step, state)
+            mgr.wait()  # expect: CKP001
+
+
+def run_elastic(manager, trainer, state, batches):
+    for step, batch in enumerate(batches):
+        state, _ = trainer.train_step(state, batch)
+        manager.save(step, state)
+        manager.wait_until_finished()  # expect: CKP001
+
+
+class Worker:
+    def _step_loop(self, state, batches):
+        for step, batch in enumerate(batches):
+            state = self.trainer.train_step(state, batch)
+            if step % self.interval == 0:
+                self.ckpt.save(step, state)
+                self.ckpt.wait()  # expect: CKP001
+
+    def train_epoch(self, state, batches):
+        def flush(step, state):
+            # nested helper still runs inside the step loop's stack
+            self.checkpointer.save(step, state, force=True)
+            self.checkpointer.wait_until_finished()  # expect: CKP001
+
+        for step, batch in enumerate(batches):
+            state = self.trainer.train_step(state, batch)
+            flush(step, state)

@@ -52,6 +52,7 @@ def test_rule_catalog_is_complete():
     assert ids == {
         "RMW001", "UID001", "TERM001", "BLK001", "EXC001", "SEC001", "LCK001",
         "DUR001", "REP001", "OBS001", "OBS002", "OBS003", "OBS004", "DIS001",
+        "CKP001",
     }
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")

@@ -1,0 +1,140 @@
+"""Persistent compile cache plumbing (runtime/compile_cache.py, ISSUE 16).
+
+Fast tier: the pure plumbing — namespace derivation, env gating, the
+train_stats blob field. Slow tier: real child processes compiling against
+a shared cache dir — the warm-restart win, corruption robustness, and
+version isolation on disk."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi_operator_tpu.machinery.objects import bounded_train_stats
+from mpi_operator_tpu.runtime import compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fast: namespace + env plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_namespace_isolates_versions_and_backends():
+    a = compile_cache.cache_namespace("0.4.37", "tpu")
+    assert a == "jax-0.4.37-tpu"
+    assert compile_cache.cache_namespace("0.4.36", "tpu") != a
+    assert compile_cache.cache_namespace("0.4.37", "cpu") != a
+
+
+def test_namespace_sanitizes_weird_version_strings():
+    ns = compile_cache.cache_namespace("0.5.0.dev+g1234/zz", "cpu")
+    assert "/" not in ns and os.sep not in ns
+    assert ns.startswith("jax-")
+
+
+def test_configure_from_env_is_noop_without_the_contract_var():
+    assert compile_cache.configure_from_env(env={}) is None
+
+
+def test_blob_field_absent_when_unconfigured():
+    # the exact-key contract of the stepstats blob (tests/test_stepstats)
+    # must hold for every pre-ISSUE-16 consumer: no compile_cache key
+    # unless the cache is actually configured and counting
+    blob = bounded_train_stats(step=3, steps=10, compile_cache=None)
+    assert "compile_cache" not in blob
+    blob = bounded_train_stats(step=3, steps=10, compile_cache={})
+    assert "compile_cache" not in blob
+
+
+def test_blob_field_bounded_when_present():
+    blob = bounded_train_stats(
+        step=3, steps=10,
+        compile_cache={"hits": 7.9, "misses": "2", "junk": "dropped"},
+    )
+    assert blob["compile_cache"] == {"hits": 7, "misses": 2}
+
+
+def test_versions_get_disjoint_dirs_on_disk(tmp_path):
+    """Two incarnations claiming different jax versions must not share a
+    cache namespace directory (rolling-upgrade isolation)."""
+    import jax
+
+    configured = compile_cache.configure(str(tmp_path))
+    try:
+        assert configured.startswith(str(tmp_path))
+        assert os.path.isdir(configured)
+        ns_now = os.path.basename(configured)
+        other = compile_cache.cache_namespace("9.9.9", "cpu")
+        assert other != ns_now
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        compile_cache._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# slow: real child processes against one cache dir
+# ---------------------------------------------------------------------------
+
+
+def _run_child(cache_root, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env[compile_cache.ENV_CACHE_DIR] = str(cache_root)
+    env.update(extra_env or {})
+    src = compile_cache._CHILD_SRC.format(repo=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", src],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1]), proc.stderr
+
+
+@pytest.mark.slow
+def test_warm_restart_hits_cache_and_collapses_compile(tmp_path):
+    cold, _ = _run_child(tmp_path)
+    warm, _ = _run_child(tmp_path)
+    assert cold["cache"]["misses"] > 0 and cold["cache"]["hits"] == 0
+    assert warm["cache"]["hits"] > 0 and warm["cache"]["misses"] == 0
+    # the whole tentpole: the warm incarnation's compile bucket collapses
+    assert warm["buckets"]["compile"] < 0.5 * cold["buckets"]["compile"], (
+        cold["buckets"], warm["buckets"],
+    )
+
+
+@pytest.mark.slow
+def test_corrupted_entry_degrades_to_fresh_compile(tmp_path):
+    """A truncated/garbage cache entry (node crash mid-write, disk fault)
+    must mean a warning + miss + recompile — NEVER a crashed worker."""
+    cold, _ = _run_child(tmp_path)
+    n_corrupted = 0
+    for dirpath, _dirs, files in os.walk(tmp_path):
+        for f in files:
+            with open(os.path.join(dirpath, f), "wb") as fh:
+                fh.write(b"\x00garbage not a cache entry\xff" * 8)
+            n_corrupted += 1
+    assert n_corrupted > 0, "cold run wrote no cache entries"
+    warm, stderr = _run_child(tmp_path)
+    # every read is now a failed-deserialize: counted as misses, process
+    # exits 0, and the step loop still ran all its steps
+    assert warm["cache"]["hits"] == 0
+    assert warm["cache"]["misses"] > 0
+    assert warm["buckets"]["compute"] >= 0
+
+
+@pytest.mark.slow
+def test_smoke_gate_passes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.runtime.compile_cache",
+         "--smoke"],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True

@@ -174,6 +174,51 @@ def test_prefetch_propagates_producer_errors(dp_mesh):
         next(gen)
 
 
+def test_prefetch_close_releases_producer_thread(dp_mesh):
+    """Regression (ISSUE 16): a consumer that abandons the generator early
+    must not leave the producer thread parked on a full queue forever —
+    that thread holds `depth` device-resident global batches alive. close()
+    (or GC of the generator) must propagate a stop to the producer."""
+    import threading
+    import time
+
+    def endless():
+        while True:
+            yield {"tokens": np.zeros((8, 4), np.int32)}
+
+    gen = prefetch(endless(), dp_mesh, depth=2)
+    next(gen)  # producer is now running and will fill + block on the queue
+    gen.close()  # early abandonment: GeneratorExit hits the consumer loop
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any(
+            t.name == "tpujob-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(
+            "prefetch producer thread still alive after generator close()"
+        )
+
+
+def test_prefetch_device_transform_applies_on_global_batch(dp_mesh):
+    it = synthetic_tokens(global_batch=8, seq_len=4, vocab=100)
+
+    def shift(batch):
+        return {k: v + 1 for k, v in batch.items()}
+
+    plain = next(prefetch(synthetic_tokens(global_batch=8, seq_len=4,
+                                           vocab=100), dp_mesh))
+    shifted = next(prefetch(it, dp_mesh, device_transform=jax.jit(shift)))
+    np.testing.assert_array_equal(
+        np.asarray(shifted["tokens"]), np.asarray(plain["tokens"]) + 1
+    )
+    assert shifted["tokens"].sharding.spec == plain["tokens"].sharding.spec
+
+
 def test_prefetch_yields_sharded_batches(dp_mesh):
     it = synthetic_tokens(global_batch=8, seq_len=4, vocab=100)
 

@@ -61,8 +61,10 @@ Rule catalog (rationale → the PR that motivated each):
   DisruptionBudget floors, maintenance evictions that never burn
   backoffLimit, one-eviction dedupe against the node monitor); an ad-hoc
   eviction on a drain path silently forfeits all three. The seam:
-  ``_migrate_batch_gangs``/``_escalate`` (controller/disruption.py) and
-  the serve controller's ``_drain_replica`` retire primitive.
+  ``_migrate_batch_gangs``/``_escalate`` (controller/disruption.py),
+  the serve controller's ``_drain_replica`` retire primitive, and the
+  rescheduler's ``_migrate_gang`` whole-gang free migration
+  (controller/rescheduler.py, ISSUE 18).
 - **REP001** a mutation verb invoked directly on a follower/standby
   handle (``follower.update(...)``, ``self.standby.store.delete(...)``).
   ISSUE 8's replicated store routes every write through the leased
@@ -676,6 +678,10 @@ _DISRUPTION_FN_RE = re.compile(r"(^|_)(drain|evacuat|maintenan|migrat)", re.I)
 # controller's gang-retire primitive (rollout + migration share it)
 _DISRUPTION_SEAM_FNS = {
     "_migrate_batch_gangs", "_escalate", "_drain_replica",
+    # the rescheduler's whole-gang free migration (ISSUE 18): its ONLY
+    # direct eviction path — every other rescheduler move is a
+    # maintenance stamp the DrainController executes
+    "_migrate_gang",
 }
 _POD_DELETE_VERBS = {"delete", "try_delete"}
 

@@ -1,0 +1,743 @@
+"""The goodput-aware defragmenting rescheduler (ISSUE 18).
+
+The scheduler's least-loaded spread is the right day-one policy — it
+minimizes blast radius — but a day of diurnal serve scaling, batch
+arrivals and maintenance churn leaves chips scattered: total-free stays
+ample while no single node can host the next gang member
+(``tpu_operator_schedulable_contiguous_chips`` collapses toward 1).
+Nothing in the operator moved work *proactively*: migration existed only
+as a reaction to a maintenance notice (the disruption plane, ISSUE 14).
+
+This controller closes that gap, leader-only and level-triggered like
+every reconciler. Each pass it:
+
+- exports the fragmentation gauges (largest contiguous free block +
+  total free chips) so the soak bench and `ctl top --fragmentation`
+  judge the same numbers it acts on;
+- moves gangs with a goodput-plane-named straggler (ISSUE 15) off the
+  suspected-sick host: the node is stamped with
+  ``tpujob.dev/straggler-node`` (the scheduler deprioritizes flagged
+  nodes — middle tier between clean and maintenance-doomed) and the
+  whole gang is evicted through the free checkpoint-then-migrate seam;
+- defragments: when a queued gang fits total-free but not
+  contiguous-free (or an idle consolidation would raise the contiguous
+  block by ``min_gain_chips``), the cheapest all-batch victim node gets
+  a short maintenance window stamped on it — the DrainController then
+  owns the evacuation end to end (cordon, budgeted free migration,
+  deadline escalation) — and once the victim is empty the rescheduler
+  uncordons it, returning one whole-node block to the pool.
+
+Every action is governed: a per-window migration cap, per-gang and
+per-node hysteresis (no ping-pong on an oscillating fleet), a minimum
+contiguous-chips gain for idle consolidation, never a gang that is
+already Migrating/Restarting (no second teardown mid-checkpoint), and
+never a node hosting serve replicas (disruption budgets stay untouched
+by construction — serve migration belongs to the drain/serve planes).
+Every eviction rides ``reason=Maintenance``, so restart_generation
+advances and restart_count does NOT: a rescheduler that burned retry
+budgets would be a reliability hazard, not an optimizer. When a move is
+wanted but governance parks it, an explaining Event lands on the
+involved object and ``tpu_operator_rescheduler_parked`` counts it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from mpi_operator_tpu.api.conditions import has_condition
+from mpi_operator_tpu.api.types import ConditionType
+from mpi_operator_tpu.machinery.events import NORMAL, WARNING, EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    ANNOTATION_STRAGGLER_NODE,
+    NODE_NAMESPACE,
+    REASON_MAINTENANCE,
+    evict_pod,
+)
+from mpi_operator_tpu.machinery.store import NotFound
+from mpi_operator_tpu.opshell import metrics
+from mpi_operator_tpu.scheduler.gang import (
+    LABEL_JOB_NAME,
+    GangScheduler,
+    pod_cost,
+)
+
+log = logging.getLogger("tpujob.rescheduler")
+
+LABEL_SERVE_NAME = "tpujob.dev/serve-name"
+
+EVENT_RESCHEDULED = "GangRescheduled"
+EVENT_DEFRAG_DRAINING = "DefragDraining"
+EVENT_DEFRAG_COMPLETE = "DefragComplete"
+EVENT_PARKED = "ReschedulingParked"
+
+
+class Rescheduler:
+    """Leader-only fragmentation/straggler reconciler; see module doc.
+
+    Knobs (the governance surface the README documents):
+
+    - ``min_gain_chips``: idle consolidation must raise the largest
+      contiguous free block by at least this many chips (make-room for a
+      concretely blocked gang ignores it — the gang itself is the gain).
+    - ``max_moves`` / ``window_s``: at most this many gang migrations
+      (straggler moves + gangs displaced by a defrag drain) per sliding
+      window — the fleet-wide churn ceiling.
+    - ``hysteresis_s``: a gang the rescheduler just moved, or a node it
+      just defragmented, is untouchable for this long; with the
+      scheduler's straggler-flag deprioritization this is what prevents
+      A→B→A ping-pong on an oscillating fleet.
+    - ``drain_window_s``: the maintenance deadline stamped on a defrag
+      victim; generous on purpose — migration happens at adoption, the
+      deadline only bounds a wedged drain (escalation is still free).
+    """
+
+    def __init__(
+        self,
+        store,
+        recorder: Optional[EventRecorder] = None,
+        *,
+        interval: float = 2.0,
+        node_grace: float = 6.0,
+        min_gain_chips: int = 2,
+        max_moves: int = 2,
+        window_s: float = 60.0,
+        hysteresis_s: float = 120.0,
+        drain_window_s: float = 60.0,
+        cache=None,
+    ):
+        self.store = store
+        self.recorder = recorder
+        self.interval = interval
+        self.node_grace = node_grace
+        self.min_gain_chips = int(min_gain_chips)
+        self.max_moves = int(max_moves)
+        self.window_s = window_s
+        self.hysteresis_s = hysteresis_s
+        self.drain_window_s = drain_window_s
+        self.cache = cache
+        self.read = cache if cache is not None else store
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # sliding-window migration timestamps (one per gang moved)
+        self._window: List[float] = []
+        # job uid -> last move ts (gang hysteresis)
+        self._moved: Dict[str, float] = {}
+        # node name -> last defrag ts (node hysteresis)
+        self._node_moved: Dict[str, float] = {}
+        # in-flight defrag drains: node name -> stamped deadline
+        self._defragging: Dict[str, float] = {}
+        # park-event dedupe: object key -> last message
+        self._last_park: Dict[str, str] = {}
+
+    # -- lifecycle (the house reconciler shape) -----------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="rescheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync()
+            except Exception:
+                log.exception("rescheduler sync failed; retrying next tick")
+
+    # -- one level-triggered pass -------------------------------------------
+
+    def sync(self) -> None:
+        if self.cache is not None and not self.cache.has_synced():
+            return
+        now = time.time()
+        nodes = self.read.list("Node", NODE_NAMESPACE)
+        if not nodes:
+            return  # scalar 'local' shape: nothing to defragment
+        pods = self.read.list("Pod")
+        live = self._live_nodes(nodes, now)
+        used = GangScheduler._node_used(pods)
+        schedulable = [
+            n for n in live
+            if ANNOTATION_MAINTENANCE_AT not in n.metadata.annotations
+        ]
+        free = {
+            n.metadata.name:
+                max(0, (n.status.capacity_chips or 0)
+                    - used.get(n.metadata.name, 0))
+            for n in schedulable
+        }
+        metrics.fleet_free_chips.set(sum(free.values()))
+        metrics.schedulable_contiguous_chips.set(max(free.values(), default=0))
+
+        self._complete_defrags(nodes, pods, now)
+        self._prune(now)
+
+        jobs = self.read.list("TPUJob")
+        jobs_by_key = {
+            (j.metadata.namespace, j.metadata.name): j for j in jobs
+        }
+        parked = 0
+        parked += self._straggler_pass(jobs, pods, nodes, schedulable,
+                                       used, now)
+        parked += self._defrag_pass(live, schedulable, free, used, pods,
+                                    jobs_by_key, now)
+        metrics.rescheduler_parked.set(parked)
+
+    # -- straggler moves ----------------------------------------------------
+
+    def _straggler_pass(self, jobs, pods, nodes, schedulable, used,
+                        now: float) -> int:
+        parked = 0
+        node_by_name = {n.metadata.name: n for n in nodes}
+        for job in sorted(jobs, key=lambda j: (j.metadata.namespace,
+                                               j.metadata.name)):
+            if not has_condition(job.status, ConditionType.STRAGGLER):
+                continue
+            blob = job.status.train_telemetry or {}
+            who = blob.get("straggler") or ""
+            if "@" not in who:
+                continue  # condition set but rollup not landed yet
+            pod_key, node_name = who.rsplit("@", 1)
+            if has_condition(job.status, ConditionType.MIGRATING) or \
+                    has_condition(job.status, ConditionType.RESTARTING):
+                continue  # a teardown is already in flight: never a second
+            node = node_by_name.get(node_name)
+            if node is None or \
+                    ANNOTATION_MAINTENANCE_AT in node.metadata.annotations:
+                continue  # gone or already draining: the drain plane owns it
+            uid = job.metadata.uid
+            last = self._moved.get(uid)
+            if last is not None and now - last < self.hysteresis_s:
+                parked += self._park(
+                    job,
+                    f"straggler move parked: gang moved {now - last:.0f}s "
+                    f"ago (hysteresis {self.hysteresis_s:.0f}s)",
+                )
+                continue
+            ns, gang = job.metadata.namespace, job.metadata.name
+            members = self._gang_pods(pods, ns, gang)
+            if not members:
+                continue
+            # the move is only a move if the gang can land somewhere that
+            # is not the sick host: simulate on clean nodes excluding it
+            cand = [n for n in schedulable if n.metadata.name != node_name]
+            scratch = self._without_gangs(used, pods, {(ns, gang)})
+            costs = [pod_cost(p) for p in
+                     sorted(members, key=lambda p: p.metadata.name)]
+            if not self._place(cand, scratch, costs):
+                parked += self._park(
+                    job,
+                    f"straggler move parked: no alternative placement for "
+                    f"the gang off {node_name}",
+                )
+                continue
+            if len(self._window) >= self.max_moves:
+                parked += self._park(
+                    job,
+                    f"straggler move parked: migration cap "
+                    f"({self.max_moves}/{self.window_s:.0f}s) exhausted",
+                )
+                continue
+            self._flag_node(node_name, now)
+            n = self._migrate_gang(
+                ns, gang, members,
+                f"straggler {pod_key} on {node_name}: gang rescheduled "
+                f"off suspected-slow hardware (free checkpoint-then-"
+                f"migrate)",
+            )
+            if n:
+                self._moved[uid] = now
+                self._window.append(now)
+                metrics.reschedules_total.inc(outcome="straggler_move")
+                if self.recorder is not None:
+                    self.recorder.event(
+                        job, NORMAL, EVENT_RESCHEDULED,
+                        f"gang {ns}/{gang}: {n} pod(s) migrating off "
+                        f"straggler-flagged node {node_name}",
+                    )
+        return parked
+
+    def _flag_node(self, name: str, now: float) -> None:
+        try:
+            self.store.patch(
+                "Node", NODE_NAMESPACE, name,
+                {"metadata": {"annotations": {
+                    ANNOTATION_STRAGGLER_NODE: str(now),
+                }}},
+            )
+        except NotFound:
+            pass  # node deregistered under us; the move still helps
+
+    def _migrate_gang(self, ns: str, gang: str, members: List,
+                      why: str) -> int:
+        """Evict every live member WHOLE through the sanctioned free
+        seam (reason=Maintenance: restart_generation advances, never
+        restart_count) — the rescheduler's only direct eviction path,
+        and an oplint DIS001 sanctioned function."""
+        n = 0
+        for p in sorted(members, key=lambda p: p.metadata.name):
+            if evict_pod(self.store, p, why, reason=REASON_MAINTENANCE):
+                n += 1
+        return n
+
+    # -- defragmentation ----------------------------------------------------
+
+    def _defrag_pass(self, live, schedulable, free, used, pods,
+                     jobs_by_key, now: float) -> int:
+        parked = 0
+        blocked = self._blocked_gangs(live, schedulable, free, used, pods)
+        if self._defragging:
+            return parked  # one drain in flight: let it land first
+        budget = self.max_moves - len(self._window)
+        if blocked and budget <= 0:
+            ns, gang, costs, members = blocked[0]
+            return parked + self._park(
+                members[0],
+                f"defrag parked: gang {ns}/{gang} ({sum(costs)} chips) is "
+                f"fragmentation-blocked but the migration cap "
+                f"({self.max_moves}/{self.window_s:.0f}s) is exhausted",
+            )
+        if budget <= 0:
+            return parked
+        plan = self._plan_defrag(live, schedulable, free, used, pods,
+                                 jobs_by_key, blocked, budget, now)
+        if plan is None:
+            if blocked:
+                ns, gang, costs, members = blocked[0]
+                parked += self._park(
+                    members[0],
+                    f"fleet fragmented: gang {ns}/{gang} ({sum(costs)} "
+                    f"chips) fits total-free ({sum(free.values())}) but "
+                    f"not contiguous-free "
+                    f"({max(free.values(), default=0)}), and no defrag "
+                    f"plan satisfies governance",
+                )
+            return parked
+        victim, gangs, moved_chips, reason = plan
+        name = victim.metadata.name
+        deadline = now + self.drain_window_s
+        try:
+            self.store.patch(
+                "Node", NODE_NAMESPACE, name,
+                {"metadata": {"annotations": {
+                    ANNOTATION_MAINTENANCE_AT: str(deadline),
+                }}},
+            )
+        except NotFound:
+            return parked  # deregistered between snapshot and act
+        self._defragging[name] = deadline
+        self._node_moved[name] = now
+        for key in gangs:
+            job = jobs_by_key.get(key)
+            if job is not None:
+                self._moved[job.metadata.uid] = now
+            self._window.append(now)
+        metrics.reschedules_total.inc(outcome="defrag_drain")
+        log.info("defrag: draining %s (%d gang(s), %d chips): %s",
+                 name, len(gangs), moved_chips, reason)
+        if self.recorder is not None:
+            self.recorder.event(
+                victim, NORMAL, EVENT_DEFRAG_DRAINING,
+                f"defrag: maintenance window stamped "
+                f"(+{self.drain_window_s:.0f}s) to consolidate "
+                f"{len(gangs)} gang(s) ({moved_chips} chips) elsewhere — "
+                f"{reason}",
+            )
+        return parked
+
+    def _blocked_gangs(self, live, schedulable, free, used, pods):
+        """Queued gangs that fit the fleet's TOTAL free chips but have no
+        placement — pure fragmentation casualties, the make-room
+        trigger (also `ctl top --fragmentation`'s exit-1 condition)."""
+        pending: Dict[Tuple[str, str], List] = {}
+        for p in pods:
+            if p.spec.node_name or p.is_finished():
+                continue
+            gang = p.metadata.labels.get(LABEL_JOB_NAME)
+            if gang and LABEL_SERVE_NAME not in p.metadata.labels:
+                pending.setdefault((p.metadata.namespace, gang),
+                                   []).append(p)
+        out = []
+        total_free = sum(free.values())
+        for (ns, gang), members in sorted(pending.items()):
+            members.sort(key=lambda p: p.metadata.name)
+            costs = [pod_cost(p) for p in members]
+            if sum(costs) > total_free:
+                continue  # genuinely out of capacity: not our problem
+            if self._place(live, dict(used), costs):
+                continue  # placeable: the scheduler just hasn't yet
+            out.append((ns, gang, costs, members))
+        return out
+
+    def _plan_defrag(self, live, schedulable, free, used, pods,
+                     jobs_by_key, blocked, budget: int, now: float):
+        """Pick the cheapest victim node whose whole-gang evacuation (a)
+        is re-placeable on the rest of the fleet, (b) either unblocks a
+        fragmentation-blocked gang or raises the contiguous block by
+        >= min_gain_chips, and (c) fits the remaining migration budget.
+        Returns (victim, gang keys, moved chips, reason) or None."""
+        cur_contig = max(free.values(), default=0)
+        best = None
+        for victim in sorted(schedulable, key=lambda n: n.metadata.name):
+            name = victim.metadata.name
+            last = self._node_moved.get(name)
+            if last is not None and now - last < self.hysteresis_s:
+                continue
+            vpods = [p for p in pods
+                     if p.spec.node_name == name and not p.is_finished()]
+            if not vpods:
+                continue  # already a clean block
+            if any(LABEL_SERVE_NAME in p.metadata.labels for p in vpods):
+                continue  # serve hosts are out of scope (budget safety)
+            gangs = set()
+            movable = True
+            for p in vpods:
+                gang = p.metadata.labels.get(LABEL_JOB_NAME)
+                if not gang:
+                    movable = False
+                    break
+                gangs.add((p.metadata.namespace, gang))
+            if not movable or len(gangs) > budget:
+                continue
+            for key in gangs:
+                job = jobs_by_key.get(key)
+                if job is None or \
+                        has_condition(job.status, ConditionType.MIGRATING) or \
+                        has_condition(job.status, ConditionType.RESTARTING) or \
+                        (job.metadata.uid in self._moved
+                         and now - self._moved[job.metadata.uid]
+                         < self.hysteresis_s):
+                    movable = False
+                    break
+            if not movable:
+                continue
+            # simulate: whole gangs leave (members anywhere — an XLA gang
+            # moves together), then must re-place off the victim
+            others = [n for n in schedulable if n.metadata.name != name]
+            scratch = self._without_gangs(used, pods, gangs)
+            moved_chips = sum(used.values()) - sum(scratch.values())
+            ok = True
+            for key in sorted(gangs):
+                members = self._gang_pods(pods, *key)
+                costs = [pod_cost(p) for p in
+                         sorted(members, key=lambda p: p.metadata.name)]
+                if not self._place(others, scratch, costs):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            if blocked:
+                # make-room: after the drain the victim is a clean block
+                # again — the blocked gang must then fit the fleet
+                sim_nodes = others + [victim]
+                sim = dict(scratch)
+                sim[name] = 0
+                ns, gang, costs, _members = blocked[0]
+                if not self._place(sim_nodes, sim, costs):
+                    continue
+                reason = (f"makes room for fragmentation-blocked gang "
+                          f"{ns}/{gang} ({sum(costs)} chips)")
+            else:
+                cap = victim.status.capacity_chips or 0
+                proj = max(
+                    [cap] + [
+                        max(0, (n.status.capacity_chips or 0)
+                            - scratch.get(n.metadata.name, 0))
+                        for n in others
+                    ]
+                )
+                if proj - cur_contig < self.min_gain_chips:
+                    continue
+                reason = (f"raises the contiguous free block "
+                          f"{cur_contig} -> {proj} chips")
+            score = (moved_chips, name)
+            if best is None or score < best[0]:
+                best = (score, victim, gangs, moved_chips, reason)
+        if best is None:
+            return None
+        _score, victim, gangs, moved_chips, reason = best
+        return victim, gangs, moved_chips, reason
+
+    def _complete_defrags(self, nodes, pods, now: float) -> None:
+        """Finish in-flight defrag drains: once the victim is empty,
+        clear the maintenance stamp and uncordon — the whole point was
+        returning the node to the pool as one contiguous block (a real
+        maintenance drain, by contrast, stays cordoned until `ctl
+        uncordon`: that hardware actually leaves)."""
+        node_by_name = {n.metadata.name: n for n in nodes}
+        for name in sorted(self._defragging):
+            node = node_by_name.get(name)
+            if node is None or \
+                    ANNOTATION_MAINTENANCE_AT not in node.metadata.annotations:
+                # deregistered, or an operator uncordoned it under us:
+                # either way the drain is no longer ours to complete
+                del self._defragging[name]
+                continue
+            if any(p.spec.node_name == name and not p.is_finished()
+                   for p in pods):
+                continue  # evacuation still in flight
+            try:
+                self.store.patch(
+                    "Node", NODE_NAMESPACE, name,
+                    {"metadata": {"annotations": {
+                        ANNOTATION_MAINTENANCE_AT: None,
+                    }}},
+                )
+                self.store.patch(
+                    "Node", NODE_NAMESPACE, name,
+                    {"status": {"unschedulable": False}},
+                    subresource="status",
+                )
+            except NotFound:
+                del self._defragging[name]
+                continue
+            del self._defragging[name]
+            metrics.reschedules_total.inc(outcome="defrag_complete")
+            log.info("defrag: %s empty; uncordoned (one clean block back "
+                     "in the pool)", name)
+            if self.recorder is not None:
+                self.recorder.event(
+                    node, NORMAL, EVENT_DEFRAG_COMPLETE,
+                    f"defrag complete: {name} evacuated and uncordoned — "
+                    f"its full chip block is schedulable again",
+                )
+
+    # -- shared helpers -----------------------------------------------------
+
+    def _live_nodes(self, all_nodes, now: float) -> List:
+        out = []
+        for n in all_nodes:
+            if not n.status.ready or n.status.unschedulable:
+                continue
+            hb = n.status.last_heartbeat
+            if hb and now - hb > self.node_grace:
+                continue
+            out.append(n)
+        return sorted(out, key=lambda n: n.metadata.name)
+
+    @staticmethod
+    def _gang_pods(pods, ns: str, gang: str) -> List:
+        return [
+            p for p in pods
+            if p.metadata.namespace == ns
+            and p.metadata.labels.get(LABEL_JOB_NAME) == gang
+            and not p.is_finished()
+        ]
+
+    @staticmethod
+    def _without_gangs(used: Dict[str, int], pods,
+                       gangs) -> Dict[str, int]:
+        """Usage snapshot with the named gangs' live members removed
+        fleet-wide (whole-gang semantics: members off the victim node
+        move too)."""
+        scratch = dict(used)
+        for p in pods:
+            if p.is_finished() or not p.spec.node_name:
+                continue
+            key = (p.metadata.namespace,
+                   p.metadata.labels.get(LABEL_JOB_NAME))
+            if key in gangs:
+                scratch[p.spec.node_name] = max(
+                    0, scratch.get(p.spec.node_name, 0) - pod_cost(p)
+                )
+        return scratch
+
+    @staticmethod
+    def _place(nodes, scratch: Dict[str, int], costs: List[int]) -> bool:
+        """Greedy placement sim using the scheduler's OWN tiered pick
+        (gang.py) so the plan and the eventual real placement cannot
+        disagree on feasibility; mutates scratch, True iff all fit."""
+        for c in costs:
+            target = GangScheduler._pick_node(nodes, scratch, c)
+            if target is None:
+                return False
+            scratch[target] = scratch.get(target, 0) + c
+        return True
+
+    def _prune(self, now: float) -> None:
+        self._window = [t for t in self._window
+                        if now - t < self.window_s]
+        for d in (self._moved, self._node_moved):
+            for k in [k for k, t in d.items()
+                      if now - t > self.hysteresis_s]:
+                del d[k]
+        if len(self._last_park) > 4096:
+            self._last_park.clear()
+
+    def _park(self, obj, message: str) -> int:
+        """Explaining Event for a governance-parked move, deduped per
+        object until the message changes. Returns 1 (the parked count
+        contribution) so call sites read additively."""
+        key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+        if self._last_park.get(key) != message:
+            self._last_park[key] = message
+            log.info("parked: %s: %s", key, message)
+            if self.recorder is not None:
+                self.recorder.event(obj, WARNING, EVENT_PARKED, message)
+        return 1
+
+
+def smoke() -> int:
+    """The <30s rescheduler smoke (verify SKILL.md static gate): three
+    2-chip filler gangs spread across a 3-node/4-chip hollow fleet, then
+    a 4-chip gang that fits total-free (6) but no single node — the
+    make-room path must stamp a defrag drain, the disruption plane must
+    migrate the victim's gang for free, and the rescheduler must
+    uncordon the emptied node so the blocked gang binds. Bars: the big
+    gang runs, zero restart_count burned anywhere, a DefragComplete
+    Event landed, and the victim is back in service (no maintenance
+    stamp, schedulable). One JSON line; exit 0 iff all hold."""
+    import json
+
+    from mpi_operator_tpu.api.client import TPUJobClient
+    from mpi_operator_tpu.controller.controller import TPUJobController
+    from mpi_operator_tpu.controller.disruption import DrainController
+    from mpi_operator_tpu.executor.hollow import HollowFleet, HollowTimeline
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    t0 = time.time()
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    client = TPUJobClient(store)
+    ctrl = TPUJobController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, interval=0.1)
+    # min_gain_chips=4 keeps idle consolidation quiet so the smoke
+    # exercises the make-room trigger specifically
+    resched = Rescheduler(
+        store, recorder, interval=0.2, min_gain_chips=4, max_moves=4,
+        window_s=60.0, hysteresis_s=5.0, drain_window_s=20.0,
+    )
+    fleet = HollowFleet(
+        store, 3, timeline=HollowTimeline(run_s=120.0),
+        capacity_chips=4, heartbeat_interval=0.5,
+    )
+    out = {"metric": "rescheduler_smoke", "ok": False}
+
+    def create(name: str, chips: int) -> None:
+        client.create({
+            "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "slots_per_worker": chips,
+                "slice": {"accelerator": "cpu", "chips_per_host": chips},
+                "run_policy": {"clean_pod_policy": "None"},
+                "worker": {"replicas": 1, "template": {
+                    "containers": [{"image": "smoke/noop",
+                                    "command": ["true"]}],
+                }},
+            },
+        })
+
+    def wait(fn, timeout: float, what: str):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = fn()
+            if v:
+                return v
+            time.sleep(0.1)
+        raise RuntimeError(f"timed out waiting for {what}")
+
+    def bound_nodes(job: str):
+        return {
+            p.spec.node_name
+            for p in store.list("Pod", "default")
+            if p.metadata.labels.get(LABEL_JOB_NAME) == job
+            and p.spec.node_name and not p.is_finished()
+        }
+
+    try:
+        ctrl.run()
+        sched.start()
+        fleet.start()
+        drain.start()
+        wait(lambda: len(store.list("Node", NODE_NAMESPACE)) == 3,
+             10.0, "fleet registration")
+        # sequential creates pin the spread: one 2-chip gang per node
+        for i in range(3):
+            create(f"frag-{i}", 2)
+            wait(lambda i=i: bound_nodes(f"frag-{i}"), 10.0,
+                 f"frag-{i} binding")
+        create("big", 4)
+        resched.start()
+        big_nodes = wait(lambda: bound_nodes("big"), 25.0,
+                         "the blocked gang binding after defrag")
+        wait(lambda: all(
+            p.status.phase == "Running"
+            for p in store.list("Pod", "default")
+            if p.metadata.labels.get(LABEL_JOB_NAME) == "big"
+        ), 10.0, "the blocked gang running")
+        burned = sum(
+            j.status.restart_count or 0
+            for j in store.list("TPUJob", "default")
+        )
+        completes = [
+            e for e in store.list("Event", NODE_NAMESPACE)
+            if e.reason == EVENT_DEFRAG_COMPLETE
+        ]
+        victim = completes[0].involved.name if completes else None
+        victim_ok = False
+        if victim:
+            n = store.get("Node", NODE_NAMESPACE, victim)
+            victim_ok = (
+                ANNOTATION_MAINTENANCE_AT not in n.metadata.annotations
+                and not n.status.unschedulable
+            )
+        out.update({
+            "big_bound_on": sorted(big_nodes),
+            "burned_restarts": burned,
+            "defrag_completes": len(completes),
+            "victim": victim,
+            "victim_back_in_service": victim_ok,
+            "elapsed_s": round(time.time() - t0, 1),
+        })
+        out["ok"] = bool(
+            big_nodes and burned == 0 and completes and victim_ok
+            and not resched._defragging
+        )
+    except Exception as e:
+        log.exception("rescheduler smoke failed")
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        resched.stop()
+        drain.stop()
+        fleet.stop()
+        sched.stop()
+        ctrl.stop()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi_operator_tpu.controller.rescheduler",
+        description=__doc__,
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the in-process defrag make-room smoke "
+                         "(one JSON line; exit 0 iff it held)")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.smoke:
+        return smoke()
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -39,7 +39,7 @@ import logging
 import random
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from mpi_operator_tpu.machinery.objects import (
@@ -101,9 +101,46 @@ class HollowTimeline:
     # benches at fleet scale with zero training processes
     train: Optional["TrainLoadModel"] = None
     train_stats_interval_s: float = 0.5
+    # checkpoint-resume (the soak bench, ISSUE 18): when set, a batch
+    # pod's scripted runtime is a stable per-POD total (seeded by pod
+    # identity, not incarnation uid) and progress accrues across
+    # incarnations — a checkpoint-then-migrated gang finishes the
+    # REMAINDER of its work instead of starting over, which is the
+    # operator's whole migration contract. Off by default: restart tests
+    # rely on each incarnation re-running the full clock.
+    checkpoint_resume: bool = False
+    _ckpt_done: Dict[str, float] = field(default_factory=dict, repr=False)
+    _ckpt_run_start: Dict[str, float] = field(default_factory=dict,
+                                              repr=False)
+    _ckpt_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False)
 
     def pod_rng(self, namespace: str, name: str, uid: str) -> random.Random:
         return random.Random(f"{self.seed}:{namespace}/{name}:{uid}")
+
+    # -- checkpoint-resume bookkeeping (fleet-shared: a migrated pod
+    # lands on a DIFFERENT node's executor, so progress lives here) -----
+
+    def ckpt_remaining(self, key: str, total: float) -> float:
+        with self._ckpt_lock:
+            return max(0.05, total - self._ckpt_done.get(key, 0.0))
+
+    def ckpt_mark_running(self, key: str) -> None:
+        with self._ckpt_lock:
+            self._ckpt_run_start.setdefault(key, time.monotonic())
+
+    def ckpt_pause(self, key: str) -> None:
+        """Pod torn down mid-run (eviction): bank the progress."""
+        with self._ckpt_lock:
+            t0 = self._ckpt_run_start.pop(key, None)
+            if t0 is not None:
+                self._ckpt_done[key] = (self._ckpt_done.get(key, 0.0)
+                                        + (time.monotonic() - t0))
+
+    def ckpt_finish(self, key: str) -> None:
+        with self._ckpt_lock:
+            self._ckpt_run_start.pop(key, None)
+            self._ckpt_done.pop(key, None)
 
 
 # serving-pod identity labels (duplicated string constants — the executor
@@ -349,14 +386,22 @@ class _TimerWheel:
     """One thread serving many scheduled callbacks (heapq): 100k hollow
     pods cannot afford a threading.Timer thread each. Handles are dicts
     with a ``cancelled`` flag — cancel is O(1), the heap entry is skipped
-    at fire time."""
+    at fire time.
 
-    def __init__(self):
+    ``clock`` (anything with ``to_wall(virtual_seconds)``, e.g.
+    ``machinery.scenario.VirtualClock``) lets callers schedule in
+    SCENARIO time: ``schedule(delay, fn, virtual=True)`` converts the
+    delay through the clock, so a compressed soak's maintenance wave
+    fires at deterministic scenario offsets instead of wall-clock ones.
+    """
+
+    def __init__(self, clock: Any = None):
         self._cond = threading.Condition()
         self._heap: List[tuple] = []
         self._seq = 0
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        self._clock = clock
 
     def start(self) -> "_TimerWheel":
         with self._cond:
@@ -377,7 +422,10 @@ class _TimerWheel:
         if t is not None:
             t.join(timeout=2.0)
 
-    def schedule(self, delay: float, fn) -> Dict[str, Any]:
+    def schedule(self, delay: float, fn, *,
+                 virtual: bool = False) -> Dict[str, Any]:
+        if virtual and self._clock is not None:
+            delay = self._clock.to_wall(delay)
         handle = {"cancelled": False, "fn": fn}
         with self._cond:
             self._seq += 1
@@ -595,6 +643,11 @@ class HollowExecutor:
             self.timeline.load.unregister(serve_key, key)
         if self.timeline.train is not None:
             self.timeline.train.forget(key, pod.metadata.uid)
+        if self.timeline.checkpoint_resume and serve_key is None:
+            # torn down mid-run (eviction): bank the progress so the
+            # replacement incarnation runs only the remainder (no-op if
+            # the pod already reached terminal — ckpt_finish cleared it)
+            self.timeline.ckpt_pause(key)
 
     # -- the scripted lifecycle ---------------------------------------------
 
@@ -605,12 +658,23 @@ class HollowExecutor:
             return
         tl = self.timeline
         rng = tl.pod_rng(pod.metadata.namespace, pod.metadata.name, uid)
-        run_s = tl.run_s + rng.uniform(0.0, tl.run_jitter_s)
         failed = rng.random() < tl.failure_rate
         ns, name = pod.metadata.namespace, pod.metadata.name
+        if tl.checkpoint_resume:
+            # stable per-POD total seeded by identity (not incarnation
+            # uid): every incarnation agrees on how much work the pod
+            # holds, and a checkpoint-then-migrated replacement runs
+            # only the remainder
+            srng = tl.pod_rng(ns, name, "ckpt")
+            total = tl.run_s + srng.uniform(0.0, tl.run_jitter_s)
+            run_s = tl.ckpt_remaining(key, total)
+        else:
+            run_s = tl.run_s + rng.uniform(0.0, tl.run_jitter_s)
         rv = pod.metadata.resource_version or 0
 
         def to_running():
+            if tl.checkpoint_resume:
+                tl.ckpt_mark_running(key)
             self._mirror(ns, name, uid, rv, {
                 "phase": PodPhase.RUNNING, "ready": True, "reason": "",
                 "pod_ip": "127.0.0.1",
@@ -645,6 +709,8 @@ class HollowExecutor:
                 if self._seen.get(key) != uid:
                     return  # deleted/recreated while the timer was armed
                 self._handles.pop(key, None)
+            if tl.checkpoint_resume:
+                tl.ckpt_finish(key)
             if failed:
                 self._mirror(ns, name, uid, rv, {
                     "phase": PodPhase.FAILED, "ready": False,
@@ -667,7 +733,10 @@ class HollowExecutor:
         else:
             # adopted mid-run: remaining runtime unknowable — restart the
             # scripted clock from now (a restarted real process would
-            # also start over)
+            # also start over; under checkpoint_resume run_s is already
+            # the banked remainder, so the clock starts accruing now)
+            if tl.checkpoint_resume:
+                tl.ckpt_mark_running(key)
             handles.append(self._wheel.schedule(run_s, to_terminal))
         stats_handle = None
         if tl.train is not None and pod.metadata.labels.get(LABEL_JOB_NAME):
@@ -794,7 +863,8 @@ class HollowFleet:
                  advertise: str = "127.0.0.1",
                  heartbeat_interval: float = 10.0,
                  batch_items: int = 256,
-                 maintenance: Optional[MaintenanceSchedule] = None):
+                 maintenance: Optional[MaintenanceSchedule] = None,
+                 clock: Any = None):
         from mpi_operator_tpu.executor.agent import StatusBatcher
 
         self.store = store
@@ -804,10 +874,15 @@ class HollowFleet:
         self.advertise = advertise
         self.heartbeat_interval = heartbeat_interval
         self.batch_items = batch_items
+        # the scenario engine's time-scalable clock (VirtualClock duck
+        # type: to_wall(virtual_s)); when set, MaintenanceSchedule knobs
+        # are read as SCENARIO seconds — a 6-hour wave compresses into a
+        # minutes-long deterministic run instead of a wall-clock one
+        self.clock = clock
         self.node_names = [f"{name_prefix}{i:04d}" for i in range(nodes)]
         self._wake = threading.Event()
         self.batcher = StatusBatcher(on_dirty=self._wake.set)
-        self.wheel = _TimerWheel()
+        self.wheel = _TimerWheel(clock=clock)
         self.executors: Dict[str, HollowExecutor] = {
             name: HollowExecutor(
                 store, node_name=name, timeline=self.timeline,
@@ -859,19 +934,25 @@ class HollowFleet:
     def arm_maintenance(self, sched: MaintenanceSchedule) -> None:
         """Schedule the rolling notice wave on the shared timer wheel
         (``start_s`` counts from THIS call — benches arm it once the
-        workload is live instead of at fleet start)."""
+        workload is live instead of at fleet start). With a scenario
+        ``clock``, every schedule knob — start, stagger, AND the notice
+        window itself — is scenario time: the wave's shape is invariant
+        under ``--time-scale``, which is what makes compressed multi-hour
+        soaks deterministic."""
         for i, name in enumerate(sched.victims(self.node_names)):
             delay = sched.start_s + i * sched.stagger_s
 
             def fire(node=name, notice=sched.notice_s):
+                wall_notice = (self.clock.to_wall(notice)
+                               if self.clock is not None else notice)
                 try:
                     self.announce_maintenance(node,
-                                              time.time() + notice)
+                                              time.time() + wall_notice)
                 except Exception:
                     log.warning("maintenance notice for %s failed", node,
                                 exc_info=True)
 
-            self.wheel.schedule(delay, fire)
+            self.wheel.schedule(delay, fire, virtual=True)
 
     def announce_maintenance(self, node: str, at_ts: float) -> None:
         """Stamp the maintenance-notice annotation (the cloud provider's
@@ -884,6 +965,21 @@ class HollowFleet:
             }}},
         )
         log.info("maintenance notice: node %s dies at %.0f", node, at_ts)
+
+    def kill_node(self, name: str) -> None:
+        """Drop one hollow node dead, mid-flight (the spot-reclaim /
+        host-loss fault): its executor stops (every armed pod transition
+        cancelled — the 'processes' die with the host), its heartbeats
+        cease (the monitor will see it go stale), and events are no
+        longer routed to it. The Node object is NOT deleted and nothing
+        is mirrored — a reclaimed host does not get to say goodbye; the
+        control plane must notice on its own."""
+        ex = self.executors.pop(name, None)
+        if ex is None:
+            raise KeyError(f"no hollow node {name!r} in this fleet")
+        self._hb_due.pop(name, None)
+        ex.stop()
+        log.warning("hollow node %s killed (no further heartbeats)", name)
 
     def stop(self) -> None:
         self._stop.set()
@@ -1027,6 +1123,27 @@ class HollowFleet:
             raise
 
 
+class HollowNodeTarget:
+    """One hollow node as a chaos process target (the ``targets=`` duck
+    type ChaosController kills): ``reclaim``/``maintenance-fire`` against
+    a hollow fleet SIGKILL nothing — they call :meth:`HollowFleet.
+    kill_node`, which is the same observable event (heartbeats stop,
+    armed pod transitions die) without a process to kill."""
+
+    def __init__(self, fleet: HollowFleet, node: str):
+        self.fleet = fleet
+        self.node = node
+
+    def kill(self) -> None:
+        self.fleet.kill_node(self.node)
+
+    def term(self) -> None:
+        self.kill()
+
+    def restart(self) -> None:
+        raise RuntimeError("a reclaimed hollow node does not come back")
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1057,6 +1174,11 @@ def main(argv=None) -> int:
                     help="seconds after fleet start the first notice fires")
     ap.add_argument("--maintenance-stagger", type=float, default=0.5,
                     help="seconds between successive notices (the wave)")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="scenario seconds per wall second (>1 compresses "
+                         "the maintenance wave: its knobs are read as "
+                         "SCENARIO time, so a multi-hour wave replays "
+                         "deterministically in minutes)")
     ap.add_argument("--token-file", default=None)
     ap.add_argument("--monitoring-port", type=int, default=None,
                     help="serve /metrics + /healthz on this port (agent "
@@ -1073,13 +1195,18 @@ def main(argv=None) -> int:
     # with a 10k-job storm may legitimately take several seconds
     store = HttpStoreClient(args.store, timeout=60.0,
                             token=read_token_file(args.token_file))
+    clock = None
+    if args.time_scale != 1.0:
+        from mpi_operator_tpu.machinery.scenario import VirtualClock
+
+        clock = VirtualClock(scale=args.time_scale)
     fleet = HollowFleet(
         store, args.nodes, name_prefix=args.prefix,
         timeline=HollowTimeline(run_s=args.run_s,
                                 failure_rate=args.failure_rate,
                                 seed=args.seed),
         capacity_chips=args.chips, heartbeat_interval=args.heartbeat,
-        batch_items=args.batch_items,
+        batch_items=args.batch_items, clock=clock,
         maintenance=(
             MaintenanceSchedule(
                 fraction=args.maintenance_fraction,

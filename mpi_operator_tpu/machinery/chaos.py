@@ -37,6 +37,7 @@ Script format (YAML or JSON; times are seconds relative to ``arm()``)::
       - {at: 1.0, fault: delay, seconds: 0.05, duration: 3.0}
       - {at: 4.0, fault: duplicate, match: mutation, prob: 1.0, duration: 1.0}
       - {at: 2.0, fault: partition, a: n0, b: n1, duration: 1.5}  # symmetric cut
+      - {at: 7.0, fault: reclaim, target: node-3}  # spot reclaim: zero warning
 
 ``partition`` is the Jepsen verb: both directions between two NAMED
 endpoints blackholed at once, healed on schedule (a duration expands to
@@ -83,8 +84,13 @@ FABRIC_FAULTS = ("partition", "heal")
 # maintenance-at notice on Node `target` with `duration` seconds of
 # warning, then expands into a `maintenance-fire` edge at the deadline —
 # which SIGKILLs the same-named process target IF anything is still
-# bound to the node (the cloud provider does not wait for your drain)
-STORE_FAULTS = ("maintenance", "maintenance-fire")
+# bound to the node (the cloud provider does not wait for your drain).
+# `reclaim` is the spot-instance verb: NO notice window — the deadline
+# annotation is stamped already expired and the node's process target is
+# SIGKILLed in the same action, so the drain plane only ever sees a dead
+# node with a past-due maintenance stamp (its escalation path owns the
+# free eviction)
+STORE_FAULTS = ("maintenance", "maintenance-fire", "reclaim")
 MATCHES = ("any", "watch", "mutation", "read")
 
 
@@ -118,6 +124,9 @@ _FAULT_KNOBS: Dict[str, frozenset] = {
     # notice window (required: a notice with no deadline is not a fault)
     "maintenance": frozenset({"target", "duration"}),
     "maintenance-fire": frozenset({"target"}),
+    # reclaim takes NO duration by construction: a notice window would
+    # make it maintenance. Passing one is rejected at parse, not ignored.
+    "reclaim": frozenset({"target"}),
 }
 
 
@@ -820,6 +829,27 @@ class ChaosController:
             )
             log.warning("chaos: maintenance notice on node %s "
                         "(deadline in %.1fs)", a.target, a.seconds)
+            return
+        if a.fault == "reclaim":
+            # the spot-instance reclaim: no warning, no drain window. The
+            # deadline is stamped ALREADY EXPIRED so the disruption plane
+            # classifies the loss as planned (evictions stay free — no
+            # burned restart_count), and the node target dies in the same
+            # breath. A missing target fails loudly: a reclaim that kills
+            # nothing would make a 'passing' chaos run meaningless.
+            target = self.targets.get(a.target)
+            if target is None:
+                raise KeyError(
+                    f"no process target {a.target!r} registered to reclaim"
+                )
+            self.store.patch(
+                "Node", NODE_NAMESPACE, a.target,
+                {"metadata": {"annotations": {
+                    ANNOTATION_MAINTENANCE_AT: str(time.time()),
+                }}},
+            )
+            log.warning("chaos: reclaiming node %s (zero warning)", a.target)
+            target.kill()
             return
         # maintenance-fire
         still_bound = [

@@ -173,6 +173,16 @@ NODE_NAMESPACE = "nodes"
 # Cleared by `ctl uncordon` when the node returns from maintenance.
 ANNOTATION_MAINTENANCE_AT = "tpujob.dev/maintenance-at"
 
+# The sick-hardware flag (the rescheduler, ISSUE 18): stamped on a node
+# when the goodput plane names one of its pods a straggler and the
+# rescheduler moves the gang off it. Value is the unix timestamp of the
+# flagging. The scheduler DEPRIORITIZES flagged nodes (middle placement
+# tier: clean > straggler-flagged > maintenance-doomed) rather than
+# excluding them — suspected-slow hardware still hosts when nothing
+# else has room. Cleared by `ctl uncordon` once the host is vindicated
+# or repaired (runbook row "rescheduler migrating too much").
+ANNOTATION_STRAGGLER_NODE = "tpujob.dev/straggler-node"
+
 
 class NodeConditionType:
     """Node conditions (operator-owned, like the cordon flag):

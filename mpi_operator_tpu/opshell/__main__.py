@@ -144,6 +144,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "(maintenance-notice drains run leader-only by "
                          "default; with it off, `ctl drain` notices are "
                          "inert and only --now drains work)")
+    ap.add_argument("--no-rescheduler", action="store_true",
+                    help="disable the goodput-aware defragmenting "
+                         "rescheduler (proactive straggler moves + "
+                         "make-room defrag drains run leader-only by "
+                         "default; the fragmentation gauges go dark "
+                         "with it off — the soak bench's A/B arm)")
+    ap.add_argument("--reschedule-interval", type=float, default=2.0,
+                    help="seconds between rescheduler passes "
+                         "(fragmentation gauges + governed moves)")
+    ap.add_argument("--reschedule-max-moves", type=int, default=2,
+                    help="rescheduler migration budget: at most this "
+                         "many gang moves per --reschedule-window "
+                         "(the brake on migration storms)")
+    ap.add_argument("--reschedule-window", type=float, default=60.0,
+                    help="seconds over which --reschedule-max-moves "
+                         "is counted (sliding window)")
     ap.add_argument("--no-serving", action="store_true",
                     help="disable the TPUServe controller + autoscaler "
                          "(batch-only operator; the serving workload "
@@ -386,6 +402,24 @@ def main(argv=None) -> int:
             store, recorder, node_grace=args.node_grace, cache=cache,
         )
 
+    # the rescheduler (leader-only, ISSUE 18): proactive migration —
+    # straggler moves off sick hardware and make-room defrag drains,
+    # governed by migration caps/hysteresis; rides the drain plane's
+    # free checkpoint-then-migrate seam (controller/rescheduler.py)
+    # defrag drains are executed by the DrainController, so the
+    # rescheduler follows it off: a stamp nothing evacuates would just
+    # cordon capacity forever
+    rescheduler = None
+    if not args.no_rescheduler and gang and not args.no_drain_controller:
+        from mpi_operator_tpu.controller.rescheduler import Rescheduler
+
+        rescheduler = Rescheduler(
+            store, recorder, interval=args.reschedule_interval,
+            node_grace=args.node_grace, cache=cache,
+            max_moves=args.reschedule_max_moves,
+            window_s=args.reschedule_window,
+        )
+
     # the serving workload class (leader-only, like every reconciler):
     # the TPUServe controller drives replica gangs + rollouts, the
     # autoscaler writes their spec.replicas from observed load
@@ -495,6 +529,8 @@ def main(argv=None) -> int:
         monitor.start()
         if drain_controller is not None:
             drain_controller.start()
+        if rescheduler is not None:
+            rescheduler.start()
         if goodput_aggregator is not None:
             goodput_aggregator.start()
         if slo_monitor is not None:
@@ -532,6 +568,8 @@ def main(argv=None) -> int:
         monitor.stop()
         if drain_controller is not None:
             drain_controller.stop()
+        if rescheduler is not None:
+            rescheduler.stop()
         if cache is not None:
             cache.stop()
         stop.set()

@@ -450,9 +450,12 @@ def cmd_uncordon(client: TPUJobClient, args) -> int:
     """Clear the cordon flag AND any maintenance notice: the node returned
     from maintenance and is a binding target again (the DrainController
     level-triggers the Draining condition inactive once the notice is
-    gone)."""
+    gone). Also clears the rescheduler's straggler flag — uncordon is the
+    operator's 'this hardware is healthy again' verdict, and a stale flag
+    would keep the scheduler deprioritizing a fixed node forever."""
     from mpi_operator_tpu.machinery.objects import (
         ANNOTATION_MAINTENANCE_AT,
+        ANNOTATION_STRAGGLER_NODE,
         NODE_NAMESPACE,
     )
 
@@ -461,7 +464,8 @@ def cmd_uncordon(client: TPUJobClient, args) -> int:
     try:
         client.store.patch(
             "Node", NODE_NAMESPACE, args.name,
-            {"metadata": {"annotations": {ANNOTATION_MAINTENANCE_AT: None}}},
+            {"metadata": {"annotations": {ANNOTATION_MAINTENANCE_AT: None,
+                                          ANNOTATION_STRAGGLER_NODE: None}}},
         )
     except NotFound:
         pass  # deleted between the two patches; nothing left to clear
@@ -1013,6 +1017,100 @@ def _top_jobs(client: TPUJobClient) -> int:
     return 1 if breached else 0
 
 
+def _top_fragmentation(client: TPUJobClient) -> int:
+    """`ctl top --fragmentation`: the defragmenting rescheduler's view —
+    a contiguous-free-chips histogram across schedulable nodes, the
+    largest gang member placeable right now, and every queued gang
+    classified fits / blocked-fragmented / blocked-capacity. Exit 1
+    while any queued gang fits total-free but not contiguous-free
+    (pure fragmentation: the rescheduler's make-room trigger — the
+    'fleet fragmented' runbook row starts here)."""
+    from collections import Counter
+
+    from mpi_operator_tpu.machinery.objects import (
+        ANNOTATION_MAINTENANCE_AT,
+        ANNOTATION_STRAGGLER_NODE,
+        NODE_NAMESPACE,
+    )
+    from mpi_operator_tpu.controller.disruption import LABEL_SERVE_NAME
+    from mpi_operator_tpu.scheduler.gang import (
+        LABEL_JOB_NAME,
+        GangScheduler,
+        pod_cost,
+    )
+
+    nodes = client.store.list("Node", NODE_NAMESPACE)
+    pods = client.store.list("Pod")
+    live = [n for n in nodes
+            if n.status.ready and not n.status.unschedulable]
+    used = GangScheduler._node_used(pods)
+    schedulable = [
+        n for n in live
+        if ANNOTATION_MAINTENANCE_AT not in n.metadata.annotations
+    ]
+    free = {
+        n.metadata.name:
+            max(0, (n.status.capacity_chips or 0)
+                - used.get(n.metadata.name, 0))
+        for n in schedulable
+    }
+    largest = max(free.values(), default=0)
+    total = sum(free.values())
+    flagged = sum(
+        1 for n in schedulable
+        if ANNOTATION_STRAGGLER_NODE in n.metadata.annotations
+    )
+    lines = [
+        f"FREE CHIPS  total={total}  largest-contiguous={largest}  "
+        f"nodes={len(schedulable)} schedulable"
+        + (f"  straggler-flagged={flagged}" if flagged else ""),
+    ]
+    hist = Counter(free.values())
+    for chips in sorted(hist, reverse=True):
+        n = hist[chips]
+        lines.append(f"  free={chips:<4d} {'#' * n} {n} node(s)")
+    # queued gangs: pending unbound batch pods grouped by job label
+    pending: dict = {}
+    for p in pods:
+        if p.spec.node_name or p.is_finished():
+            continue
+        gang = p.metadata.labels.get(LABEL_JOB_NAME)
+        if gang and LABEL_SERVE_NAME not in p.metadata.labels:
+            pending.setdefault((p.metadata.namespace, gang), []).append(p)
+    fragmented = []
+    if pending:
+        lines.append("QUEUED GANGS")
+    for (ns, gang), members in sorted(pending.items()):
+        members.sort(key=lambda p: p.metadata.name)
+        costs = [pod_cost(p) for p in members]
+        scratch = dict(used)
+        placeable = True
+        for c in costs:
+            target = GangScheduler._pick_node(live, scratch, c)
+            if target is None:
+                placeable = False
+                break
+            scratch[target] = scratch.get(target, 0) + c
+        if placeable:
+            verdict = "fits"
+        elif sum(costs) <= total:
+            verdict = "BLOCKED-FRAGMENTED"
+            fragmented.append(f"{ns}/{gang}")
+        else:
+            verdict = "blocked-capacity"
+        lines.append(f"  {ns}/{gang:<24s} pods={len(members)} "
+                     f"chips={sum(costs)}  {verdict}")
+    if fragmented:
+        lines.append(
+            f"FRAGMENTED  {len(fragmented)} gang(s) fit total-free but "
+            f"not contiguous-free: {', '.join(fragmented)} — the "
+            f"rescheduler should be defragmenting (see "
+            f"tpu_operator_rescheduler_parked if it is not)"
+        )
+    print("\n".join(lines))
+    return 1 if fragmented else 0
+
+
 def cmd_top(client: TPUJobClient, args) -> int:
     """`ctl top`: the one-scrape cluster overview — jobs by phase, chips
     held vs capacity, node/pod health, firing alerts from the store; and
@@ -1024,6 +1122,8 @@ def cmd_top(client: TPUJobClient, args) -> int:
     attribution / stragglers)."""
     if getattr(args, "jobs", False):
         return _top_jobs(client)
+    if getattr(args, "fragmentation", False):
+        return _top_fragmentation(client)
     import urllib.request
 
     import math
@@ -1565,6 +1665,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "DOMINANT-STALL / STRAGGLER from the goodput "
                         "aggregator's rollups; exit 1 while any running "
                         "job is below the goodput-collapse floor")
+    p.add_argument("--fragmentation", action="store_true",
+                   help="contiguous-free-chips histogram + largest "
+                        "schedulable gang member + queued-gang verdicts; "
+                        "exit 1 while a queued gang fits total-free but "
+                        "not contiguous-free (fleet fragmented)")
     p = sub.add_parser("profile", help="attach the profiler to a live "
                                        "gang: stamp a profile request "
                                        "(workers capture N steps of "

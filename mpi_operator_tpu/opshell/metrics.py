@@ -102,7 +102,13 @@ class _Metric:
             f"# TYPE {self.name} {self.kind}",
         ]
         with self._lock:
-            if not self._values:
+            # an empty COUNTER family renders the idiomatic zero; an
+            # empty GAUGE family renders NO sample — a per-entity gauge
+            # (job_goodput_ratio) with no entities is absent, and a
+            # synthesized 0 reads as a real entity at its worst value
+            # (the SLO monitor would page goodput-collapse on a fleet
+            # with no stepping jobs — the soak bench caught exactly this)
+            if not self._values and self.kind == "counter":
                 lines.append(f"{self.name} 0")
             for k, v in sorted(self._values.items()):
                 lines.append(f"{self.name}{_render_labels(k)} {v:g}")
@@ -599,6 +605,43 @@ goodput_sync_latency = REGISTRY.histogram(
     "Goodput-aggregator pass wall time (read every running job's worker "
     "train_stats, roll up goodput/skew, write telemetry + gauges); "
     "observed where the goodput.sync span closes",
+)
+
+# --- fleet soak & rescheduling (ISSUE 18) ----------------------------------
+
+schedulable_contiguous_chips = REGISTRY.gauge(
+    "tpu_operator_schedulable_contiguous_chips",
+    "Largest free chip block on any single live schedulable node — the "
+    "biggest gang MEMBER placeable right now without any move. Total free "
+    "chips can be ample while this sits at 1 (fragmentation); the "
+    "defragmenting rescheduler exists to raise it, and the soak bench's "
+    "A/B acceptance bar is this gauge moving vs --no-rescheduler",
+)
+fleet_free_chips = REGISTRY.gauge(
+    "tpu_operator_fleet_free_chips",
+    "Total unclaimed chips across live schedulable nodes (capacity minus "
+    "bound unfinished pods) — the denominator fragmentation is judged "
+    "against: a queued gang that fits total-free but not contiguous-free "
+    "is the rescheduler's make-room trigger and `ctl top --fragmentation`'s "
+    "exit-1 condition",
+)
+reschedules_total = REGISTRY.counter(
+    "tpu_operator_reschedules_total",
+    "Rescheduler actions by outcome= (straggler_move: a gang migrated off "
+    "straggler-flagged hardware; defrag_drain: a maintenance-window drain "
+    "stamped on a victim node to consolidate its gangs; defrag_complete: "
+    "a victim node emptied and returned to service). Every move rides the "
+    "free checkpoint-then-migrate seam — this counter climbing NEVER "
+    "implies burned restart budgets",
+)
+rescheduler_parked = REGISTRY.gauge(
+    "tpu_operator_rescheduler_parked",
+    "Candidate moves the rescheduler wanted this pass but parked under "
+    "governance (migration window cap, hysteresis, min-gain floor, no "
+    "alternative placement) — each park leaves an explaining Event; "
+    "persistently nonzero alongside a low contiguous-chips gauge means "
+    "the knobs are too tight for the fleet's churn ('fleet fragmented' "
+    "runbook row)",
 )
 
 # --- the SLO plane (ISSUE 13): the monitor's own health + alert state ------

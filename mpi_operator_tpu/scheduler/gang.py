@@ -48,6 +48,7 @@ from mpi_operator_tpu.machinery import trace
 from mpi_operator_tpu.machinery.events import WARNING, EventRecorder
 from mpi_operator_tpu.machinery.objects import (
     ANNOTATION_MAINTENANCE_AT,
+    ANNOTATION_STRAGGLER_NODE,
     LOCAL_NODE,
     NODE_NAMESPACE,
     Pod,
@@ -906,11 +907,16 @@ class GangScheduler:
     @staticmethod
     def _pick_node(nodes: List, used: Dict[str, int], cost: int) -> Optional[str]:
         """Least-loaded live node with room (spread; name order breaks
-        ties). Nodes with a pending maintenance notice are LAST-RESORT:
-        placing a migration onto the next victim would just move it twice
-        (the disruption plane's anti-hop penalty) — they only host when no
-        clean node has room."""
+        ties), in three preference tiers. Nodes with a pending maintenance
+        notice are LAST-RESORT: placing a migration onto the next victim
+        would just move it twice (the disruption plane's anti-hop
+        penalty) — they only host when no clean node has room. Nodes
+        carrying the rescheduler's straggler flag (suspected-slow
+        hardware, ISSUE 18) sit in the MIDDLE tier: a gang moved off sick
+        hardware must not land right back on it, but a flagged node is
+        still better than one the fleet is about to lose."""
         best = best_load = None
+        flagged_best = flagged_load = None
         doomed_best = doomed_load = None
         for n in nodes:
             cap = n.status.capacity_chips
@@ -921,9 +927,17 @@ class GangScheduler:
                 if doomed_best is None or u < doomed_load:
                     doomed_best, doomed_load = n.metadata.name, u
                 continue
+            if ANNOTATION_STRAGGLER_NODE in n.metadata.annotations:
+                if flagged_best is None or u < flagged_load:
+                    flagged_best, flagged_load = n.metadata.name, u
+                continue
             if best is None or u < best_load:
                 best, best_load = n.metadata.name, u
-        return best if best is not None else doomed_best
+        if best is not None:
+            return best
+        if flagged_best is not None:
+            return flagged_best
+        return doomed_best
 
     def _assign_gang(
         self, nodes: List, used: Dict[str, int], unbound: List[Pod]

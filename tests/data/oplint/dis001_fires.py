@@ -22,3 +22,11 @@ class Mover:
     def _maintenance_sweep(self, live):
         for p in live:
             self.store.delete("Pod", p.metadata.namespace, p.metadata.name)  # expect: DIS001
+
+
+class HomegrownRescheduler:
+    def _defrag_migration(self, members):
+        # a rescheduler that evicts outside its sanctioned _migrate_gang
+        # seam forfeits the free-restart accounting it exists to protect
+        for p in members:
+            evict_pod(self.store, p, "defragmenting")  # expect: DIS001

@@ -1,7 +1,8 @@
 """DIS001 fixture: the blessed forms stay silent.
 
 - the sanctioned seam functions (_migrate_batch_gangs / _escalate /
-  _drain_replica) ARE the drain plane — direct teardown is their job;
+  _drain_replica / the rescheduler's _migrate_gang) ARE the disruption
+  plane — direct teardown is their job;
 - teardown outside any drain-flavored path (the node monitor's eviction,
   a reaper's delete) is a different rule's business;
 - non-Pod deletes on a drain path are fine (a drain completing cleans its
@@ -20,6 +21,16 @@ class DrainController:
         for p in live:
             evict_pod(self.store, p, "deadline reached",
                       reason="Maintenance")
+
+
+class Rescheduler:
+    def _migrate_gang(self, ns, gang, members, why):
+        # the rescheduler's sanctioned whole-gang free migration seam
+        n = 0
+        for p in sorted(members, key=lambda p: p.metadata.name):
+            if evict_pod(self.store, p, why, reason="Maintenance"):
+                n += 1
+        return n
 
 
 class ServeController:

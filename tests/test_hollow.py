@@ -292,8 +292,12 @@ def test_hollow_fleet_smoke():
                        if cond.is_succeeded(j.status))
             pytest.fail(f"fleet converged only {done}/30 jobs")
         # the fleet actually batched: far fewer batch requests than
-        # mirrors+heartbeats shipped
-        assert fleet.stats["mirrors"] >= 120  # 30 jobs × 2 pods × 2 phases
+        # mirrors+heartbeats shipped. 30 jobs × 2 pods × 2 phases = 120
+        # mirror CALLS, but the StatusBatcher coalesces a Running mirror
+        # with the terminal one when both land in one drain window
+        # (run_s == the flush wake interval), so the wire-level floor is
+        # one shipped mirror per pod
+        assert fleet.stats["mirrors"] >= 60
         assert fleet.stats["batches"] < fleet.stats["mirrors"]
     finally:
         stop.set()

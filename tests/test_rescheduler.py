@@ -1,0 +1,485 @@
+"""The goodput-aware defragmenting rescheduler (ISSUE 18).
+
+Pins the tentpole contracts:
+
+- make-room defrag end to end: a queued gang that fits total-free but not
+  contiguous-free gets a victim node drained THROUGH the disruption
+  plane (free migration, restart_count untouched), the victim is
+  uncordoned once empty, and the blocked gang binds onto it;
+- governance safety: serve-hosting nodes are never defrag victims (the
+  disruption budget is untouchable by construction), a gang that is
+  already Migrating/Restarting is never torn down a second time, the
+  per-window migration cap and hysteresis park further moves with an
+  explaining Event (no ping-pong on an oscillating straggler), and idle
+  consolidation needs min_gain_chips;
+- straggler moves: the sick node is flagged, the whole gang migrates for
+  free, and the scheduler's three-tier _pick_node keeps the relaunched
+  gang off flagged hardware (clean > straggler-flagged > doomed);
+- the full trail stays invariant-green across a compressed scenario soak
+  with a reclaim, a maintenance wave, and the rescheduler all active.
+"""
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.api import conditions as cond
+from mpi_operator_tpu.api.types import (
+    Condition,
+    ConditionType,
+    Container,
+    ObjectMeta,
+    PodTemplate,
+    ReplicaSpec,
+    RunPolicy,
+    SliceSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from mpi_operator_tpu.controller.controller import TPUJobController
+from mpi_operator_tpu.controller.disruption import (
+    DrainController,
+    LABEL_SERVE_NAME,
+)
+from mpi_operator_tpu.controller.rescheduler import (
+    EVENT_DEFRAG_COMPLETE,
+    EVENT_DEFRAG_DRAINING,
+    EVENT_PARKED,
+    EVENT_RESCHEDULED,
+    Rescheduler,
+)
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    ANNOTATION_STRAGGLER_NODE,
+    NODE_NAMESPACE,
+    Pod,
+    PodPhase,
+    PodSpec,
+    REASON_MAINTENANCE,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+from test_agent import make_node
+
+NOW = time.time
+
+
+def make_cjob(name, chips, ns="default", replicas=1):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TPUJobSpec(
+            slots_per_worker=chips,
+            run_policy=RunPolicy(clean_pod_policy="None"),
+            worker=ReplicaSpec(
+                replicas=replicas,
+                restart_policy="Never",
+                template=PodTemplate(
+                    container=Container(image="x", command=["true"])
+                ),
+            ),
+            slice=SliceSpec(accelerator="cpu", chips_per_host=chips),
+        ),
+    )
+
+
+def make_serve_pod(store, name, node, chips, ns="default"):
+    return store.create(Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=ns, labels={LABEL_SERVE_NAME: "web"},
+        ),
+        spec=PodSpec(
+            node_name=node,
+            container=Container(
+                env={"TPUJOB_CHIPS_PER_HOST": str(chips)}
+            ),
+        ),
+    ))
+
+
+def mark_running(store, pods):
+    for p in pods:
+        store.patch(
+            "Pod", p.metadata.namespace, p.metadata.name,
+            {"status": {"phase": PodPhase.RUNNING, "ready": True}},
+            subresource="status",
+        )
+
+
+def events(store, reason=None, ns=None):
+    out = store.list("Event", ns) if ns else store.list("Event")
+    if reason is not None:
+        out = [e for e in out if e.reason == reason]
+    return out
+
+
+def job_pods(store, job, ns="default"):
+    return [
+        p for p in store.list("Pod", ns)
+        if p.metadata.labels.get("tpujob.dev/job-name") == job
+        and not p.is_finished()
+    ]
+
+
+def plane(**resched_kw):
+    """store + UNSTARTED controllers — every step an explicit sync, so
+    ordering is deterministic (the test_disruption _manual_plane idiom)."""
+    store = ObjectStore()
+    recorder = EventRecorder(store)
+    ctrl = TPUJobController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, node_grace=5.0)
+    kw = dict(min_gain_chips=2, max_moves=4, window_s=60.0,
+              hysteresis_s=60.0, drain_window_s=60.0)
+    kw.update(resched_kw)
+    resched = Rescheduler(store, recorder, **kw)
+    return store, ctrl, sched, drain, resched
+
+
+def deploy(store, ctrl, sched, name, chips, replicas=1, running=True):
+    store.create(make_cjob(name, chips, replicas=replicas))
+    ctrl.sync_handler(f"default/{name}")
+    sched.sync()
+    if running:
+        mark_running(store, job_pods(store, name))
+        ctrl.sync_handler(f"default/{name}")
+
+
+def set_straggler(store, name, who, ns="default"):
+    job = store.get("TPUJob", ns, name)
+    cond.set_condition(job.status, Condition(
+        type=ConditionType.STRAGGLER, status=True,
+        reason="StragglerDetected", message=who,
+        last_update_time=NOW(), last_transition_time=NOW(),
+    ))
+    store.patch("TPUJob", ns, name, {"status": {
+        "conditions": [c.to_dict() for c in job.status.conditions],
+        "train_telemetry": {"straggler": who},
+    }}, subresource="status")
+
+
+def node_of(store, name):
+    return store.get("Node", NODE_NAMESPACE, name)
+
+
+# ---------------------------------------------------------------------------
+# make-room defrag: the headline loop
+# ---------------------------------------------------------------------------
+
+
+def test_make_room_defrag_unblocks_fragmented_gang_for_free():
+    store, ctrl, sched, drain, resched = plane()
+    for n in ("node-a", "node-b", "node-c"):
+        make_node(store, n, chips=4)
+    # 2 chips on each node: total-free 6, largest contiguous block 2
+    for i, _ in enumerate(("node-a", "node-b", "node-c")):
+        deploy(store, ctrl, sched, f"frag-{i}", 2)
+    deploy(store, ctrl, sched, "big", 4, running=False)
+    assert not job_pods(store, "big")[0].spec.node_name, \
+        "4 chips must not fit a 2-chip largest block"
+
+    resched.sync()  # plan: drain the cheapest all-batch victim
+    stamped = [n for n in store.list("Node", NODE_NAMESPACE)
+               if ANNOTATION_MAINTENANCE_AT in n.metadata.annotations]
+    assert [n.metadata.name for n in stamped] == ["node-a"], \
+        "ties break by name: node-a is the victim"
+    assert events(store, EVENT_DEFRAG_DRAINING, ns=NODE_NAMESPACE)
+
+    drain.sync()  # the disruption plane executes: cordon + free eviction
+    evicted = [p for p in store.list("Pod") if p.is_finished()]
+    assert evicted and all(
+        p.status.reason == REASON_MAINTENANCE for p in evicted
+    ), "defrag rides the free checkpoint-then-migrate seam"
+    ctrl.sync_handler("default/frag-0")  # Migrating verdict
+    ctrl.sync_handler("default/frag-0")  # relaunch generation 1
+    sched.sync()
+    rebound = job_pods(store, "frag-0")
+    assert rebound and all(p.spec.node_name in ("node-b", "node-c")
+                           for p in rebound)
+    mark_running(store, rebound)
+
+    resched.sync()  # victim empty: uncordon, return the block
+    node = node_of(store, "node-a")
+    assert ANNOTATION_MAINTENANCE_AT not in node.metadata.annotations
+    assert not node.status.unschedulable
+    assert events(store, EVENT_DEFRAG_COMPLETE, ns=NODE_NAMESPACE)
+
+    sched.sync()  # the blocked gang finally binds onto the clean block
+    big = job_pods(store, "big")
+    assert big and all(p.spec.node_name == "node-a" for p in big)
+    for j in store.list("TPUJob", "default"):
+        assert (j.status.restart_count or 0) == 0, \
+            "a rescheduler move must NEVER burn the backoffLimit budget"
+
+
+def test_defrag_skips_serve_hosts_even_when_cheaper():
+    store, ctrl, sched, drain, resched = plane()
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    # node-a hosts ONE serve chip (the cheapest possible move);
+    # node-b hosts a 2-chip batch gang
+    make_serve_pod(store, "web-0", "node-a", 1)
+    deploy(store, ctrl, sched, "batch", 2)
+    assert job_pods(store, "batch")[0].spec.node_name == "node-b"
+    deploy(store, ctrl, sched, "big", 4, running=False)
+
+    resched.sync()
+    assert ANNOTATION_MAINTENANCE_AT not in \
+        node_of(store, "node-a").metadata.annotations, \
+        "a serve-hosting node is NEVER a defrag victim (budget safety " \
+        "by construction), even when it is the cheaper move"
+    assert ANNOTATION_MAINTENANCE_AT in \
+        node_of(store, "node-b").metadata.annotations
+    serve = store.get("Pod", "default", "web-0")
+    assert not serve.is_finished(), "the serve replica is untouched"
+
+
+def test_fragmented_but_unplannable_parks_with_explaining_event():
+    store, ctrl, sched, drain, resched = plane()
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    make_serve_pod(store, "web-0", "node-a", 2)
+    make_serve_pod(store, "web-1", "node-b", 2)
+    deploy(store, ctrl, sched, "big", 4, running=False)
+
+    resched.sync()
+    for n in ("node-a", "node-b"):
+        assert ANNOTATION_MAINTENANCE_AT not in \
+            node_of(store, n).metadata.annotations
+    parked = events(store, EVENT_PARKED)
+    assert parked and "fleet fragmented" in parked[0].message
+
+
+def test_never_tears_down_a_gang_already_migrating():
+    store, ctrl, sched, drain, resched = plane()
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    deploy(store, ctrl, sched, "g1", 2)
+    deploy(store, ctrl, sched, "g2", 2)
+    deploy(store, ctrl, sched, "big", 4, running=False)
+    for name in ("g1", "g2"):
+        job = store.get("TPUJob", "default", name)
+        cond.set_condition(job.status, Condition(
+            type=ConditionType.MIGRATING, status=True,
+            reason="TPUJobMigrating", message="drain in flight",
+            last_update_time=NOW(), last_transition_time=NOW(),
+        ))
+        store.patch("TPUJob", "default", name, {"status": {
+            "conditions": [c.to_dict() for c in job.status.conditions],
+        }}, subresource="status")
+
+    resched.sync()
+    for n in ("node-a", "node-b"):
+        assert ANNOTATION_MAINTENANCE_AT not in \
+            node_of(store, n).metadata.annotations, \
+            "a gang mid-checkpoint-migration must not get a SECOND " \
+            "teardown stacked on top"
+    assert all(not p.is_finished() for p in store.list("Pod"))
+
+
+# ---------------------------------------------------------------------------
+# straggler moves + the three-tier scheduler preference
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_move_flags_node_and_migrates_gang_free():
+    store, ctrl, sched, drain, resched = plane()
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    deploy(store, ctrl, sched, "s1", 1, replicas=2)  # spread: a + b
+    assert {p.spec.node_name for p in job_pods(store, "s1")} == \
+        {"node-a", "node-b"}
+    set_straggler(store, "s1", "default/s1-worker-0@node-a")
+
+    resched.sync()
+    assert ANNOTATION_STRAGGLER_NODE in \
+        node_of(store, "node-a").metadata.annotations
+    evicted = [p for p in store.list("Pod") if p.is_finished()]
+    assert len(evicted) == 2, "the WHOLE gang moves (XLA gang semantics)"
+    assert all(p.status.reason == REASON_MAINTENANCE for p in evicted)
+    assert events(store, EVENT_RESCHEDULED)
+
+    ctrl.sync_handler("default/s1")
+    ctrl.sync_handler("default/s1")
+    job = store.get("TPUJob", "default", "s1")
+    assert (job.status.restart_count or 0) == 0
+    sched.sync()
+    rebound = job_pods(store, "s1")
+    assert rebound and all(p.spec.node_name == "node-b" for p in rebound), \
+        "the relaunched gang must avoid the straggler-flagged node"
+
+
+def test_pick_node_prefers_clean_then_flagged_then_doomed():
+    store = ObjectStore()
+    clean = make_node(store, "n-clean", chips=4)
+    flagged = make_node(store, "n-flagged", chips=4)
+    flagged.metadata.annotations[ANNOTATION_STRAGGLER_NODE] = "1"
+    doomed = make_node(store, "n-doomed", chips=4)
+    doomed.metadata.annotations[ANNOTATION_MAINTENANCE_AT] = "9e9"
+    nodes = [clean, flagged, doomed]
+    pick = GangScheduler._pick_node
+    assert pick(nodes, {}, 2) == "n-clean"
+    assert pick(nodes, {"n-clean": 4}, 2) == "n-flagged", \
+        "suspected-slow beats about-to-die"
+    assert pick(nodes, {"n-clean": 4, "n-flagged": 4}, 2) == "n-doomed"
+    assert pick(nodes, {"n-clean": 4, "n-flagged": 4, "n-doomed": 4},
+                2) is None
+
+
+def test_hysteresis_prevents_straggler_ping_pong():
+    store, ctrl, sched, drain, resched = plane(hysteresis_s=300.0)
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    deploy(store, ctrl, sched, "s1", 1, replicas=2)
+    set_straggler(store, "s1", "default/s1-worker-0@node-a")
+    resched.sync()  # move 1: off node-a
+    ctrl.sync_handler("default/s1")
+    ctrl.sync_handler("default/s1")
+    sched.sync()
+    mark_running(store, job_pods(store, "s1"))
+    ctrl.sync_handler("default/s1")
+
+    # the oscillation: telemetry now blames the OTHER node
+    set_straggler(store, "s1", "default/s1-worker-1@node-b")
+    before = len([p for p in store.list("Pod") if p.is_finished()])
+    resched.sync()
+    after = len([p for p in store.list("Pod") if p.is_finished()])
+    assert after == before, \
+        "within hysteresis the gang stays put — no A->B->A ping-pong"
+    parked = events(store, EVENT_PARKED)
+    assert parked and "hysteresis" in parked[-1].message
+
+
+def test_migration_window_cap_parks_the_second_move():
+    store, ctrl, sched, drain, resched = plane(max_moves=1)
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    make_node(store, "node-c", chips=4)
+    deploy(store, ctrl, sched, "s1", 1)
+    deploy(store, ctrl, sched, "s2", 1)
+    set_straggler(store, "s1",
+                  f"default/s1-worker-0@"
+                  f"{job_pods(store, 's1')[0].spec.node_name}")
+    set_straggler(store, "s2",
+                  f"default/s2-worker-0@"
+                  f"{job_pods(store, 's2')[0].spec.node_name}")
+
+    resched.sync()
+    moved = {
+        p.metadata.labels.get("tpujob.dev/job-name")
+        for p in store.list("Pod") if p.is_finished()
+    }
+    assert moved == {"s1"}, "cap=1: exactly one gang moves per window"
+    parked = events(store, EVENT_PARKED)
+    assert parked and "migration cap" in parked[-1].message
+
+
+def test_idle_consolidation_needs_min_gain():
+    for min_gain, expect_stamp in ((3, False), (2, True)):
+        store, ctrl, sched, drain, resched = plane(
+            min_gain_chips=min_gain)
+        make_node(store, "node-a", chips=4)
+        make_node(store, "node-b", chips=4)
+        deploy(store, ctrl, sched, "g1", 2)
+        deploy(store, ctrl, sched, "g2", 2)
+        resched.sync()
+        stamped = [n.metadata.name
+                   for n in store.list("Node", NODE_NAMESPACE)
+                   if ANNOTATION_MAINTENANCE_AT in n.metadata.annotations]
+        if expect_stamp:
+            assert stamped == ["node-a"], \
+                f"gain 2 >= min_gain {min_gain}: consolidate"
+        else:
+            assert stamped == [], \
+                f"gain 2 < min_gain {min_gain}: leave the fleet alone"
+
+
+# ---------------------------------------------------------------------------
+# the full soak: trail invariants stay green
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.soak
+def test_soak_with_rescheduler_keeps_trail_invariants_green():
+    from invariants import Trail, check_invariants
+    from mpi_operator_tpu.executor.hollow import (
+        HollowFleet,
+        HollowTimeline,
+        ServeLoadModel,
+    )
+    from mpi_operator_tpu.machinery.scenario import (
+        Scenario,
+        ScenarioEngine,
+        VirtualClock,
+    )
+
+    doc = {
+        "seed": 21, "scale": 30.0, "duration": 90.0,
+        "serves": [{"serve": "soak/web", "curve": "diurnal",
+                    "peak_qps": 60.0, "trough_qps": 10.0,
+                    "period": 90.0, "interval": 15.0}],
+        "arrivals": [{"tenant": "etl", "rate_per_hour": 360.0,
+                      "pods": 2, "chips": 1, "end": 60.0}],
+        "maintenance": [{"at": 30.0, "fraction": 0.25, "notice": 30.0,
+                         "stagger": 5.0}],
+        "chaos": [{"at": 45.0, "fault": "reclaim",
+                   "target": "hollow-0003"}],
+    }
+    scenario = Scenario.parse(doc)
+    clock = VirtualClock(scenario.scale)
+    store = ObjectStore()
+    trail = Trail(store)
+    recorder = EventRecorder(store)
+    ctrl = TPUJobController(store, recorder)
+    sched = GangScheduler(store, recorder)
+    drain = DrainController(store, recorder, interval=0.1)
+    resched = Rescheduler(store, recorder, interval=0.2,
+                          hysteresis_s=2.0, drain_window_s=20.0)
+    fleet = HollowFleet(
+        store, 4, timeline=HollowTimeline(run_s=0.3,
+                                          load=ServeLoadModel()),
+        capacity_chips=4, heartbeat_interval=0.5, clock=clock,
+    )
+    ctrl.run()
+    sched.start()
+    fleet.start()
+    drain.start()
+    resched.start()
+    engine = ScenarioEngine(scenario, store, fleet=fleet, clock=clock)
+    try:
+        engine.start()
+        deadline = time.time() + 25.0
+        while time.time() < deadline and not engine.done():
+            time.sleep(0.1)
+        assert engine.done(), "the compressed day must finish"
+        assert not engine.errors(), engine.errors()
+
+        def all_done():
+            return all(
+                store.get("TPUJob", *k.split("/", 1)).status.conditions
+                and cond.is_succeeded(
+                    store.get("TPUJob", *k.split("/", 1)).status)
+                for k in engine.submitted
+            )
+        deadline = time.time() + 15.0
+        while time.time() < deadline and not all_done():
+            time.sleep(0.1)
+        assert all_done(), "every arrival gang must finish despite the " \
+            "reclaim + wave + rescheduler churn"
+        burned = sum(
+            j.status.restart_count or 0
+            for j in store.list("TPUJob", "soak")
+        )
+        assert burned == 0, \
+            "reclaim, drains and rescheduler moves are ALL free: zero " \
+            "burned backoffs across the whole day"
+    finally:
+        engine.stop()
+        resched.stop()
+        drain.stop()
+        fleet.stop()
+        sched.stop()
+        ctrl.stop()
+        trail.stop()
+    check_invariants(trail)

@@ -1,0 +1,312 @@
+"""The fleet scenario engine (ISSUE 18): the declarative workload DSL,
+its seeded virtual clock, and the zero-warning `reclaim` chaos fault.
+
+Pins the tentpole contracts:
+
+- `reclaim` follows the PR 3 knob policy: inapplicable knobs are REJECTED
+  at parse (a notice window would make it `maintenance`), a missing
+  target is a parse error, and at fire time the deadline annotation is
+  stamped ALREADY EXPIRED in the same breath as the target kill — the
+  drain plane only ever sees a dead node with a past-due stamp (free
+  escalation, no burned backoff);
+- the virtual clock is a pure scale (to_wall/to_virtual invert), and the
+  hollow timer wheel + maintenance wave obey it: a multi-hour notice
+  compresses into wall seconds deterministically;
+- Scenario.parse fails closed on unknown keys/curves/malformed refs, and
+  two resolutions of one seeded doc produce identical event timelines;
+- HollowFleet.kill_node drops a node mid-flight with NO goodbye (executor
+  stopped, heartbeats cease, Node object left in the store).
+"""
+
+import time
+
+import pytest
+
+from mpi_operator_tpu.executor.hollow import (
+    HollowFleet,
+    HollowNodeTarget,
+    HollowTimeline,
+    MaintenanceSchedule,
+    _TimerWheel,
+)
+from mpi_operator_tpu.machinery.chaos import (
+    ChaosController,
+    ChaosScript,
+    ChaosScriptError,
+)
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    NODE_NAMESPACE,
+)
+from mpi_operator_tpu.machinery.scenario import (
+    Scenario,
+    ScenarioError,
+    ServeCurve,
+    VirtualClock,
+)
+from mpi_operator_tpu.machinery.store import ObjectStore
+
+from test_agent import make_node
+
+
+def wait_until(fn, timeout=10.0, every=0.03, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(every)
+    raise AssertionError(f"{what} not reached within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# the reclaim fault: parse policy
+# ---------------------------------------------------------------------------
+
+
+def test_reclaim_rejects_inapplicable_knobs():
+    # a reclaim with a duration would be a maintenance window by another
+    # name — PR 3's knob policy rejects it at parse instead of ignoring it
+    with pytest.raises(ChaosScriptError) as ei:
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 1.0, "fault": "reclaim", "target": "node-0",
+             "duration": 30.0},
+        ]})
+    assert "not apply" in str(ei.value)
+
+
+def test_reclaim_rejects_proxy_knobs():
+    with pytest.raises(ChaosScriptError) as ei:
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 1.0, "fault": "reclaim", "target": "node-0",
+             "seconds": 5.0},
+        ]})
+    assert "not apply" in str(ei.value)
+
+
+def test_reclaim_requires_target():
+    with pytest.raises(ChaosScriptError):
+        ChaosScript.parse({"seed": 1, "actions": [
+            {"at": 1.0, "fault": "reclaim"},
+        ]})
+
+
+# ---------------------------------------------------------------------------
+# the reclaim fault: fire semantics
+# ---------------------------------------------------------------------------
+
+
+class FakeTarget:
+    def __init__(self):
+        self.killed = 0
+
+    def kill(self):
+        self.killed += 1
+
+
+def _reclaim_controller(store, targets):
+    script = ChaosScript.parse({"seed": 1, "actions": [
+        {"at": 0.0, "fault": "reclaim", "target": "node-0"},
+    ]})
+    return script, ChaosController(script, targets=targets, store=store)
+
+
+def test_reclaim_stamps_expired_deadline_and_kills_target():
+    store = ObjectStore()
+    make_node(store, "node-0", chips=4)
+    target = FakeTarget()
+    script, c = _reclaim_controller(store, {"node-0": target})
+    c._apply_maintenance(script.actions[0])
+    node = store.get("Node", NODE_NAMESPACE, "node-0")
+    stamp = float(node.metadata.annotations[ANNOTATION_MAINTENANCE_AT])
+    assert stamp <= time.time(), \
+        "a reclaim's deadline must be stamped ALREADY EXPIRED (zero " \
+        "warning — the drain plane's escalation owns the free eviction)"
+    assert target.killed == 1, "the node's process dies in the same action"
+
+
+def test_reclaim_missing_target_fails_loudly_without_stamping():
+    store = ObjectStore()
+    make_node(store, "node-0", chips=4)
+    script, c = _reclaim_controller(store, {})
+    with pytest.raises(KeyError):
+        c._apply_maintenance(script.actions[0])
+    node = store.get("Node", NODE_NAMESPACE, "node-0")
+    assert ANNOTATION_MAINTENANCE_AT not in node.metadata.annotations, \
+        "a reclaim that kills nothing must not half-apply the stamp"
+
+
+# ---------------------------------------------------------------------------
+# the virtual clock + timer wheel
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_conversions_invert():
+    clock = VirtualClock(scale=60.0)
+    assert clock.to_wall(120.0) == pytest.approx(2.0)
+    assert clock.to_virtual(2.0) == pytest.approx(120.0)
+    assert clock.to_virtual(clock.to_wall(7.3)) == pytest.approx(7.3)
+
+
+def test_virtual_clock_rejects_nonpositive_scale():
+    with pytest.raises(ValueError):
+        VirtualClock(scale=0.0)
+    with pytest.raises(ValueError):
+        VirtualClock(scale=-2.0)
+
+
+def test_timer_wheel_virtual_delay_obeys_scale():
+    wheel = _TimerWheel(clock=VirtualClock(scale=50.0)).start()
+    fired = []
+    try:
+        t0 = time.time()
+        # 5 VIRTUAL seconds at 50x = 0.1 wall seconds
+        wheel.schedule(5.0, lambda: fired.append(time.time() - t0),
+                       virtual=True)
+        wait_until(lambda: fired, timeout=3.0, what="virtual timer firing")
+        assert fired[0] < 2.0, \
+            f"5 virtual seconds at 50x took {fired[0]:.2f}s wall"
+    finally:
+        wheel.stop()
+
+
+def test_maintenance_wave_compresses_under_time_scale():
+    # at 60x, a 120-virtual-second notice window must land as ~2 wall
+    # seconds — wall-clock staggering would make compressed multi-hour
+    # soaks nondeterministic (the satellite this pins)
+    store = ObjectStore()
+    clock = VirtualClock(scale=60.0)
+    fleet = HollowFleet(
+        store, 2, timeline=HollowTimeline(run_s=0.2),
+        capacity_chips=4, heartbeat_interval=0.2, clock=clock,
+    )
+    fleet.start()
+    try:
+        t0 = time.time()
+        fleet.arm_maintenance(MaintenanceSchedule(
+            fraction=0.5, notice_s=120.0, start_s=6.0, stagger_s=6.0,
+            seed=3,
+        ))
+        noticed = wait_until(
+            lambda: [n for n in store.list("Node", NODE_NAMESPACE)
+                     if ANNOTATION_MAINTENANCE_AT in n.metadata.annotations],
+            timeout=5.0, what="compressed maintenance notice",
+        )
+        stamp = float(
+            noticed[0].metadata.annotations[ANNOTATION_MAINTENANCE_AT]
+        )
+        assert stamp - t0 < 10.0, \
+            "the notice window must be scenario time (2s wall at 60x), " \
+            "not 120 wall seconds"
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the scenario DSL
+# ---------------------------------------------------------------------------
+
+
+GOOD_DOC = {
+    "seed": 11, "scale": 30.0, "duration": 120.0,
+    "serves": [{"serve": "soak/web", "curve": "diurnal",
+                "peak_qps": 50.0, "trough_qps": 5.0,
+                "period": 120.0, "interval": 20.0}],
+    "arrivals": [{"tenant": "etl", "rate_per_hour": 240.0,
+                  "pods": 2, "chips": 1}],
+    "maintenance": [{"at": 60.0, "fraction": 0.25, "notice": 30.0,
+                     "stagger": 10.0}],
+}
+
+
+def test_scenario_parse_rejects_unknown_top_level_key():
+    doc = dict(GOOD_DOC)
+    doc["surprise"] = True
+    with pytest.raises(ScenarioError):
+        Scenario.parse(doc)
+
+
+def test_scenario_parse_rejects_unknown_curve():
+    doc = dict(GOOD_DOC)
+    doc["serves"] = [{"serve": "soak/web", "curve": "sawtooth"}]
+    with pytest.raises(ScenarioError):
+        Scenario.parse(doc)
+
+
+def test_scenario_parse_rejects_malformed_serve_ref():
+    doc = dict(GOOD_DOC)
+    doc["serves"] = [{"serve": "not-namespaced"}]
+    with pytest.raises(ScenarioError):
+        Scenario.parse(doc)
+
+
+def test_scenario_chaos_section_enforces_reclaim_knob_policy():
+    # the embedded chaos section is validated by ChaosScript.parse
+    # verbatim — a reclaim with a notice-window knob is rejected at
+    # SCENARIO parse, before anything runs
+    doc = dict(GOOD_DOC)
+    doc["chaos"] = [{"at": 10.0, "fault": "reclaim", "target": "node-0",
+                     "duration": 5.0}]
+    with pytest.raises(ScenarioError) as ei:
+        Scenario.parse(doc)
+    assert "not apply" in str(ei.value)
+
+
+def test_scenario_events_deterministic_and_time_sorted():
+    a = Scenario.parse(GOOD_DOC).events()
+    b = Scenario.parse(GOOD_DOC).events()
+    assert a == b, "one seed, one timeline — resolve twice, get the same"
+    assert a, "a populated doc resolves to a populated timeline"
+    assert [e[0] for e in a] == sorted(e[0] for e in a)
+    kinds = {e[1] for e in a}
+    assert {"serve-qps", "submit", "maintenance-wave"} <= kinds
+
+
+def test_scenario_different_seed_different_arrivals():
+    doc = dict(GOOD_DOC)
+    doc["seed"] = 12
+    a = [e for e in Scenario.parse(GOOD_DOC).events() if e[1] == "submit"]
+    b = [e for e in Scenario.parse(doc).events() if e[1] == "submit"]
+    assert [x[0] for x in a] != [x[0] for x in b], \
+        "the arrival process must be seeded, not fixed"
+
+
+def test_diurnal_curve_trough_at_start_peak_at_half_period():
+    c = ServeCurve(serve="s/web", curve="diurnal", peak_qps=100.0,
+                   trough_qps=10.0, period=100.0)
+    assert c.qps_at(0.0) == pytest.approx(10.0)
+    assert c.qps_at(50.0) == pytest.approx(100.0)
+    assert c.qps_at(100.0) == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# hollow node loss
+# ---------------------------------------------------------------------------
+
+
+def test_kill_node_drops_heartbeats_without_goodbye():
+    store = ObjectStore()
+    fleet = HollowFleet(
+        store, 2, timeline=HollowTimeline(run_s=0.2),
+        capacity_chips=4, heartbeat_interval=0.1,
+    )
+    fleet.start()
+    try:
+        wait_until(lambda: len(store.list("Node", NODE_NAMESPACE)) == 2,
+                   what="fleet registration")
+        victim = sorted(fleet.node_names)[0]
+        HollowNodeTarget(fleet, victim).kill()
+        assert victim not in fleet.executors
+        node = store.get("Node", NODE_NAMESPACE, victim)
+        hb0 = node.status.last_heartbeat
+        time.sleep(0.4)
+        node = store.get("Node", NODE_NAMESPACE, victim)
+        assert node.status.last_heartbeat == hb0, \
+            "a reclaimed host does not get to say goodbye — heartbeats " \
+            "just stop"
+        with pytest.raises(KeyError):
+            fleet.kill_node(victim)
+        with pytest.raises(RuntimeError):
+            HollowNodeTarget(fleet, victim).restart()
+    finally:
+        fleet.stop()

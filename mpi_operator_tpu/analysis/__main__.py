@@ -17,6 +17,10 @@
     python -m mpi_operator_tpu.analysis crash --list-points
     python -m mpi_operator_tpu.analysis crash --selftest
     python -m mpi_operator_tpu.analysis crash --replica --workload 8
+    python -m mpi_operator_tpu.analysis converge
+    python -m mpi_operator_tpu.analysis converge --corpus straggler --seed 3
+    python -m mpi_operator_tpu.analysis converge --replay 'v1:conv:quota:0:012345'
+    python -m mpi_operator_tpu.analysis converge --selftest
 
 ``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
 rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
@@ -26,7 +30,11 @@ a violating schedule, printing its replay token); ``linearize`` checks
 recorded store histories against the sequential spec. ``fuzz`` runs the
 model-differential store fuzzer over the three real backends (exit 1 on
 a divergence, printing its minimal repro + replay token); ``crash`` runs
-the ALICE-style crash-point explorer over the SqliteStore commit seam.
+the ALICE-style crash-point explorer over the SqliteStore commit seam;
+``converge`` co-simulates the six control loops over reachable start
+states and judges quiescence, write cycles, and wasted-work budgets
+(exit 1 on a violation, printing its ``v1:conv:...`` replay token; exit
+2 on an unknown corpus, malformed snapshot, or mismatched token).
 """
 
 from __future__ import annotations
@@ -226,6 +234,72 @@ def _cmd_crash(args) -> int:
     return 0 if report.ok else 1
 
 
+def _cmd_converge(args) -> int:
+    from mpi_operator_tpu.analysis import convcheck
+
+    try:
+        if args.selftest:
+            seed = 0 if args.seed is None else args.seed
+            failures = convcheck.self_test(seed, log=print)
+            for f in failures:
+                print(f"convcheck selftest FAILED: {f}", file=sys.stderr)
+            if not failures:
+                print("convcheck selftest: ok")
+            return 1 if failures else 0
+        if args.list:
+            for cid in sorted(convcheck.CORPORA):
+                print(f"{cid}")
+                print(f"  {convcheck.CORPORA[cid].description}")
+            for mid in sorted(convcheck.MUTANTS):
+                m = convcheck.MUTANTS[mid]
+                print(f"{mid} [mutant on {m.corpus_id}]")
+                print(f"  {m.description}")
+            return 0
+        snapshot = None
+        if args.snapshot:
+            snapshot = convcheck.load_snapshot_file(args.snapshot)
+        if args.replay:
+            # an explicit --corpus/--seed that CONTRADICTS the token is a
+            # user error the tool must refuse, not silently pick a winner
+            corpus_id, seed, order = convcheck.parse_token(args.replay)
+            if args.corpus is not None and args.corpus != corpus_id:
+                raise convcheck.TokenError(
+                    f"replay token names corpus {corpus_id!r} but "
+                    f"--corpus {args.corpus!r} was passed")
+            if args.seed is not None and args.seed != seed:
+                raise convcheck.TokenError(
+                    f"replay token encodes seed {seed} but --seed "
+                    f"{args.seed} was passed")
+            res = convcheck.run_one(
+                corpus_id, seed, order, mutant=args.mutant,
+                rounds=args.rounds, snapshot=snapshot)
+            print(convcheck.render_result(res))
+            return 0 if res.ok else 1
+        seed = 0 if args.seed is None else args.seed
+        corpora = ([args.corpus] if args.corpus
+                   else sorted(convcheck.CORPORA))
+        orders = [args.order] if args.order else None
+        rc = 0
+        for cid in corpora:
+            if snapshot is not None or args.order:
+                results = [convcheck.run_one(
+                    cid, seed, args.order or convcheck._IDENTITY,
+                    mutant=args.mutant, rounds=args.rounds,
+                    snapshot=snapshot)]
+            else:
+                results = convcheck.run_corpus(
+                    cid, seed, mutant=args.mutant, rounds=args.rounds,
+                    orders=orders)
+            for res in results:
+                print(convcheck.render_result(res))
+                if not res.ok:
+                    rc = 1
+        return rc
+    except convcheck.ConvergeError as exc:
+        print(f"converge: {exc}", file=sys.stderr)
+        return 2
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mpi_operator_tpu.analysis", description=__doc__
@@ -319,6 +393,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "set instead (kill-during-log-ship: failover must "
                         "keep every acked write, truncate unacked suffixes)")
     p.set_defaults(fn=_cmd_crash)
+    p = sub.add_parser(
+        "converge",
+        help="closed-loop co-simulation of the six control loops: "
+             "quiescence, write cycles, wasted-work budgets (exit 1 on "
+             "a violation; its v1:conv token replays it)",
+    )
+    p.add_argument("--selftest", action="store_true",
+                   help="real loops converge on every corpus x order + "
+                        "all six seeded mutants caught")
+    p.add_argument("--list", action="store_true",
+                   help="list corpora and mutants, then exit")
+    p.add_argument("--corpus", help="corpus id (default: all)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="interleaving-enumeration seed (default 0)")
+    p.add_argument("--order", metavar="DIGITS",
+                   help="run exactly one loop order, e.g. 543210")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="override the corpus round count")
+    p.add_argument("--mutant", help="arm a seeded mutant by id")
+    p.add_argument("--replay", metavar="TOKEN",
+                   help="re-execute the exact run a v1:conv token encodes "
+                        "(refused if --corpus/--seed contradict it)")
+    p.add_argument("--snapshot", metavar="PATH",
+                   help="start from a snapshot JSON file instead of the "
+                        "corpus warmup (fails closed on malformed docs)")
+    p.set_defaults(fn=_cmd_converge)
     args = ap.parse_args(argv)
     return args.fn(args)
 

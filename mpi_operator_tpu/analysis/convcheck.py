@@ -1,0 +1,1276 @@
+"""convcheck — convergence & quiescence checking for the six control loops.
+
+A Kubernetes-style operator is a fixed point machine: every controller is
+level-triggered, so on a cluster where nothing external changes, the whole
+plane must reach a state where NO loop writes anything — and reach it in
+bounded work. The unit suites pin each loop's transitions; nothing pins the
+**joint** liveness claim. Two individually-correct loops can still fight
+(A's fix is B's trigger), a dropped hysteresis guard turns one migration
+into a permanent ping-pong, and a status writer that forgets no-op elision
+never quiesces at all. Those defects are invisible to per-loop tests and
+catastrophic in a fleet.
+
+convcheck closes that gap with a deterministic closed-loop co-simulation:
+
+- the REAL sync functions of the six leader-only loops — TPUJobController,
+  TPUServeController, ServeAutoscaler, DrainController, Rescheduler and
+  GoodputAggregator — run against a plain in-memory ObjectStore wrapped in
+  a write-recording proxy, on a virtual clock. No threads, no sleeps: the
+  harness owns the tick order and enumerates seeded loop interleavings.
+- start states come from a small corpus of REACHABLE snapshots (built by
+  driving the real loops through a scripted warmup): mid-rollout,
+  mid-drain, fragmented fleet, straggler-blamed node, quota-saturated
+  tenant, autoscale mid-spike.
+- three judged properties per run:
+  * **quiescence** — once the scripted stimulus freezes, the final rounds
+    must see ZERO store writes from any author;
+  * **no write cycles** — a canonical state hash (volatile bookkeeping
+    stripped) revisiting an earlier value after loop-authored writes is an
+    oscillation; the minimal write cycle is printed with each write's
+    authoring loop;
+  * **bounded wasted work** — store writes per author and requeues per
+    controller against per-corpus tripwire budgets.
+
+Every failure prints a deterministic replay token
+``v1:conv:<corpus>:<seed>:<order>`` that re-executes the exact run.
+
+The self-test holds the checker to its own bar: six seeded mutants — each
+reintroducing a defect class the real loops guard against (hysteresis
+removed, stabilization window removed, no-op elision removed, anti-hop
+placement removed, alert clear-hold removed, requeue-always) — MUST be
+caught, while every REAL loop runs the whole corpus clean.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field, replace
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from mpi_operator_tpu.api.client import TPUJobClient, TPUServeClient
+from mpi_operator_tpu.api.types import (
+    ALERT_NAMESPACE,
+    Alert,
+    AlertSpec,
+    AlertState,
+    AlertStatus,
+    ObjectMeta,
+)
+from mpi_operator_tpu.controller import autoscaler as autoscaler_mod
+from mpi_operator_tpu.controller.autoscaler import (
+    ANNOTATION_OFFERED_QPS,
+    ServeAutoscaler,
+)
+from mpi_operator_tpu.controller.controller import (
+    ControllerOptions,
+    TPUJobController,
+)
+from mpi_operator_tpu.controller.disruption import DrainController
+from mpi_operator_tpu.controller.goodput import GoodputAggregator
+from mpi_operator_tpu.controller.rescheduler import Rescheduler
+from mpi_operator_tpu.controller.serve import (
+    LABEL_SERVE_NAME,
+    TPUServeController,
+)
+from mpi_operator_tpu.controller.slo_monitor import (
+    FIRE,
+    RESOLVE,
+    BurnPolicy,
+    Probe,
+)
+from mpi_operator_tpu.controller.slo_monitor import step as slo_step
+from mpi_operator_tpu.machinery.events import EventRecorder
+from mpi_operator_tpu.machinery.objects import (
+    ANNOTATION_MAINTENANCE_AT,
+    ANNOTATION_STRAGGLER_NODE,
+    NODE_NAMESPACE,
+    Node,
+    PodPhase,
+    bounded_train_stats,
+)
+from mpi_operator_tpu.machinery.scenario import (
+    ScenarioError,
+    restore_store,
+    snapshot_store,
+)
+from mpi_operator_tpu.machinery.serialize import KIND_CLASSES, encode
+from mpi_operator_tpu.machinery.store import ObjectStore
+from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+__all__ = [
+    "CORPORA",
+    "MUTANTS",
+    "ConvergeError",
+    "CorpusError",
+    "TokenError",
+    "RunResult",
+    "enumerate_orders",
+    "format_token",
+    "parse_token",
+    "replay",
+    "run_corpus",
+    "self_test",
+]
+
+# The co-sim clock. EPOCH sits ABOVE any plausible wall clock so the few
+# wall-stamped fields the loops compare against virtual time (condition
+# transition times, backoff anchors) read as "long ago" — monotone-sane —
+# instead of "in the future".
+EPOCH = 2_200_000_000.0
+DT = 60.0
+
+LOOPS = ("job", "serve", "autoscaler", "drain", "rescheduler", "goodput")
+_IDENTITY = "".join(str(i) for i in range(len(LOOPS)))
+
+LABEL_JOB_NAME = "tpujob.dev/job-name"
+LABEL_GENERATION = "tpujob.dev/generation"
+
+# Production-shaped rescheduler knobs at DT-round granularity: the
+# hysteresis must outlive a whole run (a gang is migrated for a suspected
+# straggler at most ONCE per incident), the sliding window spans one round.
+RESCHED_KW = dict(
+    hysteresis_s=3600.0,
+    window_s=60.0,
+    max_moves=2,
+    min_gain_chips=2,
+    drain_window_s=120.0,
+)
+
+
+class ConvergeError(Exception):
+    """Base failure of the convergence checker itself (not a verdict)."""
+
+
+class CorpusError(ConvergeError):
+    """Unknown corpus id, or a snapshot document that fails validation."""
+
+
+class TokenError(ConvergeError):
+    """A malformed or mismatched replay token."""
+
+
+# ---------------------------------------------------------------------------
+# the write-recording store proxy
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WriteRecord:
+    step: int          # global step index (hash points share this axis)
+    round: int
+    author: str        # loop name, "fleet", "slo", or "setup"
+    verb: str
+    kind: str
+    key: str
+
+
+class RecordingStore:
+    """Transparent ObjectStore proxy tagging every write with the author
+    the harness set around the current tick (the CountingStore idiom from
+    tests/test_stress.py, extended with attribution)."""
+
+    _WRITE_VERBS = ("create", "update", "delete", "try_delete", "patch")
+
+    def __init__(self, backing: ObjectStore):
+        self._backing = backing
+        self.author = "setup"
+        self.round = -1
+        self.step = 0
+        self.writes: List[WriteRecord] = []
+
+    def _record(self, verb: str, args: tuple) -> None:
+        kind = key = "?"
+        if args:
+            first = args[0]
+            if isinstance(first, str):
+                kind = first
+                if len(args) >= 3:
+                    key = f"{args[1]}/{args[2]}"
+            else:  # create/update take the object itself
+                kind = getattr(first, "kind", "?")
+                meta = getattr(first, "metadata", None)
+                if meta is not None:
+                    key = f"{meta.namespace}/{meta.name}"
+        self.writes.append(WriteRecord(
+            self.step, self.round, self.author, verb, kind, key))
+
+    def create(self, *a, **kw):
+        self._record("create", a)
+        return self._backing.create(*a, **kw)
+
+    def update(self, *a, **kw):
+        self._record("update", a)
+        return self._backing.update(*a, **kw)
+
+    def delete(self, *a, **kw):
+        self._record("delete", a)
+        return self._backing.delete(*a, **kw)
+
+    def try_delete(self, *a, **kw):
+        self._record("try_delete", a)
+        return self._backing.try_delete(*a, **kw)
+
+    def patch(self, *a, **kw):
+        self._record("patch", a)
+        return self._backing.patch(*a, **kw)
+
+    def patch_batch(self, items):
+        for it in items:
+            self._record("patch", tuple(it) if isinstance(it, (list, tuple))
+                         else (getattr(it, "kind", "?"),))
+        return self._backing.patch_batch(items)
+
+    def __getattr__(self, name):
+        return getattr(self._backing, name)
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for w in self.writes:
+            out[w.author] = out.get(w.author, 0) + 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# canonical state hashing
+# ---------------------------------------------------------------------------
+
+# Fields that move without the cluster's SEMANTIC state moving: identity
+# bookkeeping, timestamps, monotone incident counters, and free-text
+# messages (many embed timestamps or elapsed values). Stripping them makes
+# a genuine oscillation revisit the same hash instead of hiding behind a
+# bumped resource_version.
+_VOLATILE_KEYS = frozenset({
+    "resource_version", "uid", "creation_timestamp", "owner_references",
+    "last_transition_time", "last_heartbeat", "last_probe_time",
+    "last_scale_up_time", "last_scale_down_time",
+    "since", "resolved_at", "start_time", "completion_time", "timestamp",
+    "restart_generation", "restart_count",
+    "worst_burn", "burn", "fired_count", "incident", "message",
+})
+
+
+def _scrub(value: Any, parent_key: str = "") -> Any:
+    if isinstance(value, dict):
+        out = {}
+        for k, v in value.items():
+            if k in _VOLATILE_KEYS:
+                continue
+            if parent_key == "annotations":
+                if "trace" in k:
+                    continue  # trace ids are per-incarnation bookkeeping
+                if k == ANNOTATION_STRAGGLER_NODE:
+                    out[k] = "1"  # normalize the flag's timestamp payload
+                    continue
+            if parent_key == "labels" and k == LABEL_GENERATION:
+                continue  # monotone per-restart stamp
+            out[k] = _scrub(v, k)
+        return out
+    if isinstance(value, list):
+        return [_scrub(v, parent_key) for v in value]
+    if isinstance(value, float):
+        return round(value, 6)
+    return value
+
+
+def canonical_hash(backing: ObjectStore) -> str:
+    doc = []
+    for kind in sorted(KIND_CLASSES):
+        if kind == "Event":
+            continue  # an audit trail, not cluster state
+        objs = backing.list(kind)
+        objs.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        for obj in objs:
+            doc.append([
+                kind, obj.metadata.namespace, obj.metadata.name,
+                _scrub(encode(obj)),
+            ])
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the SLO participant
+# ---------------------------------------------------------------------------
+
+
+class _SLOShell:
+    """The alert plane's seat at the table: the real SLOMonitor's pure
+    ``step()`` core driven by scripted burn rates, writing Alert objects
+    through the recorded store exactly like the monitor's write path (the
+    monitor itself needs an HTTP scraper, which has no place in a co-sim).
+    """
+
+    OBJECTIVE = "convcheck-burn"
+
+    def __init__(self, store, policy: Optional[BurnPolicy] = None):
+        self.store = store
+        self.policy = policy or BurnPolicy()
+        self.probe = Probe()
+
+    def tick(self, burns: Optional[Mapping[str, Optional[float]]],
+             now: float) -> None:
+        if burns is None:
+            return
+        self.probe, event = slo_step(self.probe, burns, self.policy, now)
+        if event == FIRE:
+            self._write_state(AlertState.FIRING, now)
+        elif event == RESOLVE:
+            self._write_state(AlertState.RESOLVED, now)
+
+    def _write_state(self, state: str, now: float) -> None:
+        cur = self.store.try_get("Alert", ALERT_NAMESPACE, self.OBJECTIVE)
+        if cur is None:
+            alert = Alert(
+                metadata=ObjectMeta(name=self.OBJECTIVE,
+                                    namespace=ALERT_NAMESPACE),
+                spec=AlertSpec(objective=self.OBJECTIVE,
+                               metric="convcheck_scripted_burn",
+                               severity="page",
+                               description="convcheck co-sim burn script"),
+            )
+            alert.status = AlertStatus(
+                state=state, window="fast", since=self.probe.since,
+                burn=round(self.probe.worst_burn, 3),
+                fired_count=self.probe.fired_count,
+            )
+            self.store.create(alert)
+            return
+        patch: Dict[str, Any] = {
+            "state": state,
+            "burn": round(self.probe.worst_burn, 3),
+            "fired_count": self.probe.fired_count,
+        }
+        if state == AlertState.FIRING:
+            patch["since"] = self.probe.since
+            patch["resolved_at"] = None
+        else:
+            patch["resolved_at"] = now
+        self.store.patch(
+            "Alert", ALERT_NAMESPACE, self.OBJECTIVE,
+            {"metadata": {"uid": cur.metadata.uid}, "status": patch},
+            subresource="status",
+        )
+
+
+# ---------------------------------------------------------------------------
+# corpus definitions
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Corpus:
+    """One reachable start state plus its scripted environment."""
+
+    id: str
+    description: str
+    start_round: int                      # warmup occupies [0, start_round)
+    rounds: int                           # judged rounds
+    seed_objects: Callable[["World"], None]
+    stimulus: Optional[Callable[["World", int], None]] = None
+    pod_stats: Optional[Callable[["World", Any, int], Optional[dict]]] = None
+    burns: Optional[Callable[[int], Optional[Dict[str, float]]]] = None
+    finalize: Optional[Callable[["World"], None]] = None
+    # tripwire budgets over the judged run (writes per author; 'fleet' is
+    # the environment and exempt); requeues per queue-driven controller
+    write_budgets: Mapping[str, int] = field(default_factory=dict)
+    requeue_budgets: Mapping[str, int] = field(default_factory=dict)
+
+
+def _mk_node(world: "World", name: str, cap: int,
+             annotations: Optional[Dict[str, str]] = None) -> None:
+    node = Node()
+    node.metadata.namespace = NODE_NAMESPACE
+    node.metadata.name = name
+    if annotations:
+        node.metadata.annotations.update(annotations)
+    node.status.ready = True
+    node.status.last_heartbeat = 0.0  # static registration: always live
+    node.status.capacity_chips = cap
+    world.store.create(node)
+
+
+def _job_manifest(name: str, replicas: int) -> dict:
+    return {
+        "apiVersion": "tpujob.dev/v1",
+        "kind": "TPUJob",
+        "metadata": {"name": name},
+        "spec": {
+            "worker": {
+                "replicas": replicas,
+                "restart_policy": "OnFailure",
+                "template": {"containers": [{
+                    "name": "w", "image": "local", "command": ["true"],
+                }]},
+            },
+        },
+    }
+
+
+def _seed_bound_job(world: "World", name: str, placements: Sequence[str],
+                    ) -> None:
+    """Create a TPUJob and hand-bind its workers (the test_stress 'fake
+    scheduler' idiom) so the corpus controls initial placement exactly;
+    the real scheduler owns every placement AFTER the snapshot."""
+    client = TPUJobClient(world.store)
+    client.create(_job_manifest(name, len(placements)))
+    world.jobctl.sync_handler(f"default/{name}")
+    for i, node_name in enumerate(placements):
+        pod = world.store.get("Pod", "default", f"{name}-worker-{i}")
+        world.store.patch(
+            "Pod", "default", pod.metadata.name,
+            {"metadata": {"uid": pod.metadata.uid},
+             "spec": {"node_name": node_name}},
+        )
+        world.store.patch(
+            "Pod", "default", pod.metadata.name,
+            {"metadata": {"uid": pod.metadata.uid},
+             "status": {"phase": PodPhase.RUNNING, "ready": True}},
+            subresource="status",
+        )
+    world.jobctl.sync_handler(f"default/{name}")
+
+
+def _train_stats(slow_pods: Sequence[str] = (),
+                 drift_nodes: Sequence[str] = (),
+                 freeze: Optional[int] = None):
+    """A kubelet stat script: every running batch worker reports ~100ms
+    steps; ``slow_pods`` report a stable-slow 500ms (a sick WORKLOAD —
+    moving it cures nothing); pods on ``drift_nodes`` report a drifting
+    p50 (sick HARDWARE — moving off the node cures it). Workload step
+    progress freezes at round ``freeze``; hardware drift never does."""
+
+    def fn(world: "World", pod, rnd: int) -> Optional[dict]:
+        if LABEL_SERVE_NAME in pod.metadata.labels:
+            return None
+        cur = pod.status.train_stats or {}
+        step = int(cur.get("step", 0))
+        steps = int(cur.get("steps", 0))
+        frozen = freeze is not None and rnd >= freeze
+        if not frozen:
+            step += 5
+            steps += 5
+        p50 = 100.0
+        if pod.spec.node_name in drift_nodes:
+            p50 = 600.0 + 20.0 * rnd
+        elif pod.metadata.name in slow_pods:
+            p50 = 500.0
+        return dict(step=step, steps=steps, step_p50_ms=p50,
+                    buckets={"compute": 4.0, "input": 1.0})
+
+    return fn
+
+
+# -- the six corpora --------------------------------------------------------
+
+
+def _seed_fragmented(world: "World") -> None:
+    for i in (1, 2, 3):
+        _mk_node(world, f"f{i}", cap=2)
+    for i in (1, 2, 3):
+        _seed_bound_job(world, f"frag-{i}", [f"f{i}"])
+
+
+def _seed_straggler(world: "World") -> None:
+    for name, cap in (("n1", 2), ("n2", 2), ("n3", 2), ("n4", 2)):
+        _mk_node(world, name, cap)
+    # strag's worker-0 sits on the sick node n1; lag's worker-0 is an
+    # intrinsically slow WORKLOAD (slow wherever it lands)
+    _seed_bound_job(world, "strag", ["n1", "n2"])
+    _seed_bound_job(world, "lag", ["n3", "n2"])
+
+
+def _seed_mid_drain(world: "World") -> None:
+    _mk_node(world, "d1", cap=2)
+    _mk_node(world, "d2", cap=2)
+    _seed_bound_job(world, "evac", ["d1", "d1"])
+
+
+def _fin_mid_drain(world: "World") -> None:
+    node = world.store.get("Node", NODE_NAMESPACE, "d1")
+    world.store.patch(
+        "Node", NODE_NAMESPACE, "d1",
+        {"metadata": {"uid": node.metadata.uid,
+                      "annotations": {
+                          ANNOTATION_MAINTENANCE_AT: str(EPOCH + 40 * DT),
+                      }}},
+    )
+
+
+def _seed_quota(world: "World") -> None:
+    _mk_node(world, "q1", cap=2)
+    _seed_bound_job(world, "holder", ["q1", "q1"])
+    # the saturated tenant: a gang that genuinely does not fit — it must
+    # WAIT quietly (no defrag churn, no requeue storm, no event spam)
+    client = TPUJobClient(world.store)
+    client.create(_job_manifest("waiter", 2))
+    world.jobctl.sync_handler("default/waiter")
+
+
+def _quota_burns(rnd: int) -> Optional[Dict[str, float]]:
+    # judged rounds start at 1. Hot burn r1-r2, a flapping tail r3-r6
+    # (alternating hot/clean: the shape the clear-hold hysteresis exists
+    # for), clean from r7 — the real policy resolves once, ~r12. Training
+    # stats freeze at r2, so during the flap the Alert is the ONLY moving
+    # object: strip the clear-hold and the FIRING->RESOLVED->FIRING flap
+    # revisits an identical canonical state — the minimal write cycle.
+    if rnd < 1:
+        return None
+    if rnd <= 2:
+        hot = True
+    elif rnd <= 6:
+        hot = (rnd % 2 == 1)
+    else:
+        hot = False
+    v = 20.0 if hot else 0.1
+    return {"fast_short": v, "fast_long": v,
+            "slow_short": v, "slow_long": v}
+
+
+def _serve_manifest(name: str, replicas: int, autoscale: Optional[dict],
+                    ) -> dict:
+    doc: Dict[str, Any] = {
+        "kind": "TPUServe",
+        "metadata": {"name": name},
+        "spec": {"replicas": replicas},
+    }
+    if autoscale is not None:
+        doc["spec"]["autoscale"] = autoscale
+    return doc
+
+
+def _seed_mid_rollout(world: "World") -> None:
+    TPUServeClient(world.store).create(_serve_manifest("roll", 2, None))
+
+
+def _fin_mid_rollout(world: "World") -> None:
+    serve = world.store.get("TPUServe", "default", "roll")
+    world.store.patch(
+        "TPUServe", "default", "roll",
+        {"metadata": {"uid": serve.metadata.uid},
+         "spec": {"template": {"container": {"env": {"MODEL": "v2"}}}}},
+    )
+
+
+def _seed_spike(world: "World") -> None:
+    client = TPUServeClient(world.store)
+    client.create(_serve_manifest("spiky", 1, {
+        "min_replicas": 1,
+        "max_replicas": 4,
+        "target_qps_per_replica": 300.0,
+        "scale_down_stabilization_s": 300.0,
+    }))
+    serve = world.store.get("TPUServe", "default", "spiky")
+    world.store.patch(
+        "TPUServe", "default", "spiky",
+        {"metadata": {"uid": serve.metadata.uid,
+                      "annotations": {ANNOTATION_OFFERED_QPS: "100"}}},
+    )
+
+
+def _spike_stimulus(world: "World", rnd: int) -> None:
+    # judged rounds start at 2: the front door oscillates 900/100 through
+    # r7, then settles at 100 — the down-stabilization window (300s = 5
+    # rounds) is what keeps the real autoscaler from chasing every flip
+    if rnd < 2:
+        return
+    if rnd <= 7:
+        qps = "900" if rnd % 2 == 0 else "100"
+    else:
+        qps = "100"
+    serve = world.store.try_get("TPUServe", "default", "spiky")
+    if serve is None:
+        return
+    if serve.metadata.annotations.get(ANNOTATION_OFFERED_QPS) == qps:
+        return
+    world.store.patch(
+        "TPUServe", "default", "spiky",
+        {"metadata": {"uid": serve.metadata.uid,
+                      "annotations": {ANNOTATION_OFFERED_QPS: qps}}},
+    )
+
+
+CORPORA: Dict[str, Corpus] = {}
+
+
+def _register(corpus: Corpus) -> None:
+    CORPORA[corpus.id] = corpus
+
+
+_register(Corpus(
+    id="fragmented",
+    description="three 1-chip gangs pinning three 2-chip nodes: total "
+                "free fits another gang but no contiguous block does, and "
+                "the defrag gain (1 chip) is under min_gain_chips — the "
+                "rescheduler must do NOTHING",
+    start_round=1, rounds=10,
+    seed_objects=_seed_fragmented,
+    pod_stats=_train_stats(freeze=4),
+    write_budgets={"job": 2, "serve": 0, "autoscaler": 0, "drain": 0,
+                   "rescheduler": 0, "goodput": 12, "slo": 0},
+    requeue_budgets={"job": 2, "serve": 0},
+))
+
+_register(Corpus(
+    id="straggler",
+    description="goodput has blamed two gangs: one pinned to drifting-p50 "
+                "hardware (a move cures it; rebinding to the flagged node "
+                "re-poisons it), one carrying an intrinsically slow "
+                "worker (a move cures nothing; hysteresis must park it "
+                "after ONE try)",
+    start_round=1, rounds=14,
+    seed_objects=_seed_straggler,
+    pod_stats=_train_stats(slow_pods=("lag-worker-0",),
+                           drift_nodes=("n1",), freeze=8),
+    write_budgets={"job": 28, "serve": 0, "autoscaler": 0, "drain": 0,
+                   "rescheduler": 12, "goodput": 24, "slo": 0},
+    requeue_budgets={"job": 4, "serve": 0},
+))
+
+_register(Corpus(
+    id="mid-drain",
+    description="a whole gang sits on a node carrying a fresh maintenance "
+                "notice: the drain plane must cordon, migrate the gang "
+                "once, mark Drained once, and go silent",
+    start_round=1, rounds=12,
+    seed_objects=_seed_mid_drain,
+    finalize=_fin_mid_drain,
+    pod_stats=_train_stats(freeze=6),
+    write_budgets={"job": 16, "serve": 0, "autoscaler": 0, "drain": 10,
+                   "rescheduler": 2, "goodput": 8, "slo": 0},
+    requeue_budgets={"job": 4, "serve": 0},
+))
+
+_register(Corpus(
+    id="quota",
+    description="a capacity-saturated tenant (a pending gang that fits "
+                "nowhere) plus a scripted SLO burn that flaps across the "
+                "fire threshold: the waiter must wait QUIETLY and the "
+                "alert must ride the flap without re-paging",
+    start_round=1, rounds=16,
+    seed_objects=_seed_quota,
+    pod_stats=_train_stats(freeze=2),
+    burns=_quota_burns,
+    write_budgets={"job": 2, "serve": 0, "autoscaler": 0, "drain": 0,
+                   "rescheduler": 2, "goodput": 4, "slo": 3},
+    requeue_budgets={"job": 2, "serve": 0},
+))
+
+_register(Corpus(
+    id="mid-rollout",
+    description="a 2-replica serve snapshotted right after a template "
+                "change: the surge rollout must converge to the new "
+                "generation with zero unready windows and go silent",
+    start_round=2, rounds=10,
+    seed_objects=_seed_mid_rollout,
+    finalize=_fin_mid_rollout,
+    write_budgets={"job": 0, "serve": 18, "autoscaler": 0, "drain": 0,
+                   "rescheduler": 0, "goodput": 0, "slo": 0},
+    requeue_budgets={"job": 0, "serve": 4},
+))
+
+_register(Corpus(
+    id="spike",
+    description="an autoscaled serve under an oscillating front door "
+                "(900/100 qps flips for six rounds, then settles): one "
+                "scale-up, one stabilized scale-down, no chasing",
+    start_round=2, rounds=16,
+    seed_objects=_seed_spike,
+    stimulus=_spike_stimulus,
+    write_budgets={"job": 0, "serve": 18, "autoscaler": 6, "drain": 0,
+                   "rescheduler": 0, "goodput": 0, "slo": 0},
+    requeue_budgets={"job": 0, "serve": 4},
+))
+
+
+# ---------------------------------------------------------------------------
+# the co-simulation world
+# ---------------------------------------------------------------------------
+
+
+class World:
+    """One deterministic closed-loop universe: backing store on a virtual
+    clock, the six REAL loop instances (fresh, as after a leader
+    failover), the gang scheduler + a hollow kubelet as the environment
+    ('fleet'), and the SLO shell. The harness owns every tick."""
+
+    def __init__(self, corpus: Corpus,
+                 snapshot: Optional[Dict[str, Any]] = None):
+        self.corpus = corpus
+        self.backing = ObjectStore()
+        self.now = EPOCH
+        # deterministic virtual clock for every store-stamped timestamp
+        self.backing._now = lambda: self.now
+        self.store = RecordingStore(self.backing)
+        if snapshot is not None:
+            restore_store(self.backing, snapshot)
+        self.jobctl = TPUJobController(
+            self.store, EventRecorder(self.store), ControllerOptions())
+        self.servectl = TPUServeController(self.store)
+        self.autoscaler = ServeAutoscaler(self.store)
+        self.drain = DrainController(self.store)
+        self.rescheduler = Rescheduler(
+            self.store, EventRecorder(self.store), **RESCHED_KW)
+        self.goodput = GoodputAggregator(self.store)
+        self.sched = GangScheduler(self.store)
+        self.slo = _SLOShell(self.store)
+        self.requeues: Dict[str, int] = {"job": 0, "serve": 0}
+        # (step, round, hash) after every author action
+        self.hashes: List[Tuple[int, int, str]] = []
+
+    # -- participants -------------------------------------------------------
+
+    def _tick_loop(self, name: str) -> None:
+        if name == "job":
+            for job in sorted(self.store.list("TPUJob"),
+                              key=lambda j: j.metadata.key()):
+                if not self.jobctl.sync_handler(job.metadata.key()):
+                    self.requeues["job"] += 1
+        elif name == "serve":
+            for srv in sorted(self.store.list("TPUServe"),
+                              key=lambda s: s.metadata.key()):
+                if not self.servectl.sync_handler(srv.metadata.key()):
+                    self.requeues["serve"] += 1
+        elif name == "autoscaler":
+            self.autoscaler.tick(now=self.now)
+        elif name == "drain":
+            self.drain.sync(now=self.now)
+        elif name == "rescheduler":
+            self.rescheduler.sync(now=self.now)
+        elif name == "goodput":
+            self.goodput.tick(now=self.now)
+        else:  # pragma: no cover - defensive
+            raise ConvergeError(f"unknown loop {name!r}")
+
+    def _fleet_step(self) -> None:
+        """The environment's move: the gang scheduler places, the hollow
+        kubelet runs whatever got bound."""
+        self.sched.sync()
+        for p in self.store.list("Pod"):
+            if p.is_finished() or not p.spec.node_name:
+                continue
+            if p.status.phase == PodPhase.PENDING:
+                self.store.patch(
+                    "Pod", p.metadata.namespace, p.metadata.name,
+                    {"metadata": {"uid": p.metadata.uid},
+                     "status": {"phase": PodPhase.RUNNING, "ready": True}},
+                    subresource="status",
+                )
+
+    def _publish_stats(self, rnd: int) -> None:
+        fn = self.corpus.pod_stats
+        if fn is None:
+            return
+        for p in sorted(self.store.list("Pod"),
+                        key=lambda p: p.metadata.key()):
+            if p.is_finished() or p.status.phase != PodPhase.RUNNING:
+                continue
+            blob = fn(self, p, rnd)
+            if blob is None:
+                continue
+            bounded = bounded_train_stats(**blob)
+            if bounded == (p.status.train_stats or {}):
+                continue  # a quiet workload publishes nothing new
+            self.store.patch(
+                "Pod", p.metadata.namespace, p.metadata.name,
+                {"metadata": {"uid": p.metadata.uid},
+                 "status": {"train_stats": bounded}},
+                subresource="status",
+            )
+
+    def _hash_point(self) -> None:
+        # hash first, THEN advance the step counter: writes made during the
+        # upcoming tick must share the step of the hash point AFTER them,
+        # or a pre-tick revisit would claim a post-hash write in its span
+        self.hashes.append(
+            (self.store.step, self.store.round, canonical_hash(self.backing)))
+        self.store.step += 1
+
+    # -- one round ----------------------------------------------------------
+
+    def run_round(self, rnd: int, order: Sequence[int]) -> None:
+        self.now = EPOCH + rnd * DT
+        self.store.round = rnd
+        self.store.author = "fleet"
+        if self.corpus.stimulus is not None:
+            self.corpus.stimulus(self, rnd)
+        self._publish_stats(rnd)
+        self._hash_point()
+        for li in order:
+            name = LOOPS[li]
+            self.store.author = name
+            self._tick_loop(name)
+            self._hash_point()
+            self.store.author = "fleet"
+            self._fleet_step()
+            self._hash_point()
+        self.store.author = "slo"
+        self.slo.tick(
+            self.corpus.burns(rnd) if self.corpus.burns else None, self.now)
+        self._hash_point()
+        self.store.author = "fleet"
+        self._fleet_step()
+        self._hash_point()
+
+
+# ---------------------------------------------------------------------------
+# orders + replay tokens
+# ---------------------------------------------------------------------------
+
+
+def enumerate_orders(seed: int) -> List[str]:
+    """identity + reversed + four seeded shuffles, deduplicated."""
+    orders = [_IDENTITY, _IDENTITY[::-1]]
+    rng = random.Random(seed)
+    digits = list(_IDENTITY)
+    while len(orders) < 6:
+        rng.shuffle(digits)
+        cand = "".join(digits)
+        if cand not in orders:
+            orders.append(cand)
+    return orders
+
+
+def format_token(corpus_id: str, seed: int, order: str) -> str:
+    # fail closed at mint time: a token with a non-int seed would only
+    # surface later, when someone tries to --replay the printed line
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        raise TokenError(f"seed must be an int, got {seed!r}")
+    return f"v1:conv:{corpus_id}:{seed}:{order}"
+
+
+def parse_token(token: str) -> Tuple[str, int, str]:
+    parts = token.split(":")
+    if len(parts) != 5 or parts[0] != "v1" or parts[1] != "conv":
+        raise TokenError(
+            f"bad replay token {token!r}: want v1:conv:<corpus>:<seed>:"
+            f"<order>")
+    _, _, corpus_id, seed_s, order = parts
+    if corpus_id not in CORPORA:
+        raise TokenError(
+            f"bad replay token {token!r}: unknown corpus {corpus_id!r} "
+            f"(have: {', '.join(sorted(CORPORA))})")
+    try:
+        seed = int(seed_s)
+    except ValueError:
+        raise TokenError(
+            f"bad replay token {token!r}: seed {seed_s!r} is not an int")
+    if sorted(order) != sorted(_IDENTITY):
+        raise TokenError(
+            f"bad replay token {token!r}: order {order!r} is not a "
+            f"permutation of {_IDENTITY}")
+    return corpus_id, seed, order
+
+
+# ---------------------------------------------------------------------------
+# judges
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    corpus_id: str
+    seed: int
+    order: str
+    mutant: Optional[str]
+    rounds: Tuple[int, int]               # [first, last] judged rounds
+    writes: Dict[str, int]
+    requeues: Dict[str, int]
+    violations: List[str]
+
+    @property
+    def token(self) -> str:
+        return format_token(self.corpus_id, self.seed, self.order)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# controller authors whose writes can constitute an oscillation. The
+# fleet (scripted stimulus + kubelet shims) is the environment's churn,
+# not a loop fighting itself — but the SLO shell drives the real alert
+# state machine, so its writes count.
+_CYCLE_AUTHORS = frozenset(LOOPS) | {"slo"}
+
+
+def _judge(world: World, result: RunResult) -> None:
+    corpus = world.corpus
+    first, last = result.rounds
+    writes = world.store.writes
+
+    # quiescence: the stimulus scripts all freeze before the tail, so the
+    # final two rounds must be write-free from EVERY author
+    tail = [w for w in writes if w.round >= last - 1]
+    if tail:
+        by = sorted({f"{w.author}:{w.verb} {w.kind} {w.key}" for w in tail})
+        result.violations.append(
+            f"quiescence: {len(tail)} write(s) in the final two rounds "
+            f"(rounds {last - 1}-{last}) — the plane never settles: "
+            + "; ".join(by[:6]) + ("; ..." if len(by) > 6 else ""))
+
+    # write cycles: a canonical hash revisiting an earlier value with a
+    # DIFFERENT state in between and >= 1 loop-authored non-Event write in
+    # the span is an oscillation (fleet stimulus and audit Events are the
+    # environment's churn, not a loop fighting itself)
+    seen: Dict[str, int] = {}
+    cycle = None
+    for idx, (step, rnd, h) in enumerate(world.hashes):
+        if h in seen:
+            i = seen[h]
+            stretch = world.hashes[i + 1: idx]
+            if any(hh != h for _, _, hh in stretch):
+                lo_step = world.hashes[i][0]
+                span = [w for w in writes
+                        if lo_step < w.step <= step
+                        and w.author in _CYCLE_AUTHORS and w.kind != "Event"]
+                if span:
+                    cycle = (world.hashes[i][1], rnd, span)
+                    break
+        else:
+            seen[h] = idx
+    if cycle is not None:
+        lo_rnd, hi_rnd, span = cycle
+        trail = ", ".join(
+            f"{w.author}:{w.verb} {w.kind} {w.key}" for w in span[:8])
+        result.violations.append(
+            f"cycle: state hash at round {hi_rnd} revisits round {lo_rnd} "
+            f"after {len(span)} loop write(s) — an oscillation: {trail}"
+            + (", ..." if len(span) > 8 else ""))
+
+    # bounded wasted work: writes per author, requeues per controller
+    for author in sorted(corpus.write_budgets):
+        budget = corpus.write_budgets[author]
+        got = result.writes.get(author, 0)
+        if got > budget:
+            result.violations.append(
+                f"budget: author '{author}' made {got} store writes "
+                f"(budget {budget}) over rounds {first}-{last}")
+    for loop in sorted(corpus.requeue_budgets):
+        budget = corpus.requeue_budgets[loop]
+        got = result.requeues.get(loop, 0)
+        if got > budget:
+            result.violations.append(
+                f"budget: loop '{loop}' requeued {got} times "
+                f"(budget {budget})")
+
+
+# ---------------------------------------------------------------------------
+# corpus snapshots + runs
+# ---------------------------------------------------------------------------
+
+_SNAPSHOT_CACHE: Dict[str, Dict[str, Any]] = {}
+
+
+def get_corpus(corpus_id: str) -> Corpus:
+    try:
+        return CORPORA[corpus_id]
+    except KeyError:
+        raise CorpusError(
+            f"unknown corpus {corpus_id!r} (have: "
+            f"{', '.join(sorted(CORPORA))})")
+
+
+def corpus_snapshot(corpus_id: str) -> Dict[str, Any]:
+    """Build (and cache) the corpus start state by driving the REAL loops
+    through the scripted warmup — every snapshot is reachable by
+    construction, not hand-assembled."""
+    if corpus_id in _SNAPSHOT_CACHE:
+        return _SNAPSHOT_CACHE[corpus_id]
+    corpus = get_corpus(corpus_id)
+    world = World(corpus)
+    world.store.author = "setup"
+    corpus.seed_objects(world)
+    identity = tuple(range(len(LOOPS)))
+    for rnd in range(corpus.start_round):
+        world.run_round(rnd, identity)
+    if corpus.finalize is not None:
+        world.store.author = "setup"
+        corpus.finalize(world)
+    doc = snapshot_store(world.backing)
+    _SNAPSHOT_CACHE[corpus_id] = doc
+    return doc
+
+
+def load_snapshot_file(path: str) -> Dict[str, Any]:
+    """Fail-closed external snapshot loading (the --snapshot seam)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise CorpusError(f"cannot read snapshot {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise CorpusError(f"malformed snapshot JSON in {path!r}: {e}")
+    # validate by restoring into a scratch store before anyone trusts it
+    try:
+        restore_store(ObjectStore(), doc)
+    except ScenarioError as e:
+        raise CorpusError(f"invalid snapshot {path!r}: {e}")
+    return doc
+
+
+def run_one(corpus_id: str, seed: int, order: str,
+            mutant: Optional[str] = None,
+            rounds: Optional[int] = None,
+            snapshot: Optional[Dict[str, Any]] = None) -> RunResult:
+    corpus = get_corpus(corpus_id)
+    if sorted(order) != sorted(_IDENTITY):
+        raise TokenError(f"order {order!r} is not a permutation of "
+                         f"{_IDENTITY}")
+    doc = snapshot if snapshot is not None else corpus_snapshot(corpus_id)
+    world = World(corpus, snapshot=doc)
+    n_rounds = corpus.rounds if rounds is None else rounds
+    first = corpus.start_round
+    last = first + n_rounds - 1
+    undo = None
+    if mutant is not None:
+        undo = get_mutant(mutant).apply(world)
+    try:
+        for rnd in range(first, last + 1):
+            world.run_round(rnd, tuple(int(c) for c in order))
+    finally:
+        if undo is not None:
+            undo()
+    result = RunResult(
+        corpus_id=corpus_id, seed=seed, order=order, mutant=mutant,
+        rounds=(first, last), writes=world.store.counts(),
+        requeues=dict(world.requeues), violations=[],
+    )
+    _judge(world, result)
+    return result
+
+
+def run_corpus(corpus_id: str, seed: int = 0,
+               mutant: Optional[str] = None,
+               rounds: Optional[int] = None,
+               orders: Optional[Sequence[str]] = None) -> List[RunResult]:
+    outs = []
+    for order in (orders if orders is not None else enumerate_orders(seed)):
+        outs.append(run_one(corpus_id, seed, order, mutant=mutant,
+                            rounds=rounds))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# seeded mutants — the checker's own bar
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Mutant:
+    """One reintroduced defect class. ``apply`` arms it on a fresh World
+    and returns an undo closure (mutants that monkeypatch module/class
+    seams MUST restore them — the harness runs real loops right after)."""
+
+    id: str
+    corpus_id: str            # the corpus whose script exposes it
+    description: str
+    apply: Callable[[World], Callable[[], None]]
+
+
+def _m1_apply(world: World) -> Callable[[], None]:
+    prev = world.rescheduler.hysteresis_s
+    world.rescheduler.hysteresis_s = 0.0
+
+    def undo() -> None:
+        world.rescheduler.hysteresis_s = prev
+    return undo
+
+
+def _m2_apply(world: World) -> Callable[[], None]:
+    orig = autoscaler_mod.recommend
+
+    def myopic(samples, current, targets, now, last_scale_up_t=None):
+        return orig(samples[-1:], current, targets, now,
+                    last_scale_up_t=last_scale_up_t)
+
+    autoscaler_mod.recommend = myopic
+
+    def undo() -> None:
+        autoscaler_mod.recommend = orig
+    return undo
+
+
+def _m3_apply(world: World) -> Callable[[], None]:
+    def always_write(job) -> bool:
+        world.store.patch(
+            "TPUJob", job.metadata.namespace, job.metadata.name,
+            {"metadata": {"uid": job.metadata.uid},
+             "status": job.status.to_dict()},
+            subresource="status",
+        )
+        return True
+
+    world.jobctl._write_status = always_write
+    return lambda: None  # instance-local; dies with the World
+
+
+def _m4_apply(world: World) -> Callable[[], None]:
+    orig = GangScheduler.__dict__["_pick_node"]
+
+    def flat_least_loaded(nodes, used, cost):
+        best = best_load = None
+        for n in nodes:
+            cap = n.status.capacity_chips
+            u = used.get(n.metadata.name, 0)
+            if cap is not None and u + cost > cap:
+                continue
+            if best is None or u < best_load:
+                best, best_load = n.metadata.name, u
+        return best
+
+    GangScheduler._pick_node = staticmethod(flat_least_loaded)
+
+    def undo() -> None:
+        GangScheduler._pick_node = orig
+    return undo
+
+
+def _m5_apply(world: World) -> Callable[[], None]:
+    world.slo.policy = replace(world.slo.policy, clear_hold_s=0.0)
+    return lambda: None
+
+
+def _m6_apply(world: World) -> Callable[[], None]:
+    orig = world.jobctl.sync_handler
+
+    def hot_loop(key: str) -> bool:
+        orig(key)
+        return False  # "retry forever": the classic busy reconcile
+
+    world.jobctl.sync_handler = hot_loop
+    return lambda: None
+
+
+MUTANTS: Dict[str, Mutant] = {m.id: m for m in (
+    Mutant("m1-no-hysteresis", "straggler",
+           "rescheduler hysteresis removed: a gang whose straggler "
+           "survives the move is migrated again on every re-blame "
+           "(ping-pong)", _m1_apply),
+    Mutant("m2-no-stabilization", "spike",
+           "autoscaler stabilization window removed (decides on the "
+           "newest sample only): scale flaps with every qps flip",
+           _m2_apply),
+    Mutant("m3-no-elision", "fragmented",
+           "job status no-op elision removed (unconditional status write "
+           "per reconcile): the plane never quiesces", _m3_apply),
+    Mutant("m4-no-anti-hop", "straggler",
+           "scheduler placement tiers removed (flat least-loaded): a "
+           "migrated gang lands right back on the flagged sick node",
+           _m4_apply),
+    Mutant("m5-no-clear-hold", "quota",
+           "SLO clear-hold hysteresis removed: the alert re-pages on "
+           "every flap across the fire threshold", _m5_apply),
+    Mutant("m6-requeue-always", "fragmented",
+           "job reconcile returns 'retry' unconditionally: a hot loop "
+           "that burns the queue forever", _m6_apply),
+)}
+
+
+def get_mutant(mutant_id: str) -> Mutant:
+    try:
+        return MUTANTS[mutant_id]
+    except KeyError:
+        raise ConvergeError(
+            f"unknown mutant {mutant_id!r} (have: "
+            f"{', '.join(sorted(MUTANTS))})")
+
+
+# ---------------------------------------------------------------------------
+# replay + self-test
+# ---------------------------------------------------------------------------
+
+
+def replay(token: str, mutant: Optional[str] = None,
+           expect_corpus: Optional[str] = None,
+           expect_seed: Optional[int] = None) -> RunResult:
+    """Re-execute the exact run a token encodes. Explicitly-passed
+    --corpus/--seed must MATCH the token: silently preferring one over
+    the other would replay a different run than the user asked for."""
+    corpus_id, seed, order = parse_token(token)
+    if expect_corpus is not None and expect_corpus != corpus_id:
+        raise TokenError(
+            f"replay token names corpus {corpus_id!r} but --corpus "
+            f"{expect_corpus!r} was passed: refusing to guess")
+    if expect_seed is not None and expect_seed != seed:
+        raise TokenError(
+            f"replay token encodes seed {seed} but --seed {expect_seed} "
+            f"was passed: refusing to guess")
+    return run_one(corpus_id, seed, order, mutant=mutant)
+
+
+def self_test(seed: int = 0, verbose: bool = False,
+              log: Optional[Callable[[str], None]] = None) -> List[str]:
+    """The checker's own gate: every REAL loop runs the whole corpus
+    clean under every enumerated order, and every seeded mutant is caught
+    on its corpus — with a replay token that reproduces identically."""
+    say = log or (lambda s: None)
+    failures: List[str] = []
+    orders = enumerate_orders(seed)
+
+    for corpus_id in sorted(CORPORA):
+        for order in orders:
+            res = run_one(corpus_id, seed, order)
+            if res.ok:
+                say(f"  real  {corpus_id:<12} order={order}: converged")
+            else:
+                say(f"  real  {corpus_id:<12} order={order}: "
+                    f"{len(res.violations)} violation(s)")
+                failures.append(
+                    f"real loops violated convergence on corpus "
+                    f"'{corpus_id}' order {order} "
+                    f"(replay: {res.token}): {res.violations[0]}")
+
+    for mid in sorted(MUTANTS):
+        mutant = MUTANTS[mid]
+        caught: Optional[RunResult] = None
+        for order in orders:
+            res = run_one(mutant.corpus_id, seed, order, mutant=mid)
+            if not res.ok:
+                caught = res
+                break
+        if caught is None:
+            failures.append(
+                f"mutant '{mid}' NOT caught on corpus "
+                f"'{mutant.corpus_id}' under any of {len(orders)} orders")
+            say(f"  mut   {mid:<20} ESCAPED")
+            continue
+        # the token must reproduce the identical verdict (determinism)
+        again = run_one(caught.corpus_id, seed, caught.order, mutant=mid)
+        if again.violations != caught.violations:
+            failures.append(
+                f"mutant '{mid}' verdict is not deterministic: replay of "
+                f"{caught.token} produced different violations")
+        say(f"  mut   {mid:<20} caught (replay: {caught.token} "
+            f"--mutant {mid})")
+    return failures
+
+
+def render_result(res: RunResult) -> str:
+    writes = ", ".join(
+        f"{a}={res.writes.get(a, 0)}" for a in (*LOOPS, "slo", "fleet"))
+    lines = [
+        f"corpus {res.corpus_id} order={res.order} "
+        f"rounds={res.rounds[0]}..{res.rounds[1]}"
+        + (f" mutant={res.mutant}" if res.mutant else ""),
+        f"  writes: {writes}",
+        f"  requeues: job={res.requeues.get('job', 0)} "
+        f"serve={res.requeues.get('serve', 0)}",
+    ]
+    if res.ok:
+        lines.append("  CONVERGED")
+    else:
+        for v in res.violations:
+            lines.append(f"  VIOLATION {v}")
+        lines.append(f"  replay: {res.token}")
+    return "\n".join(lines)

@@ -283,6 +283,19 @@ RULES: Dict[str, Rule] = {
             "fence), close (teardown)",
         ),
         Rule(
+            "LEV001", "error",
+            "handler derives decisions from the delivered event's payload",
+            "ISSUE 19: watch deliveries are stale the moment they arrive — "
+            "compaction, resync, dedup and leader failover all drop or "
+            "reorder edges, so an event's embedded object is a snapshot of "
+            "history, not of the cluster. A handler that reads "
+            "event.obj.spec/.status is edge-triggered: it acts on the edge "
+            "it happened to see and diverges the first time an edge is "
+            "missed. Use the event only for identity (key/kind/metadata), "
+            "re-read CURRENT state from the store/lister, and derive the "
+            "decision from that — level triggers converge from any state",
+        ),
+        Rule(
             "REP001", "error",
             "direct store write on a follower/standby handle",
             "ISSUE 8: every mutation routes through the leased leader "
@@ -432,6 +445,71 @@ def _check_rmw001(ctx: _FileCtx, fn: ast.AST) -> None:
                 "RMW001", call,
                 f"get+update read-modify-write through {recv!r}; use "
                 f".patch with an rv precondition (or optimistic_update)",
+            )
+
+
+# LEV001: variables that hold a delivered watch event, by name or by a
+# WatchEvent annotation (param or annotated local, the repo's pump idiom)
+_EVENT_VAR_NAMES = {"event", "ev", "evt", "wevent", "watch_event"}
+_EVENT_PAYLOAD_ATTRS = ("obj", "object")
+
+
+def _is_watch_event_annotation(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return "WatchEvent" in ann.value
+    return _last_component(_dotted(ann)) == "WatchEvent"
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes lexically inside ``fn``, excluding nested function bodies
+    (those are visited as functions in their own right)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_lev001(ctx: _FileCtx, fn: ast.AST) -> None:
+    # event variables: any event-named name (param, local, loop target —
+    # the binding form doesn't change what the value is), plus anything
+    # annotated WatchEvent under a non-standard name
+    args = fn.args
+    params = list(args.args) + list(args.kwonlyargs)
+    params += list(getattr(args, "posonlyargs", []))
+    event_vars: Set[str] = set(_EVENT_VAR_NAMES)
+    for a in params:
+        if _is_watch_event_annotation(a.annotation):
+            event_vars.add(a.arg)
+    for node in _own_nodes(fn):
+        if (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.target, ast.Name)
+            and _is_watch_event_annotation(node.annotation)
+        ):
+            event_vars.add(node.target.id)
+    for node in _own_nodes(fn):
+        if not (isinstance(node, ast.Attribute) and node.attr in ("spec", "status")):
+            continue
+        inner = _dotted(node.value)
+        if not inner:
+            continue
+        parts = inner.split(".")
+        if (
+            len(parts) == 2
+            and parts[0] in event_vars
+            and parts[1] in _EVENT_PAYLOAD_ATTRS
+        ):
+            ctx.report(
+                "LEV001", node,
+                f"decision read from the delivered event's payload "
+                f"({inner}.{node.attr}); the payload is a stale snapshot — "
+                f"take only the key from the event, re-read current state "
+                f"from the store/lister, and decide from that",
             )
 
 
@@ -1186,6 +1264,7 @@ def lint_source(
     for fn in _iter_functions(tree):
         _check_rmw001(ctx, fn)
         _check_term001(ctx, fn)
+        _check_lev001(ctx, fn)
     _check_obs002(ctx, tree)
     _check_obs004(ctx, tree)
 

@@ -175,6 +175,12 @@ class TPUJobClient:
                     continue
                 if ev.type == DELETED:
                     raise NotFound(f"TPUJob {ns}/{name} deleted while waiting")
+                # oplint: disable=LEV001 — a wait-until helper is an
+                # OBSERVER, not a reconciler: "the predicate held in some
+                # observed state" is exactly wait semantics (kube's
+                # wait.UntilWithSync does the same), and the idle-resync
+                # branch above already re-reads live state whenever the
+                # watch goes quiet, so a dropped edge cannot strand us
                 if until(ev.obj.status):
                     return ev.obj
         finally:

@@ -160,10 +160,11 @@ class DrainController:
 
     # -- the per-tick evacuation pass ---------------------------------------
 
-    def sync(self) -> None:
+    def sync(self, now: Optional[float] = None) -> None:
         if self.cache is not None and not self.cache.has_synced():
             return  # cold cache = empty world; next tick retries
-        now = time.time()
+        # injectable clock: convcheck drives the pass on a VirtualClock
+        now = time.time() if now is None else now
         nodes = self.read.list("Node", NODE_NAMESPACE)
         noticed = {}
         for node in nodes:

@@ -474,7 +474,15 @@ class GoodputAggregator:
                 1 for w in st.workers.values() if w.steps > 0
             ),
         }
-        if blob == st.telemetry:
+        # No-op elision must ignore the goodput ratio itself: it is derived
+        # from WALL time, so it drifts every tick even when no worker has
+        # reported anything new. Comparing it would turn every idle tick
+        # into a status write — the exact never-quiesces defect convcheck
+        # exists to catch. The gauge above still tracks the live ratio;
+        # the persisted rollup only moves when telemetry-derived fields do.
+        def _stable(b):
+            return {k: v for k, v in (b or {}).items() if k != "goodput"}
+        if _stable(blob) == _stable(st.telemetry):
             return  # no-op elision: an idle rollup costs zero writes
         try:
             self.store.patch(

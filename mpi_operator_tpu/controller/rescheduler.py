@@ -157,10 +157,11 @@ class Rescheduler:
 
     # -- one level-triggered pass -------------------------------------------
 
-    def sync(self) -> None:
+    def sync(self, now: Optional[float] = None) -> None:
         if self.cache is not None and not self.cache.has_synced():
             return
-        now = time.time()
+        # injectable clock: convcheck drives the pass on a VirtualClock
+        now = time.time() if now is None else now
         nodes = self.read.list("Node", NODE_NAMESPACE)
         if not nodes:
             return  # scalar 'local' shape: nothing to defragment
@@ -219,10 +220,14 @@ class Rescheduler:
             uid = job.metadata.uid
             last = self._moved.get(uid)
             if last is not None and now - last < self.hysteresis_s:
+                # the message must be tick-stable (keyed on the MOVE time,
+                # not the elapsed time): _park dedupes on message equality,
+                # and a message embedding "Ns ago" changes every sync —
+                # one Event per tick, forever, on an otherwise-idle cluster
                 parked += self._park(
                     job,
-                    f"straggler move parked: gang moved {now - last:.0f}s "
-                    f"ago (hysteresis {self.hysteresis_s:.0f}s)",
+                    f"straggler move parked: gang moved at t={last:.0f} "
+                    f"(hysteresis {self.hysteresis_s:.0f}s)",
                 )
                 continue
             ns, gang = job.metadata.namespace, job.metadata.name

@@ -452,6 +452,12 @@ class ScenarioEngine:
                     for t, a, e in self.chaos.executed if e]
         return out
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Freeze the engine's store into a :func:`snapshot_store` document
+        — the reachable start states ``analysis convcheck`` judges come
+        from here."""
+        return snapshot_store(self.store)
+
     def _wall_chaos(self, script: ChaosScript) -> ChaosScript:
         """The embedded fault timeline, converted to wall time: `at`,
         active-rule deadlines AND injected delay amounts all compress —
@@ -554,6 +560,68 @@ class ScenarioEngine:
                 }},
             },
         })
+
+
+# ---------------------------------------------------------------------------
+# store snapshots — the export seam for offline analysis (convcheck)
+# ---------------------------------------------------------------------------
+
+SNAPSHOT_VERSION = 1
+
+
+def snapshot_store(store) -> Dict[str, Any]:
+    """Export every object in the store as a plain-dict document.
+
+    The document is the reachable-state seam between the scenario plane and
+    offline analysis: ``analysis convcheck`` replays its start-state corpus
+    from exactly this shape, so a paused soak run can be frozen mid-rollout /
+    mid-drain and judged for convergence without re-running the day."""
+    from mpi_operator_tpu.machinery import serialize
+
+    objects = []
+    for kind in sorted(serialize.KIND_CLASSES):
+        for obj in store.list(kind):
+            objects.append({"kind": kind, "object": serialize.encode(obj)})
+    return {"version": SNAPSHOT_VERSION, "objects": objects}
+
+
+def restore_store(store, doc: Dict[str, Any]) -> int:
+    """Load a :func:`snapshot_store` document into ``store`` (create-only:
+    the target is expected empty). Fails closed — an unknown kind, a wrong
+    version or a malformed entry raises :class:`ScenarioError` rather than
+    silently building a half-world. Returns the object count."""
+    from mpi_operator_tpu.machinery import serialize
+
+    if not isinstance(doc, dict):
+        raise ScenarioError(f"snapshot must be a mapping, got "
+                            f"{type(doc).__name__}")
+    version = doc.get("version")
+    if version != SNAPSHOT_VERSION:
+        raise ScenarioError(f"unsupported snapshot version {version!r} "
+                            f"(want {SNAPSHOT_VERSION})")
+    entries = doc.get("objects")
+    if not isinstance(entries, list):
+        raise ScenarioError("snapshot 'objects' must be a list")
+    n = 0
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict) or not isinstance(
+                entry.get("object"), dict):
+            raise ScenarioError(f"snapshot objects[{i}] is malformed")
+        kind = entry.get("kind")
+        try:
+            obj = serialize.decode(kind, entry["object"])
+        except KeyError:
+            raise ScenarioError(f"snapshot objects[{i}] has unknown kind "
+                                f"{kind!r}") from None
+        except Exception as e:
+            raise ScenarioError(
+                f"snapshot objects[{i}] ({kind}) failed to decode: {e}"
+            ) from None
+        # the snapshot carries authoritative uids; keep them so uid-pinned
+        # patches in the replayed loops still match
+        store.create(obj)
+        n += 1
+    return n
 
 
 def smoke() -> int:

@@ -181,6 +181,21 @@ def cmd_events(client: TPUJobClient, args) -> int:
         return 0
     rows = [[_age(e.timestamp), e.type, e.reason, e.message] for e in evs]
     print(_table(rows, ["AGE", "TYPE", "REASON", "MESSAGE"]))
+    # oscillation smell: the recorder dedupes identical (reason, message)
+    # pairs, so a reason repeating with DIFFERENT messages means some
+    # controller keeps re-deciding — the exact churn the convergence
+    # checker reproduces offline (README: "Convergence checking")
+    churn = {}
+    for e in evs:
+        churn.setdefault(e.reason, set()).add(e.message)
+    noisy = sorted(r for r, msgs in churn.items() if len(msgs) >= 5)
+    if noisy:
+        print(
+            f"note: reason(s) {', '.join(noisy)} repeat with varying "
+            "messages — controllers may be oscillating; reproduce with "
+            "`python -m mpi_operator_tpu.analysis converge`",
+            file=sys.stderr,
+        )
     return 0
 
 

@@ -1,0 +1,27 @@
+# oplint fixture: LEV001 must fire when a handler derives decisions from
+# the delivered watch event's embedded payload instead of re-reading live
+# state. Lines carrying the bad form are marked with an expect comment.
+
+
+def handle_event(self, event):
+    # edge-triggered: the event's snapshot of spec decides the action
+    if event.obj.spec.worker > 2:  # expect: LEV001
+        self.scale_down(event.obj.metadata.key())
+
+
+def on_update(ev):
+    phase = ev.obj.status.phase  # expect: LEV001
+    return phase == "Running"
+
+
+def pump(self, evt):
+    # the k8s client-go shape: the payload rides under .object
+    replicas = evt.object.spec.replicas  # expect: LEV001
+    self.desired = replicas
+
+
+def drain_queue(self, item):
+    # an annotated local is an event variable too (the repo's pump idiom)
+    we: "WatchEvent" = item
+    if we.obj.status.ready:  # expect: LEV001
+        self.enqueue(we.obj.metadata.key())

@@ -1,0 +1,31 @@
+# oplint fixture: blessed level-triggered shapes LEV001 must stay silent
+# on, plus a suppressed deliberate exception.
+
+
+def handle_event(self, event):
+    # the level-triggered idiom: the event contributes only IDENTITY; the
+    # decision is derived from a fresh read of current state
+    key = event.obj.metadata.key()
+    job = self.store.get("TPUJob", *key.split("/"))
+    if job is not None and job.spec.worker > 2:
+        self.scale_down(key)
+
+
+def route_by_kind(ev):
+    # kind/type/metadata access on the payload is identity, not state
+    if ev.kind == "Event":
+        return None
+    return ev.obj.metadata.name
+
+
+def unrelated_param(self, obj):
+    # a plain object param is not a watch event; reading its spec is the
+    # normal shape for a reconciler that already re-listed
+    return obj.spec.worker
+
+
+def dedup_filter(self, event):
+    # oplint: disable=LEV001 — resourceVersion-based dedup must compare
+    # the DELIVERED revision, not a re-read one; the decision this feeds
+    # is "drop the stale delivery", which is exactly edge metadata
+    return event.obj.status.observed_generation

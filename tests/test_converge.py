@@ -1,0 +1,223 @@
+"""convcheck: convergence & quiescence checking of the six control loops
+(ISSUE 19).
+
+Tier-1 runs every corpus under one interleaving plus a representative
+mutant pair and the CLI/token fail-closed contracts; the exhaustive sweep
+(every corpus x every enumerated order x every mutant — the full
+``converge --selftest`` bar) rides the slow tier and the verify gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi_operator_tpu.analysis import convcheck
+from mpi_operator_tpu.machinery.store import ObjectStore
+
+pytestmark = pytest.mark.converge
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+# ---------------------------------------------------------------------------
+# the real loops converge (tier-1: one interleaving per corpus)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("corpus_id", sorted(convcheck.CORPORA))
+def test_real_loops_converge(corpus_id):
+    res = convcheck.run_one(corpus_id, 0, convcheck._IDENTITY)
+    assert res.ok, convcheck.render_result(res)
+
+
+def test_run_is_deterministic_and_token_replays_it():
+    a = convcheck.run_one("straggler", 0, convcheck._IDENTITY)
+    b = convcheck.replay(a.token)
+    assert a.token == b.token
+    assert a.writes == b.writes
+    assert a.requeues == b.requeues
+    assert a.violations == b.violations
+
+
+def test_order_enumeration_is_seeded_and_deduped():
+    orders = convcheck.enumerate_orders(0)
+    assert orders[0] == convcheck._IDENTITY
+    assert len(orders) == len(set(orders))
+    assert all(sorted(o) == sorted(convcheck._IDENTITY) for o in orders)
+    assert convcheck.enumerate_orders(0) == orders  # same seed, same orders
+    assert convcheck.enumerate_orders(1) != orders
+
+
+# ---------------------------------------------------------------------------
+# mutants (tier-1 pair: the quiescence killer and the hot requeue loop;
+# the full six ride --selftest in the slow tier)
+# ---------------------------------------------------------------------------
+
+
+def test_mutant_no_elision_never_quiesces():
+    res = convcheck.run_one("fragmented", 0, convcheck._IDENTITY,
+                            mutant="m3-no-elision")
+    assert not res.ok
+    assert any("quiescence" in v for v in res.violations), res.violations
+
+
+def test_mutant_requeue_always_blows_the_budget():
+    res = convcheck.run_one("fragmented", 0, convcheck._IDENTITY,
+                            mutant="m6-requeue-always")
+    assert not res.ok
+    assert any("requeued" in v for v in res.violations), res.violations
+
+
+def test_mutant_no_clear_hold_is_a_write_cycle():
+    """The minimal oscillation: with stats frozen, the flapping Alert is
+    the only moving object — the cycle judge must print it with authors."""
+    res = convcheck.run_one("quota", 0, convcheck._IDENTITY,
+                            mutant="m5-no-clear-hold")
+    assert not res.ok
+    cycle = [v for v in res.violations if v.startswith("cycle:")]
+    assert cycle and "slo:patch Alert" in cycle[0], res.violations
+
+
+def test_mutants_leave_no_global_monkeypatch_behind():
+    """m2/m4 patch module/class seams; their undo must restore them, or
+    every later run in the process inherits the defect."""
+    from mpi_operator_tpu.controller import autoscaler as autoscaler_mod
+    from mpi_operator_tpu.scheduler.gang import GangScheduler
+
+    rec = autoscaler_mod.recommend
+    pick = GangScheduler.__dict__["_pick_node"]
+    convcheck.run_one("spike", 0, convcheck._IDENTITY,
+                      mutant="m2-no-stabilization")
+    convcheck.run_one("straggler", 0, convcheck._IDENTITY,
+                      mutant="m4-no-anti-hop")
+    assert autoscaler_mod.recommend is rec
+    assert GangScheduler.__dict__["_pick_node"] is pick
+
+
+# ---------------------------------------------------------------------------
+# fail-closed contracts: corpus ids, snapshots, tokens
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_corpus_is_a_typed_error():
+    with pytest.raises(convcheck.CorpusError, match="unknown corpus"):
+        convcheck.get_corpus("nope")
+    with pytest.raises(convcheck.CorpusError):
+        convcheck.run_one("nope", 0, convcheck._IDENTITY)
+
+
+def test_malformed_snapshot_file_fails_closed(tmp_path):
+    p = tmp_path / "snap.json"
+    p.write_text("{not json", encoding="utf-8")
+    with pytest.raises(convcheck.CorpusError, match="snapshot"):
+        convcheck.load_snapshot_file(str(p))
+    # valid JSON, wrong shape: still refused, never half-restored
+    p.write_text(json.dumps({"version": 999, "objects": "?"}),
+                 encoding="utf-8")
+    with pytest.raises(convcheck.CorpusError):
+        convcheck.load_snapshot_file(str(p))
+    with pytest.raises(convcheck.CorpusError):
+        convcheck.load_snapshot_file(str(tmp_path / "missing.json"))
+
+
+def test_snapshot_file_round_trips_the_corpus(tmp_path):
+    from mpi_operator_tpu.machinery.scenario import snapshot_store
+
+    doc = convcheck.corpus_snapshot("fragmented")
+    p = tmp_path / "frag.json"
+    p.write_text(json.dumps(doc), encoding="utf-8")
+    loaded = convcheck.load_snapshot_file(str(p))
+    res = convcheck.run_one("fragmented", 0, convcheck._IDENTITY,
+                            snapshot=loaded)
+    assert res.ok, convcheck.render_result(res)
+
+
+def test_token_parse_fails_closed():
+    good = convcheck.format_token("quota", 3, "543210")
+    assert convcheck.parse_token(good) == ("quota", 3, "543210")
+    for bad in (
+        "v2:conv:quota:0:012345",        # unknown version
+        "v1:fuzz:quota:0:012345",        # wrong family
+        "v1:conv:nope:0:012345",         # unknown corpus
+        "v1:conv:quota:x:012345",        # non-integer seed
+        "v1:conv:quota:0:011345",        # not a permutation
+        "v1:conv:quota:0",               # truncated
+    ):
+        with pytest.raises(convcheck.TokenError):
+            convcheck.parse_token(bad)
+    # minting fails closed too: a None seed (e.g. an unfilled CLI default
+    # forwarded by mistake) must not print an unreplayable token
+    with pytest.raises(convcheck.TokenError):
+        convcheck.format_token("quota", None, "012345")
+
+
+def test_replay_rejects_contradicting_flags():
+    token = convcheck.format_token("quota", 0, convcheck._IDENTITY)
+    with pytest.raises(convcheck.TokenError, match="corpus"):
+        convcheck.replay(token, expect_corpus="spike")
+    with pytest.raises(convcheck.TokenError, match="seed"):
+        convcheck.replay(token, expect_seed=7)
+    # matching flags are fine — explicitness is not an error
+    assert convcheck.replay(token, expect_corpus="quota",
+                            expect_seed=0).ok
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts
+# ---------------------------------------------------------------------------
+
+
+def test_cli_converge_replay_and_mismatch(tmp_path):
+    token = "v1:conv:fragmented:0:012345"
+    r = _run_cli("converge", "--replay", token)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CONVERGED" in r.stdout
+    # contradicting --corpus/--seed: refused with exit 2, nothing runs
+    r = _run_cli("converge", "--replay", token, "--corpus", "spike")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "refus" in r.stderr or "was passed" in r.stderr
+    r = _run_cli("converge", "--replay", token, "--seed", "9")
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_cli_converge_fail_closed_exit_codes(tmp_path):
+    r = _run_cli("converge", "--corpus", "nope")
+    assert r.returncode == 2
+    assert "unknown corpus" in r.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text("{oops", encoding="utf-8")
+    r = _run_cli("converge", "--corpus", "fragmented",
+                 "--snapshot", str(bad))
+    assert r.returncode == 2
+    assert "snapshot" in r.stderr
+    r = _run_cli("converge", "--replay", "v1:conv:bogus")
+    assert r.returncode == 2
+
+
+def test_cli_converge_mutant_exits_one_with_token():
+    r = _run_cli("converge", "--corpus", "fragmented", "--order", "012345",
+                 "--mutant", "m3-no-elision")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "VIOLATION" in r.stdout
+    assert "replay: v1:conv:fragmented:0:012345" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the exhaustive bar (slow tier + the verify gate's `converge --selftest`)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_selftest_catches_all_mutants_and_real_loops_run_clean():
+    assert convcheck.self_test(0) == []

@@ -267,3 +267,39 @@ def test_admin_token_never_crosses_a_plaintext_log_connection(capsys):
     assert log_token_for("http://x/logs/a", admin="adm", read=None) is None
     assert log_token_for("https://x/logs/a", admin="adm", read="rd") == "rd"
     assert log_token_for("/var/log/a.log", admin="adm", read=None) is None
+
+
+def test_events_churn_hint_points_at_convcheck(tmp_path, capsys):
+    """A reason repeating with VARYING messages defeats the recorder's
+    (reason, message) dedupe — the oscillation smell the convergence
+    checker reproduces offline. `ctl events` must flag it on stderr
+    without disturbing the table; a quiet trail gets no note."""
+    from mpi_operator_tpu.machinery.events import WARNING
+
+    path = str(tmp_path / "hint.db")
+    store = SqliteStore(path, poll_interval=0.02)
+    try:
+        recorder = EventRecorder(store)
+        spec = f"sqlite:{path}"
+        assert run_ctl(spec, "create", "-f", PI_YAML) == 0
+        capsys.readouterr()
+        job = store.get("TPUJob", "default", "pi")
+
+        # a quiet trail: a few distinct messages under one reason is normal
+        for i in range(3):
+            recorder.event(job, WARNING, "SchedulingParked", f"parked #{i}")
+        assert run_ctl(spec, "events", "pi") == 0
+        cap = capsys.readouterr()
+        assert "SchedulingParked" in cap.out
+        assert "oscillating" not in cap.err
+
+        # churn: the same reason keeps re-deciding with fresh messages
+        for i in range(3, 8):
+            recorder.event(job, WARNING, "SchedulingParked", f"parked #{i}")
+        assert run_ctl(spec, "events", "pi") == 0
+        cap = capsys.readouterr()
+        assert "oscillating" in cap.err
+        assert "SchedulingParked" in cap.err
+        assert "analysis converge" in cap.err
+    finally:
+        store.close()

@@ -351,6 +351,36 @@ def test_hysteresis_prevents_straggler_ping_pong():
     assert parked and "hysteresis" in parked[-1].message
 
 
+def test_park_message_is_tick_stable_one_event_not_one_per_tick():
+    """ISSUE 19 true positive, caught by convcheck's quiescence judge:
+    the hysteresis park message used to embed the ELAPSED time ("moved
+    Ns ago"), so ``_park``'s message-equality dedupe never held and every
+    idle tick minted a fresh Event forever — the rescheduler alone kept
+    an otherwise-settled cluster writing. The message is keyed on the
+    move time now; parked ticks must produce exactly one Event."""
+    store, ctrl, sched, drain, resched = plane(hysteresis_s=300.0)
+    make_node(store, "node-a", chips=4)
+    make_node(store, "node-b", chips=4)
+    deploy(store, ctrl, sched, "s1", 1, replicas=2)
+    t0 = 1_000_000.0
+    set_straggler(store, "s1", "default/s1-worker-0@node-a")
+    resched.sync(now=t0)  # move 1: off node-a
+    ctrl.sync_handler("default/s1")
+    ctrl.sync_handler("default/s1")
+    sched.sync()
+    mark_running(store, job_pods(store, "s1"))
+    ctrl.sync_handler("default/s1")
+
+    # telemetry blames the other node; the clock advances every tick
+    set_straggler(store, "s1", "default/s1-worker-1@node-b")
+    for i in range(1, 6):
+        resched.sync(now=t0 + 10.0 * i)
+    parked = events(store, EVENT_PARKED)
+    assert len(parked) == 1, [e.message for e in parked]
+    assert "t=" in parked[0].message, \
+        "message must key on the move time, not the elapsed time"
+
+
 def test_migration_window_cap_parks_the_second_move():
     store, ctrl, sched, drain, resched = plane(max_moves=1)
     make_node(store, "node-a", chips=4)

@@ -318,3 +318,151 @@ def test_idle_scheduler_does_no_list_traffic(tmp_path):
         )
     finally:
         sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-loop idle-quiescence tripwires (ISSUE 19). The threaded test above
+# pins the ASSEMBLED plane; these six pin each loop ALONE, synchronously:
+# once its world stops changing, the next tick makes ZERO store writes.
+# A regression here names the guilty loop directly — and the convcheck
+# co-simulator (mpi_operator_tpu/analysis/convcheck.py) then shows the
+# joint consequence: `python -m mpi_operator_tpu.analysis converge`.
+# ---------------------------------------------------------------------------
+
+IDLE_NOW = 2_200_000_000.0  # above wall clock: wall-stamped fields read as past
+
+
+def _idle_store():
+    from mpi_operator_tpu.machinery.store import ObjectStore
+
+    return CountingStore(ObjectStore())
+
+
+def _settled_writes(store, tick, ticks=6):
+    """Drive ``tick`` until writes stop changing, then return the write
+    delta of ONE more tick (the idle tick under test)."""
+    for _ in range(ticks):
+        tick()
+    baseline = store.write_calls
+    tick()
+    return store.write_calls - baseline
+
+
+def _bind_running(store, ns="default"):
+    for p in store.list("Pod", ns):
+        store.patch(
+            "Pod", ns, p.metadata.name,
+            {"metadata": {"uid": p.metadata.uid},
+             "spec": {"node_name": "idle-n1"}},
+        )
+        store.patch(
+            "Pod", ns, p.metadata.name,
+            {"metadata": {"uid": p.metadata.uid},
+             "status": {"phase": "Running", "ready": True}},
+            subresource="status",
+        )
+
+
+def _idle_node(store, name="idle-n1"):
+    from mpi_operator_tpu.machinery.objects import (
+        NODE_NAMESPACE, Node, NodeStatus, ObjectMeta,
+    )
+
+    store.create(Node(
+        metadata=ObjectMeta(name=name, namespace=NODE_NAMESPACE),
+        status=NodeStatus(ready=True, last_heartbeat=0.0, capacity_chips=8),
+    ))
+
+
+def test_idle_job_controller_is_write_silent():
+    store = _idle_store()
+    ctl = TPUJobController(store, EventRecorder(store), ControllerOptions())
+    m = _manifest(0)
+    del m["spec"]["run_policy"]
+    TPUJobClient(store).create(m)
+    assert _settled_writes(store, lambda: ctl.sync_handler("default/churn-000")) == 0
+
+
+def test_idle_serve_controller_is_write_silent():
+    from mpi_operator_tpu.controller.serve import TPUServeController
+
+    store = _idle_store()
+    ctl = TPUServeController(store)
+    from mpi_operator_tpu.api.client import TPUServeClient
+
+    TPUServeClient(store).create(
+        {"kind": "TPUServe", "metadata": {"name": "svc"},
+         "spec": {"replicas": 1}})
+    assert _settled_writes(store, lambda: ctl.sync_handler("default/svc")) == 0
+
+
+def test_idle_autoscaler_is_write_silent():
+    from mpi_operator_tpu.api.client import TPUServeClient
+    from mpi_operator_tpu.controller.autoscaler import ServeAutoscaler
+
+    store = _idle_store()
+    TPUServeClient(store).create(
+        {"kind": "TPUServe", "metadata": {"name": "svc"},
+         "spec": {"replicas": 1,
+                  "autoscale": {"min_replicas": 1, "max_replicas": 4,
+                                "target_qps_per_replica": 300}}})
+    scaler = ServeAutoscaler(store)
+    ticks = iter(range(100))
+    assert _settled_writes(
+        store, lambda: scaler.tick(now=IDLE_NOW + next(ticks))) == 0
+
+
+def test_idle_drain_controller_is_write_silent():
+    from mpi_operator_tpu.controller.disruption import DrainController
+
+    store = _idle_store()
+    _idle_node(store)
+    ctl = TPUJobController(store, EventRecorder(store), ControllerOptions())
+    m = _manifest(0)
+    del m["spec"]["run_policy"]
+    TPUJobClient(store).create(m)
+    ctl.sync_handler("default/churn-000")
+    _bind_running(store)
+    drain = DrainController(store)
+    assert _settled_writes(store, lambda: drain.sync(now=IDLE_NOW)) == 0
+
+
+def test_idle_rescheduler_is_write_silent():
+    from mpi_operator_tpu.controller.rescheduler import Rescheduler
+
+    store = _idle_store()
+    _idle_node(store)
+    ctl = TPUJobController(store, EventRecorder(store), ControllerOptions())
+    m = _manifest(0)
+    del m["spec"]["run_policy"]
+    TPUJobClient(store).create(m)
+    ctl.sync_handler("default/churn-000")
+    _bind_running(store)
+    resched = Rescheduler(store, EventRecorder(store))
+    assert _settled_writes(store, lambda: resched.sync(now=IDLE_NOW)) == 0
+
+
+def test_idle_goodput_aggregator_is_write_silent():
+    from mpi_operator_tpu.controller.goodput import GoodputAggregator
+
+    store = _idle_store()
+    _idle_node(store)
+    ctl = TPUJobController(store, EventRecorder(store), ControllerOptions())
+    m = _manifest(0)
+    del m["spec"]["run_policy"]
+    TPUJobClient(store).create(m)
+    ctl.sync_handler("default/churn-000")
+    _bind_running(store)
+    # a static stats blob: the rollup must be written ONCE, then elided
+    for p in store.list("Pod"):
+        store.patch(
+            "Pod", "default", p.metadata.name,
+            {"metadata": {"uid": p.metadata.uid},
+             "status": {"train_stats": {
+                 "step": 100, "steps": 100, "step_p50_ms": 100.0}}},
+            subresource="status",
+        )
+    agg = GoodputAggregator(store)
+    ticks = iter(range(100))
+    assert _settled_writes(
+        store, lambda: agg.tick(now=IDLE_NOW + next(ticks))) == 0

@@ -2,9 +2,9 @@
 golangci-lint gate + `go test -race` CI split, now grown into a model-
 checking layer):
 
-- :mod:`oplint` — AST rules over this repo's own invariants (RMW001,
-  UID001, TERM001, BLK001, EXC001, SEC001, LCK001, DUR001), with per-line
-  ``# oplint: disable=RULE`` suppressions and a stable
+- :mod:`oplint` — AST rules over this repo's own invariants (RMW001
+  through AUTH001 — the full catalog prints via ``rules``), with
+  per-line ``# oplint: disable=RULE`` suppressions and a stable
   ``lint --format json`` finding schema;
 - :mod:`racecheck` — runtime lock-order + unguarded-shared-state detector
   (tracked lock factories + lockset/Eraser attribute monitoring), exposed
@@ -28,10 +28,23 @@ checking layer):
 - :mod:`crashpoints` — ALICE-style crash-point explorer over the
   SqliteStore ``_txn`` commit seam (exact + torn-WAL-tail snapshots,
   acked-write durability at exact rv, rv monotonicity across reopen,
-  resume-or-410; oplint DUR001 keeps every mutation on the seam).
+  resume-or-410; oplint DUR001 keeps every mutation on the seam);
+- :mod:`convcheck` — closed-loop co-simulation of the six control loops
+  over reachable start states (quiescence, write-cycle, wasted-work
+  budgets; ``v1:conv`` replay tokens; oplint LEV001 keeps handlers
+  level-triggered);
+- :mod:`authzcheck` — declarative authorization matrix
+  (``authz_policy.json``: every (route, verb, tier, scope-variant) →
+  expected outcome, loaded fail-closed) probed against a REAL booted
+  store fleet — all four token tiers, an open server, a non-leader
+  follower, the OpsServer monitoring port — with route coverage
+  introspected from the live router, a wire-capture secret scan of
+  /metrics, seeded mutants, and ``v1:authz`` replay tokens; oplint
+  AUTH001 statically cross-checks route literals and auth-before-state
+  ordering against the same matrix.
 
 CLI: ``python -m mpi_operator_tpu.analysis
-{lint,rules,racecheck,explore,linearize,fuzz,crash}``.
+{lint,rules,racecheck,explore,linearize,fuzz,crash,converge,authz}``.
 """
 
 from mpi_operator_tpu.analysis.oplint import (

@@ -21,6 +21,10 @@
     python -m mpi_operator_tpu.analysis converge --corpus straggler --seed 3
     python -m mpi_operator_tpu.analysis converge --replay 'v1:conv:quota:0:012345'
     python -m mpi_operator_tpu.analysis converge --selftest
+    python -m mpi_operator_tpu.analysis authz --probe
+    python -m mpi_operator_tpu.analysis authz --probe --backend sqlite
+    python -m mpi_operator_tpu.analysis authz --replay 'v1:authz:PUT /v1/objects/{kind}/{ns}/{name}:node:cordon_flip'
+    python -m mpi_operator_tpu.analysis authz --selftest
 
 ``lint`` exits 1 when any finding survives suppressions (the tier-1 gate
 rides this — .claude/skills/verify/SKILL.md). ``racecheck`` without
@@ -35,6 +39,13 @@ the ALICE-style crash-point explorer over the SqliteStore commit seam;
 states and judges quiescence, write cycles, and wasted-work budgets
 (exit 1 on a violation, printing its ``v1:conv:...`` replay token; exit
 2 on an unknown corpus, malformed snapshot, or mismatched token).
+``authz`` boots a real store fleet (all four token tiers, an open
+server, a non-leader follower, the OpsServer monitoring port) and fires
+every cell of analysis/authz_policy.json at it, diffing observed
+status+error against the declared matrix (exit 1 on a diff, printing
+its ``v1:authz:...`` token; exit 2 when the policy itself fails to load
+— the loader fails closed on unknown routes/tiers, duplicate keys, and
+servable routes with no declaration).
 """
 
 from __future__ import annotations
@@ -300,6 +311,48 @@ def _cmd_converge(args) -> int:
         return 2
 
 
+def _cmd_authz(args) -> int:
+    from mpi_operator_tpu.analysis import authzcheck
+
+    try:
+        if args.selftest:
+            failures = authzcheck.self_test(log=print)
+            for f in failures:
+                print(f"authz selftest FAILED: {f}", file=sys.stderr)
+            if not failures:
+                print("authz selftest: ok")
+            return 1 if failures else 0
+        if args.list_mutants:
+            for name in sorted(authzcheck.MUTANTS):
+                m = authzcheck.MUTANTS[name]
+                print(name)
+                print(f"  {m.description}")
+                # m.token is a v1:authz replay token (a cell address),
+                # not a credential
+                print(f"  caught by: {m.token}")  # oplint: disable=SEC001
+            return 0
+        if args.replay:
+            finding = authzcheck.replay(
+                args.replay, args.backend, mutant=args.mutant
+            )
+            if finding is None:
+                print(f"{args.backend}: token {args.replay} probes clean")
+                return 0
+            print(finding.render())
+            return 1
+        # default (and --probe): the full live-server diff the runbook
+        # reaches for on a 403/421 storm
+        report = authzcheck.probe(
+            args.backend, mutant=args.mutant,
+            denied_only=args.denied_only, log=print,
+        )
+        print(report.render())
+        return 0 if report.ok else 1
+    except authzcheck.AuthzConfigError as exc:
+        print(f"authz: {exc}", file=sys.stderr)
+        return 2
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mpi_operator_tpu.analysis", description=__doc__
@@ -419,6 +472,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="start from a snapshot JSON file instead of the "
                         "corpus warmup (fails closed on malformed docs)")
     p.set_defaults(fn=_cmd_converge)
+    p = sub.add_parser(
+        "authz",
+        help="probe a real store fleet against the declared authorization "
+             "matrix (exit 1 on a diff; its v1:authz token replays it; "
+             "exit 2 when the policy fails closed)",
+    )
+    p.add_argument("--selftest", action="store_true",
+                   help="full matrix clean on memory AND sqlite backings, "
+                        "cross-backend parity, all seeded mutants caught "
+                        "with deterministic replays, undeclared-route "
+                        "injection fails closed")
+    p.add_argument("--probe", action="store_true",
+                   help="diff the live fleet against authz_policy.json "
+                        "(the default when no other mode is given)")
+    p.add_argument("--replay", metavar="TOKEN",
+                   help="re-probe exactly one matrix cell by its "
+                        "v1:authz:<route>:<tier>:<variant> token")
+    p.add_argument("--backend", choices=["memory", "sqlite"],
+                   default="memory",
+                   help="backing store for the probed fleet")
+    p.add_argument("--mutant", help="arm a seeded mutant by id")
+    p.add_argument("--list-mutants", action="store_true",
+                   help="list seeded mutants and the cell that catches "
+                        "each, then exit")
+    p.add_argument("--denied-only", action="store_true",
+                   help="probe only deny/pass cells (the reduced "
+                        "state-preserving set tier-1 runs)")
+    p.set_defaults(fn=_cmd_authz)
     args = ap.parse_args(argv)
     return args.fn(args)
 

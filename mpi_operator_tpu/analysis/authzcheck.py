@@ -1,0 +1,1239 @@
+"""authzcheck — the store's security plane diffed against ONE declaration.
+
+The reference operator's layer 4 materializes RBAC and scoped service
+accounts so launcher pods can only touch their own workers; our store
+grew the same posture organically — four token tiers (admin/read/node/
+peer), a status-subresource freeze, per-key denials (cordon,
+conditions), uid pinning, namespace quota — but every rule lived ad hoc
+in its handler, and four separate review passes (PRs 2, 10, 12, 13)
+each found a tier bug by hand. This module gives authorization what the
+store seam has from storecheck: a single declarative source of truth
+(``analysis/authz_policy.json``: every (route-pattern, tier,
+scope-variant) → expected outcome), loaded FAIL-CLOSED, and a probe
+harness that boots a REAL fleet — a tokened StoreServer (memory- or
+sqlite-backed) with a replication seam, an unauthenticated open-server
+variant, a non-leader replica, and the OpsServer monitoring port — then
+fires a real HTTP request for every matrix cell and diffs the observed
+status code + typed error against the declaration.
+
+Route coverage is introspected from the live router
+(``http_store.servable_routes()``), so a servable route ABSENT from the
+matrix is itself a finding: new endpoints must declare posture before
+they ship. The client-side peer table (``replica_wire.PEER_ROUTES``) is
+diffed against the server's for mirror drift. The OpsServer probe also
+wire-captures /metrics and scans the exposition body for fleet secrets
+and secret-named label values (SEC001's runtime twin).
+
+Every diff carries a deterministic ``v1:authz:<route>:<tier>:<variant>``
+token that ``--replay`` re-probes exactly (fresh fleet, one cell).
+``self_test()`` is the detector-of-the-detector bar: the full matrix
+probes clean on BOTH backends with identical denied-cell codes, each of
+the six seeded mutants (the bug classes those review passes kept
+finding) is caught on its expected token with a twice-identical replay,
+and an injected undeclared route fails closed as a finding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+__all__ = [
+    "AuthzConfigError",
+    "Finding",
+    "Fleet",
+    "MUTANTS",
+    "Policy",
+    "ProbeReport",
+    "TIERS",
+    "coverage_findings",
+    "encode_token",
+    "load_policy",
+    "make_fleet",
+    "parse_token",
+    "probe",
+    "replay",
+    "scan_exposition",
+    "self_test",
+]
+
+DEFAULT_POLICY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "authz_policy.json"
+)
+
+# probe order is the tier lattice, weakest credential first
+TIERS = ("anon", "garbage", "read", "node", "peer", "admin")
+
+# fixed fleet credentials: the values are arbitrary but STABLE so replay
+# tokens probe the identical fleet; the secret-scan below asserts none of
+# them ever appears in a /metrics exposition body
+# oplint: disable=SEC001 — test-fleet credentials, minted fresh per probe
+_FLEET_TOKENS = {
+    "admin": "authz-adm1n-t0k3n",
+    "read": "authz-read-t0k3n",
+    "node": "authz-agent-t0k3n",
+    "peer": "authz-p33r-t0k3n",
+    "garbage": "authz-garbage-t0k3n",
+    "anon": None,
+}
+
+NODE_NAME = "n1"
+OTHER_NODE = "n2"
+WL_NS = "wl"
+QUOTA_NS = "quota-ns"
+
+_TOKEN_PREFIX = "v1:authz:"
+
+
+class AuthzConfigError(ValueError):
+    """authz_policy.json (or a replay token) failed validation — the
+    loader refuses rather than guessing: an authorization matrix that
+    silently dropped a tier or route would certify a hole as clean."""
+
+
+# ---------------------------------------------------------------------------
+# outcome grammar
+# ---------------------------------------------------------------------------
+
+_OUTCOME_RE = re.compile(r"^(?:allow|(?:deny|pass):[1-5][0-9]{2}:[A-Za-z]+)$")
+
+
+@dataclass(frozen=True)
+class Outcome:
+    """One declared cell outcome. ``kind`` is 'allow' (authorized, 200),
+    'deny' (the authorization plane refuses with a typed error) or
+    'pass' (authz ADMITS the request; the handler's in-band typed
+    outcome — AlreadyExists on re-registration, NotFound on a raced
+    delete, NotLeader on a follower — is the declared posture). deny and
+    pass verify identically on the wire; the split documents WHERE the
+    answer comes from."""
+
+    raw: str
+    kind: str
+    status: int
+    error: Optional[str]
+
+    @staticmethod
+    def parse(raw: Any, where: str) -> "Outcome":
+        if not isinstance(raw, str) or not _OUTCOME_RE.match(raw):
+            raise AuthzConfigError(
+                f"{where}: outcome {raw!r} does not match the grammar "
+                f"'allow' | 'deny:<code>:<Error>' | 'pass:<code>:<Error>'"
+            )
+        if raw == "allow":
+            return Outcome(raw=raw, kind="allow", status=200, error=None)
+        kind, code, err = raw.split(":")
+        return Outcome(raw=raw, kind=kind, status=int(code), error=err)
+
+    def matches(self, status: int, err: Optional[str]) -> bool:
+        if self.kind == "allow":
+            return status == 200 and err is None
+        return status == self.status and err == self.error
+
+
+# ---------------------------------------------------------------------------
+# the declared matrix, loaded fail-closed
+# ---------------------------------------------------------------------------
+
+_TOP_KEYS = {"_comment", "version", "semantics", "routes", "ops_server"}
+_SEMANTIC_KEYS = {
+    "_comment", "missing_token", "invalid_token", "wrong_tier",
+    "out_of_scope", "stale_rv_write", "not_leader",
+}
+
+# scope variants per (route, tier); every other cell has exactly
+# ("default",). The loader enforces EXACT agreement between this table
+# and the policy file, so a variant declared without a builder (or built
+# without a declaration) is a config error, not a silent skip.
+_EXTRA_VARIANTS: Dict[Tuple[str, str], Tuple[str, ...]] = {
+    ("GET /v1/objects/{kind}/{ns}/{name}", "anon"):
+        ("default", "open_server"),
+    ("POST /v1/objects", "anon"): ("default", "open_server"),
+    ("POST /v1/objects", "node"):
+        ("own_node_register", "own_node_wrong_namespace",
+         "other_kind_create"),
+    ("POST /v1/objects", "admin"): ("default", "quota_exceeded"),
+    ("PUT /v1/objects/{kind}/{ns}/{name}", "anon"):
+        ("default", "open_server"),
+    ("PUT /v1/objects/{kind}/{ns}/{name}", "node"):
+        ("own_node_heartbeat", "other_node", "cordon_flip",
+         "conditions_change", "stale_rv", "force_update", "own_pod",
+         "other_pod", "pod_relabel", "pod_reuid"),
+    ("PUT /v1/objects/{kind}/{ns}/{name}", "admin"):
+        ("default", "not_leader"),
+    ("PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}", "node"):
+        ("own_node_status", "cordon_key", "conditions_key",
+         "spec_subresource", "own_pod_status", "other_pod_status",
+         "absent_pod_status", "uid_precondition_overwritten"),
+    ("POST /v1/patch-batch", "node"):
+        ("own_status_batch", "item_crosses_tier", "spec_item"),
+    ("POST /v1/replica/append-entries", "anon"):
+        ("default", "open_server"),
+}
+
+
+def variants_for(route: str, tier: str) -> Tuple[str, ...]:
+    return _EXTRA_VARIANTS.get((route, tier), ("default",))
+
+
+@dataclass(frozen=True)
+class Policy:
+    version: int
+    semantics: Dict[str, str]
+    # route → tier → variant → Outcome
+    routes: Dict[str, Dict[str, Dict[str, Outcome]]]
+    ops_server: Dict[str, Outcome]
+
+
+def _refuse_dups(pairs):
+    d: Dict[str, Any] = {}
+    for k, v in pairs:
+        if k in d:
+            raise AuthzConfigError(f"duplicate key {k!r} in authz policy")
+        d[k] = v
+    return d
+
+
+def servable_routes() -> List[str]:
+    """The live router's route table (re-exported so callers and tests
+    need only this module)."""
+    from mpi_operator_tpu.machinery.http_store import (
+        servable_routes as _live_routes,
+    )
+
+    return _live_routes()
+
+
+def load_policy(
+    path: Optional[str] = None, *, servable: Optional[List[str]] = None
+) -> Policy:
+    """Parse + validate the matrix, refusing anything it cannot fully
+    account for: unknown top-level keys, a version this checker does not
+    speak, unknown/missing tiers, unknown/missing scope variants, bad
+    outcome grammar, duplicate keys, and policy routes the live router
+    cannot serve. (The INVERSE gap — servable but undeclared — is a
+    probe finding via coverage_findings, not a load error: the policy
+    file must stay loadable so the finding can be reported.)"""
+    path = path or DEFAULT_POLICY_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        raise AuthzConfigError(f"cannot read authz policy {path}: {e}")
+    try:
+        doc = json.loads(text, object_pairs_hook=_refuse_dups)
+    except json.JSONDecodeError as e:
+        raise AuthzConfigError(f"authz policy {path} is not valid JSON: {e}")
+    if not isinstance(doc, dict):
+        raise AuthzConfigError("authz policy must be a JSON object")
+    unknown = set(doc) - _TOP_KEYS
+    if unknown:
+        raise AuthzConfigError(
+            f"unknown top-level key(s) {sorted(unknown)} in authz policy"
+        )
+    missing = _TOP_KEYS - {"_comment"} - set(doc)
+    if missing:
+        raise AuthzConfigError(
+            f"authz policy is missing top-level key(s) {sorted(missing)}"
+        )
+    if doc["version"] != 1:
+        raise AuthzConfigError(
+            f"authz policy version {doc['version']!r} is not 1"
+        )
+    semantics = doc["semantics"]
+    if not isinstance(semantics, dict):
+        raise AuthzConfigError("'semantics' must be an object")
+    bad = set(semantics) - _SEMANTIC_KEYS
+    if bad:
+        raise AuthzConfigError(f"unknown semantics key(s) {sorted(bad)}")
+    for k, v in semantics.items():
+        if k != "_comment":
+            Outcome.parse(v, f"semantics.{k}")
+    raw_routes = doc["routes"]
+    if not isinstance(raw_routes, dict) or not raw_routes:
+        raise AuthzConfigError("'routes' must be a non-empty object")
+    live = list(servable if servable is not None else servable_routes())
+    routes: Dict[str, Dict[str, Dict[str, Outcome]]] = {}
+    for route, cells in raw_routes.items():
+        if route not in live:
+            raise AuthzConfigError(
+                f"policy declares route {route!r} but the live router "
+                f"does not serve it (stale entry, or a typo that would "
+                f"leave the real route unprobed)"
+            )
+        if not isinstance(cells, dict):
+            raise AuthzConfigError(f"route {route!r}: cells must be an object")
+        tier_keys = set(cells) - {"_comment"}
+        if tier_keys - set(TIERS):
+            raise AuthzConfigError(
+                f"route {route!r}: unknown tier(s) "
+                f"{sorted(tier_keys - set(TIERS))}"
+            )
+        if set(TIERS) - tier_keys:
+            raise AuthzConfigError(
+                f"route {route!r}: missing tier(s) "
+                f"{sorted(set(TIERS) - tier_keys)} — every tier must "
+                f"declare a posture (fail closed, no implicit allow)"
+            )
+        routes[route] = {}
+        for tier in TIERS:
+            raw_cell = cells[tier]
+            expected = set(variants_for(route, tier))
+            if isinstance(raw_cell, str):
+                declared = {"default": raw_cell}
+            elif isinstance(raw_cell, dict):
+                declared = dict(raw_cell)
+            else:
+                raise AuthzConfigError(
+                    f"route {route!r} tier {tier!r}: cell must be an "
+                    f"outcome string or a variant object"
+                )
+            if set(declared) != expected:
+                raise AuthzConfigError(
+                    f"route {route!r} tier {tier!r}: declared variants "
+                    f"{sorted(declared)} != probeable variants "
+                    f"{sorted(expected)}"
+                )
+            routes[route][tier] = {
+                variant: Outcome.parse(
+                    raw, f"route {route!r} tier {tier!r} variant {variant!r}"
+                )
+                for variant, raw in declared.items()
+            }
+    raw_ops = doc["ops_server"]
+    if not isinstance(raw_ops, dict):
+        raise AuthzConfigError("'ops_server' must be an object")
+    ops_keys = set(raw_ops) - {"_comment"}
+    if ops_keys != {"GET /healthz", "GET /metrics"}:
+        raise AuthzConfigError(
+            f"ops_server must declare exactly GET /healthz and "
+            f"GET /metrics, got {sorted(ops_keys)}"
+        )
+    ops = {
+        r: Outcome.parse(raw_ops[r], f"ops_server {r!r}") for r in ops_keys
+    }
+    return Policy(version=1, semantics=dict(semantics), routes=routes,
+                  ops_server=ops)
+
+
+# ---------------------------------------------------------------------------
+# finding tokens
+# ---------------------------------------------------------------------------
+
+
+def encode_token(route: str, tier: str, variant: str) -> str:
+    return f"{_TOKEN_PREFIX}{route}:{tier}:{variant}"
+
+
+def parse_token(token: str) -> Tuple[str, str, str]:
+    """``v1:authz:<route>:<tier>:<variant>`` → (route, tier, variant).
+    The route itself contains a space but never a colon, so the tail
+    splits unambiguously right-to-left."""
+    if not token.startswith(_TOKEN_PREFIX):
+        raise AuthzConfigError(
+            f"replay token {token!r} does not start with {_TOKEN_PREFIX!r}"
+        )
+    rest = token[len(_TOKEN_PREFIX):]
+    parts = rest.rsplit(":", 2)
+    if len(parts) != 3 or not all(parts) or " " not in parts[0]:
+        raise AuthzConfigError(
+            f"replay token {token!r} is not "
+            f"'{_TOKEN_PREFIX}<METHOD /route>:<tier>:<variant>'"
+        )
+    return parts[0], parts[1], parts[2]
+
+
+# ---------------------------------------------------------------------------
+# the real fleet
+# ---------------------------------------------------------------------------
+
+
+class _ReplicaSeamStub:
+    """Wraps a real backing store with a stub replication seam so the
+    main server can be constructed with a peer token (StoreServer
+    refuses a peer tier that routes nowhere). The peer cells only probe
+    AUTHORIZATION — the RPCs land here and return inert acks; the real
+    protocol has its own checkers (crash --replica, fuzz replica)."""
+
+    def __init__(self, inner: Any):
+        self._inner = inner
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def request_vote(self, *args: Any) -> Dict[str, Any]:
+        return {"granted": False, "stub": True}
+
+    def append_entries(self, *args: Any) -> Dict[str, Any]:
+        return {"ok": True, "stub": True}
+
+    def fetch_entries(self, *args: Any) -> Dict[str, Any]:
+        return {"entries": [], "stub": True}
+
+    def install_snapshot(self, *args: Any) -> Dict[str, Any]:
+        return {"ok": True, "stub": True}
+
+    def snapshot_chunk(self, *args: Any) -> Dict[str, Any]:
+        return {"ok": True, "stub": True}
+
+    def snapshot_done(self, *args: Any) -> Dict[str, Any]:
+        return {"ok": True, "stub": True}
+
+
+class _NotLeaderStub:
+    """Wraps a backing store as a non-leader replica: every mutation
+    bounces NotLeader with a leader hint, reads pass through — the 421
+    posture cell probes the wire mapping without electing anything."""
+
+    LEADER_HINT = "http://leader.invalid:8475"
+
+    def __init__(self, inner: Any):
+        self._inner = inner
+
+    def __getattr__(self, name: str) -> Any:
+        if name in ("create", "update", "delete", "patch", "patch_batch"):
+            from mpi_operator_tpu.machinery.store import NotLeader
+
+            def bounce(*args: Any, **kwargs: Any) -> Any:
+                raise NotLeader(
+                    "this replica is a follower; mutations go to the "
+                    "leased leader", leader=self.LEADER_HINT,
+                )
+
+            return bounce
+        return getattr(self._inner, name)
+
+
+@dataclass
+class Fleet:
+    """One booted probe fleet: the tokened main server, the
+    unauthenticated open server, the non-leader follower, the OpsServer
+    monitoring port — plus direct handles on the backings so builders
+    can read current rv/uid state at fire time (order-robust)."""
+
+    backend: str
+    main: Any
+    open: Any
+    follower: Any
+    ops: Any
+    main_backing: Any
+    open_backing: Any
+    follower_backing: Any
+    _cleanups: List[Callable[[], None]] = field(default_factory=list)
+
+    def url(self, server_key: str) -> str:
+        if server_key == "ops":
+            return f"http://127.0.0.1:{self.ops.port}"
+        return {"main": self.main, "open": self.open,
+                "follower": self.follower}[server_key].url
+
+    def close(self) -> None:
+        for srv in (self.main, self.open, self.follower):
+            try:
+                srv.stop()
+            except Exception:  # oplint: disable=EXC001 — teardown best-effort
+                pass
+        try:
+            self.ops.stop()
+        except Exception:  # oplint: disable=EXC001 — teardown best-effort
+            pass
+        for fn in self._cleanups:
+            fn()
+
+
+def _mk_backing(backend: str) -> Tuple[Any, Callable[[], None]]:
+    if backend == "memory":
+        from mpi_operator_tpu.machinery.store import ObjectStore
+
+        return ObjectStore(), lambda: None
+    if backend == "sqlite":
+        from mpi_operator_tpu.machinery.sqlite_store import SqliteStore
+
+        d = tempfile.mkdtemp(prefix="authzcheck-")
+        s = SqliteStore(os.path.join(d, "authz.db"), poll_interval=0.01)
+
+        def teardown() -> None:
+            s.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+        return s, teardown
+    raise AuthzConfigError(f"unknown backend {backend!r}")
+
+
+def _seed_main(backing: Any) -> None:
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node, Pod
+
+    for name in (NODE_NAME, OTHER_NODE):
+        backing.create(
+            Node(metadata=ObjectMeta(name=name, namespace=NODE_NAMESPACE))
+        )
+    for name, bound_to in (
+        ("p-own", NODE_NAME), ("p-other", OTHER_NODE), ("p-uid", NODE_NAME),
+        ("p-del", ""), ("p-admin", ""),
+    ):
+        created = backing.create(
+            Pod(metadata=ObjectMeta(name=name, namespace=WL_NS))
+        )
+        if bound_to:
+            created.spec.node_name = bound_to
+            # binding a fresh seed pod before the servers boot — no
+            # concurrent writer exists for force to stomp
+            backing.update(created, force=True)  # oplint: disable=TERM001
+
+
+def make_fleet(backend: str = "memory") -> Fleet:
+    from mpi_operator_tpu.api.types import ObjectMeta
+    from mpi_operator_tpu.machinery.fairqueue import NamespaceQuota
+    from mpi_operator_tpu.machinery.http_store import StoreServer
+    from mpi_operator_tpu.machinery.objects import Pod
+    from mpi_operator_tpu.machinery.store import ObjectStore
+    from mpi_operator_tpu.opshell.server import OpsServer
+
+    inner, cleanup = _mk_backing(backend)
+    main_backing = _ReplicaSeamStub(inner)
+    _seed_main(main_backing)
+    main = StoreServer(
+        main_backing, "127.0.0.1", 0,
+        token=_FLEET_TOKENS["admin"],
+        read_token=_FLEET_TOKENS["read"],
+        auth_reads=True,
+        agent_tokens={_FLEET_TOKENS["node"]: NODE_NAME},
+        peer_token=_FLEET_TOKENS["peer"],
+        quota=NamespaceQuota({QUOTA_NS: {"max_jobs": 0}}),
+    ).start()
+    open_backing = ObjectStore()
+    open_backing.create(Pod(metadata=ObjectMeta(name="p-open",
+                                                namespace=WL_NS)))
+    open_srv = StoreServer(open_backing, "127.0.0.1", 0).start()
+    follower_backing = _NotLeaderStub(ObjectStore())
+    follower_backing._inner.create(
+        Pod(metadata=ObjectMeta(name="p-own", namespace=WL_NS))
+    )
+    follower = StoreServer(
+        follower_backing, "127.0.0.1", 0, token=_FLEET_TOKENS["admin"],
+    ).start()
+    ops = OpsServer(port=0)
+    ops.start()
+    return Fleet(
+        backend=backend, main=main, open=open_srv, follower=follower,
+        ops=ops, main_backing=main_backing, open_backing=open_backing,
+        follower_backing=follower_backing, _cleanups=[cleanup],
+    )
+
+
+# ---------------------------------------------------------------------------
+# cell builders: (route, tier, variant) → one concrete wire request
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Probe:
+    server: str  # main | open | follower
+    method: str
+    path: str
+    body: Optional[Dict[str, Any]]
+    bearer: Optional[str]
+
+
+def _enc(obj: Any) -> Dict[str, Any]:
+    from mpi_operator_tpu.machinery.serialize import encode
+
+    return encode(obj)
+
+
+def _current(fleet: Fleet, server: str, kind: str, ns: str,
+             name: str) -> Dict[str, Any]:
+    backing = {"main": fleet.main_backing, "open": fleet.open_backing,
+               "follower": fleet.follower_backing}[server]
+    return _enc(backing.get(kind, ns, name))
+
+
+def build_probe(fleet: Fleet, route: str, tier: str, variant: str) -> Probe:
+    """The one concrete request a cell fires. Builders read CURRENT
+    backing state (rv, uid, bindings) at fire time, so cells stay
+    correct regardless of what earlier allow-cells mutated."""
+    from mpi_operator_tpu.api.types import ObjectMeta, TPUJob
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE, Node, Pod
+
+    method, path = route.split(" ", 1)
+    server = "main"
+    bearer = _FLEET_TOKENS[tier]
+    if variant == "open_server":
+        server, bearer = "open", None
+    if variant == "not_leader":
+        server = "follower"
+    body: Optional[Dict[str, Any]] = None
+
+    if path.startswith("/v1/replica/") and method == "POST":
+        return Probe(server, method, path, {"src": "authz-probe", "args": []},
+                     bearer)
+    if route in ("GET /healthz", "GET /v1/replica/status", "GET /v1/watch"):
+        return Probe(server, method, path, None, bearer)
+    if route == "GET /v1/objects/{kind}":
+        return Probe(server, method, "/v1/objects/Pod", None, bearer)
+    if route == "GET /v1/objects/{kind}/{ns}/{name}":
+        target = "p-open" if server == "open" else "p-own"
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/{target}",
+                     None, bearer)
+    if route == "POST /v1/objects":
+        if variant == "own_node_register":
+            node = Node(metadata=ObjectMeta(name=NODE_NAME,
+                                            namespace=NODE_NAMESPACE))
+            body = {"kind": "Node", "object": _enc(node)}
+        elif variant == "own_node_wrong_namespace":
+            node = Node(metadata=ObjectMeta(name=NODE_NAME, namespace=WL_NS))
+            body = {"kind": "Node", "object": _enc(node)}
+        elif variant == "quota_exceeded":
+            job = TPUJob(metadata=ObjectMeta(name="probe-quota",
+                                             namespace=QUOTA_NS))
+            body = {"kind": "TPUJob", "object": _enc(job)}
+        else:
+            pod = Pod(metadata=ObjectMeta(name=f"probe-{tier}-{variant}",
+                                          namespace=WL_NS))
+            body = {"kind": "Pod", "object": _enc(pod)}
+        return Probe(server, method, "/v1/objects", body, bearer)
+    if route == "PUT /v1/objects/{kind}/{ns}/{name}":
+        return _build_put(fleet, server, tier, variant, bearer)
+    if route == "DELETE /v1/objects/{kind}/{ns}/{name}":
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/p-del",
+                     None, bearer)
+    if route == "PATCH /v1/objects/{kind}/{ns}/{name}":
+        target = "p-admin" if tier == "admin" else "p-own"
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/{target}",
+                     {"patch": {"status": {"message": "authz-probe"}}},
+                     bearer)
+    if route == "PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}":
+        return _build_subresource_patch(fleet, server, tier, variant, bearer)
+    if route == "POST /v1/patch-batch":
+        return _build_batch(fleet, server, tier, variant, bearer)
+    raise AuthzConfigError(f"no builder for route {route!r}")
+
+
+def _build_put(fleet: Fleet, server: str, tier: str, variant: str,
+               bearer: Optional[str]) -> Probe:
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+    method = "PUT"
+    if variant in ("own_node_heartbeat", "cordon_flip", "conditions_change",
+                   "stale_rv", "force_update"):
+        node = _current(fleet, server, "Node", NODE_NAMESPACE, NODE_NAME)
+        if variant == "own_node_heartbeat":
+            node["status"]["last_heartbeat"] = 123.0
+        elif variant == "cordon_flip":
+            node["status"]["unschedulable"] = (
+                not node["status"].get("unschedulable", False)
+            )
+        elif variant == "conditions_change":
+            node["status"]["conditions"] = [
+                {"type": "Draining", "status": "True"}
+            ]
+        elif variant == "stale_rv":
+            node["metadata"]["resource_version"] += 999
+        suffix = "?force=1" if variant == "force_update" else ""
+        return Probe(server, method,
+                     f"/v1/objects/Node/{NODE_NAMESPACE}/{NODE_NAME}{suffix}",
+                     {"object": node}, bearer)
+    if variant == "other_node":
+        node = _current(fleet, server, "Node", NODE_NAMESPACE, OTHER_NODE)
+        return Probe(server, method,
+                     f"/v1/objects/Node/{NODE_NAMESPACE}/{OTHER_NODE}",
+                     {"object": node}, bearer)
+    if variant in ("own_pod", "other_pod", "pod_relabel", "pod_reuid"):
+        name = "p-other" if variant == "other_pod" else "p-own"
+        pod = _current(fleet, server, "Pod", WL_NS, name)
+        if variant == "pod_relabel":
+            pod["metadata"]["labels"] = {"stolen": "1"}
+        elif variant == "pod_reuid":
+            pod["metadata"]["uid"] = "0" * 8
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/{name}",
+                     {"object": pod}, bearer)
+    # default / open_server / not_leader / every non-node tier: a benign
+    # full-object re-PUT of a pod the fleet seeded on that server
+    target = "p-open" if server == "open" else (
+        "p-own" if server == "follower" else "p-admin"
+    )
+    pod = _current(fleet, server, "Pod", WL_NS, target)
+    return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/{target}",
+                 {"object": pod}, bearer)
+
+
+def _build_subresource_patch(fleet: Fleet, server: str, tier: str,
+                             variant: str, bearer: Optional[str]) -> Probe:
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+    method = "PATCH"
+    if variant == "own_node_status":
+        return Probe(server, method,
+                     f"/v1/objects/Node/{NODE_NAMESPACE}/{NODE_NAME}/status",
+                     {"patch": {"status": {"last_heartbeat": 124.0}}}, bearer)
+    if variant == "cordon_key":
+        return Probe(server, method,
+                     f"/v1/objects/Node/{NODE_NAMESPACE}/{NODE_NAME}/status",
+                     {"patch": {"status": {"unschedulable": False}}}, bearer)
+    if variant == "conditions_key":
+        return Probe(server, method,
+                     f"/v1/objects/Node/{NODE_NAMESPACE}/{NODE_NAME}/status",
+                     {"patch": {"status": {"conditions": []}}}, bearer)
+    if variant == "spec_subresource":
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/p-own/spec",
+                     {"patch": {"spec": {"hostname": "authz-probe"}}}, bearer)
+    if variant == "other_pod_status":
+        return Probe(server, method,
+                     f"/v1/objects/Pod/{WL_NS}/p-other/status",
+                     {"patch": {"status": {"message": "authz-probe"}}},
+                     bearer)
+    if variant == "absent_pod_status":
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/p-gone/status",
+                     {"patch": {"status": {"message": "authz-probe"}}},
+                     bearer)
+    if variant == "uid_precondition_overwritten":
+        # the client LIES about the uid; the server's pin must overwrite
+        # it with the verified incarnation's uid, so this succeeds —
+        # with the pin skipped (mutant) the lie survives to the store's
+        # uid precondition and bounces Conflict
+        return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/p-uid/status",
+                     {"patch": {"metadata": {"uid": "not-the-real-uid"},
+                                "status": {"message": "authz-probe"}}},
+                     bearer)
+    # default / own_pod_status / every non-node tier
+    target = "p-admin" if tier == "admin" else "p-own"
+    return Probe(server, method, f"/v1/objects/Pod/{WL_NS}/{target}/status",
+                 {"patch": {"status": {"message": "authz-probe"}}}, bearer)
+
+
+def _build_batch(fleet: Fleet, server: str, tier: str, variant: str,
+                 bearer: Optional[str]) -> Probe:
+    from mpi_operator_tpu.machinery.objects import NODE_NAMESPACE
+
+    def node_item() -> Dict[str, Any]:
+        return {"kind": "Node", "namespace": NODE_NAMESPACE,
+                "name": NODE_NAME, "subresource": "status",
+                "patch": {"status": {"last_heartbeat": 125.0}}}
+
+    def pod_item(name: str, subresource: Optional[str] = "status"
+                 ) -> Dict[str, Any]:
+        item: Dict[str, Any] = {
+            "kind": "Pod", "namespace": WL_NS, "name": name,
+            "patch": {"status": {"message": "authz-probe"}},
+        }
+        if subresource is not None:
+            item["subresource"] = subresource
+        return item
+
+    if variant == "own_status_batch":
+        items = [node_item(), pod_item("p-own")]
+    elif variant == "item_crosses_tier":
+        # first item is squarely in scope; the SECOND crosses onto
+        # another node's pod — per-item authz must fail the whole batch
+        items = [node_item(), pod_item("p-other")]
+    elif variant == "spec_item":
+        items = [pod_item("p-own", subresource=None)]
+    else:
+        items = [pod_item("p-admin")]
+    return Probe(server, "POST", "/v1/patch-batch", {"items": items}, bearer)
+
+
+# ---------------------------------------------------------------------------
+# firing + diffing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Observed:
+    status: int
+    error: Optional[str]
+    message: str
+
+
+def _fire(fleet: Fleet, probe_req: Probe, timeout: float = 10.0) -> Observed:
+    url = fleet.url(probe_req.server) + probe_req.path
+    data = (json.dumps(probe_req.body).encode()
+            if probe_req.body is not None else None)
+    req = urlrequest.Request(url, data=data, method=probe_req.method)
+    req.add_header("Content-Type", "application/json")
+    if probe_req.bearer is not None:
+        req.add_header("Authorization", f"Bearer {probe_req.bearer}")
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as resp:
+            status, raw = resp.status, resp.read()
+    except urlerror.HTTPError as e:
+        status, raw = e.code, e.read()
+    try:
+        payload = json.loads(raw) if raw else {}
+    except json.JSONDecodeError:
+        payload = {}
+    err = payload.get("error") if isinstance(payload, dict) else None
+    msg = payload.get("message", "") if isinstance(payload, dict) else ""
+    return Observed(status=status, error=err, message=str(msg))
+
+
+@dataclass(frozen=True)
+class Finding:
+    token: str
+    declared: str
+    observed_status: Optional[int]
+    observed_error: Optional[str]
+    message: str
+
+    def render(self) -> str:
+        obs = ("(not fired)" if self.observed_status is None
+               else f"{self.observed_status} {self.observed_error or '-'}")
+        return (f"AUTHZ DIFF {self.token}\n"
+                f"  declared: {self.declared}\n"
+                f"  observed: {obs}\n"
+                f"  {self.message}")
+
+
+@dataclass
+class ProbeReport:
+    backend: str
+    cells: int
+    findings: List[Finding]
+    observed: Dict[str, Tuple[int, Optional[str]]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def render(self) -> str:
+        head = (f"authz[{self.backend}]: {self.cells} cell(s) probed, "
+                f"{len(self.findings)} diff(s)")
+        if self.ok:
+            return head + " — clean"
+        return "\n".join([head] + [f.render() for f in self.findings])
+
+
+@dataclass(frozen=True)
+class Cell:
+    route: str
+    tier: str
+    variant: str
+    expected: Outcome
+
+    @property
+    def token(self) -> str:
+        return encode_token(self.route, self.tier, self.variant)
+
+
+def iter_cells(policy: Policy) -> List[Cell]:
+    out: List[Cell] = []
+    for route, tiers in policy.routes.items():
+        for tier in TIERS:
+            for variant, outcome in tiers[tier].items():
+                out.append(Cell(route, tier, variant, outcome))
+    return out
+
+
+def coverage_findings(
+    policy: Policy, servable: Optional[List[str]] = None
+) -> List[Finding]:
+    """Routes the live router serves but the matrix does not declare —
+    the fail-closed direction for NEW endpoints — plus mirror drift
+    between the server's peer table and the client fabric's."""
+    live = list(servable if servable is not None else servable_routes())
+    out: List[Finding] = []
+    for route in live:
+        if route not in policy.routes:
+            out.append(Finding(
+                token=encode_token(route, "*", "undeclared"),
+                declared="<absent>", observed_status=None,
+                observed_error=None,
+                message=(f"servable route {route!r} has no entry in "
+                         f"authz_policy.json — declare its posture for "
+                         f"every tier before it ships"),
+            ))
+    try:
+        from mpi_operator_tpu.machinery.http_store import StoreServer
+        from mpi_operator_tpu.machinery.replica_wire import peer_wire_routes
+
+        server_side = sorted(
+            "/v1/replica/" + wire for wire in StoreServer._PEER_ROUTE_METHODS
+        )
+        if server_side != peer_wire_routes():
+            out.append(Finding(
+                token=encode_token("POST /v1/replica/*", "*", "mirror-drift"),
+                declared=str(server_side), observed_status=None,
+                observed_error=None,
+                message=(f"server peer routes {server_side} != client "
+                         f"fabric routes {peer_wire_routes()} — a route "
+                         f"added to one table but not the other 404s in "
+                         f"a real failover"),
+            ))
+    except ImportError:
+        pass
+    return out
+
+
+# secret-named exposition labels: the label NAME suggests a credential
+# and the value is non-empty → a secret is riding the monitoring plane
+_SECRET_LABEL_RE = re.compile(
+    r'([A-Za-z_]*(?:token|secret|passw|credential|bearer)[A-Za-z_]*)'
+    r'="([^"]+)"',
+    re.IGNORECASE,
+)
+
+
+def scan_exposition(body: str) -> List[str]:
+    """SEC001's runtime twin: no fleet credential and no secret-named
+    label value may appear in a metrics exposition body. Returns
+    human-readable violations (empty = clean); values are NEVER echoed
+    into the messages."""
+    out: List[str] = []
+    for tier, tok in _FLEET_TOKENS.items():
+        if tok is not None and tok in body:
+            out.append(f"the {tier}-tier bearer token value appears in "
+                       f"the exposition body")
+    for m in _SECRET_LABEL_RE.finditer(body):
+        out.append(f"secret-named exposition label {m.group(1)!r} carries "
+                   f"a non-empty value")
+    return out
+
+
+def _ops_findings(fleet: Fleet, policy: Policy) -> Tuple[int, List[Finding]]:
+    cells = 0
+    out: List[Finding] = []
+    for route, outcome in sorted(policy.ops_server.items()):
+        method, path = route.split(" ", 1)
+        obs = _fire(fleet, Probe("ops", method, path, None, None))
+        cells += 1
+        if obs.status != outcome.status:
+            out.append(Finding(
+                token=encode_token(route, "anon", "ops_server"),
+                declared=outcome.raw, observed_status=obs.status,
+                observed_error=obs.error,
+                message="ops-server posture diverged from the declaration",
+            ))
+        if path == "/metrics" and obs.status == 200:
+            url = fleet.url("ops") + path
+            with urlrequest.urlopen(url, timeout=10.0) as resp:
+                text = resp.read().decode("utf-8", "replace")
+            for violation in scan_exposition(text):
+                out.append(Finding(
+                    token=encode_token(route, "anon", "secret_scan"),
+                    declared="no secret in any exposition body",
+                    observed_status=200, observed_error=None,
+                    message=violation,
+                ))
+    return cells, out
+
+
+# ---------------------------------------------------------------------------
+# probe / replay
+# ---------------------------------------------------------------------------
+
+
+def probe(
+    backend: str = "memory",
+    *,
+    policy: Optional[Policy] = None,
+    policy_path: Optional[str] = None,
+    servable: Optional[List[str]] = None,
+    mutant: Optional[str] = None,
+    denied_only: bool = False,
+    log: Optional[Callable[[str], None]] = None,
+) -> ProbeReport:
+    """Boot a fresh fleet and fire every matrix cell, diffing observed
+    (status, typed error) against the declaration. ``denied_only``
+    restricts to deny/pass cells — the reduced tier-1 set (no
+    state-mutating allow cells, so it is also the set the cross-backend
+    parity suite compares verbatim). ``mutant`` arms a seeded bug
+    first; see MUTANTS."""
+    policy = policy or load_policy(policy_path, servable=servable)
+    findings = coverage_findings(policy, servable)
+    observed: Dict[str, Tuple[int, Optional[str]]] = {}
+    fleet = make_fleet(backend)
+    cells = 0
+    try:
+        if mutant is not None:
+            if mutant not in MUTANTS:
+                raise AuthzConfigError(
+                    f"unknown mutant {mutant!r} (have {sorted(MUTANTS)})"
+                )
+            MUTANTS[mutant].apply(fleet)
+        for cell in iter_cells(policy):
+            if denied_only and cell.expected.kind == "allow":
+                continue
+            obs = _fire(fleet, build_probe(fleet, cell.route, cell.tier,
+                                           cell.variant))
+            cells += 1
+            observed[cell.token] = (obs.status, obs.error)
+            if not cell.expected.matches(obs.status, obs.error):
+                findings.append(Finding(
+                    token=cell.token, declared=cell.expected.raw,
+                    observed_status=obs.status, observed_error=obs.error,
+                    message=obs.message,
+                ))
+        if not denied_only:
+            ops_cells, ops_diffs = _ops_findings(fleet, policy)
+            cells += ops_cells
+            findings.extend(ops_diffs)
+    finally:
+        fleet.close()
+    if log:
+        log(f"authz[{backend}]: {cells} cell(s), "
+            f"{len(findings)} diff(s)")
+    return ProbeReport(backend=backend, cells=cells, findings=findings,
+                       observed=observed)
+
+
+def replay(
+    token: str,
+    backend: str = "memory",
+    *,
+    mutant: Optional[str] = None,
+    policy_path: Optional[str] = None,
+) -> Optional[Finding]:
+    """Re-probe EXACTLY one cell on a fresh fleet. Returns the Finding
+    when the cell still diffs, None when it probes clean."""
+    route, tier, variant = parse_token(token)
+    policy = load_policy(policy_path)
+    for cell in iter_cells(policy):
+        if (cell.route, cell.tier, cell.variant) == (route, tier, variant):
+            break
+    else:
+        raise AuthzConfigError(
+            f"token {token!r} names no declared matrix cell"
+        )
+    fleet = make_fleet(backend)
+    try:
+        if mutant is not None:
+            if mutant not in MUTANTS:
+                raise AuthzConfigError(
+                    f"unknown mutant {mutant!r} (have {sorted(MUTANTS)})"
+                )
+            MUTANTS[mutant].apply(fleet)
+        obs = _fire(fleet, build_probe(fleet, route, tier, variant))
+    finally:
+        fleet.close()
+    if cell.expected.matches(obs.status, obs.error):
+        return None
+    return Finding(token=token, declared=cell.expected.raw,
+                   observed_status=obs.status, observed_error=obs.error,
+                   message=obs.message)
+
+
+# ---------------------------------------------------------------------------
+# seeded mutants: the bug classes four review passes kept finding by hand
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Mutant:
+    name: str
+    description: str
+    token: str  # the cell whose diff must catch it
+    apply: Callable[[Fleet], None]
+
+
+def _mut_node_spec_patch(fleet: Fleet) -> None:
+    """Mutant 1: the node tier's patch gate rewrites every subresource to
+    'status' — i.e. the status-only restriction is gone and a node can
+    drive spec patches (the PR 12 bug class)."""
+    srv = fleet.main
+    orig = srv._agent_patch_denied
+
+    def mutated(rest: List[str], patch: Any, node: str):
+        if len(rest) == 4:
+            return orig([rest[0], rest[1], rest[2], "status"], patch, node)
+        return orig(rest, patch, node)
+
+    srv._agent_patch_denied = mutated
+
+
+def _mut_peer_behind_open(fleet: Fleet) -> None:
+    """Mutant 2: the OPEN server's auth gate runs the unauthenticated
+    early-out BEFORE the peer-route fence — replication RPCs become
+    reachable on any open store (the PR 13 ordering bug)."""
+    srv = fleet.open
+    handler_cls = srv._httpd.RequestHandlerClass
+    orig = handler_cls._auth_error
+
+    def mutated(self, method: str, body):
+        if srv.token is None and not srv.agent_tokens:
+            self._tier = None
+            return None
+        return orig(self, method, body)
+
+    handler_cls._auth_error = mutated
+
+
+def _mut_read_mutates(fleet: Fleet) -> None:
+    """Mutant 3: the read tier's mutation denial is dropped — the
+    'read-only' token silently becomes a second admin credential."""
+    handler_cls = fleet.main._httpd.RequestHandlerClass
+    orig = handler_cls._auth_error
+
+    def mutated(self, method: str, body):
+        denied = orig(self, method, body)
+        if denied is not None and denied[0] == 403 and "read-only" in denied[1]:
+            return None
+        return denied
+
+    handler_cls._auth_error = mutated
+
+
+def _mut_cordon_dropped(fleet: Fleet) -> None:
+    """Mutant 4: the cordon-key denial is dropped from the node patch
+    gate — a compromised node can un-cordon itself (the PR 10 bug)."""
+    srv = fleet.main
+    orig = srv._agent_patch_denied
+
+    def mutated(rest: List[str], patch: Any, node: str):
+        denied = orig(rest, patch, node)
+        if denied is not None and "unschedulable" in denied[1]:
+            return None
+        return denied
+
+    srv._agent_patch_denied = mutated
+
+
+def _mut_uid_pin_skipped(fleet: Fleet) -> None:
+    """Mutant 5: the uid pin is a no-op — the client-supplied uid
+    precondition survives to the store, so the authz-to-apply window is
+    back (the PR 2 TOCTOU) and the probe's deliberate uid lie bounces."""
+    fleet.main._pin_uid = lambda patch, uid: None
+
+
+def _mut_batch_collapsed(fleet: Fleet) -> None:
+    """Mutant 6: per-item batch authz collapses to batch level — only
+    the FIRST item is checked, so an in-scope heartbeat smuggles an
+    out-of-scope pod write in the same batch."""
+    srv = fleet.main
+    orig = srv._agent_denied
+
+    def mutated(method: str, path: str, body: Any, node: str):
+        if (method == "POST" and isinstance(body, dict)
+                and isinstance(body.get("items"), list)):
+            body = dict(body, items=body["items"][:1])
+        return orig(method, path, body, node)
+
+    srv._agent_denied = mutated
+
+
+MUTANTS: Dict[str, Mutant] = {
+    m.name: m for m in (
+        Mutant(
+            name="node-spec-patch-allowed",
+            description="node tier allowed a spec patch (status-only "
+                        "restriction dropped)",
+            token=encode_token(
+                "PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}",
+                "node", "spec_subresource"),
+            apply=_mut_node_spec_patch,
+        ),
+        Mutant(
+            name="peer-routes-behind-open-early-out",
+            description="peer replication routes moved behind the "
+                        "open-server early-out",
+            token=encode_token("POST /v1/replica/append-entries",
+                               "anon", "open_server"),
+            apply=_mut_peer_behind_open,
+        ),
+        Mutant(
+            name="read-token-accepts-mutation",
+            description="read tier's mutation denial dropped",
+            token=encode_token("POST /v1/objects", "read", "default"),
+            apply=_mut_read_mutates,
+        ),
+        Mutant(
+            name="cordon-key-denial-dropped",
+            description="node tier may touch status.unschedulable",
+            token=encode_token(
+                "PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}",
+                "node", "cordon_key"),
+            apply=_mut_cordon_dropped,
+        ),
+        Mutant(
+            name="uid-pin-precondition-skipped",
+            description="the server-side uid pin no longer overwrites "
+                        "the client's uid claim",
+            token=encode_token(
+                "PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}",
+                "node", "uid_precondition_overwritten"),
+            apply=_mut_uid_pin_skipped,
+        ),
+        Mutant(
+            name="batch-item-authz-collapsed",
+            description="patch-batch authz checks only the first item",
+            token=encode_token("POST /v1/patch-batch",
+                               "node", "item_crosses_tier"),
+            apply=_mut_batch_collapsed,
+        ),
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# self test: the detector-of-the-detector bar
+# ---------------------------------------------------------------------------
+
+
+def self_test(log: Optional[Callable[[str], None]] = None) -> List[str]:
+    """(1) the full real matrix probes clean on memory AND sqlite
+    fleets; (2) every denied/pass cell observes IDENTICAL (status,
+    error) across the two backends; (3) each seeded mutant is caught on
+    its expected token, and replaying that token twice on fresh mutant
+    fleets is twice-identical; (4) an injected undeclared route fails
+    closed as a coverage finding; (5) the /metrics wire capture carries
+    no secret. Returns failure strings (empty = pass)."""
+    failures: List[str] = []
+    observed_by_backend: Dict[str, Dict[str, Tuple[int, Optional[str]]]] = {}
+    for backend in ("memory", "sqlite"):
+        report = probe(backend=backend, log=log)
+        observed_by_backend[backend] = report.observed
+        for f in report.findings:
+            failures.append(
+                f"{backend}: real server diffs from the declared matrix: "
+                f"{f.token} declared={f.declared} "
+                f"observed={f.observed_status}:{f.observed_error}"
+            )
+    mem, sql = observed_by_backend["memory"], observed_by_backend["sqlite"]
+    for token in sorted(set(mem) & set(sql)):
+        if mem[token] != sql[token]:
+            failures.append(
+                f"cross-backend parity: {token} observed {mem[token]} on "
+                f"memory but {sql[token]} on sqlite"
+            )
+    for name in sorted(MUTANTS):
+        m = MUTANTS[name]
+        report = probe(backend="memory", mutant=name)
+        tokens = {f.token for f in report.findings}
+        if m.token not in tokens:
+            failures.append(
+                f"mutant {name}: expected finding {m.token} not among "
+                f"{sorted(tokens)}"
+            )
+            continue
+        first = replay(m.token, mutant=name)
+        second = replay(m.token, mutant=name)
+        if first is None or second is None:
+            failures.append(
+                f"mutant {name}: --replay {m.token} did not reproduce "
+                f"the diff"
+            )
+        elif ((first.observed_status, first.observed_error)
+              != (second.observed_status, second.observed_error)):
+            failures.append(
+                f"mutant {name}: replay is nondeterministic "
+                f"({first.observed_status}:{first.observed_error} vs "
+                f"{second.observed_status}:{second.observed_error})"
+            )
+        if log:
+            log(f"authz: mutant {name} caught on {m.token}")
+    injected = "POST /v1/authz-selftest-injected"
+    inj_policy = load_policy(servable=servable_routes() + [injected])
+    inj = coverage_findings(inj_policy, servable_routes() + [injected])
+    if not any(injected in f.token for f in inj):
+        failures.append(
+            "undeclared-route injection: a servable route absent from the "
+            "matrix did NOT produce a coverage finding"
+        )
+    return failures

@@ -101,6 +101,7 @@ smell (README "Static analysis & race checking").
 from __future__ import annotations
 
 import ast
+import json
 import os
 import re
 from dataclasses import dataclass, field
@@ -294,6 +295,21 @@ RULES: Dict[str, Rule] = {
             "missed. Use the event only for identity (key/kind/metadata), "
             "re-read CURRENT state from the store/lister, and derive the "
             "decision from that — level triggers converge from any state",
+        ),
+        Rule(
+            "AUTH001", "error",
+            "route outside the authz permission matrix, or store state "
+            "touched before the tier check",
+            "ISSUE 20: authz_policy.json is the single source of "
+            "authorization truth — a route literal served/compared in "
+            "handler code with no matrix entry ships an endpoint whose "
+            "posture nobody declared (authzcheck probes only what is "
+            "declared, so the hole is invisible to the differ too). And "
+            "reading/mutating store state BEFORE the tier check "
+            "(_auth_error/_peer_denied/_agent_denied/_agent_patch_denied) "
+            "re-opens the PR 2 TOCTOU: the state consulted for the "
+            "decision can change between the touch and the gate — "
+            "authorize first, touch state after",
         ),
         Rule(
             "REP001", "error",
@@ -1247,6 +1263,163 @@ def is_test_path(path: str) -> bool:
     )
 
 
+# ---------------------------------------------------------------------------
+# AUTH001: the authorization plane's static cross-check (ISSUE 20). Half
+# one: every route literal the server-side handler code compares its
+# parsed path against must appear in analysis/authz_policy.json (the
+# declared matrix authzcheck probes), peer wire tables included. Half
+# two: within a function that runs one of the tier gates, no store-like
+# receiver may be touched BEFORE the gate (the PR 2 TOCTOU shape).
+# ---------------------------------------------------------------------------
+
+_AUTH_GATE_NAMES = {
+    "_auth_error", "_peer_denied", "_agent_denied", "_agent_patch_denied",
+}
+_PEER_TABLE_NAMES = {"_PEER_ROUTE_METHODS", "PEER_ROUTES"}
+_AUTHZ_PATHS_CACHE: Optional[List[List[str]]] = None
+_AUTHZ_PATHS_LOADED = False
+
+
+def _authz_declared_paths() -> Optional[List[List[str]]]:
+    """Path patterns authz_policy.json declares (method stripped, split
+    into segments), loaded once per process from the canonical file next
+    to this module. None when the policy cannot be found/parsed — the
+    route half of AUTH001 then stands down rather than false-firing on
+    every route literal in the tree."""
+    global _AUTHZ_PATHS_CACHE, _AUTHZ_PATHS_LOADED
+    if _AUTHZ_PATHS_LOADED:
+        return _AUTHZ_PATHS_CACHE
+    _AUTHZ_PATHS_LOADED = True
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "authz_policy.json"
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        routes = doc["routes"]
+        if not isinstance(routes, dict):
+            return None
+    except (OSError, ValueError, KeyError):
+        return None
+    out: List[List[str]] = []
+    for key in routes:
+        if isinstance(key, str) and " " in key:
+            out.append(key.split(" ", 1)[1].strip("/").split("/"))
+    _AUTHZ_PATHS_CACHE = out
+    return _AUTHZ_PATHS_CACHE
+
+
+def _auth001_declared(segs: List[str], declared: List[List[str]]) -> bool:
+    """True when the concrete segment list is a (placeholder-tolerant)
+    prefix of some declared path — ``["v1", "objects", "TPUServe"]``
+    matches ``/v1/objects/{kind}``; ``["v1", "replica"]`` matches
+    ``/v1/replica/status``."""
+    for pat in declared:
+        if len(segs) > len(pat):
+            continue
+        if all(
+            p == s or (p.startswith("{") and p.endswith("}"))
+            for s, p in zip(segs, pat)
+        ):
+            return True
+    return False
+
+
+def _auth001_route_lists(node: ast.Compare) -> List[ast.List]:
+    """The list literals a route-parts comparison checks against —
+    handles ``parts == [...]``, ``parts[:2] == [...]`` and
+    ``_route_parts(p) in ([...], [...])``."""
+    left = node.left
+    base = left.value if isinstance(left, ast.Subscript) else left
+    is_parts = _last_component(_dotted(base)) == "parts"
+    if not is_parts and isinstance(base, ast.Call):
+        is_parts = _last_component(_dotted(base.func)) == "_route_parts"
+    if not is_parts:
+        return []
+    out: List[ast.List] = []
+    for comp in node.comparators:
+        if isinstance(comp, ast.List):
+            out.append(comp)
+        elif isinstance(comp, ast.Tuple):
+            out.extend(e for e in comp.elts if isinstance(e, ast.List))
+    return out
+
+
+def _check_auth001_routes(ctx: _FileCtx, tree: ast.AST) -> None:
+    declared = _authz_declared_paths()
+    if declared is None:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            for lst in _auth001_route_lists(node):
+                segs = [
+                    e.value for e in lst.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                if len(segs) != len(lst.elts) or not segs:
+                    continue
+                if segs[0] not in ("v1", "healthz"):
+                    continue
+                if not _auth001_declared(segs, declared):
+                    route = "/" + "/".join(segs)
+                    ctx.report(
+                        "AUTH001", lst,
+                        f"route {route!r} is served here but has no entry "
+                        f"in analysis/authz_policy.json — declare its "
+                        f"authorization posture before it ships",
+                    )
+        elif isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+            if not (names & _PEER_TABLE_NAMES):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            # the two peer tables are inverse orientations (server:
+            # wire-route → method name; client fabric: method → wire
+            # route), so the wire segment may sit on either side of a
+            # pair — an entry is declared when EITHER side matches
+            for key, val in zip(node.value.keys, node.value.values):
+                sides = [s for s in (_const(key), _const(val))
+                         if isinstance(s, str)]
+                if not sides:
+                    continue
+                if not any(
+                    _auth001_declared(["v1", "replica", side], declared)
+                    for side in sides
+                ):
+                    wire = next((s for s in sides if "-" in s), sides[0])
+                    ctx.report(
+                        "AUTH001", val,
+                        f"peer wire route '/v1/replica/{wire}' has "
+                        f"no entry in analysis/authz_policy.json — the "
+                        f"peer tables and the matrix must agree",
+                    )
+
+
+def _check_auth001_toctou(ctx: _FileCtx, fn: ast.AST) -> None:
+    calls = [n for n in _own_nodes(fn) if isinstance(n, ast.Call)]
+    auth_lines = [
+        c.lineno for c in calls
+        if isinstance(c.func, ast.Attribute) and c.func.attr in _AUTH_GATE_NAMES
+    ]
+    if not auth_lines:
+        return
+    last_auth = max(auth_lines)
+    for c in calls:
+        if not isinstance(c.func, ast.Attribute):
+            continue
+        if c.func.attr in _AUTH_GATE_NAMES:
+            continue
+        recv = _dotted(c.func.value)
+        if _is_store_like(recv) and c.lineno < last_auth:
+            ctx.report(
+                "AUTH001", c,
+                f"store state touched through {recv!r} BEFORE the tier "
+                f"check on line {last_auth} — authorize first, touch "
+                f"state after (the PR 2 TOCTOU)",
+            )
+
+
 def lint_source(
     source: str, path: str = "<string>", *, is_test: Optional[bool] = None
 ) -> List[Finding]:
@@ -1265,8 +1438,10 @@ def lint_source(
         _check_rmw001(ctx, fn)
         _check_term001(ctx, fn)
         _check_lev001(ctx, fn)
+        _check_auth001_toctou(ctx, fn)
     _check_obs002(ctx, tree)
     _check_obs004(ctx, tree)
+    _check_auth001_routes(ctx, tree)
 
     # pre-pass for OBS003: families this file registers itself count
     # toward the catalog (a module may register and reference its own)

@@ -601,9 +601,15 @@ class StoreServer:
                     )
                 if server.token is None and not server.agent_tokens:
                     return None
-                if method == "GET" and self.path.split("?", 1)[0] == "/healthz":
-                    # liveness probes carry no headers; /healthz leaks
-                    # nothing, so it stays open even under --auth-reads
+                if method == "GET" and _route_parts(self.path) in (
+                    ["healthz"], ["v1", "replica", "status"]
+                ):
+                    # liveness and role probes carry no headers; /healthz
+                    # leaks nothing and /v1/replica/status is how `ctl
+                    # store status` and failover triage discover
+                    # membership without the tenant token, so both stay
+                    # open even under --auth-reads (authz_policy.json
+                    # declares this posture explicitly)
                     return None
                 candidates = (server.token, server.read_token,
                               *server.agent_tokens)
@@ -1000,18 +1006,27 @@ class StoreServer:
 
     def _peer_denied(self, header: str) -> Optional[Tuple[int, str]]:
         """The PEER tier's gate: replication RPCs accept EXACTLY the peer
-        token. Missing, wrong, or any OTHER tier's token (admin, read,
-        node — none of them is a replication identity) is a typed 403;
-        with no peer token configured the routes are disabled outright.
-        Always fail closed: replication traffic rewrites history."""
+        token. The split matches the repo-wide 401-vs-403 pin in
+        authz_policy.json: a MISSING or UNRECOGNIZED token is a 401
+        (authentication failed — present a credential), while a VALID
+        token from another tier (admin, read, node — none of them is a
+        replication identity) is a 403 (authenticated, but out of scope);
+        with no peer token configured the routes are disabled outright as
+        a 403 regardless of header. Always fail closed: replication
+        traffic rewrites history."""
         if self.peer_token is None:
             return (403, "replica peer routes are disabled on this "
                          "server (run with --peer-token-file)")
         if check_bearer(header, (self.peer_token,)) is not None:
             return None
-        return (403, "replica peer routes require the peer token "
-                     "(the admin/read/node tiers are not replication "
-                     "identities)")
+        if check_bearer(
+            header, (self.token, self.read_token, *self.agent_tokens)
+        ) is not None:
+            return (403, "replica peer routes require the peer token "
+                         "(the admin/read/node tiers are not replication "
+                         "identities)")
+        return (401, "missing or invalid bearer token "
+                     "(server runs with --peer-token-file)")
 
     def _agent_denied(
         self, method: str, path: str, body: Dict[str, Any], node: str
@@ -1646,6 +1661,35 @@ def _all_kinds() -> List[str]:
     from mpi_operator_tpu.machinery.serialize import KIND_CLASSES
 
     return list(KIND_CLASSES)
+
+
+def servable_routes() -> List[str]:
+    """Every ``"METHOD /route-pattern"`` the router above dispatches — THE
+    introspection seam analysis/authzcheck.py diffs authz_policy.json
+    against, so a new endpoint that ships without a declared authorization
+    posture is a checker finding, not a silent hole. Placeholder segments
+    (``{kind}`` etc.) stand for the object-path wildcards ``_handle_objects``
+    consumes positionally; the peer RPC fan-out is enumerated from the SAME
+    ``_PEER_ROUTE_METHODS`` table ``_handle_replica`` dispatches from, so
+    the two can never drift."""
+    routes = [
+        "GET /healthz",
+        "GET /v1/replica/status",
+        "GET /v1/watch",
+        "POST /v1/patch-batch",
+        "POST /v1/objects",
+        "GET /v1/objects/{kind}",
+        "GET /v1/objects/{kind}/{ns}/{name}",
+        "PUT /v1/objects/{kind}/{ns}/{name}",
+        "DELETE /v1/objects/{kind}/{ns}/{name}",
+        "PATCH /v1/objects/{kind}/{ns}/{name}",
+        "PATCH /v1/objects/{kind}/{ns}/{name}/{subresource}",
+    ]
+    routes.extend(
+        "POST /v1/replica/" + wire
+        for wire in sorted(StoreServer._PEER_ROUTE_METHODS)
+    )
+    return routes
 
 
 def _event_wire(e: Tuple) -> Dict[str, Any]:

@@ -81,6 +81,14 @@ PEER_ROUTES = {
 }
 
 
+def peer_wire_routes() -> List[str]:
+    """The wire paths this fabric dials, sorted — the client-side mirror of
+    StoreServer._PEER_ROUTE_METHODS. analysis/authzcheck.py diffs the two
+    on every probe so a route added to one table but not the other is a
+    finding before it is a 404 storm in a real failover."""
+    return sorted("/v1/replica/" + wire for wire in PEER_ROUTES.values())
+
+
 def parse_peer_map(spec: str, flag: str = "--peers") -> Dict[str, str]:
     """``'n0=http://a:8475,n1=http://b:8475'`` → {id: url}. Fails fast on
     malformed entries — a typo'd peer URL silently dropped would shrink

@@ -1,0 +1,39 @@
+# oplint fixture: AUTH001 must fire on (a) a route literal the handler
+# dispatches on that analysis/authz_policy.json does not declare, (b) a
+# peer wire-table entry absent from the matrix, and (c) store state
+# touched BEFORE the tier gate (the PR 2 TOCTOU shape). Lines carrying
+# the bad form are marked with an expect comment.
+
+
+def _handle(self, method, parts, body):
+    # an undeclared route: nothing in the matrix starts with shadow-admin
+    if parts == ["v1", "shadow-admin"]:  # expect: AUTH001
+        return self._serve_shadow(body)
+    # prefix comparisons are mined too — /healthz/deep is NOT /healthz
+    if parts[:2] == ["healthz", "deep"]:  # expect: AUTH001
+        return self._deep_health()
+
+
+def dispatch(self, p):
+    # the _route_parts(...) in (list, list) membership form
+    if _route_parts(p) in (["v1", "rogue"], ["v1", "replica", "status"]):  # expect: AUTH001
+        return self._route(p)
+
+
+# a peer wire route served by the replication seam but absent from the
+# matrix: neither side of the pair matches a declared /v1/replica/ path
+_PEER_ROUTE_METHODS = {
+    "append-entries": "append_entries",
+    "shadow-sync": "shadow_sync",  # expect: AUTH001
+}
+
+
+def do_PUT(self):
+    # TOCTOU: the backing store is read before the tier check runs, so
+    # the authorization decision is made against state the check never
+    # saw (the PR 2 shape)
+    current = self.backing.get("Pod", "ns", "name")  # expect: AUTH001
+    err = self._auth_error("PUT")
+    if err is not None:
+        return self._send_error(err)
+    return self._finish_put(current)

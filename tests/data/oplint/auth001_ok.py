@@ -1,0 +1,36 @@
+# oplint fixture: blessed authorization shapes AUTH001 must stay silent
+# on, plus a suppressed deliberate exception.
+
+
+def _handle(self, method, parts, body):
+    # every route compared against here is declared in the matrix; the
+    # prefix match tolerates {kind}/{ns}/{name} placeholders
+    if parts == ["healthz"]:
+        return self._ok()
+    if parts[:2] == ["v1", "objects"]:
+        return self._objects(method, parts)
+    if parts == ["v1", "replica", "status"]:
+        return self._replica_status()
+
+
+# a peer table whose wire routes all appear in the matrix; orientation
+# (method -> wire here, wire -> method in the server) does not matter
+PEER_ROUTES = {
+    "append_entries": "append-entries",
+    "request_vote": "request-vote",
+}
+
+
+def do_PATCH(self):
+    # the blessed order: authorize FIRST, touch store state after
+    err = self._auth_error("PATCH")
+    if err is not None:
+        return self._send_error(err)
+    return self.backing.patch(self._read_body())
+
+
+def probe_route(self, parts):
+    # oplint: disable=AUTH001 — an experiment-only route kept behind a
+    # feature flag, deliberately out of the shipping matrix while it
+    # bakes; the flag gate refuses it in production builds
+    return parts == ["v1", "x-experimental"]

@@ -52,7 +52,7 @@ def test_rule_catalog_is_complete():
     assert ids == {
         "RMW001", "UID001", "TERM001", "BLK001", "EXC001", "SEC001", "LCK001",
         "DUR001", "REP001", "OBS001", "OBS002", "OBS003", "OBS004", "DIS001",
-        "CKP001", "LEV001",
+        "CKP001", "LEV001", "AUTH001",
     }
     for rule in RULES.values():
         assert rule.severity in ("error", "warning")
@@ -296,6 +296,19 @@ def test_cli_lint_json_schema_is_stable(tmp_path):
     assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
     assert f["rule"] == "LEV001" and f["severity"] == "error"
     assert f["line"] == 2 and "re-read" in f["message"]
+    # AUTH001 rides the same six-key schema (ISSUE 20's companion rule)
+    auth = tmp_path / "auth.py"
+    auth.write_text(
+        "def _handle(self, parts):\n"
+        "    return parts == ['v1', 'shadow-admin']\n"
+    )
+    r = _run_cli("lint", "--format", "json", str(auth))
+    assert r.returncode == 1, r.stdout + r.stderr
+    findings = jsonlib.loads(r.stdout)
+    f = findings[0]
+    assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+    assert f["rule"] == "AUTH001" and f["severity"] == "error"
+    assert f["line"] == 2 and "authz_policy.json" in f["message"]
     # clean tree → empty JSON array, exit 0 (CI can always parse stdout)
     good = tmp_path / "good.py"
     good.write_text("x = 1\n")

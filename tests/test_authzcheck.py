@@ -1,0 +1,271 @@
+"""authzcheck: the declarative authorization matrix probed against a
+real booted store fleet (ISSUE 20).
+
+Tier-1 runs the loader's fail-closed contracts, the denied-cell probe on
+the memory backing, cross-backend denied parity, the undeclared-route
+injection, a representative mutant pair, the ops-plane wire-capture
+secret scan, and the two regressions the first probe found (the peer
+401/403 split and /v1/replica/status staying open under --auth-reads).
+The exhaustive bar — full matrix clean on BOTH backings, all six
+mutants caught with deterministic replays — is ``authz --selftest`` and
+rides the slow tier plus the verify gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mpi_operator_tpu.analysis import authzcheck
+from mpi_operator_tpu.analysis.authzcheck import (
+    AuthzConfigError,
+    Probe,
+    _fire,
+    encode_token,
+    parse_token,
+)
+
+pytestmark = pytest.mark.authz
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_operator_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    f = authzcheck.make_fleet("memory")
+    yield f
+    f.close()
+
+
+# ---------------------------------------------------------------------------
+# the loader fails closed
+# ---------------------------------------------------------------------------
+
+
+def _canonical_doc():
+    with open(authzcheck.DEFAULT_POLICY_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _load_mutated(tmp_path, mutate):
+    doc = _canonical_doc()
+    mutate(doc)
+    p = tmp_path / "policy.json"
+    p.write_text(json.dumps(doc))
+    return authzcheck.load_policy(str(p))
+
+
+def test_canonical_policy_loads():
+    policy = authzcheck.load_policy()
+    assert policy.version == 1
+    # every servable route is declared — the probe's coverage direction
+    assert authzcheck.coverage_findings(policy) == []
+
+
+@pytest.mark.parametrize(
+    "mutate, match",
+    [
+        (lambda d: d.update(extra=1), "unknown top-level"),
+        (lambda d: d.update(version=2), "not 1"),
+        (lambda d: d["routes"]["GET /healthz"].update(superuser="allow"),
+         "unknown tier"),
+        (lambda d: d["routes"]["GET /healthz"].pop("admin"),
+         "missing tier"),
+        (lambda d: d["routes"]["GET /healthz"].update(admin="deny:9xx"),
+         "grammar"),
+        (lambda d: d["routes"]["POST /v1/objects"].update(
+            admin={"default": "allow"}), "variants"),
+        (lambda d: d["routes"].update({"GET /v1/nonexistent": "allow"}),
+         "does not serve"),
+        (lambda d: d["ops_server"].pop("GET /metrics"),
+         "ops_server"),
+    ],
+    ids=["unknown-top-key", "bad-version", "unknown-tier", "missing-tier",
+         "bad-outcome", "variant-mismatch", "non-servable-route",
+         "missing-ops-route"],
+)
+def test_loader_fails_closed(tmp_path, mutate, match):
+    with pytest.raises(AuthzConfigError, match=match):
+        _load_mutated(tmp_path, mutate)
+
+
+def test_loader_refuses_duplicate_keys(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"version": 1, "version": 1}')
+    with pytest.raises(AuthzConfigError, match="duplicate key"):
+        authzcheck.load_policy(str(p))
+
+
+def test_undeclared_servable_route_is_a_finding():
+    # a NEW endpoint the router serves but the matrix does not declare
+    # must surface as a finding, not load-fail (the policy file stays
+    # loadable so the gap can be reported) — the injection self_test and
+    # the ISSUE acceptance both ride this seam
+    injected = "GET /v1/debug-dump"
+    servable = authzcheck.servable_routes() + [injected]
+    policy = authzcheck.load_policy(servable=servable)
+    findings = authzcheck.coverage_findings(policy, servable)
+    assert [f.token for f in findings] == [
+        encode_token(injected, "*", "undeclared")
+    ]
+    assert "no entry" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# replay tokens
+# ---------------------------------------------------------------------------
+
+
+def test_token_round_trip():
+    route = "PUT /v1/objects/{kind}/{ns}/{name}"
+    tok = encode_token(route, "node", "cordon_flip")
+    assert tok == f"v1:authz:{route}:node:cordon_flip"
+    assert parse_token(tok) == (route, "node", "cordon_flip")
+
+
+@pytest.mark.parametrize("bad", [
+    "v2:authz:GET /x:anon:default",   # wrong prefix
+    "v1:authz:GET/x:anon:default",    # no space → not a METHOD /route
+    "v1:authz:GET /x:anon",           # too few fields
+    "v1:authz:::",                    # empty fields
+])
+def test_bad_tokens_are_refused(bad):
+    with pytest.raises(AuthzConfigError):
+        parse_token(bad)
+
+
+def test_replay_refuses_undeclared_cell():
+    with pytest.raises(AuthzConfigError, match="no declared matrix cell"):
+        authzcheck.replay("v1:authz:GET /healthz:anon:no_such_variant")
+
+
+# ---------------------------------------------------------------------------
+# the denied set probes clean, identically on both backings (tier-1's
+# reduced state-preserving slice of the full-matrix selftest bar)
+# ---------------------------------------------------------------------------
+
+
+def test_denied_cells_probe_clean_and_backends_agree():
+    mem = authzcheck.probe("memory", denied_only=True)
+    assert mem.ok, mem.render()
+    sql = authzcheck.probe("sqlite", denied_only=True)
+    assert sql.ok, sql.render()
+    # parity: every denied cell observes the SAME (status, typed error)
+    # on both backings — authorization must not depend on the backing
+    assert set(mem.observed) == set(sql.observed)
+    diverged = {
+        tok: (mem.observed[tok], sql.observed[tok])
+        for tok in mem.observed if mem.observed[tok] != sql.observed[tok]
+    }
+    assert diverged == {}
+
+
+# ---------------------------------------------------------------------------
+# mutants (tier-1 pair: a tier-gate drop and a scope-check drop; the
+# full six + deterministic replays ride --selftest in the slow tier)
+# ---------------------------------------------------------------------------
+
+
+def test_mutant_read_token_accepting_mutations_is_caught():
+    mutant = "read-token-accepts-mutation"
+    expected = authzcheck.MUTANTS[mutant].token
+    report = authzcheck.probe("memory", mutant=mutant, denied_only=True)
+    assert not report.ok
+    assert expected in {f.token for f in report.findings}, report.render()
+    # the token replays the exact diff deterministically, and the same
+    # cell probes clean on an unmutated fleet
+    first = authzcheck.replay(expected, mutant=mutant)
+    second = authzcheck.replay(expected, mutant=mutant)
+    assert first is not None and first == second
+    assert authzcheck.replay(expected) is None
+
+
+def test_mutant_cordon_key_denial_dropped_is_caught():
+    mutant = "cordon-key-denial-dropped"
+    expected = authzcheck.MUTANTS[mutant].token
+    report = authzcheck.probe("memory", mutant=mutant, denied_only=True)
+    assert not report.ok
+    assert expected in {f.token for f in report.findings}, report.render()
+
+
+# ---------------------------------------------------------------------------
+# ops-plane posture: deliberately open, but no secret rides it
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_secret_scan():
+    assert authzcheck.scan_exposition(
+        'cp_jobs_total{phase="Running"} 3\n'
+    ) == []
+    leak = authzcheck.scan_exposition('cp_info{peer_token="s3cr3t"} 1\n')
+    assert leak and "peer_token" in leak[0]
+    # values are never echoed into the violation messages
+    assert "s3cr3t" not in " ".join(leak)
+
+
+def test_ops_metrics_open_and_secret_free(fleet):
+    obs = _fire(fleet, Probe("ops", "GET", "/metrics", None, None))
+    assert obs.status == 200
+    from urllib.request import urlopen
+
+    with urlopen(fleet.url("ops") + "/metrics", timeout=10.0) as resp:
+        body = resp.read().decode("utf-8", "replace")
+    assert authzcheck.scan_exposition(body) == []
+    for tok in authzcheck._FLEET_TOKENS.values():
+        assert tok is None or tok not in body
+
+
+# ---------------------------------------------------------------------------
+# regressions the first probe found (fixed, not allowlisted)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_routes_split_401_vs_403(fleet):
+    # missing/unrecognized credentials are AUTHENTICATION failures: 401
+    for bearer in (None, "not-a-real-token"):
+        obs = _fire(fleet, Probe(
+            "main", "POST", "/v1/replica/fetch-entries", {"args": [0, 1]},
+            bearer,
+        ))
+        assert (obs.status, obs.error) == (401, "Unauthorized"), obs
+    # a VALID token of the wrong tier is an AUTHORIZATION failure: 403
+    for tier in ("admin", "read", "node"):
+        obs = _fire(fleet, Probe(
+            "main", "POST", "/v1/replica/fetch-entries", {"args": [0, 1]},
+            authzcheck._FLEET_TOKENS[tier],
+        ))
+        assert (obs.status, obs.error) == (403, "Forbidden"), (tier, obs)
+
+
+def test_replica_status_and_healthz_stay_open_under_auth_reads(fleet):
+    # the main fleet server runs --auth-reads; liveness and role probes
+    # carry no credentials and must stay open regardless
+    for path in ("/healthz", "/v1/replica/status"):
+        obs = _fire(fleet, Probe("main", "GET", path, None, None))
+        assert obs.status == 200, (path, obs)
+
+
+def test_cli_replay_bad_token_fails_closed():
+    res = _run_cli("authz", "--replay", "not-a-token")
+    assert res.returncode == 2
+    assert "v1:authz:" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# the exhaustive bar (slow tier; also the verify gate's static check)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_selftest_full_bar():
+    assert authzcheck.self_test() == []

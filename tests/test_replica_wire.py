@@ -225,8 +225,11 @@ def _post(url, path, token=None, body=b'{"args": []}'):
 
 
 def test_peer_routes_reject_every_non_peer_tier(tmp_path):
-    """Missing/wrong tokens AND the admin/read/node tiers are all typed
-    403s at every peer route — replication identity is its own secret."""
+    """Replication identity is its own secret, and the denial is typed
+    per the repo-wide authz semantics (analysis/authz_policy.json):
+    missing/unrecognized credentials are authentication failures (401
+    Unauthorized); a VALID token of another tier is an authorization
+    failure (403 Forbidden)."""
     membership = WireMembership(["n0", "n1"], {})
     fab = HttpPeerFabric("n0", {}, PEER_TOKEN, seed=1)
     node = ReplicaNode("n0", str(tmp_path / "n0.db"), fab, membership,
@@ -241,8 +244,12 @@ def test_peer_routes_reject_every_non_peer_tier(tmp_path):
         routes = ["request-vote", "append-entries", "fetch-entries",
                   "install-snapshot", "snapshot-chunk", "snapshot-done"]
         for route in routes:
-            for tok in (None, "wrong", "adm1n-tok", "read-tok",
-                        "agent-tok"):
+            for tok in (None, "wrong"):
+                code, payload = _post(server.url, f"/v1/replica/{route}",
+                                      token=tok)
+                assert code == 401, (route, tok, payload)
+                assert payload["error"] == "Unauthorized", (route, tok)
+            for tok in ("adm1n-tok", "read-tok", "agent-tok"):
                 code, payload = _post(server.url, f"/v1/replica/{route}",
                                       token=tok)
                 assert code == 403, (route, tok, payload)
